@@ -1,0 +1,79 @@
+"""pipelinedp_tpu: a TPU-native differential-privacy aggregation framework.
+
+Computes anonymized statistics (COUNT, SUM, MEAN, VARIANCE, PERCENTILE,
+VECTOR_SUM, PRIVACY_ID_COUNT) over keyed datasets with contribution bounding,
+private partition selection, and privacy-budget accounting. The compute path
+is columnar JAX/XLA (sort + segment reductions + batched noise under jit,
+sharded over a device mesh); host-side backends provide the correctness
+oracle and small-data execution.
+
+Public API parity: pipeline_dp/__init__.py:14-42.
+"""
+
+from pipelinedp_tpu.aggregate_params import (
+    AddDPNoiseParams,
+    AggregateParams,
+    CalculatePrivateContributionBoundsParams,
+    CountParams,
+    MeanParams,
+    MechanismType,
+    Metric,
+    Metrics,
+    NoiseKind,
+    NormKind,
+    PartitionSelectionStrategy,
+    PrivacyIdCountParams,
+    PrivateContributionBounds,
+    SelectPartitionsParams,
+    SumParams,
+    VarianceParams,
+)
+from pipelinedp_tpu.budget_accounting import (
+    Budget,
+    BudgetAccountant,
+    MechanismSpec,
+    NaiveBudgetAccountant,
+    PLDBudgetAccountant,
+)
+from pipelinedp_tpu.data_extractors import (
+    DataExtractors,
+    MultiValueDataExtractors,
+    PreAggregateExtractors,
+)
+from pipelinedp_tpu.report_generator import ExplainComputationReport
+from pipelinedp_tpu.backends.base import PipelineBackend
+from pipelinedp_tpu.backends.local import LocalBackend, MultiProcLocalBackend
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AddDPNoiseParams",
+    "AggregateParams",
+    "Budget",
+    "BudgetAccountant",
+    "CalculatePrivateContributionBoundsParams",
+    "CountParams",
+    "DataExtractors",
+    "ExplainComputationReport",
+    "LocalBackend",
+    "MeanParams",
+    "MechanismSpec",
+    "MechanismType",
+    "Metric",
+    "Metrics",
+    "MultiProcLocalBackend",
+    "MultiValueDataExtractors",
+    "NaiveBudgetAccountant",
+    "NoiseKind",
+    "NormKind",
+    "PLDBudgetAccountant",
+    "PartitionSelectionStrategy",
+    "PipelineBackend",
+    "PreAggregateExtractors",
+    "PrivacyIdCountParams",
+    "PrivateContributionBounds",
+    "SelectPartitionsParams",
+    "SumParams",
+    "VarianceParams",
+    "__version__",
+]

@@ -1,0 +1,535 @@
+"""User-facing configuration dataclasses, enums, and their validation.
+
+This is the public parameter surface of the framework. API parity with the
+reference: pipeline_dp/aggregate_params.py (Metric/Metrics :28-72, NoiseKind
+:75, PartitionSelectionStrategy :86, MechanismType :92, NormKind :129,
+AggregateParams :189-395, SelectPartitionsParams :398, SumParams :428,
+VarianceParams :473, MeanParams :521, CountParams :567, PrivacyIdCountParams
+:606, AddDPNoiseParams :645, parameters_to_readable_string :707).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import logging
+import math
+import numbers
+from typing import Any, Callable, List, Optional, Sequence
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Metric:
+    """A metric to compute, e.g. ``Metrics.COUNT`` or ``Metrics.PERCENTILE(90)``.
+
+    ``parameter`` carries the percentile rank for PERCENTILE metrics.
+    """
+    name: str
+    parameter: Optional[float] = None
+
+    def __str__(self) -> str:
+        if self.parameter is None:
+            return self.name
+        return f"{self.name}({self.parameter})"
+
+    def __repr__(self) -> str:
+        return self.__str__()
+
+    @property
+    def is_percentile(self) -> bool:
+        return self.name == "PERCENTILE"
+
+
+class Metrics:
+    """Namespace of supported metrics."""
+    COUNT = Metric("COUNT")
+    PRIVACY_ID_COUNT = Metric("PRIVACY_ID_COUNT")
+    SUM = Metric("SUM")
+    MEAN = Metric("MEAN")
+    VARIANCE = Metric("VARIANCE")
+    VECTOR_SUM = Metric("VECTOR_SUM")
+
+    @classmethod
+    def PERCENTILE(cls, percentile_to_compute: float) -> Metric:
+        return Metric("PERCENTILE", percentile_to_compute)
+
+
+# ---------------------------------------------------------------------------
+# Enums
+# ---------------------------------------------------------------------------
+
+
+class NoiseKind(enum.Enum):
+    LAPLACE = "laplace"
+    GAUSSIAN = "gaussian"
+
+    def convert_to_mechanism_type(self) -> "MechanismType":
+        if self is NoiseKind.LAPLACE:
+            return MechanismType.LAPLACE
+        return MechanismType.GAUSSIAN
+
+
+class PartitionSelectionStrategy(enum.Enum):
+    TRUNCATED_GEOMETRIC = "Truncated Geometric"
+    LAPLACE_THRESHOLDING = "Laplace Thresholding"
+    GAUSSIAN_THRESHOLDING = "Gaussian Thresholding"
+
+    @property
+    def mechanism_type(self) -> "MechanismType":
+        if self is PartitionSelectionStrategy.LAPLACE_THRESHOLDING:
+            return MechanismType.LAPLACE_THRESHOLDING
+        if self is PartitionSelectionStrategy.GAUSSIAN_THRESHOLDING:
+            return MechanismType.GAUSSIAN_THRESHOLDING
+        return MechanismType.GENERIC
+
+
+class MechanismType(enum.Enum):
+    LAPLACE = "Laplace"
+    GAUSSIAN = "Gaussian"
+    LAPLACE_THRESHOLDING = "Laplace Thresholding"
+    GAUSSIAN_THRESHOLDING = "Gaussian Thresholding"
+    TRUNCATED_GEOMETRIC = "Truncated Geometric"
+    GENERIC = "Generic"
+
+    def to_noise_kind(self) -> NoiseKind:
+        if self in (MechanismType.LAPLACE, MechanismType.LAPLACE_THRESHOLDING):
+            return NoiseKind.LAPLACE
+        if self in (MechanismType.GAUSSIAN,
+                    MechanismType.GAUSSIAN_THRESHOLDING):
+            return NoiseKind.GAUSSIAN
+        raise ValueError(f"MechanismType {self.value} has no noise kind.")
+
+    def to_partition_selection_strategy(self) -> PartitionSelectionStrategy:
+        if self is MechanismType.LAPLACE_THRESHOLDING:
+            return PartitionSelectionStrategy.LAPLACE_THRESHOLDING
+        if self is MechanismType.GAUSSIAN_THRESHOLDING:
+            return PartitionSelectionStrategy.GAUSSIAN_THRESHOLDING
+        raise ValueError(
+            f"MechanismType {self.value} is not a thresholding mechanism.")
+
+    @property
+    def is_thresholding_mechanism(self) -> bool:
+        return self in (MechanismType.LAPLACE_THRESHOLDING,
+                        MechanismType.GAUSSIAN_THRESHOLDING)
+
+
+def noise_to_thresholding(noise_kind: NoiseKind) -> MechanismType:
+    """Maps a noise kind to the corresponding thresholding mechanism.
+
+    Parity: aggregate_params.py:120-126.
+    """
+    if noise_kind == NoiseKind.LAPLACE:
+        return MechanismType.LAPLACE_THRESHOLDING
+    if noise_kind == NoiseKind.GAUSSIAN:
+        return MechanismType.GAUSSIAN_THRESHOLDING
+    raise ValueError(f"Unknown noise kind {noise_kind}")
+
+
+class NormKind(enum.Enum):
+    Linf = "linf"
+    L0 = "l0"
+    L1 = "l1"
+    L2 = "l2"
+
+
+# ---------------------------------------------------------------------------
+# Helpers
+# ---------------------------------------------------------------------------
+
+
+def _is_number(value: Any) -> bool:
+    return isinstance(value, numbers.Number) and not isinstance(value, bool)
+
+
+def _is_finite_number(value: Any) -> bool:
+    return _is_number(value) and math.isfinite(value)
+
+
+def _is_positive_int(value: Any) -> bool:
+    return (isinstance(value, numbers.Integral) and
+            not isinstance(value, bool) and value > 0)
+
+
+def _require_positive_int(value: Any, field_name: str) -> None:
+    if not _is_positive_int(value):
+        raise ValueError(
+            f"{field_name} has to be positive integer, but {value} given.")
+
+
+# ---------------------------------------------------------------------------
+# Parameter dataclasses
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CalculatePrivateContributionBoundsParams:
+    """Config for DP computation of contribution bounds.
+
+    The computed bound targets COUNT / PRIVACY_ID_COUNT aggregations.
+    Parity: aggregate_params.py:136-174.
+    """
+    aggregation_noise_kind: NoiseKind
+    aggregation_eps: float
+    aggregation_delta: float
+    calculation_eps: float
+    max_partitions_contributed_upper_bound: int
+
+    def __post_init__(self):
+        from pipelinedp_tpu import input_validators
+        if self.aggregation_noise_kind is None:
+            raise ValueError("aggregation_noise_kind must be set.")
+        input_validators.validate_epsilon_delta(
+            self.aggregation_eps, self.aggregation_delta,
+            "CalculatePrivateContributionBoundsParams aggregation")
+        if (self.aggregation_noise_kind == NoiseKind.GAUSSIAN and
+                self.aggregation_delta == 0):
+            raise ValueError(
+                "Gaussian noise requires a positive aggregation_delta.")
+        if not _is_number(self.calculation_eps) or self.calculation_eps <= 0:
+            raise ValueError(
+                f"calculation_eps must be positive, got {self.calculation_eps}.")
+        _require_positive_int(self.max_partitions_contributed_upper_bound,
+                              "max_partitions_contributed_upper_bound")
+
+
+@dataclasses.dataclass
+class PrivateContributionBounds:
+    """DP-computed contribution bounds (output of
+    DPEngine.calculate_private_contribution_bounds).
+
+    Parity: aggregate_params.py:176-186.
+    """
+    max_partitions_contributed: int
+
+
+@dataclasses.dataclass
+class AggregateParams:
+    """Parameters of a DP aggregation (DPEngine.aggregate).
+
+    Parity: aggregate_params.py:189-395 — same fields, same validation
+    semantics (checked by tests/aggregate_params_test.py).
+    """
+    metrics: List[Metric]
+    noise_kind: NoiseKind = NoiseKind.LAPLACE
+    max_partitions_contributed: Optional[int] = None
+    max_contributions_per_partition: Optional[int] = None
+    max_contributions: Optional[int] = None
+    budget_weight: float = 1
+    min_value: Optional[float] = None
+    max_value: Optional[float] = None
+    min_sum_per_partition: Optional[float] = None
+    max_sum_per_partition: Optional[float] = None
+    custom_combiners: Optional[Sequence] = None
+    vector_norm_kind: Optional[NormKind] = None
+    vector_max_norm: Optional[float] = None
+    vector_size: Optional[int] = None
+    contribution_bounds_already_enforced: bool = False
+    public_partitions_already_filtered: bool = False
+    partition_selection_strategy: PartitionSelectionStrategy = (
+        PartitionSelectionStrategy.TRUNCATED_GEOMETRIC)
+    pre_threshold: Optional[int] = None
+    post_aggregation_thresholding: bool = False
+    perform_cross_partition_contribution_bounding: bool = True
+    output_noise_stddev: bool = False
+
+    @property
+    def metrics_str(self) -> str:
+        if self.metrics:
+            return f"metrics={[str(m) for m in self.metrics]}"
+        return f"custom combiners={[type(c).__name__ for c in (self.custom_combiners or [])]}"
+
+    @property
+    def bounds_per_contribution_are_set(self) -> bool:
+        return self.min_value is not None and self.max_value is not None
+
+    @property
+    def bounds_per_partition_are_set(self) -> bool:
+        return (self.min_sum_per_partition is not None and
+                self.max_sum_per_partition is not None)
+
+    def __post_init__(self):
+        self._validate_paired("min_value", "max_value")
+        self._validate_paired("min_sum_per_partition", "max_sum_per_partition")
+
+        value_bound = self.min_value is not None
+        partition_bound = self.min_sum_per_partition is not None
+        if value_bound and partition_bound:
+            raise ValueError(
+                "min_value and min_sum_per_partition can not be both set.")
+        if value_bound:
+            self._validate_range("min_value", "max_value")
+        if partition_bound:
+            self._validate_range("min_sum_per_partition",
+                                 "max_sum_per_partition")
+
+        if self.metrics:
+            self._validate_metric_compatibility(value_bound, partition_bound)
+
+        if self.custom_combiners:
+            logging.warning(
+                "Custom combiners are an experimental feature; behavior may "
+                "change without notice.")
+            if self.metrics:
+                raise ValueError(
+                    "Custom combiners can not be used with standard metrics")
+
+        self._validate_contribution_bounds()
+
+        if self.pre_threshold is not None:
+            _require_positive_int(self.pre_threshold, "pre_threshold")
+
+    def _validate_metric_compatibility(self, value_bound: bool,
+                                       partition_bound: bool) -> None:
+        metrics = set(self.metrics)
+        if Metrics.VECTOR_SUM in metrics:
+            if metrics & {Metrics.SUM, Metrics.MEAN, Metrics.VARIANCE}:
+                raise ValueError(
+                    "AggregateParams: vector sum can not be computed together "
+                    "with scalar metrics such as sum, mean etc")
+        elif partition_bound:
+            disallowed = metrics - {
+                Metrics.SUM, Metrics.PRIVACY_ID_COUNT, Metrics.COUNT
+            }
+            if disallowed:
+                raise ValueError(
+                    f"AggregateParams: min_sum_per_partition is not compatible "
+                    f"with metrics {disallowed}. Please use "
+                    f"min_value/max_value.")
+        elif not value_bound:
+            needs_bounds = metrics - {Metrics.PRIVACY_ID_COUNT, Metrics.COUNT}
+            if needs_bounds:
+                raise ValueError(
+                    f"AggregateParams: for metrics {needs_bounds} bounds per "
+                    f"partition are required (e.g. min_value, max_value).")
+        if (self.contribution_bounds_already_enforced and
+                Metrics.PRIVACY_ID_COUNT in metrics):
+            raise ValueError(
+                "AggregateParams: Cannot calculate PRIVACY_ID_COUNT when "
+                "contribution_bounds_already_enforced is set to True.")
+
+    def _validate_contribution_bounds(self) -> None:
+        if self.max_contributions is not None:
+            _require_positive_int(self.max_contributions, "max_contributions")
+            if (self.max_partitions_contributed is not None or
+                    self.max_contributions_per_partition is not None):
+                raise ValueError(
+                    "AggregateParams: only one in max_contributions or both "
+                    "max_partitions_contributed and "
+                    "max_contributions_per_partition must be set")
+        else:
+            n_set = sum(v is not None
+                        for v in (self.max_partitions_contributed,
+                                  self.max_contributions_per_partition))
+            if n_set == 0:
+                raise ValueError(
+                    "AggregateParams: either max_contributions must be set or "
+                    "both max_partitions_contributed and "
+                    "max_contributions_per_partition must be set.")
+            if n_set == 1:
+                raise ValueError(
+                    "AggregateParams: either none or both "
+                    "max_partitions_contributed and "
+                    "max_contributions_per_partition must be set.")
+            _require_positive_int(self.max_partitions_contributed,
+                                  "max_partitions_contributed")
+            _require_positive_int(self.max_contributions_per_partition,
+                                  "max_contributions_per_partition")
+
+    def _validate_paired(self, name1: str, name2: str) -> None:
+        v1, v2 = getattr(self, name1), getattr(self, name2)
+        if (v1 is None) != (v2 is None):
+            raise ValueError(
+                f"AggregateParams: {name1} and {name2} should be both set or "
+                f"both None.")
+
+    def _validate_range(self, min_name: str, max_name: str) -> None:
+        for name in (min_name, max_name):
+            if not _is_finite_number(getattr(self, name)):
+                raise ValueError(
+                    f"AggregateParams: {name} must be a finite number")
+        if getattr(self, min_name) > getattr(self, max_name):
+            raise ValueError(
+                f"AggregateParams: {max_name} must be equal to or greater "
+                f"than {min_name}")
+
+    def __str__(self):
+        return parameters_to_readable_string(self)
+
+
+@dataclasses.dataclass
+class SelectPartitionsParams:
+    """Parameters of DP partition selection (DPEngine.select_partitions).
+
+    Parity: aggregate_params.py:398-425.
+    """
+    max_partitions_contributed: int
+    budget_weight: float = 1
+    partition_selection_strategy: PartitionSelectionStrategy = (
+        PartitionSelectionStrategy.TRUNCATED_GEOMETRIC)
+    pre_threshold: Optional[int] = None
+    contribution_bounds_already_enforced: bool = False
+
+    def __post_init__(self):
+        _require_positive_int(self.max_partitions_contributed,
+                              "max_partitions_contributed")
+        if self.pre_threshold is not None:
+            _require_positive_int(self.pre_threshold, "pre_threshold")
+
+    def __str__(self):
+        return "Private Partitions"
+
+
+@dataclasses.dataclass
+class SumParams:
+    """Parameters for a DP SUM via the high-level APIs.
+
+    Parity: aggregate_params.py:428-470.
+    """
+    max_partitions_contributed: int
+    max_contributions_per_partition: int
+    min_value: float
+    max_value: float
+    partition_extractor: Callable
+    value_extractor: Callable
+    budget_weight: float = 1
+    noise_kind: NoiseKind = NoiseKind.LAPLACE
+    contribution_bounds_already_enforced: bool = False
+    pre_threshold: Optional[int] = None
+
+
+@dataclasses.dataclass
+class VarianceParams:
+    """Parameters for a DP VARIANCE via the high-level APIs.
+
+    Parity: aggregate_params.py:473-518.
+    """
+    max_partitions_contributed: int
+    max_contributions_per_partition: int
+    min_value: float
+    max_value: float
+    partition_extractor: Callable
+    value_extractor: Callable
+    budget_weight: float = 1
+    noise_kind: NoiseKind = NoiseKind.LAPLACE
+    contribution_bounds_already_enforced: bool = False
+    pre_threshold: Optional[int] = None
+
+
+@dataclasses.dataclass
+class MeanParams:
+    """Parameters for a DP MEAN via the high-level APIs.
+
+    Parity: aggregate_params.py:521-565.
+    """
+    max_partitions_contributed: int
+    max_contributions_per_partition: int
+    min_value: float
+    max_value: float
+    partition_extractor: Callable
+    value_extractor: Callable
+    budget_weight: float = 1
+    noise_kind: NoiseKind = NoiseKind.LAPLACE
+    contribution_bounds_already_enforced: bool = False
+    pre_threshold: Optional[int] = None
+
+
+@dataclasses.dataclass
+class CountParams:
+    """Parameters for a DP COUNT via the high-level APIs.
+
+    Parity: aggregate_params.py:567-604.
+    """
+    noise_kind: NoiseKind
+    max_partitions_contributed: int
+    max_contributions_per_partition: int
+    partition_extractor: Callable
+    budget_weight: float = 1
+    contribution_bounds_already_enforced: bool = False
+    pre_threshold: Optional[int] = None
+
+
+@dataclasses.dataclass
+class PrivacyIdCountParams:
+    """Parameters for a DP PRIVACY_ID_COUNT via the high-level APIs.
+
+    Parity: aggregate_params.py:606-643.
+    """
+    noise_kind: NoiseKind
+    max_partitions_contributed: int
+    partition_extractor: Callable
+    budget_weight: float = 1
+    contribution_bounds_already_enforced: bool = False
+    pre_threshold: Optional[int] = None
+
+
+@dataclasses.dataclass
+class AddDPNoiseParams:
+    """Parameters for DPEngine.add_dp_noise.
+
+    Unlike aggregate(), add_dp_noise does NOT enforce contribution bounds; the
+    caller is responsible for the provided sensitivities being true.
+    Parity: aggregate_params.py:645-675.
+    """
+    noise_kind: NoiseKind
+    l0_sensitivity: int
+    linf_sensitivity: float
+    budget_weight: float = 1
+
+    def __post_init__(self):
+        for name in ("l0_sensitivity", "linf_sensitivity", "budget_weight"):
+            value = getattr(self, name)
+            if value is not None and value <= 0:
+                raise ValueError(
+                    f"{name} must be positive, but {value} given.")
+
+
+# ---------------------------------------------------------------------------
+# Readable stringification (used by explain-computation reports)
+# ---------------------------------------------------------------------------
+
+_BOUND_PROPERTIES = (
+    "max_partitions_contributed",
+    "max_contributions_per_partition",
+    "max_contributions",
+    "min_value",
+    "max_value",
+    "min_sum_per_partition",
+    "max_sum_per_partition",
+)
+_VECTOR_PROPERTIES = ("vector_max_norm", "vector_size", "vector_norm_kind")
+
+
+def parameters_to_readable_string(
+        params: Any, is_public_partition: Optional[bool] = None) -> str:
+    """Renders a params dataclass as the human-readable multi-line string used
+    in Explain Computation reports.
+
+    Parity: aggregate_params.py:707-738.
+    """
+    lines = [f"{type(params).__name__}:"]
+    if hasattr(params, "metrics_str"):
+        lines.append(f" {params.metrics_str}")
+    if getattr(params, "noise_kind", None) is not None:
+        lines.append(f" noise_kind={params.noise_kind.value}")
+    if hasattr(params, "budget_weight"):
+        lines.append(f" budget_weight={params.budget_weight}")
+    lines.append(" Contribution bounding:")
+    for name in _BOUND_PROPERTIES:
+        value = getattr(params, name, None)
+        if value is not None:
+            lines.append(f"  {name}={value}")
+    if getattr(params, "contribution_bounds_already_enforced", False):
+        lines.append("  contribution_bounds_already_enforced=True")
+    for name in _VECTOR_PROPERTIES:
+        value = getattr(params, name, None)
+        if value is not None:
+            lines.append(f"  {name}={value}")
+    if is_public_partition is not None:
+        kind = "public" if is_public_partition else "private"
+        lines.append(f" Partition selection: {kind} partitions")
+    return "\n".join(lines)

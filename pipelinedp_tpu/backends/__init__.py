@@ -1,0 +1,13 @@
+from pipelinedp_tpu.backends.base import (Annotator, PipelineBackend,
+                                          UniqueLabelsGenerator,
+                                          register_annotator)
+from pipelinedp_tpu.backends.local import LocalBackend, MultiProcLocalBackend
+
+__all__ = [
+    "Annotator",
+    "LocalBackend",
+    "MultiProcLocalBackend",
+    "PipelineBackend",
+    "UniqueLabelsGenerator",
+    "register_annotator",
+]

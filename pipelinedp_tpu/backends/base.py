@@ -1,0 +1,176 @@
+"""Pipeline backend abstraction — the seam between DP logic and execution.
+
+Everything above this layer (combiners, bounders, DPEngine, analysis)
+expresses computation exclusively through the ~18 dataflow primitives below,
+so an execution strategy (lazy local generators, multiprocess, columnar
+JAX/TPU) is a drop-in class.
+
+Parity: pipeline_dp/pipeline_backend.py (PipelineBackend ABC :38-195,
+UniqueLabelsGenerator :198-219, Annotator/register_annotator :826-851).
+"""
+
+from __future__ import annotations
+
+import abc
+import itertools
+from typing import Any, Callable, Iterable, List
+
+
+class PipelineBackend(abc.ABC):
+    """Abstract dataflow vocabulary.
+
+    Collections are opaque backend-native handles; all ops are lazy where the
+    backend supports it. ``stage_name`` labels the op for explain reports,
+    profiles, and debugging.
+    """
+
+    def to_collection(self, collection_or_iterable, col, stage_name: str):
+        """Converts an iterable to this backend's native collection type.
+
+        ``col`` is an existing native collection used to infer pipeline
+        context where needed (e.g. a distributed runtime handle).
+        """
+        return collection_or_iterable
+
+    def to_multi_transformable_collection(self, col):
+        """Returns a collection that supports multiple downstream transforms.
+
+        Needed for generator-based backends where a collection can be
+        consumed only once.
+        """
+        return col
+
+    @abc.abstractmethod
+    def map(self, col, fn: Callable, stage_name: str):
+        """Element-wise transform."""
+
+    @abc.abstractmethod
+    def map_with_side_inputs(self, col, fn: Callable, side_input_cols,
+                             stage_name: str):
+        """Like map, but fn also receives each side input materialized as a
+        list: fn(element, *side_inputs)."""
+
+    @abc.abstractmethod
+    def flat_map(self, col, fn: Callable, stage_name: str):
+        """Element-wise transform producing zero or more outputs each."""
+
+    def flat_map_with_side_inputs(self, col, fn: Callable, side_input_cols,
+                                  stage_name: str):
+        """flat_map with side inputs; default via map_with_side_inputs."""
+        mapped = self.map_with_side_inputs(col, fn, side_input_cols,
+                                           stage_name)
+        return self.flat_map(mapped, lambda x: x, f"{stage_name} (flatten)")
+
+    @abc.abstractmethod
+    def map_tuple(self, col, fn: Callable, stage_name: str):
+        """For collections of tuples: fn(*element)."""
+
+    @abc.abstractmethod
+    def map_values(self, col, fn: Callable, stage_name: str):
+        """For (key, value) collections: (key, fn(value))."""
+
+    @abc.abstractmethod
+    def group_by_key(self, col, stage_name: str):
+        """(key, value) -> (key, iterable-of-values). The shuffle."""
+
+    @abc.abstractmethod
+    def filter(self, col, fn: Callable, stage_name: str):
+        """Keeps elements where fn(element) is truthy."""
+
+    @abc.abstractmethod
+    def filter_by_key(self, col, keys_to_keep, stage_name: str):
+        """Keeps (key, value) pairs whose key is in keys_to_keep.
+
+        ``keys_to_keep`` may be a local list/set or a backend collection.
+        """
+
+    @abc.abstractmethod
+    def keys(self, col, stage_name: str):
+        """(key, value) -> key."""
+
+    @abc.abstractmethod
+    def values(self, col, stage_name: str):
+        """(key, value) -> value."""
+
+    @abc.abstractmethod
+    def sample_fixed_per_key(self, col, n: int, stage_name: str):
+        """(key, value) -> (key, [<=n values sampled without replacement])."""
+
+    @abc.abstractmethod
+    def count_per_element(self, col, stage_name: str):
+        """element -> (element, multiplicity)."""
+
+    @abc.abstractmethod
+    def sum_per_key(self, col, stage_name: str):
+        """(key, number) -> (key, sum of numbers)."""
+
+    @abc.abstractmethod
+    def combine_accumulators_per_key(self, col, combiner, stage_name: str):
+        """(key, accumulator) -> (key, merged accumulator) using
+        combiner.merge_accumulators."""
+
+    @abc.abstractmethod
+    def reduce_per_key(self, col, fn: Callable, stage_name: str):
+        """(key, value) -> (key, reduced value); fn must be associative and
+        commutative."""
+
+    @abc.abstractmethod
+    def flatten(self, cols: Iterable, stage_name: str):
+        """Union of several collections."""
+
+    @abc.abstractmethod
+    def distinct(self, col, stage_name: str):
+        """Deduplicates the collection."""
+
+    @abc.abstractmethod
+    def to_list(self, col, stage_name: str):
+        """Collection -> 1-element collection holding a list of all elements."""
+
+    def annotate(self, col, stage_name: str, **kwargs):
+        """Applies all registered annotators (no-op unless overridden)."""
+        return col
+
+
+class UniqueLabelsGenerator:
+    """Uniquifies stage labels within one pipeline (for legible runtime UIs).
+
+    Parity: pipeline_backend.py:198-219.
+    """
+
+    def __init__(self, suffix: str = ""):
+        self._labels = set()
+        self._suffix = f"_{suffix}" if suffix else ""
+
+    def unique(self, label: str) -> str:
+        label = label or "UNDEFINED_STAGE_NAME"
+        candidate = label + self._suffix
+        if candidate not in self._labels:
+            self._labels.add(candidate)
+            return candidate
+        for i in itertools.count(1):
+            candidate = f"{label}_{i}{self._suffix}"
+            if candidate not in self._labels:
+                self._labels.add(candidate)
+                return candidate
+
+
+class Annotator(abc.ABC):
+    """Hook for attaching metadata (e.g. budget) to output collections.
+
+    Parity: pipeline_backend.py:826-851.
+    """
+
+    @abc.abstractmethod
+    def annotate(self, col, stage_name: str, **kwargs):
+        """Returns the annotated collection."""
+
+
+_annotators: List[Annotator] = []
+
+
+def register_annotator(annotator: Annotator) -> None:
+    _annotators.append(annotator)
+
+
+def registered_annotators() -> List[Annotator]:
+    return list(_annotators)

@@ -1,0 +1,231 @@
+"""Single-process backends.
+
+``LocalBackend`` is the lazy-generator execution strategy: every op returns a
+generator, nothing runs until the output is iterated. It is the correctness
+oracle the JAX backend is conformance-tested against, and the CPU baseline
+for the benchmark targets.
+
+Parity: pipeline_dp/pipeline_backend.py LocalBackend :477-583 (lazy
+generators, defaultdict group-by), MultiProcLocalBackend :600-823
+(experimental multi-worker local execution).
+"""
+
+from __future__ import annotations
+
+import collections
+import concurrent.futures
+import itertools
+import multiprocessing
+from typing import Callable, Iterable
+
+from pipelinedp_tpu.backends import base
+from pipelinedp_tpu.sampling_utils import choose_from_list_without_replacement
+
+
+class LocalBackend(base.PipelineBackend):
+    """Lazy single-process backend over Python iterables."""
+
+    def to_collection(self, collection_or_iterable, col, stage_name: str):
+        return collection_or_iterable
+
+    def to_multi_transformable_collection(self, col):
+        return list(col)
+
+    def map(self, col, fn: Callable, stage_name: str = None):
+        return (fn(x) for x in col)
+
+    def map_with_side_inputs(self, col, fn: Callable, side_input_cols,
+                             stage_name: str = None):
+
+        def gen():
+            side_inputs = [list(s) for s in side_input_cols]
+            for x in col:
+                yield fn(x, *side_inputs)
+
+        return gen()
+
+    def flat_map(self, col, fn: Callable, stage_name: str = None):
+        return (y for x in col for y in fn(x))
+
+    def flat_map_with_side_inputs(self, col, fn: Callable, side_input_cols,
+                                  stage_name: str = None):
+
+        def gen():
+            side_inputs = [list(s) for s in side_input_cols]
+            for x in col:
+                yield from fn(x, *side_inputs)
+
+        return gen()
+
+    def map_tuple(self, col, fn: Callable, stage_name: str = None):
+        return (fn(*x) for x in col)
+
+    def map_values(self, col, fn: Callable, stage_name: str = None):
+        return ((k, fn(v)) for k, v in col)
+
+    def group_by_key(self, col, stage_name: str = None):
+
+        def gen():
+            groups = collections.defaultdict(list)
+            for key, value in col:
+                groups[key].append(value)
+            yield from groups.items()
+
+        return gen()
+
+    def filter(self, col, fn: Callable, stage_name: str = None):
+        return (x for x in col if fn(x))
+
+    def filter_by_key(self, col, keys_to_keep, stage_name: str = None):
+
+        def gen():
+            keep = keys_to_keep
+            if not isinstance(keep, (list, set, frozenset, dict)):
+                keep = list(keep)
+            keep = set(keep) if not isinstance(keep, (set, frozenset)) else keep
+            for key, value in col:
+                if key in keep:
+                    yield key, value
+
+        return gen()
+
+    def keys(self, col, stage_name: str = None):
+        return (k for k, _ in col)
+
+    def values(self, col, stage_name: str = None):
+        return (v for _, v in col)
+
+    def sample_fixed_per_key(self, col, n: int, stage_name: str = None):
+        grouped = self.group_by_key(col, stage_name)
+        return ((k, choose_from_list_without_replacement(v, n))
+                for k, v in grouped)
+
+    def count_per_element(self, col, stage_name: str = None):
+
+        def gen():
+            counts = collections.Counter(col)
+            yield from counts.items()
+
+        return gen()
+
+    def sum_per_key(self, col, stage_name: str = None):
+        return self.reduce_per_key(col, lambda a, b: a + b, stage_name)
+
+    def combine_accumulators_per_key(self, col, combiner,
+                                     stage_name: str = None):
+        return self.reduce_per_key(col, combiner.merge_accumulators,
+                                   stage_name)
+
+    def reduce_per_key(self, col, fn: Callable, stage_name: str = None):
+
+        def gen():
+            reduced = {}
+            for key, value in col:
+                if key in reduced:
+                    reduced[key] = fn(reduced[key], value)
+                else:
+                    reduced[key] = value
+            yield from reduced.items()
+
+        return gen()
+
+    def flatten(self, cols: Iterable, stage_name: str = None):
+        return itertools.chain(*cols)
+
+    def distinct(self, col, stage_name: str = None):
+
+        def gen():
+            yield from set(col)
+
+        return gen()
+
+    def to_list(self, col, stage_name: str = None):
+        return iter([list(col)])
+
+    def annotate(self, col, stage_name: str = None, **kwargs):
+        for annotator in base.registered_annotators():
+            col = annotator.annotate(col, stage_name, **kwargs)
+        return col
+
+
+class MultiProcLocalBackend(LocalBackend):
+    """Experimental multi-worker local backend.
+
+    Parallelizes the element-wise ops (map / flat_map / filter) across a
+    worker pool while inheriting the shuffle ops from LocalBackend. Because
+    arbitrary Python closures are not picklable, workers are threads by
+    default ("threads" mode); "processes" mode uses a fork-based pool and
+    requires picklable functions. The reference's equivalent
+    (pipeline_backend.py:600-823) is likewise marked experimental with
+    several ops unimplemented.
+    """
+
+    def __init__(self, n_jobs: int = None, mode: str = "threads",
+                 chunksize: int = 1024):
+        self._n_jobs = n_jobs or multiprocessing.cpu_count()
+        if mode not in ("threads", "processes"):
+            raise ValueError(f"mode must be 'threads' or 'processes': {mode}")
+        self._mode = mode
+        self._chunksize = chunksize
+
+    def _executor(self):
+        if self._mode == "threads":
+            return concurrent.futures.ThreadPoolExecutor(self._n_jobs)
+        return concurrent.futures.ProcessPoolExecutor(self._n_jobs)
+
+    def _parallel_chunks(self, col, chunk_fn: Callable):
+        # Keeps at most 2 * n_jobs chunks in flight so a large (or streamed)
+        # input is never materialized whole — Executor.map would consume the
+        # entire chunk iterator eagerly.
+
+        def gen():
+            iter_col = iter(col)
+            chunks = iter(
+                lambda: list(itertools.islice(iter_col, self._chunksize)), [])
+            max_in_flight = 2 * self._n_jobs
+            with self._executor() as pool:
+                in_flight = collections.deque()
+                for chunk in chunks:
+                    in_flight.append(pool.submit(chunk_fn, chunk))
+                    if len(in_flight) >= max_in_flight:
+                        yield from in_flight.popleft().result()
+                while in_flight:
+                    yield from in_flight.popleft().result()
+
+        return gen()
+
+    def map(self, col, fn: Callable, stage_name: str = None):
+        return self._parallel_chunks(col, _MapChunk(fn))
+
+    def flat_map(self, col, fn: Callable, stage_name: str = None):
+        return self._parallel_chunks(col, _FlatMapChunk(fn))
+
+    def filter(self, col, fn: Callable, stage_name: str = None):
+        return self._parallel_chunks(col, _FilterChunk(fn))
+
+
+class _MapChunk:
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, chunk):
+        return [self._fn(x) for x in chunk]
+
+
+class _FlatMapChunk:
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, chunk):
+        return [y for x in chunk for y in self._fn(x)]
+
+
+class _FilterChunk:
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, chunk):
+        return [x for x in chunk if self._fn(x)]

@@ -1,0 +1,429 @@
+"""Lazy privacy-budget accounting.
+
+The contract (parity: pipeline_dp/budget_accounting.py): DP operations call
+``request_budget()`` while the computation graph is being built, receiving a
+*lazy* ``MechanismSpec`` whose eps/delta (or noise std) are unset; after all
+aggregations are registered the user calls ``compute_budgets()``, which
+resolves every spec in place. The same spec objects are captured inside
+compiled/jitted closures, so resolution must happen before execution — with
+JAX this maps to treating eps/delta/sigma as runtime scalars fed into jitted
+kernels (see pipelinedp_tpu/ops/noise.py), not trace-time constants.
+
+API parity map: MechanismSpec (:40-111), MechanismSpecInternal (:114),
+Budget (:122), BudgetAccountant (:125-270), BudgetAccountantScope (:273-298),
+NaiveBudgetAccountant (:301-408), PLDBudgetAccountant (:411-619).
+"""
+
+from __future__ import annotations
+
+import abc
+import collections
+import dataclasses
+import logging
+import math
+from typing import List, Optional
+
+from pipelinedp_tpu import input_validators
+from pipelinedp_tpu import pld as pld_lib
+from pipelinedp_tpu.aggregate_params import MechanismType
+
+Budget = collections.namedtuple("Budget", ["epsilon", "delta"])
+
+
+@dataclasses.dataclass
+class MechanismSpec:
+    """A lazily-resolved mechanism budget.
+
+    Created unset by ``request_budget``; ``compute_budgets`` fills in either
+    (eps, delta) (naive accounting) or the noise standard deviation (PLD
+    accounting). Accessing an unresolved field raises AssertionError.
+    """
+    mechanism_type: MechanismType
+    _noise_standard_deviation: Optional[float] = None
+    _eps: Optional[float] = None
+    _delta: Optional[float] = None
+    _count: int = 1
+
+    @property
+    def noise_standard_deviation(self) -> float:
+        if self._noise_standard_deviation is None:
+            raise AssertionError(
+                "Noise standard deviation is not calculated yet.")
+        return self._noise_standard_deviation
+
+    @property
+    def eps(self) -> float:
+        if self._eps is None:
+            raise AssertionError("Privacy budget is not calculated yet.")
+        return self._eps
+
+    @property
+    def delta(self) -> float:
+        if self._delta is None:
+            raise AssertionError("Privacy budget is not calculated yet.")
+        return self._delta
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def set_eps_delta(self, eps: float, delta: Optional[float]) -> None:
+        if eps is None:
+            raise AssertionError("eps must not be None.")
+        self._eps = eps
+        self._delta = delta
+
+    def set_noise_standard_deviation(self, stddev: float) -> None:
+        self._noise_standard_deviation = stddev
+
+    def use_delta(self) -> bool:
+        return self.mechanism_type != MechanismType.LAPLACE
+
+    @property
+    def standard_deviation_is_set(self) -> bool:
+        return self._noise_standard_deviation is not None
+
+
+@dataclasses.dataclass
+class MechanismSpecInternal:
+    """Sensitivity and weight bookkeeping not exposed via MechanismSpec."""
+    sensitivity: float
+    weight: float
+    mechanism_spec: MechanismSpec
+
+
+class BudgetAccountantScope:
+    """Context manager grouping the mechanisms of one aggregation.
+
+    On exit, the weights of all mechanisms registered inside the scope are
+    normalized to sum to the scope's weight, so one aggregation's budget share
+    is independent of how many mechanisms it happens to use internally.
+    Parity: budget_accounting.py:273-298.
+    """
+
+    def __init__(self, accountant: "BudgetAccountant", weight: float):
+        self.accountant = accountant
+        self.weight = weight
+        self.mechanisms: List[MechanismSpecInternal] = []
+
+    def __enter__(self):
+        self.accountant._enter_scope(self)
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.accountant._exit_scope()
+        self._normalize_mechanism_weights()
+
+    def _normalize_mechanism_weights(self):
+        if not self.mechanisms:
+            return
+        total = sum(m.weight for m in self.mechanisms)
+        factor = self.weight / total
+        for m in self.mechanisms:
+            m.weight *= factor
+
+
+class BudgetAccountant(abc.ABC):
+    """Base class: mechanism registry, scopes, aggregation restrictions."""
+
+    def __init__(self, total_epsilon: float, total_delta: float,
+                 num_aggregations: Optional[int],
+                 aggregation_weights: Optional[list]):
+        input_validators.validate_epsilon_delta(total_epsilon, total_delta,
+                                                type(self).__name__)
+        self._total_epsilon = total_epsilon
+        self._total_delta = total_delta
+        self._scopes_stack: List[BudgetAccountantScope] = []
+        self._mechanisms: List[MechanismSpecInternal] = []
+        self._finalized = False
+        if num_aggregations is not None and aggregation_weights is not None:
+            raise ValueError(
+                "'num_aggregations' and 'aggregation_weights' can not be both "
+                "set.")
+        if num_aggregations is not None:
+            input_validators.validate_positive_int(num_aggregations,
+                                                   "num_aggregations",
+                                                   type(self).__name__)
+        self._expected_num_aggregations = num_aggregations
+        self._expected_aggregation_weights = aggregation_weights
+        self._actual_aggregation_weights: List[float] = []
+
+    @property
+    def total_epsilon(self) -> float:
+        return self._total_epsilon
+
+    @property
+    def total_delta(self) -> float:
+        return self._total_delta
+
+    @abc.abstractmethod
+    def request_budget(self,
+                       mechanism_type: MechanismType,
+                       sensitivity: float = 1,
+                       weight: float = 1,
+                       count: int = 1,
+                       noise_standard_deviation: Optional[float] = None
+                       ) -> MechanismSpec:
+        """Registers a mechanism; returns its lazy spec."""
+
+    @abc.abstractmethod
+    def compute_budgets(self) -> None:
+        """Resolves every registered MechanismSpec in place."""
+
+    def scope(self, weight: float) -> BudgetAccountantScope:
+        return BudgetAccountantScope(self, weight)
+
+    def _compute_budget_for_aggregation(self, weight: float) -> Optional[Budget]:
+        """Naive-composition estimate of one aggregation's (eps, delta) share.
+
+        Mutates internal state (records the aggregation weight); callable only
+        from DPEngine API functions. Parity: budget_accounting.py:189-213.
+        """
+        self._actual_aggregation_weights.append(weight)
+        if self._expected_num_aggregations:
+            return Budget(self._total_epsilon / self._expected_num_aggregations,
+                          self._total_delta / self._expected_num_aggregations)
+        if self._expected_aggregation_weights:
+            ratio = weight / sum(self._expected_aggregation_weights)
+            return Budget(self._total_epsilon * ratio,
+                          self._total_delta * ratio)
+        return None
+
+    def _check_aggregation_restrictions(self):
+        actual = self._actual_aggregation_weights
+        if self._expected_num_aggregations:
+            if len(actual) != self._expected_num_aggregations:
+                raise ValueError(
+                    f"'num_aggregations'({self._expected_num_aggregations}) in "
+                    f"the constructor of BudgetAccountant is different from "
+                    f"the actual number of aggregations in the pipeline"
+                    f"({len(actual)}). If 'num_aggregations' is specified, you "
+                    f"must have that many aggregations in the pipeline.")
+            if any(w != 1 for w in actual):
+                raise ValueError(
+                    f"Aggregation weights = {actual}. If 'num_aggregations' is "
+                    f"set in the constructor of BudgetAccountant, all "
+                    f"aggregation weights have to be 1. If you'd like to have "
+                    f"different weights use 'aggregation_weights'.")
+        if self._expected_aggregation_weights:
+            expected = self._expected_aggregation_weights
+            if len(actual) != len(expected):
+                raise ValueError(
+                    f"Length of 'aggregation_weights' in the constructor of "
+                    f"BudgetAccountant is {len(expected)} != {len(actual)} the "
+                    f"actual number of aggregations.")
+            if any(w1 != w2 for w1, w2 in zip(actual, expected)):
+                raise ValueError(
+                    f"'aggregation_weights' in the constructor ({expected}) is "
+                    f"different from actual aggregation weights ({actual}). If "
+                    f"'aggregation_weights' is specified, they must be the "
+                    f"same.")
+
+    def _register_mechanism(
+            self, mechanism: MechanismSpecInternal) -> MechanismSpecInternal:
+        self._mechanisms.append(mechanism)
+        for scope in self._scopes_stack:
+            scope.mechanisms.append(mechanism)
+        return mechanism
+
+    def _enter_scope(self, scope: BudgetAccountantScope):
+        self._scopes_stack.append(scope)
+
+    def _exit_scope(self):
+        self._scopes_stack.pop()
+
+    def _finalize(self):
+        if self._finalized:
+            raise Exception("compute_budgets can not be called twice.")
+        self._finalized = True
+
+    def _pre_compute_checks(self) -> bool:
+        """Shared compute_budgets prologue. Returns False if nothing to do."""
+        self._check_aggregation_restrictions()
+        self._finalize()
+        if not self._mechanisms:
+            logging.warning("No budgets were requested.")
+            return False
+        if self._scopes_stack:
+            raise Exception(
+                "Cannot call compute_budgets from within a budget scope.")
+        return True
+
+    def _check_not_finalized(self):
+        if self._finalized:
+            raise Exception(
+                "request_budget() is called after compute_budgets(). Please "
+                "ensure that compute_budgets() is called after DP "
+                "aggregations.")
+
+
+class NaiveBudgetAccountant(BudgetAccountant):
+    """Splits (eps, delta) across mechanisms proportionally to their weights.
+
+    Naive (basic) composition: eps_i = eps_total * w_i / sum(w), and delta
+    likewise but only across delta-consuming mechanisms.
+    Parity: budget_accounting.py:301-408.
+    """
+
+    def __init__(self,
+                 total_epsilon: float,
+                 total_delta: float,
+                 num_aggregations: Optional[int] = None,
+                 aggregation_weights: Optional[list] = None):
+        super().__init__(total_epsilon, total_delta, num_aggregations,
+                         aggregation_weights)
+
+    def request_budget(self,
+                       mechanism_type: MechanismType,
+                       sensitivity: float = 1,
+                       weight: float = 1,
+                       count: int = 1,
+                       noise_standard_deviation: Optional[float] = None
+                       ) -> MechanismSpec:
+        self._check_not_finalized()
+        if noise_standard_deviation is not None:
+            raise NotImplementedError(
+                "Noise standard deviation is not supported by "
+                "NaiveBudgetAccountant.request_budget.")
+        if (mechanism_type == MechanismType.GAUSSIAN and
+                self._total_delta == 0):
+            raise ValueError(
+                "The Gaussian mechanism requires that the pipeline delta is "
+                "greater than 0")
+        spec = MechanismSpec(mechanism_type=mechanism_type, _count=count)
+        self._register_mechanism(
+            MechanismSpecInternal(sensitivity=sensitivity,
+                                  weight=weight,
+                                  mechanism_spec=spec))
+        return spec
+
+    def compute_budgets(self) -> None:
+        if not self._pre_compute_checks():
+            return
+        total_w_eps = sum(m.weight * m.mechanism_spec.count
+                          for m in self._mechanisms)
+        total_w_delta = sum(m.weight * m.mechanism_spec.count
+                            for m in self._mechanisms
+                            if m.mechanism_spec.use_delta())
+        for m in self._mechanisms:
+            eps = (self._total_epsilon * m.weight /
+                   total_w_eps) if total_w_eps else 0.0
+            delta = 0.0
+            if m.mechanism_spec.use_delta() and total_w_delta:
+                delta = self._total_delta * m.weight / total_w_delta
+            m.mechanism_spec.set_eps_delta(eps, delta)
+
+
+class PLDBudgetAccountant(BudgetAccountant):
+    """Tight accounting via Privacy Loss Distribution composition.
+
+    Finds (by binary search) the minimum common noise multiplier such that
+    the composition of all mechanisms' PLDs stays within (eps, delta); each
+    mechanism then gets noise std = sensitivity * multiplier / weight.
+    Parity: budget_accounting.py:411-619 (semantics preserved; the PLD math
+    itself lives in pipelinedp_tpu/pld.py instead of dp_accounting).
+    """
+
+    def __init__(self,
+                 total_epsilon: float,
+                 total_delta: float,
+                 pld_discretization: float = 1e-4,
+                 num_aggregations: Optional[int] = None,
+                 aggregation_weights: Optional[list] = None):
+        super().__init__(total_epsilon, total_delta, num_aggregations,
+                         aggregation_weights)
+        self.minimum_noise_std: Optional[float] = None
+        self._pld_discretization = pld_discretization
+
+    def request_budget(self,
+                       mechanism_type: MechanismType,
+                       sensitivity: float = 1,
+                       weight: float = 1,
+                       count: int = 1,
+                       noise_standard_deviation: Optional[float] = None
+                       ) -> MechanismSpec:
+        self._check_not_finalized()
+        if count != 1 or noise_standard_deviation is not None:
+            raise NotImplementedError(
+                "count != 1 / noise std are not supported by "
+                "PLDBudgetAccountant.request_budget.")
+        if (mechanism_type == MechanismType.GAUSSIAN and
+                self._total_delta == 0):
+            raise AssertionError(
+                "The Gaussian mechanism requires that the pipeline delta is "
+                "greater than 0")
+        spec = MechanismSpec(mechanism_type=mechanism_type)
+        self._register_mechanism(
+            MechanismSpecInternal(sensitivity=sensitivity,
+                                  weight=weight,
+                                  mechanism_spec=spec))
+        return spec
+
+    def compute_budgets(self) -> None:
+        if not self._pre_compute_checks():
+            return
+        if self._total_delta == 0:
+            sum_weights = sum(m.weight for m in self._mechanisms)
+            minimum_noise_std = sum_weights / self._total_epsilon * math.sqrt(2)
+        else:
+            minimum_noise_std = self._find_minimum_noise_std()
+        self.minimum_noise_std = minimum_noise_std
+        for m in self._mechanisms:
+            noise_std = m.sensitivity * minimum_noise_std / m.weight
+            m.mechanism_spec.set_noise_standard_deviation(noise_std)
+            if m.mechanism_spec.mechanism_type == MechanismType.GENERIC:
+                eps0 = math.sqrt(2) / noise_std
+                delta0 = eps0 / self._total_epsilon * self._total_delta
+                m.mechanism_spec.set_eps_delta(eps0, delta0)
+
+    def _find_minimum_noise_std(self) -> float:
+        threshold = 1e-4
+        low, high = 0.0, self._calculate_max_noise_std()
+        while low + threshold < high:
+            mid = (low + high) / 2
+            eps = self._composed_epsilon(mid)
+            if eps <= self._total_epsilon:
+                high = mid
+            else:
+                low = mid
+        return high
+
+    def _calculate_max_noise_std(self) -> float:
+        max_noise_std = 1.0
+        while self._composed_epsilon(max_noise_std * 2) > self._total_epsilon:
+            max_noise_std *= 2
+        return max_noise_std * 2
+
+    def _composed_epsilon(self, noise_standard_deviation: float) -> float:
+        return self._compose_distributions(
+            noise_standard_deviation).get_epsilon_for_delta(self._total_delta)
+
+    def _compose_distributions(
+            self,
+            noise_standard_deviation: float) -> pld_lib.PrivacyLossDistribution:
+        composed = None
+        for m in self._mechanisms:
+            mtype = m.mechanism_spec.mechanism_type
+            scale = m.sensitivity * noise_standard_deviation / m.weight
+            if mtype == MechanismType.LAPLACE:
+                # Laplace scale parameter b = std / sqrt(2).
+                pld = pld_lib.from_laplace_mechanism(
+                    scale / math.sqrt(2),
+                    value_discretization_interval=self._pld_discretization)
+            elif mtype == MechanismType.GAUSSIAN:
+                pld = pld_lib.from_gaussian_mechanism(
+                    scale,
+                    value_discretization_interval=self._pld_discretization)
+            elif mtype == MechanismType.GENERIC:
+                eps0 = math.sqrt(2) / noise_standard_deviation
+                delta0 = eps0 / self._total_epsilon * self._total_delta
+                pld = pld_lib.from_privacy_parameters(
+                    eps0,
+                    delta0,
+                    value_discretization_interval=self._pld_discretization)
+            else:
+                raise NotImplementedError(
+                    f"PLD accounting for mechanism type {mtype} is not "
+                    f"supported.")
+            composed = pld if composed is None else composed.compose(pld)
+        return composed

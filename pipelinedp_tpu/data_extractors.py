@@ -1,0 +1,51 @@
+"""Row -> (privacy_id, partition_key, value) projection specs.
+
+Parity: pipeline_dp/data_extractors.py (reference: data_extractors.py:5-37).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Any, Optional
+
+
+@dataclasses.dataclass
+class DataExtractors:
+    """Functions projecting an input row onto the three DP-relevant columns.
+
+    ``privacy_id_extractor`` maps a row to the unit of privacy (e.g. user id),
+    ``partition_key_extractor`` to the group-by key, ``value_extractor`` to the
+    numeric value being aggregated (may be None for COUNT-only pipelines).
+    """
+    privacy_id_extractor: Optional[Callable[[Any], Any]] = None
+    partition_key_extractor: Optional[Callable[[Any], Any]] = None
+    value_extractor: Optional[Callable[[Any], Any]] = None
+
+
+@dataclasses.dataclass
+class PreAggregateExtractors:
+    """Extractors for pre-aggregated input rows.
+
+    Pre-aggregated rows carry ``(partition_key, (count, sum, n_partitions,
+    n_contributions))`` — the output format of ``analysis.pre_aggregation``.
+    Parity: data_extractors.py:18-37.
+    """
+    partition_extractor: Callable[[Any], Any]
+    preaggregate_extractor: Callable[[Any], Any]
+
+
+@dataclasses.dataclass
+class MultiValueDataExtractors(DataExtractors):
+    """Extractors producing a tuple of values per row (multi-column SUM).
+
+    Each extractor in ``value_extractors`` yields one scalar; rows are mapped
+    to tuples. Mirrors the multi-column aggregation support of the reference
+    dataframes API (dataframes.py:167-244).
+    """
+    value_extractors: tuple = ()
+
+    def __post_init__(self):
+        if self.value_extractors and self.value_extractor is None:
+            extractors = tuple(self.value_extractors)
+            self.value_extractor = lambda row: tuple(
+                e(row) for e in extractors)

@@ -1,0 +1,683 @@
+"""Differential-privacy computations: mechanisms, sensitivities, DP
+mean/variance algorithms, exponential mechanism, thresholding.
+
+Parity: pipeline_dp/dp_computations.py (ScalarNoiseParams :28, compute_middle
+:71, L1/L2 sensitivity :78-103, compute_sigma :106, Laplace/Gaussian
+application :119-151, AdditiveVectorNoiseParams/_clip_vector/add_noise_vector
+:186-229, equally_split_budget :232, compute_dp_var :306-365, noise-std
+helpers :368-394, AdditiveMechanism :397, LaplaceMechanism :430,
+GaussianMechanism :480, MeanMechanism :540-575, Sensitivities :578-618,
+create_additive_mechanism :621, create_mean_mechanism :649,
+ExponentialMechanism :661-715, compute_sensitivities_* :718-771,
+ThresholdingMechanism :774-825, create_thresholding_mechanism :828).
+
+Where the reference calls PyDP C++ mechanism objects, this module calls the
+native noise core (pipelinedp_tpu/noise_core.py); batched device-side
+equivalents are in pipelinedp_tpu/ops/noise.py.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import math
+from typing import Any, List, Optional, Tuple, Union
+
+import numpy as np
+
+from pipelinedp_tpu import budget_accounting
+from pipelinedp_tpu import noise_core
+from pipelinedp_tpu import partition_selection
+from pipelinedp_tpu.aggregate_params import (AggregateParams, Metric, Metrics,
+                                             NoiseKind, NormKind,
+                                             PartitionSelectionStrategy)
+
+
+@dataclasses.dataclass
+class ScalarNoiseParams:
+    """Parameters for computing DP count/sum/mean/variance."""
+
+    eps: float
+    delta: float
+    min_value: Optional[float]
+    max_value: Optional[float]
+    min_sum_per_partition: Optional[float]
+    max_sum_per_partition: Optional[float]
+    max_partitions_contributed: int
+    max_contributions_per_partition: Optional[int]
+    noise_kind: NoiseKind
+
+    def __post_init__(self):
+        assert (self.min_value is None) == (self.max_value is None), (
+            "min_value and max_value should be both set or both None.")
+        assert (self.min_sum_per_partition is None) == (
+            self.max_sum_per_partition is None), (
+                "min_sum_per_partition and max_sum_per_partition should be "
+                "both set or both None.")
+
+    def l0_sensitivity(self) -> int:
+        return self.max_partitions_contributed
+
+    @property
+    def bounds_per_contribution_are_set(self) -> bool:
+        return self.min_value is not None and self.max_value is not None
+
+    @property
+    def bounds_per_partition_are_set(self) -> bool:
+        return (self.min_sum_per_partition is not None and
+                self.max_sum_per_partition is not None)
+
+
+def compute_squares_interval(min_value: float,
+                             max_value: float) -> Tuple[float, float]:
+    """Range of x^2 for x in [min_value, max_value]."""
+    if min_value < 0 < max_value:
+        return 0.0, max(min_value**2, max_value**2)
+    return min_value**2, max_value**2
+
+
+def compute_middle(min_value: float, max_value: float) -> float:
+    """Midpoint, computed overflow-safely."""
+    return min_value + (max_value - min_value) / 2
+
+
+def compute_l1_sensitivity(l0_sensitivity: float,
+                           linf_sensitivity: float) -> float:
+    return l0_sensitivity * linf_sensitivity
+
+
+def compute_l2_sensitivity(l0_sensitivity: float,
+                           linf_sensitivity: float) -> float:
+    return math.sqrt(l0_sensitivity) * linf_sensitivity
+
+
+def compute_sigma(eps: float, delta: float, l2_sensitivity: float) -> float:
+    """Optimal Gaussian sigma (analytic Gaussian mechanism)."""
+    return noise_core.analytic_gaussian_sigma(eps, delta, l2_sensitivity)
+
+
+def apply_laplace_mechanism(value: float, eps: float,
+                            l1_sensitivity: float) -> float:
+    return noise_core.add_laplace_noise(
+        value, noise_core.laplace_diversity(eps, l1_sensitivity))
+
+
+def apply_gaussian_mechanism(value: float, eps: float, delta: float,
+                             l2_sensitivity: float) -> float:
+    return noise_core.add_gaussian_noise(
+        value, compute_sigma(eps, delta, l2_sensitivity))
+
+
+def _add_random_noise(value: float, eps: float, delta: float,
+                      l0_sensitivity: float, linf_sensitivity: float,
+                      noise_kind: NoiseKind) -> float:
+    if noise_kind == NoiseKind.LAPLACE:
+        return apply_laplace_mechanism(
+            value, eps, compute_l1_sensitivity(l0_sensitivity,
+                                               linf_sensitivity))
+    if noise_kind == NoiseKind.GAUSSIAN:
+        return apply_gaussian_mechanism(
+            value, eps, delta,
+            compute_l2_sensitivity(l0_sensitivity, linf_sensitivity))
+    raise ValueError("Noise kind must be either Laplace or Gaussian.")
+
+
+# ---------------------------------------------------------------------------
+# Vector sums
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class AdditiveVectorNoiseParams:
+    eps_per_coordinate: float
+    delta_per_coordinate: float
+    max_norm: float
+    l0_sensitivity: float
+    linf_sensitivity: float
+    norm_kind: NormKind
+    noise_kind: NoiseKind
+
+
+def _clip_vector(vec: np.ndarray, max_norm: float,
+                 norm_kind: NormKind) -> np.ndarray:
+    kind = norm_kind.value
+    if kind == "linf":
+        return np.clip(vec, -max_norm, max_norm)
+    if kind in ("l1", "l2"):
+        norm = np.linalg.norm(vec, ord=int(kind[-1]))
+        if norm == 0:
+            return vec
+        return vec * min(1.0, max_norm / norm)
+    raise NotImplementedError(
+        f"Vector norm of kind '{kind}' is not supported.")
+
+
+def add_noise_vector(vec: np.ndarray,
+                     noise_params: AdditiveVectorNoiseParams) -> np.ndarray:
+    """Clips the vector to max_norm and noises each coordinate."""
+    vec = _clip_vector(np.asarray(vec, dtype=np.float64),
+                       noise_params.max_norm, noise_params.norm_kind)
+    return np.array([
+        _add_random_noise(v, noise_params.eps_per_coordinate,
+                          noise_params.delta_per_coordinate,
+                          noise_params.l0_sensitivity,
+                          noise_params.linf_sensitivity,
+                          noise_params.noise_kind) for v in vec
+    ])
+
+
+def equally_split_budget(eps: float, delta: float,
+                         no_mechanisms: int) -> List[Tuple[float, float]]:
+    """Splits (eps, delta) into no_mechanisms equal parts; the last part takes
+    the floating-point remainder so the parts sum exactly."""
+    if no_mechanisms <= 0:
+        raise ValueError("The number of mechanisms must be a positive integer.")
+    eps_used = delta_used = 0.0
+    budgets = []
+    for _ in range(no_mechanisms - 1):
+        budgets.append((eps / no_mechanisms, delta / no_mechanisms))
+        eps_used += eps / no_mechanisms
+        delta_used += delta / no_mechanisms
+    budgets.append((eps - eps_used, delta - delta_used))
+    return budgets
+
+
+# ---------------------------------------------------------------------------
+# DP variance (budget split across count / normalized sum / sum of squares)
+# ---------------------------------------------------------------------------
+
+
+def _compute_mean_for_normalized_sum(dp_count: float, sum_: float,
+                                     min_value: float, max_value: float,
+                                     eps: float, delta: float,
+                                     l0_sensitivity: float,
+                                     max_contributions_per_partition: float,
+                                     noise_kind: NoiseKind) -> float:
+    """DP mean of a normalized sum, dividing by a clamped DP count."""
+    if min_value == max_value:
+        return min_value
+    middle = compute_middle(min_value, max_value)
+    linf_sensitivity = max_contributions_per_partition * abs(middle - min_value)
+    dp_normalized_sum = _add_random_noise(sum_, eps, delta, l0_sensitivity,
+                                          linf_sensitivity, noise_kind)
+    return dp_normalized_sum / max(1.0, dp_count)
+
+
+def compute_dp_var(count: int, normalized_sum: float,
+                   normalized_sum_squares: float,
+                   dp_params: ScalarNoiseParams):
+    """DP (count, sum, mean, variance) from raw moments.
+
+    Budget is split equally between count, normalized sum, and normalized sum
+    of squares; variance = E[x^2] - E[x]^2 on the noised normalized moments.
+    """
+    ((count_eps, count_delta), (sum_eps, sum_delta),
+     (sq_eps, sq_delta)) = equally_split_budget(dp_params.eps, dp_params.delta,
+                                                3)
+    l0 = dp_params.l0_sensitivity()
+
+    dp_count = _add_random_noise(count, count_eps, count_delta, l0,
+                                 dp_params.max_contributions_per_partition,
+                                 dp_params.noise_kind)
+    dp_mean = _compute_mean_for_normalized_sum(
+        dp_count, normalized_sum, dp_params.min_value, dp_params.max_value,
+        sum_eps, sum_delta, l0, dp_params.max_contributions_per_partition,
+        dp_params.noise_kind)
+    sq_min, sq_max = compute_squares_interval(dp_params.min_value,
+                                              dp_params.max_value)
+    dp_mean_squares = _compute_mean_for_normalized_sum(
+        dp_count, normalized_sum_squares, sq_min, sq_max, sq_eps, sq_delta,
+        l0, dp_params.max_contributions_per_partition, dp_params.noise_kind)
+    dp_var = dp_mean_squares - dp_mean**2
+    if dp_params.min_value != dp_params.max_value:
+        dp_mean += compute_middle(dp_params.min_value, dp_params.max_value)
+    return dp_count, dp_mean * dp_count, dp_mean, dp_var
+
+
+def _compute_noise_std(linf_sensitivity: float,
+                       dp_params: ScalarNoiseParams) -> float:
+    if dp_params.noise_kind == NoiseKind.LAPLACE:
+        l1 = compute_l1_sensitivity(dp_params.l0_sensitivity(),
+                                    linf_sensitivity)
+        return noise_core.laplace_diversity(dp_params.eps, l1) * math.sqrt(2)
+    if dp_params.noise_kind == NoiseKind.GAUSSIAN:
+        l2 = compute_l2_sensitivity(dp_params.l0_sensitivity(),
+                                    linf_sensitivity)
+        return compute_sigma(dp_params.eps, dp_params.delta, l2)
+    raise ValueError("Only Laplace and Gaussian noise is supported.")
+
+
+def compute_dp_count_noise_std(dp_params: ScalarNoiseParams) -> float:
+    return _compute_noise_std(dp_params.max_contributions_per_partition,
+                              dp_params)
+
+
+def compute_dp_sum_noise_std(dp_params: ScalarNoiseParams) -> float:
+    linf = max(abs(dp_params.min_sum_per_partition),
+               abs(dp_params.max_sum_per_partition))
+    return _compute_noise_std(linf, dp_params)
+
+
+# ---------------------------------------------------------------------------
+# Mechanism objects
+# ---------------------------------------------------------------------------
+
+
+class AdditiveMechanism(abc.ABC):
+    """An additive noise mechanism (Laplace or Gaussian)."""
+
+    @abc.abstractmethod
+    def add_noise(self, value: Union[int, float]) -> float:
+        """Anonymizes value by adding noise."""
+
+    def add_noise_vectorized(self, values: np.ndarray) -> np.ndarray:
+        """Batched add_noise over a numpy array (used by vectorized paths)."""
+        return np.array([self.add_noise(float(v)) for v in values])
+
+    @property
+    @abc.abstractmethod
+    def noise_kind(self) -> NoiseKind:
+        ...
+
+    @property
+    @abc.abstractmethod
+    def noise_parameter(self) -> float:
+        """Distribution parameter (Laplace scale b / Gaussian sigma)."""
+
+    @property
+    @abc.abstractmethod
+    def std(self) -> float:
+        ...
+
+    @property
+    @abc.abstractmethod
+    def sensitivity(self) -> float:
+        ...
+
+    @abc.abstractmethod
+    def describe(self) -> str:
+        """One-line description for explain-computation reports."""
+
+
+class LaplaceMechanism(AdditiveMechanism):
+
+    def __init__(self, epsilon: float, l1_sensitivity: float):
+        self._epsilon = epsilon
+        self._l1_sensitivity = l1_sensitivity
+        self._scale = noise_core.laplace_diversity(epsilon, l1_sensitivity)
+
+    @classmethod
+    def create_from_epsilon(cls, epsilon: float,
+                            l1_sensitivity: float) -> "LaplaceMechanism":
+        return cls(epsilon, l1_sensitivity)
+
+    @classmethod
+    def create_from_std_deviation(cls, normalized_stddev: float,
+                                  l1_sensitivity: float) -> "LaplaceMechanism":
+        """normalized_stddev: std divided by l1_sensitivity."""
+        b = normalized_stddev / math.sqrt(2)
+        return cls(1.0 / b, l1_sensitivity)
+
+    def add_noise(self, value: Union[int, float]) -> float:
+        return noise_core.add_laplace_noise(float(value), self._scale)
+
+    def add_noise_vectorized(self, values: np.ndarray) -> np.ndarray:
+        g = noise_core.laplace_granularity(self._scale)
+        snapped = noise_core.round_to_granularity(
+            np.asarray(values, dtype=np.float64), g)
+        return snapped + noise_core.sample_laplace(self._scale,
+                                                   size=snapped.shape)
+
+    @property
+    def epsilon(self) -> float:
+        return self._epsilon
+
+    @property
+    def noise_parameter(self) -> float:
+        return self._scale
+
+    @property
+    def std(self) -> float:
+        return self._scale * math.sqrt(2)
+
+    @property
+    def noise_kind(self) -> NoiseKind:
+        return NoiseKind.LAPLACE
+
+    @property
+    def sensitivity(self) -> float:
+        return self._l1_sensitivity
+
+    def describe(self) -> str:
+        return (f"Laplace mechanism:  parameter={self.noise_parameter}  eps="
+                f"{self._epsilon}  l1_sensitivity={self.sensitivity}")
+
+
+class GaussianMechanism(AdditiveMechanism):
+
+    def __init__(self, sigma: float, l2_sensitivity: float,
+                 epsilon: float = 0.0, delta: float = 0.0):
+        self._sigma = sigma
+        self._l2_sensitivity = l2_sensitivity
+        self._epsilon = epsilon
+        self._delta = delta
+
+    @classmethod
+    def create_from_epsilon_delta(cls, epsilon: float, delta: float,
+                                  l2_sensitivity: float) -> "GaussianMechanism":
+        sigma = noise_core.analytic_gaussian_sigma(epsilon, delta,
+                                                   l2_sensitivity)
+        return cls(sigma, l2_sensitivity, epsilon, delta)
+
+    @classmethod
+    def create_from_std_deviation(cls, normalized_stddev: float,
+                                  l2_sensitivity: float) -> "GaussianMechanism":
+        """normalized_stddev: std divided by l2_sensitivity."""
+        return cls(normalized_stddev * l2_sensitivity, l2_sensitivity)
+
+    def add_noise(self, value: Union[int, float]) -> float:
+        return noise_core.add_gaussian_noise(float(value), self._sigma)
+
+    def add_noise_vectorized(self, values: np.ndarray) -> np.ndarray:
+        g = noise_core.gaussian_granularity(self._sigma)
+        snapped = noise_core.round_to_granularity(
+            np.asarray(values, dtype=np.float64), g)
+        return snapped + noise_core.sample_gaussian(self._sigma,
+                                                    size=snapped.shape)
+
+    @property
+    def epsilon(self) -> float:
+        return self._epsilon
+
+    @property
+    def delta(self) -> float:
+        return self._delta
+
+    @property
+    def noise_kind(self) -> NoiseKind:
+        return NoiseKind.GAUSSIAN
+
+    @property
+    def noise_parameter(self) -> float:
+        return self._sigma
+
+    @property
+    def std(self) -> float:
+        return self._sigma
+
+    @property
+    def sensitivity(self) -> float:
+        return self._l2_sensitivity
+
+    def describe(self) -> str:
+        if self._epsilon > 0:
+            eps_delta_str = f"eps={self._epsilon}  delta={self._delta}  "
+        else:
+            eps_delta_str = ""
+        return (f"Gaussian mechanism:  parameter={self.noise_parameter}"
+                f"  {eps_delta_str}l2_sensitivity={self.sensitivity}")
+
+
+class MeanMechanism:
+    """DP mean via the normalized-sum trick.
+
+    normalized_sum = sum(x_i - mid) with mid = (min+max)/2 has Linf
+    sensitivity (max-min)/2 * max_contributions — smaller than the raw sum's
+    max(|min|,|max|) * max_contributions. dp_mean = mid +
+    dp_normalized_sum / max(1, dp_count).
+    """
+
+    def __init__(self, range_middle: float, count_mechanism: AdditiveMechanism,
+                 sum_mechanism: AdditiveMechanism):
+        self._range_middle = range_middle
+        self._count_mechanism = count_mechanism
+        self._sum_mechanism = sum_mechanism
+
+    def compute_mean(self, count: float, normalized_sum: float):
+        dp_count = self._count_mechanism.add_noise(count)
+        denominator = max(1.0, dp_count)
+        dp_normalized_sum = self._sum_mechanism.add_noise(normalized_sum)
+        dp_mean = self._range_middle + dp_normalized_sum / denominator
+        return dp_count, dp_mean * dp_count, dp_mean
+
+    def describe(self) -> str:
+        return (f"    a. Computed 'normalized_sum' = sum of (value - "
+                f"{self._range_middle})\n"
+                f"    b. Applied to 'count' {self._count_mechanism.describe()}\n"
+                f"    c. Applied to 'normalized_sum' "
+                f"{self._sum_mechanism.describe()}")
+
+
+@dataclasses.dataclass
+class Sensitivities:
+    """L0/Linf/L1/L2 sensitivities with consistency validation."""
+    l0: Optional[int] = None
+    linf: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+
+    def __post_init__(self):
+        for name in ("l0", "linf", "l1", "l2"):
+            value = getattr(self, name)
+            if value is not None and value <= 0:
+                raise ValueError(
+                    f"{name.capitalize()} must be positive, but {value} given.")
+        if (self.l0 is None) != (self.linf is None):
+            raise ValueError("l0 and linf sensitivities must be either both "
+                             "set or both unset.")
+        if self.l0 is not None:
+            l1 = compute_l1_sensitivity(self.l0, self.linf)
+            if self.l1 is None:
+                self.l1 = l1
+            elif abs(l1 - self.l1) > 1e-12:
+                raise ValueError(f"L1={self.l1} != L0*Linf={l1}")
+            l2 = compute_l2_sensitivity(self.l0, self.linf)
+            if self.l2 is None:
+                self.l2 = l2
+            elif abs(l2 - self.l2) > 1e-12:
+                raise ValueError(f"L2={self.l2} != sqrt(L0)*Linf={l2}")
+
+
+def create_additive_mechanism(
+        mechanism_spec: budget_accounting.MechanismSpec,
+        sensitivities: Sensitivities) -> AdditiveMechanism:
+    """Builds the mechanism from a resolved budget spec + sensitivities."""
+    noise_kind = mechanism_spec.mechanism_type.to_noise_kind()
+    if noise_kind == NoiseKind.LAPLACE:
+        if sensitivities.l1 is None:
+            raise ValueError("L1 or (L0 and Linf) sensitivities must be set "
+                             "for Laplace mechanism.")
+        if mechanism_spec.standard_deviation_is_set:
+            return LaplaceMechanism.create_from_std_deviation(
+                mechanism_spec.noise_standard_deviation, sensitivities.l1)
+        return LaplaceMechanism.create_from_epsilon(mechanism_spec.eps,
+                                                    sensitivities.l1)
+    if noise_kind == NoiseKind.GAUSSIAN:
+        if sensitivities.l2 is None:
+            raise ValueError("L2 or (L0 and Linf) sensitivities must be set "
+                             "for Gaussian mechanism.")
+        if mechanism_spec.standard_deviation_is_set:
+            return GaussianMechanism.create_from_std_deviation(
+                mechanism_spec.noise_standard_deviation, sensitivities.l2)
+        return GaussianMechanism.create_from_epsilon_delta(
+            mechanism_spec.eps, mechanism_spec.delta, sensitivities.l2)
+    raise ValueError(f"{noise_kind} not supported.")
+
+
+def create_mean_mechanism(
+        range_middle: float, count_spec: budget_accounting.MechanismSpec,
+        count_sensitivities: Sensitivities,
+        normalized_sum_spec: budget_accounting.MechanismSpec,
+        normalized_sum_sensitivities: Sensitivities) -> MeanMechanism:
+    return MeanMechanism(
+        range_middle,
+        create_additive_mechanism(count_spec, count_sensitivities),
+        create_additive_mechanism(normalized_sum_spec,
+                                  normalized_sum_sensitivities))
+
+
+# ---------------------------------------------------------------------------
+# Exponential mechanism
+# ---------------------------------------------------------------------------
+
+
+class ExponentialMechanism:
+    """Chooses one of a finite set of candidates with probability
+    proportional to exp(eps * score / (2 * sensitivity)) (the factor 2 is
+    dropped for monotonic scoring functions). In-memory only."""
+
+    class ScoringFunction(abc.ABC):
+
+        @abc.abstractmethod
+        def score(self, k) -> float:
+            """Higher score => higher selection probability."""
+
+        @property
+        @abc.abstractmethod
+        def global_sensitivity(self) -> float:
+            ...
+
+        @property
+        @abc.abstractmethod
+        def is_monotonic(self) -> bool:
+            """Whether neighboring datasets move all scores one direction."""
+
+    _rng = np.random.default_rng()
+
+    @classmethod
+    def seed_rng(cls, seed: Optional[int]) -> None:
+        """Reseeds the selection RNG (tests only)."""
+        cls._rng = np.random.default_rng(seed)
+
+    def __init__(self, scoring_function: "ExponentialMechanism.ScoringFunction"):
+        self._scoring_function = scoring_function
+
+    def apply(self, eps: float, inputs_to_score_col: List[Any]) -> Any:
+        probs = self._calculate_probabilities(eps, inputs_to_score_col)
+        index = ExponentialMechanism._rng.choice(len(inputs_to_score_col),
+                                                 p=probs)
+        return inputs_to_score_col[index]
+
+    def _calculate_probabilities(self, eps: float,
+                                 inputs_to_score_col: List[Any]) -> np.ndarray:
+        scores = np.array(
+            [self._scoring_function.score(k) for k in inputs_to_score_col],
+            dtype=np.float64)
+        denominator = self._scoring_function.global_sensitivity
+        if not self._scoring_function.is_monotonic:
+            denominator *= 2
+        # Subtract max for numerical stability (invariant under softmax).
+        weights = np.exp((scores - scores.max()) * eps / denominator)
+        return weights / weights.sum()
+
+
+# ---------------------------------------------------------------------------
+# Per-metric sensitivities
+# ---------------------------------------------------------------------------
+
+
+def compute_sensitivities_for_count(params: AggregateParams) -> Sensitivities:
+    if params.max_contributions is not None:
+        return Sensitivities(l1=params.max_contributions,
+                             l2=params.max_contributions)
+    return Sensitivities(l0=params.max_partitions_contributed,
+                         linf=params.max_contributions_per_partition)
+
+
+def compute_sensitivities_for_privacy_id_count(
+        params: AggregateParams) -> Sensitivities:
+    if params.max_contributions is not None:
+        return Sensitivities(l1=params.max_contributions,
+                             l2=math.sqrt(params.max_contributions))
+    return Sensitivities(l0=params.max_partitions_contributed, linf=1)
+
+
+def compute_sensitivities_for_sum(params: AggregateParams) -> Sensitivities:
+    if params.bounds_per_contribution_are_set:
+        max_abs = max(abs(params.min_value), abs(params.max_value))
+        if params.max_contributions:
+            l1_l2 = max_abs * params.max_contributions
+            return Sensitivities(l1=l1_l2, l2=l1_l2)
+        linf = max_abs * params.max_contributions_per_partition
+    else:
+        linf = max(abs(params.min_sum_per_partition),
+                   abs(params.max_sum_per_partition))
+    return Sensitivities(l0=params.max_partitions_contributed, linf=linf)
+
+
+def compute_sensitivities(metric: Metric,
+                          params: AggregateParams) -> Sensitivities:
+    if metric == Metrics.COUNT:
+        return compute_sensitivities_for_count(params)
+    if metric == Metrics.PRIVACY_ID_COUNT:
+        return compute_sensitivities_for_privacy_id_count(params)
+    if metric == Metrics.SUM:
+        return compute_sensitivities_for_sum(params)
+    raise ValueError(f"Sensitivity computations for {metric} not supported")
+
+
+def compute_sensitivities_for_normalized_sum(
+        params: AggregateParams) -> Sensitivities:
+    max_abs = (params.max_value - params.min_value) / 2
+    if params.max_contributions:
+        l1_l2 = max_abs * params.max_contributions
+        return Sensitivities(l1=l1_l2, l2=l1_l2)
+    return Sensitivities(l0=params.max_partitions_contributed,
+                         linf=max_abs * params.max_contributions_per_partition)
+
+
+# ---------------------------------------------------------------------------
+# Thresholding mechanism (post-aggregation partition selection)
+# ---------------------------------------------------------------------------
+
+
+class ThresholdingMechanism:
+    """Noises a privacy-unit count and keeps it only above a threshold.
+
+    Steps 2-3 of the (Laplace/Gaussian) thresholding algorithm: noise with
+    stddev from (eps, delta, l0_sensitivity), threshold from delta (per
+    Delta_For_Thresholding.pdf).
+    """
+
+    def __init__(self, epsilon: float, delta: float,
+                 strategy: PartitionSelectionStrategy, l0_sensitivity: int,
+                 pre_threshold: Optional[int]):
+        self._strategy_type = strategy
+        self._pre_threshold = pre_threshold
+        self._thresholding_strategy = (
+            partition_selection.create_partition_selection_strategy(
+                strategy, epsilon, delta, l0_sensitivity, pre_threshold))
+
+    def noised_value_if_should_keep(
+            self, num_privacy_units: int) -> Optional[float]:
+        return self._thresholding_strategy.noised_value_if_should_keep(
+            num_privacy_units)
+
+    def describe(self) -> str:
+        eps = self._thresholding_strategy.epsilon
+        delta = self._thresholding_strategy.delta
+        threshold = self._thresholding_strategy.threshold
+        text = (f"{self._strategy_type.value} with threshold={threshold:.1f} "
+                f"eps={eps} delta={delta}")
+        if self._pre_threshold is not None:
+            text += f" and pre_threshold={self._pre_threshold}"
+        return text
+
+    def threshold(self) -> float:
+        return self._thresholding_strategy.threshold
+
+    @property
+    def strategy(self) -> partition_selection.PartitionSelection:
+        return self._thresholding_strategy
+
+
+def create_thresholding_mechanism(
+        mechanism_spec: budget_accounting.MechanismSpec,
+        sensitivities: Sensitivities,
+        pre_threshold: Optional[int]) -> ThresholdingMechanism:
+    strategy = mechanism_spec.mechanism_type.to_partition_selection_strategy()
+    return ThresholdingMechanism(epsilon=mechanism_spec.eps,
+                                 delta=mechanism_spec.delta,
+                                 strategy=strategy,
+                                 l0_sensitivity=sensitivities.l0,
+                                 pre_threshold=pre_threshold)

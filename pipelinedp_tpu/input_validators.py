@@ -1,0 +1,52 @@
+"""Validation helpers shared across the public API surface.
+
+Parity: pipeline_dp/input_validators.py (reference: input_validators.py:17-35).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+
+def validate_epsilon_delta(epsilon: float, delta: float, who: str) -> None:
+    """Validates an (epsilon, delta) differential-privacy budget.
+
+    Raises ValueError unless epsilon > 0 and 0 <= delta < 1 (both finite).
+    """
+    for name, value in (("epsilon", epsilon), ("delta", delta)):
+        if value is None:
+            raise ValueError(f"{who}: {name} must not be None.")
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            raise TypeError(
+                f"{who}: {name} must be a number, got {type(value).__name__}.")
+        if math.isnan(value) or math.isinf(value):
+            raise ValueError(f"{who}: {name} must be finite, got {value}.")
+    if epsilon <= 0:
+        raise ValueError(f"{who}: epsilon must be positive, got {epsilon}.")
+    if delta < 0:
+        raise ValueError(f"{who}: delta must be non-negative, got {delta}.")
+    if delta >= 1:
+        raise ValueError(f"{who}: delta must be < 1, got {delta}.")
+
+
+def validate_positive_int(value: Any, name: str, who: str) -> None:
+    if value is None:
+        raise ValueError(f"{who}: {name} must not be None.")
+    if isinstance(value, bool) or not isinstance(value, int):
+        raise TypeError(
+            f"{who}: {name} must be an int, got {type(value).__name__}.")
+    if value <= 0:
+        raise ValueError(f"{who}: {name} must be positive, got {value}.")
+
+
+def validate_non_negative_number(value: Any, name: str, who: str) -> None:
+    if value is None:
+        raise ValueError(f"{who}: {name} must not be None.")
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        raise TypeError(
+            f"{who}: {name} must be a number, got {type(value).__name__}.")
+    if math.isnan(value):
+        raise ValueError(f"{who}: {name} must not be NaN.")
+    if value < 0:
+        raise ValueError(f"{who}: {name} must be non-negative, got {value}.")

@@ -1,0 +1,168 @@
+"""Scalar noise core: calibration + sampling for the host-side (driver) path.
+
+Role in the stack: this module is the Python-visible surface of the L0
+"native DP primitives" layer (reference reaches it through PyDP's pybind11
+wrapper over Google's C++ differential-privacy library —
+dp_computations.py:25, see SURVEY.md §2.4). Noise calibration (sigma for the
+analytic Gaussian mechanism, Laplace diversity) lives here in pure
+float math; *sampling* will be delegated to the native C++ library
+(pipelinedp_tpu/native, see its loader once built) when available, with the
+numpy fallback below as the default.
+
+Security note (why a native library exists at all): naive float Laplace
+sampling leaks information through the floating-point representation
+(Mironov 2012, "On significance of the least significant bits for
+differential privacy"). The mitigations implemented natively are the
+snapping/granularity construction: noise is sampled as an *integer* multiple
+of a power-of-two granularity (a discrete Laplace / discrete Gaussian), and
+the value is rounded to the same granularity before adding. The numpy
+fallback implements the same granularity snapping on top of numpy's float
+samplers — distributions match, bit-level security guarantees require the
+native path.
+
+The TPU bulk path (pipelinedp_tpu/ops/noise.py, built alongside the JAX
+backend) applies the same snapping scheme with JAX's counter-based threefry
+PRNG.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+from scipy import stats
+
+# 2^-40: relative granularity for Laplace snapping (matches the construction
+# used by Google's C++ library: granularity = next power of two of scale/2^40).
+_LAPLACE_GRANULARITY_BITS = 40
+# 2^-57 for Gaussian.
+_GAUSSIAN_GRANULARITY_BITS = 57
+
+
+def next_power_of_two(x: float) -> float:
+    """Smallest power of two >= x (x > 0). Exact for float64."""
+    if x <= 0 or not math.isfinite(x):
+        raise ValueError(f"next_power_of_two requires finite x > 0, got {x}")
+    mantissa, exponent = math.frexp(x)  # x = mantissa * 2**exponent
+    if mantissa == 0.5:
+        return x
+    return math.ldexp(1.0, exponent)
+
+
+def laplace_granularity(scale: float) -> float:
+    return next_power_of_two(
+        max(scale, 2.0**-_LAPLACE_GRANULARITY_BITS) *
+        2.0**-_LAPLACE_GRANULARITY_BITS)
+
+
+def gaussian_granularity(stddev: float) -> float:
+    return next_power_of_two(
+        max(stddev, 2.0**-_GAUSSIAN_GRANULARITY_BITS) *
+        2.0**-_GAUSSIAN_GRANULARITY_BITS)
+
+
+def round_to_granularity(value, granularity: float):
+    return np.round(value / granularity) * granularity
+
+
+# ---------------------------------------------------------------------------
+# Calibration
+# ---------------------------------------------------------------------------
+
+
+def gaussian_delta(sigma: float, eps: float, l2_sensitivity: float) -> float:
+    """delta achieved by a Gaussian mechanism (exact analytic expression).
+
+    delta = Phi(s/(2 sigma) - eps sigma/s) - e^eps Phi(-s/(2 sigma) - eps
+    sigma/s), per Balle & Wang, "Improving the Gaussian mechanism for
+    differential privacy" (arXiv:1805.06530) — the calibration the reference
+    uses via PyDP (dp_computations.py:116, cited at
+    private_contribution_bounds.py:126).
+    """
+    s = l2_sensitivity
+    a = s / (2.0 * sigma)
+    b = eps * sigma / s
+    return float(
+        stats.norm.cdf(a - b) - math.exp(eps) * stats.norm.cdf(-a - b))
+
+
+def analytic_gaussian_sigma(eps: float, delta: float,
+                            l2_sensitivity: float) -> float:
+    """Minimal sigma with gaussian_delta(sigma) <= delta (binary search)."""
+    if eps <= 0:
+        raise ValueError(f"eps must be positive, got {eps}")
+    if not 0 < delta < 1:
+        raise ValueError(f"delta must be in (0, 1), got {delta}")
+    if l2_sensitivity <= 0:
+        raise ValueError(
+            f"l2_sensitivity must be positive, got {l2_sensitivity}")
+    # Bracket: classical sigma = sqrt(2 ln(1.25/delta)) * s / eps always works
+    # for eps <= 1; double until valid for the general case.
+    hi = math.sqrt(2.0 * math.log(1.25 / delta)) * l2_sensitivity / eps
+    while gaussian_delta(hi, eps, l2_sensitivity) > delta:
+        hi *= 2.0
+    lo = hi / 2.0**20
+    if gaussian_delta(lo, eps, l2_sensitivity) <= delta:
+        return lo
+    for _ in range(200):
+        mid = 0.5 * (lo + hi)
+        if gaussian_delta(mid, eps, l2_sensitivity) <= delta:
+            hi = mid
+        else:
+            lo = mid
+        if hi - lo <= 1e-12 * hi:
+            break
+    return hi
+
+
+def laplace_diversity(eps: float, l1_sensitivity: float) -> float:
+    """Laplace scale parameter b = l1_sensitivity / eps."""
+    if eps <= 0:
+        raise ValueError(f"eps must be positive, got {eps}")
+    return l1_sensitivity / eps
+
+
+# ---------------------------------------------------------------------------
+# Sampling (numpy fallback; native override installed by native/loader.py)
+# ---------------------------------------------------------------------------
+
+_rng = np.random.default_rng()
+
+
+def seed_fallback_rng(seed: Optional[int]) -> None:
+    """Reseeds the numpy fallback RNG (tests only)."""
+    global _rng
+    _rng = np.random.default_rng(seed)
+
+
+def _fallback_laplace(scale: float, size=None):
+    g = laplace_granularity(scale)
+    raw = _rng.laplace(0.0, scale, size)
+    return round_to_granularity(raw, g)
+
+
+def _fallback_gaussian(stddev: float, size=None):
+    g = gaussian_granularity(stddev)
+    raw = _rng.normal(0.0, stddev, size)
+    return round_to_granularity(raw, g)
+
+
+# Hook points: the native loader replaces these with C++ implementations.
+sample_laplace = _fallback_laplace
+sample_gaussian = _fallback_gaussian
+
+
+def using_native_sampling() -> bool:
+    return sample_laplace is not _fallback_laplace
+
+
+def add_laplace_noise(value: float, scale: float) -> float:
+    """value snapped to granularity + secure Laplace noise."""
+    g = laplace_granularity(scale)
+    return float(round_to_granularity(value, g) + sample_laplace(scale))
+
+
+def add_gaussian_noise(value: float, stddev: float) -> float:
+    g = gaussian_granularity(stddev)
+    return float(round_to_granularity(value, g) + sample_gaussian(stddev))

@@ -1,0 +1,328 @@
+"""Private partition selection strategies.
+
+The reference reaches these through PyDP's C++ objects
+(pipeline_dp/partition_selection.py:16-44; used at dp_engine.py:355,
+dp_computations.py:804, analysis/per_partition_combiners.py:134). This module
+implements the same three strategies natively, exposing the same surface:
+``should_keep(n)``, ``probability_of_keep(n)``,
+``noised_value_if_should_keep(n)``, ``threshold``, ``epsilon``, ``delta`` —
+plus vectorized forms (``probability_of_keep_vec``, and precomputed
+threshold/scale scalars) that the JAX backend feeds into batched kernels so
+the hot path stays on device.
+
+Strategies:
+
+* ``TruncatedGeometricPartitionSelection`` — the optimal "magic" partition
+  selection of Desfontaines, Voss & Lam, "Differentially private partition
+  selection" (PoPETs 2022). Keep probabilities follow the saturated
+  recurrence  pi_{n+1} = min(e^eps' pi_n + delta', 1 - e^-eps'(1 - pi_n -
+  delta'), 1)  with per-partition eps' = eps/m and delta' = 1-(1-delta)^(1/m)
+  for l0 bound m; closed forms below (validated against the recurrence in
+  tests/partition_selection_test.py).
+* ``LaplaceThresholdingPartitionSelection`` / ``GaussianThresholding...`` —
+  noise the privacy-unit count and keep if it clears a threshold derived from
+  delta (per google/differential-privacy Delta_For_Thresholding.pdf, cited at
+  reference dp_computations.py:790-791).
+"""
+
+from __future__ import annotations
+
+import abc
+import math
+from typing import Optional
+
+import numpy as np
+from scipy import stats
+
+from pipelinedp_tpu import noise_core
+from pipelinedp_tpu.aggregate_params import PartitionSelectionStrategy
+
+PARTITION_STRATEGY_ENUM_TO_STR = {
+    PartitionSelectionStrategy.TRUNCATED_GEOMETRIC: "truncated_geometric",
+    PartitionSelectionStrategy.LAPLACE_THRESHOLDING: "laplace",
+    PartitionSelectionStrategy.GAUSSIAN_THRESHOLDING: "gaussian",
+}
+
+_rng = np.random.default_rng()
+
+
+def seed_rng(seed: Optional[int]) -> None:
+    """Reseeds the selection RNG (tests only)."""
+    global _rng
+    _rng = np.random.default_rng(seed)
+
+
+def _per_partition_delta(delta: float, max_partitions: int) -> float:
+    """delta' such that m independent per-partition failures compose to delta.
+
+    1 - (1 - delta')^m = delta  =>  delta' = 1 - (1 - delta)^(1/m).
+    """
+    return -math.expm1(math.log1p(-delta) / max_partitions)
+
+
+class PartitionSelection(abc.ABC):
+    """Interface matching the PyDP partition-selection objects."""
+
+    def __init__(self, epsilon: float, delta: float,
+                 max_partitions_contributed: int,
+                 pre_threshold: Optional[int]):
+        if epsilon <= 0:
+            raise ValueError(f"epsilon must be positive, got {epsilon}")
+        if not 0 < delta < 1:
+            raise ValueError(f"delta must be in (0, 1), got {delta}")
+        if max_partitions_contributed <= 0:
+            raise ValueError("max_partitions_contributed must be positive, "
+                             f"got {max_partitions_contributed}")
+        if pre_threshold is not None and pre_threshold < 1:
+            raise ValueError(f"pre_threshold must be >= 1: {pre_threshold}")
+        self._epsilon = epsilon
+        self._delta = delta
+        self._max_partitions = max_partitions_contributed
+        self._pre_threshold = pre_threshold
+
+    @property
+    def epsilon(self) -> float:
+        return self._epsilon
+
+    @property
+    def delta(self) -> float:
+        return self._delta
+
+    @property
+    def max_partitions_contributed(self) -> int:
+        return self._max_partitions
+
+    @property
+    def pre_threshold(self) -> Optional[int]:
+        return self._pre_threshold
+
+    def _pre_threshold_shift(self, num_privacy_units):
+        """Maps the raw count to the count the DP test sees.
+
+        With pre_threshold t, partitions with fewer than t units are never
+        kept; otherwise the strategy is applied to n - (t - 1).
+        """
+        if self._pre_threshold is None:
+            return num_privacy_units
+        return num_privacy_units - (self._pre_threshold - 1)
+
+    def probability_of_keep(self, num_privacy_units: int) -> float:
+        n = self._pre_threshold_shift(num_privacy_units)
+        if n <= 0:
+            return 0.0
+        return float(self._probability_of_keep_shifted(np.asarray([n]))[0])
+
+    def probability_of_keep_vec(self, num_privacy_units) -> np.ndarray:
+        """Vectorized keep probabilities for an int array of counts."""
+        n = self._pre_threshold_shift(np.asarray(num_privacy_units))
+        probs = self._probability_of_keep_shifted(np.maximum(n, 1))
+        return np.where(n <= 0, 0.0, probs)
+
+    def should_keep(self, num_privacy_units: int) -> bool:
+        return bool(_rng.random() < self.probability_of_keep(num_privacy_units))
+
+    @abc.abstractmethod
+    def _probability_of_keep_shifted(self, n: np.ndarray) -> np.ndarray:
+        """P(keep) for pre-threshold-adjusted counts n >= 1."""
+
+    @property
+    @abc.abstractmethod
+    def threshold(self) -> float:
+        """Count at which a partition is kept with probability >= 1/2
+        (exact threshold for thresholding strategies)."""
+
+    def noised_value_if_should_keep(self,
+                                    num_privacy_units: int) -> Optional[float]:
+        """Returns a DP estimate of the count if the partition is kept."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not produce noised values.")
+
+
+class TruncatedGeometricPartitionSelection(PartitionSelection):
+    """Optimal partition selection via the generalized geometric mechanism.
+
+    Closed forms for the saturated recurrence (a = e^-eps', d = delta'):
+      segment A (small n):  pi_n = d (e^{n eps'} - 1) / (e^{eps'} - 1)
+      segment B (large n):  pi_n = pi_inf - (pi_inf - pi_{n1}) e^{-(n-n1) eps'}
+    where pi_inf = 1 + d a/(1-a) is the fixed point of the B-branch and n1 is
+    the last n on segment A (branch crossover at
+    pi* = (1-d)(1-a)/(e^{eps'} - a)).
+    """
+
+    def __init__(self, epsilon: float, delta: float,
+                 max_partitions_contributed: int,
+                 pre_threshold: Optional[int] = None):
+        super().__init__(epsilon, delta, max_partitions_contributed,
+                         pre_threshold)
+        self._eps_p = epsilon / max_partitions_contributed
+        self._delta_p = _per_partition_delta(delta, max_partitions_contributed)
+        e = self._eps_p
+        d = self._delta_p
+        a = math.exp(-e)
+        # Crossover probability between the two branches.
+        pi_star = (1.0 - d) * (1.0 - a) / (math.exp(e) - a)
+        # The recurrence steps with branch A while pi_n <= pi*, so segment A's
+        # closed form holds through n1 = (last n with pi_A(n) <= pi*) + 1.
+        ratio = 1.0 + pi_star * math.expm1(e) / d
+        self._n1 = max(1, math.floor(math.log(ratio) / e) + 1)
+        self._pi_n1 = self._segment_a(np.asarray([self._n1], dtype=np.float64))[0]
+        self._pi_inf = 1.0 + d * a / (1.0 - a)
+        # First n with pi_n == 1 (numerically), for the threshold property.
+        gap = self._pi_inf - self._pi_n1
+        if gap <= self._pi_inf - 1.0:
+            self._n_always_keep = self._n1
+        else:
+            self._n_always_keep = self._n1 + math.ceil(
+                math.log(gap / (self._pi_inf - 1.0)) / e)
+
+    def _segment_a(self, n: np.ndarray) -> np.ndarray:
+        e, d = self._eps_p, self._delta_p
+        return d * np.expm1(n * e) / math.expm1(e)
+
+    def _segment_b(self, n: np.ndarray) -> np.ndarray:
+        e = self._eps_p
+        return self._pi_inf - (self._pi_inf - self._pi_n1) * np.exp(
+            -(n - self._n1) * e)
+
+    def _probability_of_keep_shifted(self, n: np.ndarray) -> np.ndarray:
+        n = np.asarray(n, dtype=np.float64)
+        probs = np.where(n <= self._n1, self._segment_a(np.minimum(n, self._n1)),
+                         self._segment_b(n))
+        return np.clip(probs, 0.0, 1.0)
+
+    @property
+    def threshold(self) -> float:
+        """Smallest count kept with probability >= 1/2."""
+        probs = self._probability_of_keep_shifted(
+            np.arange(1, self._n_always_keep + 1))
+        idx = int(np.searchsorted(probs, 0.5))
+        base = idx + 1
+        if self._pre_threshold is not None:
+            base += self._pre_threshold - 1
+        return float(base)
+
+
+class _ThresholdingPartitionSelection(PartitionSelection):
+    """Shared noised-count-vs-threshold logic."""
+
+    # Set by subclasses:
+    _noise_stddev: float
+    _threshold_shifted: float  # threshold in pre-threshold-adjusted count space
+
+    @property
+    def threshold(self) -> float:
+        if self._pre_threshold is not None:
+            return self._threshold_shifted + self._pre_threshold - 1
+        return self._threshold_shifted
+
+    @property
+    def noise_stddev(self) -> float:
+        return self._noise_stddev
+
+    @abc.abstractmethod
+    def _sample_noise(self) -> float:
+        ...
+
+    @abc.abstractmethod
+    def _noise_sf(self, x: np.ndarray) -> np.ndarray:
+        """P(noise > x), vectorized."""
+
+    def _probability_of_keep_shifted(self, n: np.ndarray) -> np.ndarray:
+        return self._noise_sf(self._threshold_shifted -
+                              np.asarray(n, dtype=np.float64))
+
+    def should_keep(self, num_privacy_units: int) -> bool:
+        return self.noised_value_if_should_keep(num_privacy_units) is not None
+
+    def noised_value_if_should_keep(self,
+                                    num_privacy_units: int) -> Optional[float]:
+        n = self._pre_threshold_shift(num_privacy_units)
+        if n <= 0:
+            return None
+        noised = n + self._sample_noise()
+        if noised < self._threshold_shifted:
+            return None
+        if self._pre_threshold is not None:
+            noised += self._pre_threshold - 1
+        return float(noised)
+
+
+class LaplaceThresholdingPartitionSelection(_ThresholdingPartitionSelection):
+    """Keep iff count + Lap(m/eps) >= T, T calibrated so that a partition
+    with a single privacy unit is kept with probability <= delta'."""
+
+    def __init__(self, epsilon: float, delta: float,
+                 max_partitions_contributed: int,
+                 pre_threshold: Optional[int] = None):
+        super().__init__(epsilon, delta, max_partitions_contributed,
+                         pre_threshold)
+        m = max_partitions_contributed
+        self._scale = m / epsilon  # l1 sensitivity m
+        self._noise_stddev = self._scale * math.sqrt(2.0)
+        delta_p = _per_partition_delta(delta, m)
+        # T solves P(1 + Lap(b) >= T) = delta_p.
+        if delta_p <= 0.5:
+            self._threshold_shifted = 1.0 - self._scale * math.log(
+                2.0 * delta_p)
+        else:
+            self._threshold_shifted = 1.0 + self._scale * math.log(
+                2.0 * (1.0 - delta_p))
+
+    def _sample_noise(self) -> float:
+        return float(noise_core.sample_laplace(self._scale))
+
+    def _noise_sf(self, x: np.ndarray) -> np.ndarray:
+        b = self._scale
+        return np.where(x >= 0, 0.5 * np.exp(-x / b),
+                        1.0 - 0.5 * np.exp(x / b))
+
+
+class GaussianThresholdingPartitionSelection(_ThresholdingPartitionSelection):
+    """Keep iff count + N(0, sigma^2) >= T.
+
+    delta is split evenly: delta/2 calibrates sigma (analytic Gaussian
+    mechanism with l2 sensitivity sqrt(m)), delta/2 calibrates the threshold.
+    """
+
+    def __init__(self, epsilon: float, delta: float,
+                 max_partitions_contributed: int,
+                 pre_threshold: Optional[int] = None):
+        super().__init__(epsilon, delta, max_partitions_contributed,
+                         pre_threshold)
+        m = max_partitions_contributed
+        delta_noise = delta / 2.0
+        delta_thresh = delta / 2.0
+        self._sigma = noise_core.analytic_gaussian_sigma(
+            epsilon, delta_noise, math.sqrt(m))
+        self._noise_stddev = self._sigma
+        delta_p = _per_partition_delta(delta_thresh, m)
+        self._threshold_shifted = 1.0 + self._sigma * float(
+            stats.norm.isf(delta_p))
+
+    @property
+    def sigma(self) -> float:
+        return self._sigma
+
+    def _sample_noise(self) -> float:
+        return float(noise_core.sample_gaussian(self._sigma))
+
+    def _noise_sf(self, x: np.ndarray) -> np.ndarray:
+        return stats.norm.sf(np.asarray(x, dtype=np.float64) / self._sigma)
+
+
+def create_partition_selection_strategy(
+        strategy: PartitionSelectionStrategy,
+        epsilon: float,
+        delta: float,
+        max_partitions_contributed: int,
+        pre_threshold: Optional[int] = None) -> PartitionSelection:
+    """Factory mirroring pipeline_dp/partition_selection.py:29-44."""
+    if strategy == PartitionSelectionStrategy.TRUNCATED_GEOMETRIC:
+        cls = TruncatedGeometricPartitionSelection
+    elif strategy == PartitionSelectionStrategy.LAPLACE_THRESHOLDING:
+        cls = LaplaceThresholdingPartitionSelection
+    elif strategy == PartitionSelectionStrategy.GAUSSIAN_THRESHOLDING:
+        cls = GaussianThresholdingPartitionSelection
+    else:
+        raise ValueError(f"Unknown partition selection strategy: {strategy}")
+    return cls(epsilon, delta, max_partitions_contributed, pre_threshold)

@@ -1,0 +1,58 @@
+"""Composite backend-agnostic helpers built from PipelineBackend primitives.
+
+Parity: pipeline_dp/pipeline_functions.py (key_by :23, size :30,
+collect_to_container :41, min_max_elements :102).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Type
+
+from pipelinedp_tpu.backends import base
+
+
+def key_by(backend: base.PipelineBackend, col, key_extractor: Callable,
+           stage_name: str):
+    """element -> (key_extractor(element), element)."""
+    return backend.map(col, lambda el: (key_extractor(el), el),
+                       f"{stage_name}: key by extractor")
+
+
+def size(backend: base.PipelineBackend, col, stage_name: str):
+    """Returns a 1-element collection holding the input's size."""
+    keyed = backend.map(col, lambda _: None, f"{stage_name}: to common key")
+    counted = backend.count_per_element(keyed, f"{stage_name}: count")
+    return backend.values(counted, f"{stage_name}: drop key")
+
+
+def collect_to_container(backend: base.PipelineBackend, cols: Dict[str, Any],
+                         container_class: Type, stage_name: str):
+    """Packs several 1-element collections into one container instance.
+
+    ``cols`` maps constructor-argument names to 1-element collections; the
+    result is a 1-element collection holding
+    ``container_class(**{name: value})``.
+    """
+
+    def keyer(key):
+        return lambda _: key
+
+    keyed = [
+        key_by(backend, col, keyer(key), f"{stage_name}: key inputs")
+        for key, col in cols.items()
+    ]
+    flat = backend.flatten(keyed, f"{stage_name}: flatten inputs")
+    as_list = backend.to_list(flat, f"{stage_name}: collect to list")
+    as_dict = backend.map(as_list, dict, f"{stage_name}: list to dict")
+    return backend.map(as_dict, lambda d: container_class(**d),
+                       f"{stage_name}: construct container")
+
+
+def min_max_elements(backend: base.PipelineBackend, col, stage_name: str):
+    """Returns a 1-element collection with (min, max) of the input."""
+    keyed = backend.map(col, lambda x: (None, (x, x)),
+                        f"{stage_name}: key by dummy key")
+    reduced = backend.reduce_per_key(
+        keyed, lambda a, b: (min(a[0], b[0]), max(a[1], b[1])),
+        f"{stage_name}: reduce min/max")
+    return backend.values(reduced, f"{stage_name}: drop keys")

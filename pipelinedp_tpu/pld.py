@@ -1,0 +1,222 @@
+"""Privacy Loss Distribution (PLD) accounting, implemented on numpy.
+
+The reference delegates PLD accounting to Google's ``dp_accounting`` package
+(budget_accounting.py:27-32, 579-619). That package is not vendored here, so
+this module provides a self-contained implementation of the surface the
+framework needs:
+
+  * ``from_laplace_mechanism(parameter, value_discretization_interval)``
+  * ``from_gaussian_mechanism(standard_deviation, value_discretization_interval)``
+  * ``from_privacy_parameters(eps, delta, value_discretization_interval)``
+  * ``PrivacyLossDistribution.compose`` / ``self_compose``
+  * ``PrivacyLossDistribution.get_delta_for_epsilon``
+  * ``PrivacyLossDistribution.get_epsilon_for_delta``
+
+Representation: a PLD is the distribution of the privacy loss random variable
+L(x) = ln(P(x)/Q(x)) for x ~ P, where P is the mechanism output on a dataset D
+and Q on an adjacent D'. We store a pessimistic discretization: probability
+mass on the grid ``loss = (offset + i) * interval``, each continuous loss
+rounded UP to the next grid point (which can only over-estimate delta, never
+under-estimate — the same convention as the reference library), plus an
+``infinity_mass`` for events impossible under Q.
+
+The hockey-stick divergence gives
+    delta(eps) = infinity_mass + sum_{l_i > eps} p_i * (1 - exp(eps - l_i)).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+from scipy import signal, stats
+
+_TAIL_MASS = 1e-15
+
+
+class PrivacyLossDistribution:
+    """Discretized privacy loss distribution (pessimistic estimate)."""
+
+    def __init__(self, probs: np.ndarray, offset: int, interval: float,
+                 infinity_mass: float):
+        # probs[i] is the mass at loss (offset + i) * interval.
+        self._probs = np.asarray(probs, dtype=np.float64)
+        self._offset = int(offset)
+        self._interval = float(interval)
+        self._infinity_mass = float(infinity_mass)
+
+    @property
+    def value_discretization_interval(self) -> float:
+        return self._interval
+
+    @property
+    def infinity_mass(self) -> float:
+        return self._infinity_mass
+
+    def losses_and_probs(self):
+        losses = (self._offset +
+                  np.arange(len(self._probs))) * self._interval
+        return losses, self._probs
+
+    def compose(self,
+                other: "PrivacyLossDistribution") -> "PrivacyLossDistribution":
+        """Composition of two independent mechanisms: loss variables add."""
+        if not math.isclose(self._interval, other._interval):
+            raise ValueError(
+                "Cannot compose PLDs with different discretization intervals: "
+                f"{self._interval} vs {other._interval}")
+        probs = signal.fftconvolve(self._probs, other._probs)
+        probs = np.clip(probs, 0.0, None)
+        inf_mass = 1.0 - (1.0 - self._infinity_mass) * (1.0 -
+                                                        other._infinity_mass)
+        return PrivacyLossDistribution(probs, self._offset + other._offset,
+                                       self._interval, inf_mass)
+
+    def self_compose(self, count: int) -> "PrivacyLossDistribution":
+        """Composes the mechanism with itself ``count`` times (square & multiply)."""
+        if count < 1:
+            raise ValueError(f"count must be >= 1, got {count}")
+        result: Optional[PrivacyLossDistribution] = None
+        base = self
+        n = count
+        while n:
+            if n & 1:
+                result = base if result is None else result.compose(base)
+            n >>= 1
+            if n:
+                base = base.compose(base)
+        return result
+
+    def get_delta_for_epsilon(self, epsilon: float) -> float:
+        """Hockey-stick divergence delta(eps)."""
+        losses, probs = self.losses_and_probs()
+        mask = losses > epsilon
+        delta = self._infinity_mass
+        if np.any(mask):
+            tail_losses = losses[mask]
+            tail_probs = probs[mask]
+            delta += float(
+                np.sum(tail_probs * -np.expm1(epsilon - tail_losses)))
+        return min(max(delta, 0.0), 1.0)
+
+    def get_epsilon_for_delta(self, delta: float) -> float:
+        """Smallest eps with delta(eps) <= delta; inf if unreachable."""
+        if delta < 0:
+            raise ValueError(f"delta must be >= 0, got {delta}")
+        if self._infinity_mass > delta:
+            return math.inf
+        losses, _ = self.losses_and_probs()
+        hi = float(losses[-1]) if len(losses) else 0.0
+        if self.get_delta_for_epsilon(hi) > delta:
+            # Only possible via float round-off at the top of the grid.
+            return hi + self._interval
+        lo = float(losses[0]) - self._interval if len(losses) else -1.0
+        if self.get_delta_for_epsilon(lo) <= delta:
+            return max(lo, 0.0) if delta > 0 else lo
+        for _ in range(100):
+            mid = 0.5 * (lo + hi)
+            if self.get_delta_for_epsilon(mid) <= delta:
+                hi = mid
+            else:
+                lo = mid
+            if hi - lo < 1e-9:
+                break
+        return hi
+
+
+def _discretize_from_cdf(cdf, lo: float, hi: float, interval: float,
+                         infinity_mass: float) -> PrivacyLossDistribution:
+    """Builds a pessimistic PLD from the CDF of the loss variable.
+
+    ``cdf(l)`` must be P(L <= l) for l in [lo, hi]; all mass in [lo, hi].
+    Mass in the half-open bin ((i-1)*d, i*d] lands on grid point i*d, i.e.
+    each loss is rounded up.
+    """
+    lo_idx = math.floor(lo / interval)
+    hi_idx = math.ceil(hi / interval)
+    grid = np.arange(lo_idx, hi_idx + 1) * interval
+    cdf_vals = np.clip(np.array([cdf(g) for g in grid]), 0.0, 1.0)
+    cdf_vals[-1] = 1.0 - infinity_mass if infinity_mass else cdf_vals[-1]
+    probs = np.diff(cdf_vals, prepend=0.0)
+    probs = np.clip(probs, 0.0, None)
+    return PrivacyLossDistribution(probs, lo_idx, interval, infinity_mass)
+
+
+def from_laplace_mechanism(
+        parameter: float,
+        sensitivity: float = 1.0,
+        value_discretization_interval: float = 1e-4
+) -> PrivacyLossDistribution:
+    """PLD of the Laplace mechanism with noise scale ``parameter``.
+
+    For x ~ Lap(0, b) vs Lap(s, b) the loss is L(x) = (|x - s| - |x|)/b:
+    an atom of mass 1/2 at s/b (x <= 0), an atom of mass exp(-s/b)/2 at -s/b
+    (x >= s), and continuously distributed in between with
+    P(L <= l) = exp((l*b - s)/(2b))/2.
+    """
+    if parameter <= 0:
+        raise ValueError(f"Laplace parameter must be positive: {parameter}")
+    b = parameter / sensitivity  # scale in units of sensitivity
+    max_loss = 1.0 / b
+
+    def cdf(l: float) -> float:
+        if l < -max_loss:
+            return 0.0
+        if l >= max_loss:
+            return 1.0
+        return 0.5 * math.exp((l - max_loss) / 2.0)
+
+    return _discretize_from_cdf(cdf, -max_loss, max_loss,
+                                value_discretization_interval, 0.0)
+
+
+def from_gaussian_mechanism(
+        standard_deviation: float,
+        sensitivity: float = 1.0,
+        value_discretization_interval: float = 1e-4
+) -> PrivacyLossDistribution:
+    """PLD of the Gaussian mechanism with std ``standard_deviation``.
+
+    For x ~ N(0, sigma^2) vs N(s, sigma^2) the loss under P is
+    L ~ N(s^2/(2 sigma^2), s^2/sigma^2) (mu = s/sigma in loss-std units).
+    Tails beyond ``_TAIL_MASS`` quantiles are truncated; the upper tail is
+    pessimistically folded into infinity_mass.
+    """
+    if standard_deviation <= 0:
+        raise ValueError(f"std must be positive: {standard_deviation}")
+    sigma = standard_deviation / sensitivity
+    mu = 1.0 / (2.0 * sigma * sigma)
+    loss_std = 1.0 / sigma
+    lo = mu + loss_std * stats.norm.ppf(_TAIL_MASS)
+    hi = mu + loss_std * stats.norm.isf(_TAIL_MASS)
+    upper_tail = _TAIL_MASS
+
+    def cdf(l: float) -> float:
+        return float(stats.norm.cdf((l - mu) / loss_std))
+
+    return _discretize_from_cdf(cdf, lo, hi, value_discretization_interval,
+                                upper_tail)
+
+
+def from_privacy_parameters(
+        eps: float,
+        delta: float,
+        value_discretization_interval: float = 1e-4
+) -> PrivacyLossDistribution:
+    """Canonical PLD of an arbitrary (eps, delta)-DP mechanism.
+
+    The dominating pair for a generic (eps, delta)-DP mechanism puts mass
+    delta at +infinity and splits the remaining mass between losses +eps and
+    -eps with odds e^eps : 1 (reference semantics:
+    dp_accounting from_privacy_parameters, used at budget_accounting.py:612).
+    """
+    interval = value_discretization_interval
+    idx_hi = math.ceil(eps / interval)
+    idx_lo = math.ceil(-eps / interval)  # round up: pessimistic
+    probs = np.zeros(idx_hi - idx_lo + 1)
+    p_hi = (1.0 - delta) * math.exp(eps) / (1.0 + math.exp(eps))
+    p_lo = (1.0 - delta) / (1.0 + math.exp(eps))
+    probs[-1] = p_hi
+    probs[0] += p_lo
+    return PrivacyLossDistribution(probs, idx_lo, interval, delta)

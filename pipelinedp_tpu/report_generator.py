@@ -1,0 +1,68 @@
+"""Explain Computation reports.
+
+Every DP aggregation collects an ordered list of stage descriptions; stages
+may be callables that are resolved only when the report text is rendered —
+after ``BudgetAccountant.compute_budgets()`` — because budget numbers are not
+known at graph-construction time.
+
+Parity: pipeline_dp/report_generator.py (ReportGenerator :46-89,
+ExplainComputationReport :92-115).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Union
+
+from pipelinedp_tpu import aggregate_params as agg
+
+
+class ReportGenerator:
+    """Accumulates the stages of one DP aggregation and renders the report."""
+
+    def __init__(self,
+                 params,
+                 method_name: str,
+                 is_public_partition: Optional[bool] = None):
+        self._params_str: Optional[str] = None
+        if params:
+            self._params_str = agg.parameters_to_readable_string(
+                params, is_public_partition)
+        self._method_name = method_name
+        self._stages: List[Union[Callable[[], str], str]] = []
+
+    def add_stage(self, stage_description: Union[Callable[[], str],
+                                                 str]) -> None:
+        """Appends a stage; callables are rendered lazily at report() time."""
+        self._stages.append(stage_description)
+
+    def report(self) -> str:
+        if not self._params_str:
+            return ""
+        lines = [f"DPEngine method: {self._method_name}", self._params_str,
+                 "Computation graph:"]
+        for i, stage in enumerate(self._stages, start=1):
+            text = stage() if callable(stage) else stage
+            lines.append(f" {i}. {text}")
+        return "\n".join(lines)
+
+
+class ExplainComputationReport:
+    """User-facing handle for one aggregation's explain report."""
+
+    def __init__(self):
+        self._report_generator: Optional[ReportGenerator] = None
+
+    def _set_report_generator(self, report_generator: ReportGenerator):
+        self._report_generator = report_generator
+
+    def text(self) -> str:
+        if self._report_generator is None:
+            raise ValueError(
+                "The report_generator is not set.\nWas this object passed as "
+                "an argument to a DP aggregation method?")
+        try:
+            return self._report_generator.report()
+        except Exception as e:
+            raise ValueError(
+                "Explain computation report failed to be generated.\nWas "
+                "BudgetAccountant.compute_budgets() called?") from e

@@ -1,0 +1,50 @@
+"""Sampling helpers used by contribution bounding.
+
+Parity: pipeline_dp/sampling_utils.py (choose_from_list_without_replacement
+:19, ValueSampler :38-51). The JAX backend has its own batched counterparts
+in pipelinedp_tpu/ops/sampling.py; these host-side versions serve the
+LocalBackend correctness oracle.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, List
+
+import numpy as np
+
+
+def choose_from_list_without_replacement(a: List[Any], size: int) -> List[Any]:
+    """Uniformly samples ``size`` elements without replacement.
+
+    Returns the input list unchanged when it is already small enough. Sampling
+    is done over indices so elements keep their native Python types (no numpy
+    casting — matters for both serialization and arbitrary-precision ints).
+    """
+    if len(a) <= size:
+        return a
+    picked = np.random.choice(len(a), size, replace=False)
+    return [a[i] for i in picked]
+
+
+def _hash64(value: Any) -> int:
+    digest = hashlib.sha1(repr(value).encode()).hexdigest()
+    return int(digest[:16], 16)
+
+
+class ValueSampler:
+    """Deterministic hash-based Bernoulli sampler.
+
+    ``keep(v)`` is a fixed function of ``v``; over uniformly random values the
+    keep probability equals ``sampling_rate``. Used for deterministic
+    partition subsampling in the analysis layer.
+    """
+
+    def __init__(self, sampling_rate: float):
+        if not 0 < sampling_rate <= 1:
+            raise ValueError(
+                f"sampling_rate must be in (0, 1], got {sampling_rate}")
+        self._keep_bound = int(round(2**64 * sampling_rate))
+
+    def keep(self, value: Any) -> bool:
+        return _hash64(value) < self._keep_bound

@@ -1,0 +1,185 @@
+"""Tests for aggregate_params validation semantics.
+
+Mirrors the validation checks exercised by the reference's
+tests/aggregate_params_test.py against aggregate_params.py:281-395.
+"""
+
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu.aggregate_params import (Metric, noise_to_thresholding,
+                                             parameters_to_readable_string)
+
+
+def valid_params(**overrides):
+    kwargs = dict(
+        metrics=[pdp.Metrics.COUNT],
+        max_partitions_contributed=2,
+        max_contributions_per_partition=3,
+    )
+    kwargs.update(overrides)
+    return pdp.AggregateParams(**kwargs)
+
+
+class TestMetrics:
+
+    def test_equality_and_hash(self):
+        assert pdp.Metrics.COUNT == Metric("COUNT")
+        assert pdp.Metrics.PERCENTILE(90) == Metric("PERCENTILE", 90)
+        assert pdp.Metrics.PERCENTILE(90) != pdp.Metrics.PERCENTILE(50)
+        assert len({pdp.Metrics.COUNT, Metric("COUNT")}) == 1
+
+    def test_str(self):
+        assert str(pdp.Metrics.COUNT) == "COUNT"
+        assert str(pdp.Metrics.PERCENTILE(90)) == "PERCENTILE(90)"
+
+    def test_is_percentile(self):
+        assert pdp.Metrics.PERCENTILE(5).is_percentile
+        assert not pdp.Metrics.SUM.is_percentile
+
+
+class TestEnums:
+
+    def test_noise_kind_to_mechanism_type(self):
+        assert (pdp.NoiseKind.LAPLACE.convert_to_mechanism_type() ==
+                pdp.MechanismType.LAPLACE)
+        assert (pdp.NoiseKind.GAUSSIAN.convert_to_mechanism_type() ==
+                pdp.MechanismType.GAUSSIAN)
+
+    def test_mechanism_type_to_noise_kind(self):
+        assert pdp.MechanismType.LAPLACE.to_noise_kind() == pdp.NoiseKind.LAPLACE
+        assert (pdp.MechanismType.GAUSSIAN_THRESHOLDING.to_noise_kind() ==
+                pdp.NoiseKind.GAUSSIAN)
+        with pytest.raises(ValueError):
+            pdp.MechanismType.GENERIC.to_noise_kind()
+
+    def test_noise_to_thresholding(self):
+        assert (noise_to_thresholding(pdp.NoiseKind.LAPLACE) ==
+                pdp.MechanismType.LAPLACE_THRESHOLDING)
+        assert (noise_to_thresholding(pdp.NoiseKind.GAUSSIAN) ==
+                pdp.MechanismType.GAUSSIAN_THRESHOLDING)
+
+
+class TestAggregateParamsValidation:
+
+    def test_valid(self):
+        valid_params()
+
+    def test_missing_contribution_bounds(self):
+        with pytest.raises(ValueError, match="max_contributions must be set"):
+            pdp.AggregateParams(metrics=[pdp.Metrics.COUNT])
+
+    def test_only_one_bound_set(self):
+        with pytest.raises(ValueError, match="none or both"):
+            pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                max_partitions_contributed=2)
+
+    def test_max_contributions_conflicts(self):
+        with pytest.raises(ValueError, match="only one"):
+            valid_params(max_contributions=5)
+
+    def test_max_contributions_alone_ok(self):
+        pdp.AggregateParams(metrics=[pdp.Metrics.COUNT], max_contributions=5)
+
+    def test_non_positive_bounds(self):
+        with pytest.raises(ValueError):
+            valid_params(max_partitions_contributed=0)
+        with pytest.raises(ValueError):
+            valid_params(max_contributions_per_partition=-1)
+
+    def test_min_without_max_value(self):
+        with pytest.raises(ValueError, match="both set or both None"):
+            valid_params(min_value=1)
+
+    def test_min_greater_than_max(self):
+        with pytest.raises(ValueError, match="equal to or greater"):
+            valid_params(metrics=[pdp.Metrics.SUM], min_value=2, max_value=1)
+
+    def test_nan_bounds(self):
+        with pytest.raises(ValueError, match="finite"):
+            valid_params(metrics=[pdp.Metrics.SUM],
+                         min_value=float("nan"),
+                         max_value=1)
+
+    def test_value_and_partition_bounds_conflict(self):
+        with pytest.raises(ValueError, match="not be both set"):
+            valid_params(metrics=[pdp.Metrics.SUM],
+                         min_value=0,
+                         max_value=1,
+                         min_sum_per_partition=0,
+                         max_sum_per_partition=2)
+
+    def test_sum_requires_bounds(self):
+        with pytest.raises(ValueError, match="bounds per partition"):
+            valid_params(metrics=[pdp.Metrics.SUM])
+
+    def test_partition_bounds_not_for_mean(self):
+        with pytest.raises(ValueError, match="min_sum_per_partition"):
+            valid_params(metrics=[pdp.Metrics.MEAN],
+                         min_sum_per_partition=0,
+                         max_sum_per_partition=1)
+
+    def test_vector_sum_with_scalar_metrics(self):
+        with pytest.raises(ValueError, match="vector sum"):
+            valid_params(metrics=[pdp.Metrics.VECTOR_SUM, pdp.Metrics.SUM],
+                         min_value=0,
+                         max_value=1)
+
+    def test_privacy_id_count_with_bounds_already_enforced(self):
+        with pytest.raises(ValueError, match="PRIVACY_ID_COUNT"):
+            valid_params(metrics=[pdp.Metrics.PRIVACY_ID_COUNT],
+                         contribution_bounds_already_enforced=True)
+
+    def test_custom_combiners_with_metrics(self):
+        with pytest.raises(ValueError, match="[Cc]ustom combiners"):
+            valid_params(custom_combiners=[object()])
+
+    def test_pre_threshold_validation(self):
+        with pytest.raises(ValueError, match="pre_threshold"):
+            valid_params(pre_threshold=0)
+
+
+class TestOtherParams:
+
+    def test_select_partitions_params(self):
+        pdp.SelectPartitionsParams(max_partitions_contributed=2)
+        with pytest.raises(ValueError):
+            pdp.SelectPartitionsParams(max_partitions_contributed=0)
+
+    def test_add_dp_noise_params(self):
+        pdp.AddDPNoiseParams(noise_kind=pdp.NoiseKind.LAPLACE,
+                             l0_sensitivity=2,
+                             linf_sensitivity=1.5)
+        with pytest.raises(ValueError, match="positive"):
+            pdp.AddDPNoiseParams(noise_kind=pdp.NoiseKind.LAPLACE,
+                                 l0_sensitivity=0,
+                                 linf_sensitivity=1.0)
+
+    def test_calculate_private_contribution_bounds_params(self):
+        pdp.CalculatePrivateContributionBoundsParams(
+            aggregation_noise_kind=pdp.NoiseKind.GAUSSIAN,
+            aggregation_eps=1.0,
+            aggregation_delta=1e-6,
+            calculation_eps=0.5,
+            max_partitions_contributed_upper_bound=100)
+        with pytest.raises(ValueError, match="positive aggregation_delta"):
+            pdp.CalculatePrivateContributionBoundsParams(
+                aggregation_noise_kind=pdp.NoiseKind.GAUSSIAN,
+                aggregation_eps=1.0,
+                aggregation_delta=0,
+                calculation_eps=0.5,
+                max_partitions_contributed_upper_bound=100)
+
+
+class TestReadableString:
+
+    def test_contains_key_fields(self):
+        params = valid_params(metrics=[pdp.Metrics.SUM],
+                              min_value=1,
+                              max_value=5)
+        text = parameters_to_readable_string(params, is_public_partition=False)
+        assert "AggregateParams" in text
+        assert "max_partitions_contributed=2" in text
+        assert "min_value=1" in text
+        assert "noise_kind=laplace" in text
+        assert "private partitions" in text

@@ -1,0 +1,223 @@
+"""Tests for budget accounting (naive + PLD).
+
+Mirrors the semantics pinned by the reference's
+tests/budget_accounting_test.py against budget_accounting.py:40-619.
+"""
+
+import math
+
+import pytest
+
+from pipelinedp_tpu import budget_accounting as ba
+from pipelinedp_tpu.aggregate_params import MechanismType
+
+
+class TestMechanismSpec:
+
+    def test_unresolved_access_raises(self):
+        spec = ba.MechanismSpec(MechanismType.LAPLACE)
+        with pytest.raises(AssertionError):
+            _ = spec.eps
+        with pytest.raises(AssertionError):
+            _ = spec.delta
+        with pytest.raises(AssertionError):
+            _ = spec.noise_standard_deviation
+
+    def test_use_delta(self):
+        assert not ba.MechanismSpec(MechanismType.LAPLACE).use_delta()
+        assert ba.MechanismSpec(MechanismType.GAUSSIAN).use_delta()
+        assert ba.MechanismSpec(MechanismType.GENERIC).use_delta()
+
+
+class TestNaiveBudgetAccountant:
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ba.NaiveBudgetAccountant(total_epsilon=0, total_delta=1e-6)
+        with pytest.raises(ValueError):
+            ba.NaiveBudgetAccountant(total_epsilon=1, total_delta=-1e-6)
+        with pytest.raises(ValueError):
+            ba.NaiveBudgetAccountant(total_epsilon=1, total_delta=1e-6,
+                                     num_aggregations=2,
+                                     aggregation_weights=[1, 1])
+
+    def test_single_mechanism_gets_everything(self):
+        accountant = ba.NaiveBudgetAccountant(total_epsilon=1,
+                                              total_delta=1e-6)
+        spec = accountant.request_budget(MechanismType.GAUSSIAN)
+        accountant.compute_budgets()
+        assert spec.eps == 1
+        assert spec.delta == 1e-6
+
+    def test_laplace_gets_no_delta(self):
+        accountant = ba.NaiveBudgetAccountant(total_epsilon=1,
+                                              total_delta=1e-6)
+        laplace = accountant.request_budget(MechanismType.LAPLACE)
+        gaussian = accountant.request_budget(MechanismType.GAUSSIAN)
+        accountant.compute_budgets()
+        assert laplace.eps == pytest.approx(0.5)
+        assert laplace.delta == 0
+        assert gaussian.eps == pytest.approx(0.5)
+        assert gaussian.delta == pytest.approx(1e-6)
+
+    def test_weights(self):
+        accountant = ba.NaiveBudgetAccountant(total_epsilon=1, total_delta=0)
+        a = accountant.request_budget(MechanismType.LAPLACE, weight=1)
+        b = accountant.request_budget(MechanismType.LAPLACE, weight=3)
+        accountant.compute_budgets()
+        assert a.eps == pytest.approx(0.25)
+        assert b.eps == pytest.approx(0.75)
+
+    def test_count(self):
+        accountant = ba.NaiveBudgetAccountant(total_epsilon=1, total_delta=0)
+        a = accountant.request_budget(MechanismType.LAPLACE, count=3)
+        b = accountant.request_budget(MechanismType.LAPLACE)
+        accountant.compute_budgets()
+        # a's weight is effectively repeated 3 times in the denominator.
+        assert a.eps == pytest.approx(0.25)
+        assert b.eps == pytest.approx(0.25)
+
+    def test_gaussian_requires_delta(self):
+        accountant = ba.NaiveBudgetAccountant(total_epsilon=1, total_delta=0)
+        with pytest.raises(ValueError, match="Gaussian"):
+            accountant.request_budget(MechanismType.GAUSSIAN)
+
+    def test_request_after_compute_raises(self):
+        accountant = ba.NaiveBudgetAccountant(total_epsilon=1, total_delta=0)
+        accountant.request_budget(MechanismType.LAPLACE)
+        accountant.compute_budgets()
+        with pytest.raises(Exception, match="request_budget"):
+            accountant.request_budget(MechanismType.LAPLACE)
+
+    def test_compute_twice_raises(self):
+        accountant = ba.NaiveBudgetAccountant(total_epsilon=1, total_delta=0)
+        accountant.request_budget(MechanismType.LAPLACE)
+        accountant.compute_budgets()
+        with pytest.raises(Exception, match="twice"):
+            accountant.compute_budgets()
+
+    def test_scope_normalizes_weights(self):
+        accountant = ba.NaiveBudgetAccountant(total_epsilon=1, total_delta=0)
+        with accountant.scope(weight=1):
+            a = accountant.request_budget(MechanismType.LAPLACE)
+            b = accountant.request_budget(MechanismType.LAPLACE)
+        with accountant.scope(weight=1):
+            c = accountant.request_budget(MechanismType.LAPLACE)
+        accountant.compute_budgets()
+        # Scope 1 splits its half between two mechanisms.
+        assert a.eps == pytest.approx(0.25)
+        assert b.eps == pytest.approx(0.25)
+        assert c.eps == pytest.approx(0.5)
+
+    def test_num_aggregations_restriction(self):
+        accountant = ba.NaiveBudgetAccountant(total_epsilon=1, total_delta=0,
+                                              num_aggregations=2)
+        accountant._compute_budget_for_aggregation(1)
+        with pytest.raises(ValueError, match="num_aggregations"):
+            accountant.compute_budgets()
+
+    def test_aggregation_weights_restriction(self):
+        accountant = ba.NaiveBudgetAccountant(total_epsilon=1, total_delta=0,
+                                              aggregation_weights=[1, 2])
+        accountant._compute_budget_for_aggregation(1)
+        accountant._compute_budget_for_aggregation(3)
+        with pytest.raises(ValueError, match="aggregation_weights"):
+            accountant.compute_budgets()
+
+    def test_budget_for_aggregation_split(self):
+        accountant = ba.NaiveBudgetAccountant(total_epsilon=1,
+                                              total_delta=1e-6,
+                                              num_aggregations=2)
+        budget = accountant._compute_budget_for_aggregation(1)
+        assert budget.epsilon == pytest.approx(0.5)
+        assert budget.delta == pytest.approx(5e-7)
+
+
+class TestPLDBudgetAccountant:
+
+    def test_delta_zero_closed_form(self):
+        accountant = ba.PLDBudgetAccountant(total_epsilon=2, total_delta=0)
+        spec = accountant.request_budget(MechanismType.LAPLACE)
+        accountant.compute_budgets()
+        # One Laplace mechanism, weight 1: min noise std = sqrt(2)/eps.
+        assert spec.noise_standard_deviation == pytest.approx(
+            math.sqrt(2) / 2)
+
+    def test_single_laplace_close_to_naive(self):
+        accountant = ba.PLDBudgetAccountant(total_epsilon=1,
+                                            total_delta=1e-8,
+                                            pld_discretization=1e-3)
+        spec = accountant.request_budget(MechanismType.LAPLACE)
+        accountant.compute_budgets()
+        # A single Laplace mechanism with eps=1 has std sqrt(2); PLD should
+        # find nearly that (tiny delta barely helps).
+        assert spec.noise_standard_deviation == pytest.approx(math.sqrt(2),
+                                                              rel=0.05)
+
+    def test_composition_beats_naive(self):
+        n_mechanisms = 4
+        naive = ba.NaiveBudgetAccountant(total_epsilon=1, total_delta=1e-6)
+        naive_specs = [
+            naive.request_budget(MechanismType.GAUSSIAN)
+            for _ in range(n_mechanisms)
+        ]
+        naive.compute_budgets()
+        from pipelinedp_tpu import noise_core
+        naive_std = noise_core.analytic_gaussian_sigma(
+            naive_specs[0].eps, naive_specs[0].delta, 1.0)
+
+        pld = ba.PLDBudgetAccountant(total_epsilon=1, total_delta=1e-6,
+                                     pld_discretization=1e-3)
+        pld_specs = [
+            pld.request_budget(MechanismType.GAUSSIAN)
+            for _ in range(n_mechanisms)
+        ]
+        pld.compute_budgets()
+        # PLD composition is tighter than naive composition => less noise.
+        assert pld_specs[0].noise_standard_deviation < naive_std
+
+    def test_generic_mechanism(self):
+        accountant = ba.PLDBudgetAccountant(total_epsilon=1, total_delta=1e-6,
+                                            pld_discretization=1e-3)
+        spec = accountant.request_budget(MechanismType.GENERIC)
+        accountant.compute_budgets()
+        assert spec.eps > 0
+        assert spec.delta > 0
+
+
+class TestPLDLibrary:
+
+    def test_laplace_pld_epsilon_roundtrip(self):
+        from pipelinedp_tpu import pld
+        dist = pld.from_laplace_mechanism(1.0,
+                                          value_discretization_interval=1e-4)
+        # Laplace with scale 1, sensitivity 1 is exactly (1, 0)-DP.
+        eps = dist.get_epsilon_for_delta(0.0)
+        assert eps == pytest.approx(1.0, abs=1e-3)
+
+    def test_gaussian_pld_matches_analytic(self):
+        from pipelinedp_tpu import noise_core
+        from pipelinedp_tpu import pld
+        sigma = noise_core.analytic_gaussian_sigma(1.0, 1e-6, 1.0)
+        dist = pld.from_gaussian_mechanism(
+            sigma, value_discretization_interval=1e-4)
+        eps = dist.get_epsilon_for_delta(1e-6)
+        assert eps == pytest.approx(1.0, abs=0.01)
+
+    def test_composition_epsilon_grows(self):
+        from pipelinedp_tpu import pld
+        one = pld.from_laplace_mechanism(2.0,
+                                         value_discretization_interval=1e-4)
+        two = one.compose(one)
+        eps1 = one.get_epsilon_for_delta(1e-9)
+        eps2 = two.get_epsilon_for_delta(1e-9)
+        assert eps1 < eps2 <= 2 * eps1 + 1e-6
+
+    def test_self_compose_matches_compose(self):
+        from pipelinedp_tpu import pld
+        one = pld.from_laplace_mechanism(2.0,
+                                         value_discretization_interval=1e-3)
+        a = one.compose(one).compose(one)
+        b = one.self_compose(3)
+        assert a.get_epsilon_for_delta(1e-9) == pytest.approx(
+            b.get_epsilon_for_delta(1e-9), rel=1e-6)

@@ -1,0 +1,275 @@
+"""Tests for dp_computations (mirrors reference tests/dp_computations_test.py
+coverage of sensitivity math, mechanisms, DP mean/variance, thresholding)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import budget_accounting as ba
+from pipelinedp_tpu import dp_computations as dp
+from pipelinedp_tpu import noise_core
+from pipelinedp_tpu.aggregate_params import MechanismType, NormKind
+
+
+class TestSensitivityMath:
+
+    def test_l1_l2(self):
+        assert dp.compute_l1_sensitivity(3, 2.0) == 6.0
+        assert dp.compute_l2_sensitivity(4, 2.0) == pytest.approx(4.0)
+
+    def test_middle_and_squares(self):
+        assert dp.compute_middle(-2, 4) == 1
+        assert dp.compute_squares_interval(-2, 4) == (0, 16)
+        assert dp.compute_squares_interval(1, 3) == (1, 9)
+        # Convention: returns (min^2, max^2) unordered for all-negative
+        # ranges; downstream only uses the midpoint and |mid - lo|, which are
+        # symmetric.
+        assert dp.compute_squares_interval(-3, -1) == (9, 1)
+
+    def test_sigma_satisfies_analytic_condition(self):
+        eps, delta, s = 1.0, 1e-6, 2.0
+        sigma = dp.compute_sigma(eps, delta, s)
+        assert noise_core.gaussian_delta(sigma, eps, s) <= delta + 1e-15
+        # And it is nearly tight.
+        assert noise_core.gaussian_delta(sigma * 0.99, eps, s) > delta
+
+    def test_sigma_beats_classical(self):
+        eps, delta, s = 1.0, 1e-6, 1.0
+        sigma = dp.compute_sigma(eps, delta, s)
+        classical = math.sqrt(2 * math.log(1.25 / delta)) * s / eps
+        assert sigma < classical
+
+
+class TestSensitivities:
+
+    def test_derives_l1_l2(self):
+        s = dp.Sensitivities(l0=4, linf=2.0)
+        assert s.l1 == 8.0
+        assert s.l2 == pytest.approx(4.0)
+
+    def test_inconsistent_raises(self):
+        with pytest.raises(ValueError, match="L1"):
+            dp.Sensitivities(l0=4, linf=2.0, l1=5.0)
+
+    def test_only_l0_raises(self):
+        with pytest.raises(ValueError, match="both"):
+            dp.Sensitivities(l0=4)
+
+    def test_non_positive_raises(self):
+        with pytest.raises(ValueError):
+            dp.Sensitivities(l0=0, linf=1)
+
+
+class TestMechanisms:
+
+    def test_laplace_properties(self):
+        m = dp.LaplaceMechanism.create_from_epsilon(2.0, 3.0)
+        assert m.noise_parameter == pytest.approx(1.5)
+        assert m.std == pytest.approx(1.5 * math.sqrt(2))
+        assert m.sensitivity == 3.0
+        assert m.noise_kind == pdp.NoiseKind.LAPLACE
+        assert "Laplace" in m.describe()
+
+    def test_laplace_from_std(self):
+        # normalized_stddev is the std divided by l1_sensitivity.
+        m = dp.LaplaceMechanism.create_from_std_deviation(2.0, 4.0)
+        assert m.std == pytest.approx(8.0)
+
+    def test_gaussian_properties(self):
+        m = dp.GaussianMechanism.create_from_epsilon_delta(1.0, 1e-6, 2.0)
+        assert m.std == pytest.approx(dp.compute_sigma(1.0, 1e-6, 2.0))
+        assert m.noise_kind == pdp.NoiseKind.GAUSSIAN
+        assert "Gaussian" in m.describe()
+
+    def test_gaussian_from_std(self):
+        m = dp.GaussianMechanism.create_from_std_deviation(3.0, 2.0)
+        assert m.std == pytest.approx(6.0)
+
+    def test_laplace_noise_distribution(self):
+        noise_core.seed_fallback_rng(0)
+        m = dp.LaplaceMechanism.create_from_epsilon(1.0, 1.0)
+        samples = np.array([m.add_noise(100.0) for _ in range(4000)])
+        assert samples.mean() == pytest.approx(100.0, abs=0.15)
+        assert samples.std() == pytest.approx(math.sqrt(2), rel=0.1)
+
+    def test_gaussian_noise_distribution(self):
+        noise_core.seed_fallback_rng(0)
+        m = dp.GaussianMechanism.create_from_std_deviation(2.0, 1.0)
+        samples = m.add_noise_vectorized(np.full(4000, 50.0))
+        assert samples.mean() == pytest.approx(50.0, abs=0.2)
+        assert samples.std() == pytest.approx(2.0, rel=0.1)
+
+    def test_vectorized_matches_scalar_distribution(self):
+        noise_core.seed_fallback_rng(1)
+        m = dp.LaplaceMechanism.create_from_epsilon(1.0, 1.0)
+        batch = m.add_noise_vectorized(np.zeros(4000))
+        assert batch.std() == pytest.approx(math.sqrt(2), rel=0.1)
+
+    def test_noise_is_snapped_to_granularity(self):
+        m = dp.LaplaceMechanism.create_from_epsilon(1.0, 1.0)
+        g = noise_core.laplace_granularity(1.0)
+        value = m.add_noise(0.0)
+        assert value / g == pytest.approx(round(value / g), abs=1e-6)
+
+    def test_create_additive_mechanism_from_spec(self):
+        spec = ba.MechanismSpec(MechanismType.LAPLACE)
+        spec.set_eps_delta(1.0, 0.0)
+        m = dp.create_additive_mechanism(spec, dp.Sensitivities(l0=2, linf=1))
+        assert isinstance(m, dp.LaplaceMechanism)
+        assert m.sensitivity == 2.0
+
+        spec2 = ba.MechanismSpec(MechanismType.GAUSSIAN)
+        spec2.set_noise_standard_deviation(3.0)
+        m2 = dp.create_additive_mechanism(spec2,
+                                          dp.Sensitivities(l0=4, linf=1))
+        assert isinstance(m2, dp.GaussianMechanism)
+        assert m2.std == pytest.approx(6.0)  # normalized_std * l2
+
+
+class TestMeanMechanism:
+
+    def test_no_noise_mean(self):
+        # Huge eps => negligible noise: mean of values in [0, 10].
+        count_spec = ba.MechanismSpec(MechanismType.LAPLACE)
+        count_spec.set_eps_delta(1e6, 0.0)
+        sum_spec = ba.MechanismSpec(MechanismType.LAPLACE)
+        sum_spec.set_eps_delta(1e6, 0.0)
+        mech = dp.create_mean_mechanism(5.0, count_spec,
+                                        dp.Sensitivities(l0=1, linf=1),
+                                        sum_spec,
+                                        dp.Sensitivities(l0=1, linf=5))
+        values = [1.0, 2.0, 6.0]
+        normalized_sum = sum(v - 5.0 for v in values)
+        dp_count, dp_sum, dp_mean = mech.compute_mean(len(values),
+                                                      normalized_sum)
+        assert dp_count == pytest.approx(3, abs=1e-3)
+        assert dp_mean == pytest.approx(3.0, abs=1e-3)
+        assert dp_sum == pytest.approx(9.0, abs=1e-2)
+
+
+class TestVariance:
+
+    def test_no_noise_variance(self):
+        params = dp.ScalarNoiseParams(
+            eps=1e8, delta=0.0,
+            min_value=0.0, max_value=10.0,
+            min_sum_per_partition=None, max_sum_per_partition=None,
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            noise_kind=pdp.NoiseKind.LAPLACE)
+        values = np.array([1.0, 3.0, 5.0, 7.0])
+        normalized = values - 5.0
+        dp_count, dp_sum, dp_mean, dp_var = dp.compute_dp_var(
+            len(values), normalized.sum(), (normalized**2).sum(), params)
+        assert dp_count == pytest.approx(4, abs=1e-2)
+        assert dp_mean == pytest.approx(4.0, abs=1e-2)
+        assert dp_var == pytest.approx(values.var(), abs=0.1)
+
+
+class TestVectorNoise:
+
+    def test_clip_linf(self):
+        v = dp._clip_vector(np.array([-5.0, 0.5, 3.0]), 1.0, NormKind.Linf)
+        np.testing.assert_allclose(v, [-1.0, 0.5, 1.0])
+
+    def test_clip_l2(self):
+        v = dp._clip_vector(np.array([3.0, 4.0]), 1.0, NormKind.L2)
+        np.testing.assert_allclose(v, [0.6, 0.8])
+
+    def test_clip_l1(self):
+        v = dp._clip_vector(np.array([2.0, 2.0]), 2.0, NormKind.L1)
+        np.testing.assert_allclose(v, [1.0, 1.0])
+
+    def test_add_noise_vector(self):
+        noise_core.seed_fallback_rng(0)
+        params = dp.AdditiveVectorNoiseParams(
+            eps_per_coordinate=1e6, delta_per_coordinate=0.0, max_norm=10.0,
+            l0_sensitivity=1, linf_sensitivity=1.0,
+            norm_kind=NormKind.Linf, noise_kind=pdp.NoiseKind.LAPLACE)
+        out = dp.add_noise_vector(np.array([1.0, 2.0]), params)
+        np.testing.assert_allclose(out, [1.0, 2.0], atol=1e-3)
+
+
+class TestBudgetSplit:
+
+    def test_equally_split_budget(self):
+        budgets = dp.equally_split_budget(1.0, 3e-6, 3)
+        assert len(budgets) == 3
+        assert sum(b[0] for b in budgets) == pytest.approx(1.0)
+        assert sum(b[1] for b in budgets) == pytest.approx(3e-6)
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            dp.equally_split_budget(1.0, 0.0, 0)
+
+
+class TestExponentialMechanism:
+
+    class Scoring(dp.ExponentialMechanism.ScoringFunction):
+
+        def score(self, k):
+            return float(k)
+
+        @property
+        def global_sensitivity(self):
+            return 1.0
+
+        @property
+        def is_monotonic(self):
+            return True
+
+    def test_probabilities(self):
+        mech = dp.ExponentialMechanism(self.Scoring())
+        probs = mech._calculate_probabilities(1.0, [0, 1, 2])
+        expected = np.exp([0.0, 1.0, 2.0])
+        expected /= expected.sum()
+        np.testing.assert_allclose(probs, expected, rtol=1e-12)
+
+    def test_prefers_high_scores(self):
+        mech = dp.ExponentialMechanism(self.Scoring())
+        picks = [mech.apply(5.0, [0, 1, 10]) for _ in range(50)]
+        assert picks.count(10) > 40
+
+
+class TestThresholdingMechanism:
+
+    def test_create_and_describe(self):
+        spec = ba.MechanismSpec(MechanismType.LAPLACE_THRESHOLDING)
+        spec.set_eps_delta(1.0, 1e-6)
+        mech = dp.create_thresholding_mechanism(
+            spec, dp.Sensitivities(l0=2, linf=1), pre_threshold=None)
+        assert mech.threshold() > 1
+        assert "Laplace Thresholding" in mech.describe()
+
+    def test_keeps_large_drops_small(self):
+        spec = ba.MechanismSpec(MechanismType.GAUSSIAN_THRESHOLDING)
+        spec.set_eps_delta(1.0, 1e-6)
+        mech = dp.create_thresholding_mechanism(
+            spec, dp.Sensitivities(l0=1, linf=1), pre_threshold=None)
+        big = int(mech.threshold()) + 100
+        assert mech.noised_value_if_should_keep(big) is not None
+        assert mech.noised_value_if_should_keep(1) is None
+
+
+class TestNoiseStdHelpers:
+
+    def test_count_noise_std_laplace(self):
+        params = dp.ScalarNoiseParams(
+            eps=1.0, delta=0.0, min_value=None, max_value=None,
+            min_sum_per_partition=None, max_sum_per_partition=None,
+            max_partitions_contributed=2, max_contributions_per_partition=3,
+            noise_kind=pdp.NoiseKind.LAPLACE)
+        # b = l1/eps = 6, std = 6*sqrt(2)
+        assert dp.compute_dp_count_noise_std(params) == pytest.approx(
+            6 * math.sqrt(2))
+
+    def test_sum_noise_std_gaussian(self):
+        params = dp.ScalarNoiseParams(
+            eps=1.0, delta=1e-6, min_value=None, max_value=None,
+            min_sum_per_partition=-2.0, max_sum_per_partition=4.0,
+            max_partitions_contributed=4, max_contributions_per_partition=None,
+            noise_kind=pdp.NoiseKind.GAUSSIAN)
+        expected = dp.compute_sigma(1.0, 1e-6, 4.0 * 2)  # l2 = sqrt(4)*4
+        assert dp.compute_dp_sum_noise_std(params) == pytest.approx(expected)

@@ -1,0 +1,169 @@
+"""Tests for private partition selection strategies.
+
+The truncated-geometric closed forms are validated against the exact
+saturated recurrence pi_{n+1} = min(e^eps pi_n + delta,
+1 - e^-eps (1 - pi_n - delta), 1) — the defining DP-optimality property
+(Desfontaines et al. 2022), which also pins the probabilities the way the
+reference's tests pin PyDP behavior (tests/dp_engine_test.py:38-45).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from pipelinedp_tpu import partition_selection as ps
+from pipelinedp_tpu.aggregate_params import PartitionSelectionStrategy
+
+
+def reference_pi(eps, delta, max_partitions, n_max):
+    """Exact recurrence for per-partition keep probabilities."""
+    eps_p = eps / max_partitions
+    delta_p = -math.expm1(math.log1p(-delta) / max_partitions)
+    pis = [0.0]
+    for _ in range(n_max):
+        pi = pis[-1]
+        branch_a = math.exp(eps_p) * pi + delta_p
+        branch_b = 1.0 - math.exp(-eps_p) * (1.0 - pi - delta_p)
+        pis.append(min(branch_a, branch_b, 1.0))
+    return np.array(pis[1:])
+
+
+class TestTruncatedGeometric:
+
+    @pytest.mark.parametrize("eps,delta,m", [
+        (1.0, 1e-6, 1),
+        (1.0, 1e-6, 8),
+        (0.1, 1e-5, 2),
+        (3.0, 1e-10, 4),
+        (0.5, 1e-3, 1),
+    ])
+    def test_matches_recurrence(self, eps, delta, m):
+        strategy = ps.TruncatedGeometricPartitionSelection(eps, delta, m)
+        n_max = 2000
+        expected = reference_pi(eps, delta, m, n_max)
+        actual = strategy.probability_of_keep_vec(np.arange(1, n_max + 1))
+        np.testing.assert_allclose(actual, expected, rtol=1e-9, atol=1e-12)
+
+    def test_zero_and_negative_counts(self):
+        strategy = ps.TruncatedGeometricPartitionSelection(1.0, 1e-6, 1)
+        assert strategy.probability_of_keep(0) == 0.0
+
+    def test_single_user_probability_is_delta(self):
+        # pi(1) = delta' per the recurrence.
+        strategy = ps.TruncatedGeometricPartitionSelection(1.0, 1e-6, 1)
+        assert strategy.probability_of_keep(1) == pytest.approx(1e-6, rel=1e-6)
+
+    def test_monotonic_and_saturates(self):
+        strategy = ps.TruncatedGeometricPartitionSelection(1.0, 1e-6, 2)
+        probs = strategy.probability_of_keep_vec(np.arange(1, 500))
+        assert np.all(np.diff(probs) >= -1e-15)
+        assert probs[-1] == pytest.approx(1.0)
+
+    def test_threshold_is_median_count(self):
+        strategy = ps.TruncatedGeometricPartitionSelection(1.0, 1e-6, 1)
+        t = int(strategy.threshold)
+        assert strategy.probability_of_keep(t) >= 0.5
+        assert strategy.probability_of_keep(t - 1) < 0.5
+
+    def test_should_keep_statistical(self):
+        ps.seed_rng(0)
+        strategy = ps.TruncatedGeometricPartitionSelection(1.0, 1e-6, 1)
+        n = int(strategy.threshold)
+        keeps = sum(strategy.should_keep(n) for _ in range(2000))
+        p = strategy.probability_of_keep(n)
+        assert abs(keeps / 2000 - p) < 0.05
+
+    def test_pre_threshold(self):
+        base = ps.TruncatedGeometricPartitionSelection(1.0, 1e-6, 1)
+        pre = ps.TruncatedGeometricPartitionSelection(1.0, 1e-6, 1,
+                                                      pre_threshold=10)
+        assert pre.probability_of_keep(9) == 0.0
+        assert pre.probability_of_keep(14) == pytest.approx(
+            base.probability_of_keep(5))
+
+
+class TestThresholding:
+
+    @pytest.mark.parametrize("strategy_enum", [
+        PartitionSelectionStrategy.LAPLACE_THRESHOLDING,
+        PartitionSelectionStrategy.GAUSSIAN_THRESHOLDING,
+    ])
+    def test_delta_bound_on_single_user(self, strategy_enum):
+        """P(keep | 1 user) must be <= delta (the defining property)."""
+        eps, delta, m = 1.0, 1e-6, 4
+        strategy = ps.create_partition_selection_strategy(
+            strategy_enum, eps, delta, m)
+        p1 = strategy.probability_of_keep(1)
+        assert 0 < p1 <= delta
+
+    def test_laplace_threshold_formula(self):
+        eps, delta, m = 1.0, 1e-6, 1
+        strategy = ps.LaplaceThresholdingPartitionSelection(eps, delta, m)
+        expected = 1.0 - (1.0 / eps) * math.log(2 * delta)
+        assert strategy.threshold == pytest.approx(expected)
+
+    def test_laplace_probability_of_keep(self):
+        strategy = ps.LaplaceThresholdingPartitionSelection(1.0, 1e-6, 1)
+        t = strategy.threshold
+        # At the threshold count the keep probability is exactly 1/2.
+        assert strategy.probability_of_keep(round(t)) == pytest.approx(
+            0.5, abs=0.2)
+        probs = strategy.probability_of_keep_vec(np.arange(1, 100))
+        assert np.all(np.diff(probs) >= -1e-15)
+
+    def test_noised_value_above_threshold(self):
+        strategy = ps.LaplaceThresholdingPartitionSelection(1.0, 1e-6, 1)
+        big_n = int(strategy.threshold) + 200
+        value = strategy.noised_value_if_should_keep(big_n)
+        assert value is not None
+        assert value >= strategy.threshold
+        assert value == pytest.approx(big_n, rel=0.2)
+
+    def test_noised_value_for_tiny_count_usually_none(self):
+        strategy = ps.LaplaceThresholdingPartitionSelection(1.0, 1e-6, 1)
+        results = [
+            strategy.noised_value_if_should_keep(1) for _ in range(200)
+        ]
+        assert sum(r is not None for r in results) == 0
+
+    def test_gaussian_sigma_calibration(self):
+        from pipelinedp_tpu import noise_core
+        eps, delta, m = 1.0, 1e-6, 4
+        strategy = ps.GaussianThresholdingPartitionSelection(eps, delta, m)
+        # sigma must satisfy the analytic Gaussian condition for (eps, delta/2)
+        # with l2 sensitivity sqrt(m).
+        achieved_delta = noise_core.gaussian_delta(strategy.sigma, eps,
+                                                   math.sqrt(m))
+        assert achieved_delta <= delta / 2 + 1e-12
+
+    def test_pre_threshold_shifts(self):
+        strategy = ps.LaplaceThresholdingPartitionSelection(1.0, 1e-6, 1,
+                                                            pre_threshold=100)
+        assert strategy.probability_of_keep(99) == 0.0
+        base = ps.LaplaceThresholdingPartitionSelection(1.0, 1e-6, 1)
+        assert strategy.threshold == pytest.approx(base.threshold + 99)
+
+
+class TestFactory:
+
+    def test_factory_types(self):
+        for enum, cls in [
+            (PartitionSelectionStrategy.TRUNCATED_GEOMETRIC,
+             ps.TruncatedGeometricPartitionSelection),
+            (PartitionSelectionStrategy.LAPLACE_THRESHOLDING,
+             ps.LaplaceThresholdingPartitionSelection),
+            (PartitionSelectionStrategy.GAUSSIAN_THRESHOLDING,
+             ps.GaussianThresholdingPartitionSelection),
+        ]:
+            assert isinstance(
+                ps.create_partition_selection_strategy(enum, 1.0, 1e-6, 2),
+                cls)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ps.TruncatedGeometricPartitionSelection(0, 1e-6, 1)
+        with pytest.raises(ValueError):
+            ps.TruncatedGeometricPartitionSelection(1, 0, 1)
+        with pytest.raises(ValueError):
+            ps.TruncatedGeometricPartitionSelection(1, 1e-6, 0)
