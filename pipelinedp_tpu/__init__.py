@@ -43,6 +43,8 @@ from pipelinedp_tpu.data_extractors import (
 from pipelinedp_tpu.report_generator import ExplainComputationReport
 from pipelinedp_tpu.backends.base import PipelineBackend
 from pipelinedp_tpu.backends.local import LocalBackend, MultiProcLocalBackend
+from pipelinedp_tpu.combiners import CustomCombiner
+from pipelinedp_tpu.dp_engine import DPEngine
 
 __version__ = "0.1.0"
 
@@ -53,6 +55,8 @@ __all__ = [
     "BudgetAccountant",
     "CalculatePrivateContributionBoundsParams",
     "CountParams",
+    "CustomCombiner",
+    "DPEngine",
     "DataExtractors",
     "ExplainComputationReport",
     "LocalBackend",

@@ -1,0 +1,751 @@
+"""Combiners: the per-metric accumulator algebra.
+
+A combiner encapsulates one DP aggregation: ``create_accumulator(values)``
+builds per-(privacy_id, partition) state, ``merge_accumulators`` is the
+associative reduce, ``compute_metrics`` applies the DP mechanism. The
+CompoundCombiner nests several of them with accumulator
+``(row_count, (child_accs...))``.
+
+Parity: pipeline_dp/combiners.py (Combiner ABC :32-85, CustomCombiner :88,
+CombinerParams :142, MechanismContainerMixin :203-217, AdditiveMechanismMixin
+:220, CountCombiner :241, PrivacyIdCountCombiner :283,
+PostAggregationThresholdingCombiner :328, SumCombiner :385, MeanCombiner
+:440, VarianceCombiner :522, QuantileCombiner :590-669, CompoundCombiner
+:698-797, VectorSumCombiner :800, create_compound_combiner :849-922,
+create_compound_combiner_with_custom_combiners :925).
+
+Serialization contract: mechanism objects are created lazily and dropped
+from pickled state (``MechanismContainerMixin.__getstate__``) so combiners
+can ship to workers before budgets resolve — the same MechanismSpec objects
+referenced in worker closures are mutated in place by compute_budgets() in
+the driver. The columnar JAX engine instead reads specs/sensitivities off
+the combiners and lowers them to batched kernels (pipelinedp_tpu/ops).
+"""
+
+from __future__ import annotations
+
+import abc
+import collections
+import copy
+from typing import Iterable, List, Optional, Sized, Tuple, Union
+
+import numpy as np
+
+from pipelinedp_tpu import budget_accounting
+from pipelinedp_tpu import dp_computations
+from pipelinedp_tpu import quantile_tree
+from pipelinedp_tpu.aggregate_params import (AggregateParams, Metrics,
+                                             NoiseKind, noise_to_thresholding)
+
+
+class Combiner(abc.ABC):
+    """Base combiner. Logic lives here; data lives in accumulators."""
+
+    @abc.abstractmethod
+    def create_accumulator(self, values):
+        """Creates an accumulator from one privacy ID's values."""
+
+    @abc.abstractmethod
+    def merge_accumulators(self, accumulator1, accumulator2):
+        """Associative merge."""
+
+    @abc.abstractmethod
+    def compute_metrics(self, accumulator):
+        """Applies the DP mechanism and returns the metric dict."""
+
+    @abc.abstractmethod
+    def metrics_names(self) -> List[str]:
+        ...
+
+    @abc.abstractmethod
+    def explain_computation(self):
+        """Returns a string or lazy callable describing the computation."""
+
+    def expects_per_partition_sampling(self) -> bool:
+        """If True the framework Linf-samples values before
+        create_accumulator; otherwise the combiner bounds sensitivity
+        itself."""
+        return True
+
+
+class CustomCombiner(Combiner, abc.ABC):
+    """User-provided combiner (experimental).
+
+    Must implement its own DP mechanism in compute_metrics and, if needed,
+    contribution bounding in create_accumulator. The budget accountant must
+    NOT be stored on self — it lives in the driver only.
+    """
+
+    @abc.abstractmethod
+    def request_budget(self,
+                       budget_accountant: budget_accounting.BudgetAccountant):
+        """Called during graph construction; store the returned spec on self."""
+
+    def set_aggregate_params(self, aggregate_params: AggregateParams):
+        self._aggregate_params = aggregate_params
+
+    def metrics_names(self) -> List[str]:
+        return [self.__class__.__name__]
+
+
+class CombinerParams:
+    """Bundle of (mechanism spec, aggregate params) handed to a combiner."""
+
+    def __init__(self, spec: budget_accounting.MechanismSpec,
+                 aggregate_params: AggregateParams):
+        self.mechanism_spec = spec
+        self.aggregate_params = copy.copy(aggregate_params)
+
+    @property
+    def eps(self):
+        return self.mechanism_spec.eps
+
+    @property
+    def delta(self):
+        return self.mechanism_spec.delta
+
+    @property
+    def scalar_noise_params(self) -> dp_computations.ScalarNoiseParams:
+        p = self.aggregate_params
+        return dp_computations.ScalarNoiseParams(
+            self.eps, self.delta, p.min_value, p.max_value,
+            p.min_sum_per_partition, p.max_sum_per_partition,
+            p.max_partitions_contributed, p.max_contributions_per_partition,
+            p.noise_kind)
+
+    @property
+    def additive_vector_noise_params(
+            self) -> dp_computations.AdditiveVectorNoiseParams:
+        p = self.aggregate_params
+        return dp_computations.AdditiveVectorNoiseParams(
+            eps_per_coordinate=self.eps / p.vector_size,
+            delta_per_coordinate=self.delta / p.vector_size,
+            max_norm=p.vector_max_norm,
+            l0_sensitivity=p.max_partitions_contributed,
+            linf_sensitivity=p.max_contributions_per_partition,
+            norm_kind=p.vector_norm_kind,
+            noise_kind=p.noise_kind)
+
+
+class MechanismContainerMixin(abc.ABC):
+    """Lazily creates and caches a DP mechanism; drops it from pickles."""
+
+    @abc.abstractmethod
+    def create_mechanism(
+        self
+    ) -> Union[dp_computations.AdditiveMechanism,
+               dp_computations.MeanMechanism,
+               dp_computations.ThresholdingMechanism]:
+        ...
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_mechanism", None)
+        return state
+
+    def get_mechanism(self):
+        if not hasattr(self, "_mechanism"):
+            self._mechanism = self.create_mechanism()
+        return self._mechanism
+
+
+class AdditiveMechanismMixin(MechanismContainerMixin):
+    """MechanismContainer specialization for additive mechanisms."""
+
+    def create_mechanism(self) -> dp_computations.AdditiveMechanism:
+        return dp_computations.create_additive_mechanism(
+            self.mechanism_spec(), self.sensitivities())
+
+    @abc.abstractmethod
+    def sensitivities(self) -> dp_computations.Sensitivities:
+        ...
+
+    @abc.abstractmethod
+    def mechanism_spec(self) -> budget_accounting.MechanismSpec:
+        ...
+
+
+class CountCombiner(Combiner, AdditiveMechanismMixin):
+    """DP COUNT. Accumulator: int element count."""
+    AccumulatorType = int
+
+    def __init__(self, mechanism_spec: budget_accounting.MechanismSpec,
+                 aggregate_params: AggregateParams):
+        self._mechanism_spec = mechanism_spec
+        self._sensitivities = dp_computations.compute_sensitivities_for_count(
+            aggregate_params)
+
+    def create_accumulator(self, values: Sized) -> int:
+        return len(values)
+
+    def merge_accumulators(self, count1: int, count2: int) -> int:
+        return count1 + count2
+
+    def compute_metrics(self, count: int) -> dict:
+        return {"count": self.get_mechanism().add_noise(count)}
+
+    def metrics_names(self) -> List[str]:
+        return ["count"]
+
+    def explain_computation(self):
+        return lambda: (f"Computed DP count with\n"
+                        f"     {self.get_mechanism().describe()}")
+
+    def mechanism_spec(self) -> budget_accounting.MechanismSpec:
+        return self._mechanism_spec
+
+    def sensitivities(self) -> dp_computations.Sensitivities:
+        return self._sensitivities
+
+
+class PrivacyIdCountCombiner(Combiner, AdditiveMechanismMixin):
+    """DP PRIVACY_ID_COUNT. Accumulator: int count of privacy ids."""
+    AccumulatorType = int
+
+    def __init__(self, mechanism_spec: budget_accounting.MechanismSpec,
+                 aggregate_params: AggregateParams):
+        self._mechanism_spec = mechanism_spec
+        self._sensitivities = (
+            dp_computations.compute_sensitivities_for_privacy_id_count(
+                aggregate_params))
+
+    def create_accumulator(self, values: Sized) -> int:
+        return 1 if values else 0
+
+    def merge_accumulators(self, count1: int, count2: int) -> int:
+        return count1 + count2
+
+    def compute_metrics(self, count: int) -> dict:
+        return {"privacy_id_count": self.get_mechanism().add_noise(count)}
+
+    def metrics_names(self) -> List[str]:
+        return ["privacy_id_count"]
+
+    def explain_computation(self):
+        return lambda: (f"Computed DP privacy_id_count with\n"
+                        f"     {self.get_mechanism().describe()}")
+
+    def mechanism_spec(self) -> budget_accounting.MechanismSpec:
+        return self._mechanism_spec
+
+    def sensitivities(self) -> dp_computations.Sensitivities:
+        return self._sensitivities
+
+    def expects_per_partition_sampling(self) -> bool:
+        return False
+
+
+class PostAggregationThresholdingCombiner(Combiner, MechanismContainerMixin):
+    """DP privacy-id count + thresholding partition selection in one step.
+
+    Requests its own (thresholding) budget at construction time.
+    """
+    AccumulatorType = int
+
+    def __init__(self, budget_accountant: budget_accounting.BudgetAccountant,
+                 aggregate_params: AggregateParams):
+        mechanism_type = noise_to_thresholding(aggregate_params.noise_kind)
+        self._mechanism_spec = budget_accountant.request_budget(
+            mechanism_type, weight=aggregate_params.budget_weight)
+        self._sensitivities = (
+            dp_computations.compute_sensitivities_for_privacy_id_count(
+                aggregate_params))
+        self._pre_threshold = aggregate_params.pre_threshold
+
+    def create_accumulator(self, values: Sized) -> int:
+        return 1 if values else 0
+
+    def merge_accumulators(self, count1: int, count2: int) -> int:
+        return count1 + count2
+
+    def compute_metrics(self, count: int) -> dict:
+        return {
+            "privacy_id_count":
+                self.get_mechanism().noised_value_if_should_keep(count)
+        }
+
+    def metrics_names(self) -> List[str]:
+        return ["privacy_id_count"]
+
+    def explain_computation(self):
+        return lambda: (f"Computed DP privacy_id_count with thresholding:\n"
+                        f"     {self.get_mechanism().describe()}")
+
+    def mechanism_spec(self) -> budget_accounting.MechanismSpec:
+        return self._mechanism_spec
+
+    def sensitivities(self) -> dp_computations.Sensitivities:
+        return self._sensitivities
+
+    def expects_per_partition_sampling(self) -> bool:
+        return False
+
+    def create_mechanism(self) -> dp_computations.ThresholdingMechanism:
+        return dp_computations.create_thresholding_mechanism(
+            self.mechanism_spec(), self.sensitivities(), self._pre_threshold)
+
+
+class SumCombiner(Combiner, AdditiveMechanismMixin):
+    """DP SUM with either per-contribution or per-partition clipping."""
+    AccumulatorType = float
+
+    def __init__(self, mechanism_spec: budget_accounting.MechanismSpec,
+                 aggregate_params: AggregateParams):
+        self._mechanism_spec = mechanism_spec
+        self._sensitivities = dp_computations.compute_sensitivities_for_sum(
+            aggregate_params)
+        self._bounding_per_partition = (
+            aggregate_params.bounds_per_partition_are_set)
+        if self._bounding_per_partition:
+            self._min_bound = aggregate_params.min_sum_per_partition
+            self._max_bound = aggregate_params.max_sum_per_partition
+        else:
+            self._min_bound = aggregate_params.min_value
+            self._max_bound = aggregate_params.max_value
+
+    def create_accumulator(self, values: Iterable[float]) -> float:
+        if self._bounding_per_partition:
+            # Sum first, then clip the per-partition sum.
+            return float(np.clip(sum(values), self._min_bound,
+                                 self._max_bound))
+        # Clip each value, then sum.
+        return float(
+            np.clip(np.asarray(list(values), dtype=np.float64),
+                    self._min_bound, self._max_bound).sum())
+
+    def merge_accumulators(self, sum1: float, sum2: float) -> float:
+        return sum1 + sum2
+
+    def compute_metrics(self, sum_: float) -> dict:
+        return {"sum": self.get_mechanism().add_noise(sum_)}
+
+    def metrics_names(self) -> List[str]:
+        return ["sum"]
+
+    def expects_per_partition_sampling(self) -> bool:
+        return not self._bounding_per_partition
+
+    def explain_computation(self):
+        return lambda: (f"Computed DP sum with\n"
+                        f"     {self.get_mechanism().describe()}")
+
+    def mechanism_spec(self) -> budget_accounting.MechanismSpec:
+        return self._mechanism_spec
+
+    def sensitivities(self) -> dp_computations.Sensitivities:
+        return self._sensitivities
+
+
+class MeanCombiner(Combiner, MechanismContainerMixin):
+    """DP MEAN (optionally also count and sum).
+
+    Accumulator: (count, normalized_sum) with values normalized to the middle
+    of [min_value, max_value].
+    """
+    AccumulatorType = Tuple[int, float]
+
+    def __init__(self, count_spec: budget_accounting.MechanismSpec,
+                 sum_spec: budget_accounting.MechanismSpec,
+                 params: AggregateParams, metrics_to_compute: Iterable[str]):
+        metrics_to_compute = list(metrics_to_compute)
+        if len(metrics_to_compute) != len(set(metrics_to_compute)):
+            raise ValueError(f"{metrics_to_compute} cannot contain duplicates")
+        for metric in metrics_to_compute:
+            if metric not in ("count", "sum", "mean"):
+                raise ValueError(
+                    f"{metric} should be one of ['count', 'sum', 'mean']")
+        if "mean" not in metrics_to_compute:
+            raise ValueError(
+                f"one of the {metrics_to_compute} should be 'mean'")
+        self._count_spec = count_spec
+        self._sum_spec = sum_spec
+        self._metrics_to_compute = metrics_to_compute
+        self._min_value = params.min_value
+        self._max_value = params.max_value
+        self._count_sensitivities = (
+            dp_computations.compute_sensitivities_for_count(params))
+        self._sum_sensitivities = (
+            dp_computations.compute_sensitivities_for_normalized_sum(params))
+
+    def create_accumulator(self,
+                           values: Iterable[float]) -> Tuple[int, float]:
+        values = np.asarray(list(values), dtype=np.float64)
+        middle = dp_computations.compute_middle(self._min_value,
+                                                self._max_value)
+        normalized = np.clip(values, self._min_value, self._max_value) - middle
+        return len(values), float(normalized.sum())
+
+    def merge_accumulators(self, accum1, accum2):
+        return accum1[0] + accum2[0], accum1[1] + accum2[1]
+
+    def compute_metrics(self, accum: Tuple[int, float]) -> dict:
+        count, normalized_sum = accum
+        noisy_count, noisy_sum, noisy_mean = self.get_mechanism().compute_mean(
+            count, normalized_sum)
+        result = {"mean": noisy_mean}
+        if "count" in self._metrics_to_compute:
+            result["count"] = noisy_count
+        if "sum" in self._metrics_to_compute:
+            result["sum"] = noisy_sum
+        return result
+
+    def metrics_names(self) -> List[str]:
+        return self._metrics_to_compute
+
+    def explain_computation(self):
+        return lambda: ("DP mean computation:\n" +
+                        self.get_mechanism().describe())
+
+    def create_mechanism(self) -> dp_computations.MeanMechanism:
+        middle = dp_computations.compute_middle(self._min_value,
+                                                self._max_value)
+        return dp_computations.create_mean_mechanism(
+            middle, self._count_spec, self._count_sensitivities,
+            self._sum_spec, self._sum_sensitivities)
+
+    def mechanism_spec(self):
+        return (self._count_spec, self._sum_spec)
+
+
+class VarianceCombiner(Combiner):
+    """DP VARIANCE (optionally also mean, sum, count).
+
+    Accumulator: (count, normalized_sum, normalized_sum_of_squares).
+    """
+    AccumulatorType = Tuple[int, float, float]
+
+    def __init__(self, params: CombinerParams,
+                 metrics_to_compute: Iterable[str]):
+        self._params = params
+        metrics_to_compute = list(metrics_to_compute)
+        if len(metrics_to_compute) != len(set(metrics_to_compute)):
+            raise ValueError(f"{metrics_to_compute} cannot contain duplicates")
+        for metric in metrics_to_compute:
+            if metric not in ("count", "sum", "mean", "variance"):
+                raise ValueError(f"{metric} should be one of "
+                                 f"['count', 'sum', 'mean', 'variance']")
+        if "variance" not in metrics_to_compute:
+            raise ValueError(
+                f"one of the {metrics_to_compute} should be 'variance'")
+        self._metrics_to_compute = metrics_to_compute
+
+    def create_accumulator(self, values) -> Tuple[int, float, float]:
+        p = self._params.aggregate_params
+        values = np.asarray(list(values), dtype=np.float64)
+        middle = dp_computations.compute_middle(p.min_value, p.max_value)
+        normalized = np.clip(values, p.min_value, p.max_value) - middle
+        return len(values), float(normalized.sum()), float(
+            (normalized**2).sum())
+
+    def merge_accumulators(self, accum1, accum2):
+        return (accum1[0] + accum2[0], accum1[1] + accum2[1],
+                accum1[2] + accum2[2])
+
+    def compute_metrics(self, accum) -> dict:
+        count, norm_sum, norm_sq = accum
+        noisy_count, noisy_sum, noisy_mean, noisy_var = (
+            dp_computations.compute_dp_var(count, norm_sum, norm_sq,
+                                           self._params.scalar_noise_params))
+        result = {"variance": noisy_var}
+        if "count" in self._metrics_to_compute:
+            result["count"] = noisy_count
+        if "sum" in self._metrics_to_compute:
+            result["sum"] = noisy_sum
+        if "mean" in self._metrics_to_compute:
+            result["mean"] = noisy_mean
+        return result
+
+    def metrics_names(self) -> List[str]:
+        return self._metrics_to_compute
+
+    def explain_computation(self):
+        return lambda: (f"Computed variance with (eps={self._params.eps} "
+                        f"delta={self._params.delta})")
+
+    def mechanism_spec(self) -> budget_accounting.MechanismSpec:
+        return self._params.mechanism_spec
+
+
+class QuantileCombiner(Combiner):
+    """DP percentiles via mergeable quantile-tree sketches.
+
+    Accumulator: serialized tree summary bytes (fixed-size dense array).
+    """
+    AccumulatorType = bytes
+
+    def __init__(self, params: CombinerParams,
+                 percentiles_to_compute: List[float]):
+        self._params = params
+        self._percentiles = percentiles_to_compute
+        self._quantiles_to_compute = [p / 100 for p in percentiles_to_compute]
+
+    def create_accumulator(self, values) -> bytes:
+        tree = self._create_empty_quantile_tree()
+        tree.add_entries(list(values))
+        return tree.serialize().to_bytes()
+
+    def merge_accumulators(self, acc1: bytes, acc2: bytes) -> bytes:
+        tree = self._create_empty_quantile_tree()
+        tree.merge(quantile_tree.bytes_to_summary(acc1))
+        tree.merge(quantile_tree.bytes_to_summary(acc2))
+        return tree.serialize().to_bytes()
+
+    def compute_metrics(self, accumulator: bytes) -> dict:
+        tree = self._create_empty_quantile_tree()
+        tree.merge(quantile_tree.bytes_to_summary(accumulator))
+        p = self._params.aggregate_params
+        quantiles = tree.compute_quantiles(self._params.eps,
+                                           self._params.delta,
+                                           p.max_partitions_contributed,
+                                           p.max_contributions_per_partition,
+                                           self._quantiles_to_compute,
+                                           self._noise_type())
+        return dict(zip(self.metrics_names(), quantiles))
+
+    def metrics_names(self) -> List[str]:
+
+        def format_name(p: float) -> str:
+            int_p = int(round(p))
+            text = str(int_p) if int_p == p else str(p).replace(".", "_")
+            return f"percentile_{text}"
+
+        return [format_name(p) for p in self._percentiles]
+
+    def explain_computation(self):
+        return lambda: (f"Computed percentiles {self._percentiles} with "
+                        f"(eps={self._params.eps} delta={self._params.delta})")
+
+    def _create_empty_quantile_tree(self) -> quantile_tree.QuantileTree:
+        p = self._params.aggregate_params
+        return quantile_tree.QuantileTree(
+            p.min_value, p.max_value, quantile_tree.DEFAULT_TREE_HEIGHT,
+            quantile_tree.DEFAULT_BRANCHING_FACTOR)
+
+    def _noise_type(self) -> str:
+        noise_kind = self._params.aggregate_params.noise_kind
+        if noise_kind == NoiseKind.LAPLACE:
+            return "laplace"
+        if noise_kind == NoiseKind.GAUSSIAN:
+            return "gaussian"
+        raise ValueError(f"{noise_kind} is not supported by quantile tree.")
+
+    def mechanism_spec(self) -> budget_accounting.MechanismSpec:
+        return self._params.mechanism_spec
+
+
+# -- namedtuple output type (picklable across processes) ---------------------
+
+_named_tuple_cache = {}
+
+
+def _get_or_create_named_tuple(type_name: str, field_names: tuple):
+    """namedtuple type with a __reduce__ making instances picklable even
+    though the type is created dynamically."""
+    cache_key = (type_name, field_names)
+    named_tuple = _named_tuple_cache.get(cache_key)
+    if named_tuple is None:
+        named_tuple = collections.namedtuple(type_name, field_names)
+        named_tuple.__reduce__ = lambda self: (_create_named_tuple_instance,
+                                               (type_name, field_names,
+                                                tuple(self)))
+        _named_tuple_cache[cache_key] = named_tuple
+    return named_tuple
+
+
+def _create_named_tuple_instance(type_name: str, field_names: tuple, values):
+    return _get_or_create_named_tuple(type_name, field_names)(*values)
+
+
+class CompoundCombiner(Combiner):
+    """Nests several combiners; accumulator = (row_count, (child_accs...)).
+
+    row_count counts input rows (after grouping by privacy id it is the
+    privacy-id count, which private partition selection consumes).
+    """
+
+    AccumulatorType = Tuple[int, Tuple]
+
+    def __init__(self, combiners: Iterable[Combiner],
+                 return_named_tuple: bool):
+        self._combiners = list(combiners)
+        self._return_named_tuple = return_named_tuple
+        self._metrics_to_compute = []
+        if not return_named_tuple:
+            return
+        for combiner in self._combiners:
+            self._metrics_to_compute.extend(combiner.metrics_names())
+        if len(self._metrics_to_compute) != len(set(self._metrics_to_compute)):
+            raise ValueError(
+                f"two combiners in {combiners} cannot compute the same metrics"
+            )
+        self._metrics_to_compute = tuple(self._metrics_to_compute)
+        self._MetricsTuple = _get_or_create_named_tuple(
+            "MetricsTuple", self._metrics_to_compute)
+
+    @property
+    def combiners(self) -> List[Combiner]:
+        return self._combiners
+
+    def create_accumulator(self, values) -> "CompoundCombiner.AccumulatorType":
+        return (1,
+                tuple(
+                    combiner.create_accumulator(values)
+                    for combiner in self._combiners))
+
+    def merge_accumulators(self, acc1, acc2):
+        row_count1, children1 = acc1
+        row_count2, children2 = acc2
+        merged = tuple(
+            combiner.merge_accumulators(a1, a2)
+            for combiner, a1, a2 in zip(self._combiners, children1, children2))
+        return (row_count1 + row_count2, merged)
+
+    def compute_metrics(self, compound_accumulator):
+        _, children = compound_accumulator
+        if not self._return_named_tuple:
+            return tuple(
+                combiner.compute_metrics(acc)
+                for combiner, acc in zip(self._combiners, children))
+        combined = {}
+        for combiner, acc in zip(self._combiners, children):
+            metrics = combiner.compute_metrics(acc)
+            for name in metrics:
+                if name in combined:
+                    raise Exception(
+                        f"{name} computed by {combiner} was already computed "
+                        f"by another combiner")
+            combined.update(metrics)
+        return _create_named_tuple_instance("MetricsTuple",
+                                            tuple(combined.keys()),
+                                            tuple(combined.values()))
+
+    def metrics_names(self) -> List[str]:
+        return self._metrics_to_compute
+
+    def explain_computation(self):
+        return [combiner.explain_computation() for combiner in self._combiners]
+
+    def expects_per_partition_sampling(self) -> bool:
+        return any(c.expects_per_partition_sampling()
+                   for c in self._combiners)
+
+
+class VectorSumCombiner(Combiner):
+    """DP VECTOR_SUM. Accumulator: np.ndarray of shape (vector_size,)."""
+    AccumulatorType = np.ndarray
+
+    def __init__(self, params: CombinerParams):
+        self._params = params
+
+    def create_accumulator(self, values) -> np.ndarray:
+        expected_shape = (self._params.aggregate_params.vector_size,)
+        array_sum = None
+        for value in values:
+            value = np.asarray(value)
+            if value.shape != expected_shape:
+                raise TypeError(
+                    f"Shape mismatch: {value.shape} != {expected_shape}")
+            array_sum = value if array_sum is None else array_sum + value
+        return array_sum
+
+    def merge_accumulators(self, sum1: np.ndarray,
+                           sum2: np.ndarray) -> np.ndarray:
+        return sum1 + sum2
+
+    def compute_metrics(self, array_sum: np.ndarray) -> dict:
+        return {
+            "vector_sum":
+                dp_computations.add_noise_vector(
+                    array_sum, self._params.additive_vector_noise_params)
+        }
+
+    def metrics_names(self) -> List[str]:
+        return ["vector_sum"]
+
+    def explain_computation(self):
+        return lambda: (f"Computed vector sum with (eps={self._params.eps} "
+                        f"delta={self._params.delta})")
+
+    def mechanism_spec(self) -> budget_accounting.MechanismSpec:
+        return self._params.mechanism_spec
+
+
+def create_compound_combiner(
+        aggregate_params: AggregateParams,
+        budget_accountant: budget_accounting.BudgetAccountant
+) -> CompoundCombiner:
+    """Builds the CompoundCombiner for the requested metrics, requesting one
+    budget per underlying mechanism (VARIANCE subsumes MEAN subsumes
+    COUNT/SUM so their budgets are not double-requested)."""
+    combiners = []
+    metrics = aggregate_params.metrics
+    mechanism_type = aggregate_params.noise_kind.convert_to_mechanism_type()
+    weight = aggregate_params.budget_weight
+
+    if Metrics.VARIANCE in metrics:
+        spec = budget_accountant.request_budget(mechanism_type, weight=weight)
+        extra = [
+            name for metric, name in ((Metrics.MEAN, "mean"),
+                                      (Metrics.COUNT, "count"),
+                                      (Metrics.SUM, "sum")) if metric in metrics
+        ]
+        combiners.append(
+            VarianceCombiner(CombinerParams(spec, aggregate_params),
+                             ["variance"] + extra))
+    elif Metrics.MEAN in metrics:
+        count_spec = budget_accountant.request_budget(mechanism_type,
+                                                      weight=weight)
+        sum_spec = budget_accountant.request_budget(mechanism_type,
+                                                    weight=weight)
+        extra = [
+            name for metric, name in ((Metrics.COUNT, "count"),
+                                      (Metrics.SUM, "sum")) if metric in metrics
+        ]
+        combiners.append(
+            MeanCombiner(count_spec, sum_spec, aggregate_params,
+                         ["mean"] + extra))
+    else:
+        if Metrics.COUNT in metrics:
+            spec = budget_accountant.request_budget(mechanism_type,
+                                                    weight=weight)
+            combiners.append(CountCombiner(spec, aggregate_params))
+        if Metrics.SUM in metrics:
+            spec = budget_accountant.request_budget(mechanism_type,
+                                                    weight=weight)
+            combiners.append(SumCombiner(spec, aggregate_params))
+
+    if Metrics.PRIVACY_ID_COUNT in metrics:
+        if aggregate_params.post_aggregation_thresholding:
+            combiners.append(
+                PostAggregationThresholdingCombiner(budget_accountant,
+                                                    aggregate_params))
+        else:
+            spec = budget_accountant.request_budget(mechanism_type,
+                                                    weight=weight)
+            combiners.append(PrivacyIdCountCombiner(spec, aggregate_params))
+
+    if Metrics.VECTOR_SUM in metrics:
+        spec = budget_accountant.request_budget(mechanism_type, weight=weight)
+        combiners.append(
+            VectorSumCombiner(CombinerParams(spec, aggregate_params)))
+
+    percentiles = [m.parameter for m in metrics if m.is_percentile]
+    if percentiles:
+        spec = budget_accountant.request_budget(mechanism_type, weight=weight)
+        combiners.append(
+            QuantileCombiner(CombinerParams(spec, aggregate_params),
+                             percentiles))
+
+    return CompoundCombiner(combiners, return_named_tuple=True)
+
+
+def create_compound_combiner_with_custom_combiners(
+        aggregate_params: AggregateParams,
+        budget_accountant: budget_accounting.BudgetAccountant,
+        custom_combiners: Iterable[CustomCombiner]) -> CompoundCombiner:
+    for combiner in custom_combiners:
+        params_copy = copy.copy(aggregate_params)
+        params_copy.custom_combiners = None
+        combiner.set_aggregate_params(params_copy)
+        combiner.request_budget(budget_accountant)
+    return CompoundCombiner(custom_combiners, return_named_tuple=False)

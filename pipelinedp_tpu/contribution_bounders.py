@@ -1,0 +1,173 @@
+"""Contribution bounders: enforce L0/Linf/L1 sensitivity by per-key sampling.
+
+Parity: pipeline_dp/contribution_bounders.py (ContributionBounder ABC :31,
+SamplingCrossAndPerPartitionContributionBounder :62-111,
+SamplingPerPrivacyIdContributionBounder :114-156,
+SamplingCrossPartitionContributionBounder :159-201, LinfSampler :204-230,
+NoOpSampler :233-246, collect_values_per_partition_key_per_privacy_id :249).
+
+These are expressed purely in backend primitives so any backend (local
+generators or the columnar JAX backend, which lowers sample_fixed_per_key to
+a sort + random-rank kernel) executes them.
+"""
+
+from __future__ import annotations
+
+import abc
+import collections
+from typing import Callable, Iterable
+
+from pipelinedp_tpu import sampling_utils
+from pipelinedp_tpu.backends import base
+
+
+class ContributionBounder(abc.ABC):
+    """Bounds each privacy unit's contributions, then aggregates.
+
+    ``bound_contributions`` receives (privacy_id, partition_key, value) rows
+    and returns ((privacy_id, partition_key), accumulator) after applying
+    ``aggregate_fn`` to the surviving values of each (pid, pk) group.
+    """
+
+    @abc.abstractmethod
+    def bound_contributions(self, col, params, backend: base.PipelineBackend,
+                            report_generator, aggregate_fn: Callable):
+        ...
+
+
+class SamplingCrossAndPerPartitionContributionBounder(ContributionBounder):
+    """L0 + Linf bounding: samples values within each (pid, pk) to
+    max_contributions_per_partition, then samples partitions per pid to
+    max_partitions_contributed."""
+
+    def bound_contributions(self, col, params, backend, report_generator,
+                            aggregate_fn):
+        max_partitions = params.max_partitions_contributed
+        max_per_partition = params.max_contributions_per_partition
+        col = backend.map_tuple(
+            col, lambda pid, pk, v: ((pid, pk), v),
+            "Rekey to ((privacy_id, partition_key), value)")
+        col = backend.sample_fixed_per_key(
+            col, max_per_partition, "Sample per (privacy_id, partition_key)")
+        report_generator.add_stage(
+            f"Per-partition contribution bounding: for each privacy_id and "
+            f"each partition, randomly select "
+            f"max(actual_contributions_per_partition, {max_per_partition}) "
+            f"contributions.")
+        col = backend.map_values(
+            col, aggregate_fn, "Apply aggregate_fn after per partition "
+            "bounding")
+        # ((pid, pk), accumulator) -> (pid, (pk, accumulator))
+        col = backend.map_tuple(
+            col, lambda pid_pk, acc: (pid_pk[0], (pid_pk[1], acc)),
+            "Rekey to (privacy_id, (partition_key, accumulator))")
+        col = backend.sample_fixed_per_key(col, max_partitions,
+                                           "Sample per privacy_id")
+        report_generator.add_stage(
+            f"Cross-partition contribution bounding: for each privacy_id "
+            f"randomly select max(actual_partition_contributed, "
+            f"{max_partitions}) partitions")
+
+        def unnest(pid, pk_accs):
+            return (((pid, pk), acc) for pk, acc in pk_accs)
+
+        return backend.flat_map(
+            col, lambda kv: unnest(*kv), "Rekey by privacy_id and unnest")
+
+
+class SamplingPerPrivacyIdContributionBounder(ContributionBounder):
+    """L1 bounding: samples each privacy id's total contributions down to
+    max_contributions, across all partitions."""
+
+    def bound_contributions(self, col, params, backend, report_generator,
+                            aggregate_fn):
+        max_contributions = params.max_contributions
+        col = backend.map_tuple(
+            col, lambda pid, pk, v: (pid, (pk, v)),
+            "Rekey to (privacy_id, (partition_key, value))")
+        col = backend.sample_fixed_per_key(col, max_contributions,
+                                           "Sample per privacy_id")
+        report_generator.add_stage(
+            f"User contribution bounding: randomly selected not more than "
+            f"{max_contributions} contributions")
+        col = collect_values_per_partition_key_per_privacy_id(col, backend)
+
+        def unnest(pid, partition_values):
+            return (((pid, pk), values) for pk, values in partition_values)
+
+        col = backend.flat_map(col, lambda kv: unnest(*kv), "Unnest")
+        return backend.map_values(
+            col, aggregate_fn,
+            "Apply aggregate_fn after per privacy_id contribution bounding")
+
+
+class SamplingCrossPartitionContributionBounder(ContributionBounder):
+    """L0-only bounding: samples partitions per privacy id; per-partition
+    bounding is the aggregate_fn's responsibility (e.g. per-partition sum
+    clipping)."""
+
+    def bound_contributions(self, col, params, backend, report_generator,
+                            aggregate_fn):
+        col = backend.map_tuple(
+            col, lambda pid, pk, v: (pid, (pk, v)),
+            "Rekey to (privacy_id, (partition_key, value))")
+        col = backend.group_by_key(col, "Group by privacy_id")
+        col = collect_values_per_partition_key_per_privacy_id(col, backend)
+        sample = sampling_utils.choose_from_list_without_replacement
+        sample_size = params.max_partitions_contributed
+        col = backend.map_values(col, lambda a: sample(a, sample_size),
+                                 "Sample")
+
+        def unnest(pid, partition_values):
+            return (((pid, pk), values) for pk, values in partition_values)
+
+        col = backend.flat_map(col, lambda kv: unnest(*kv),
+                               "Unnest per privacy_id")
+        return backend.map_values(
+            col, aggregate_fn,
+            "Apply aggregate_fn after cross-partition contribution bounding")
+
+
+class LinfSampler(ContributionBounder):
+    """Linf-only bounding: samples values within each (pid, pk)."""
+
+    def bound_contributions(self, col, params, backend, report_generator,
+                            aggregate_fn):
+        col = backend.map_tuple(
+            col, lambda pid, pk, v: ((pid, pk), v),
+            "Rekey to ((privacy_id, partition_key), value)")
+        col = backend.sample_fixed_per_key(
+            col, params.max_contributions_per_partition,
+            "Sample per (privacy_id, partition_key)")
+        report_generator.add_stage(
+            f"Per-partition contribution bounding: for each privacy_id and "
+            f"each partition, randomly select "
+            f"max(actual_contributions_per_partition, "
+            f"{params.max_contributions_per_partition}) contributions.")
+        return backend.map_values(col, aggregate_fn, "Apply aggregate_fn")
+
+
+class NoOpSampler(ContributionBounder):
+    """No bounding: groups per (pid, pk) and aggregates everything."""
+
+    def bound_contributions(self, col, params, backend, report_generator,
+                            aggregate_fn):
+        col = backend.map_tuple(
+            col, lambda pid, pk, v: ((pid, pk), v),
+            "Rekey to ((privacy_id, partition_key), value)")
+        col = backend.group_by_key(col, "Group by (privacy_id, partition_key)")
+        return backend.map_values(col, aggregate_fn, "Apply aggregate_fn")
+
+
+def collect_values_per_partition_key_per_privacy_id(
+        col, backend: base.PipelineBackend):
+    """(pid, Iterable[(pk, value)]) -> (pid, [(pk, [values])])."""
+
+    def collect(pairs: Iterable):
+        grouped = collections.defaultdict(list)
+        for key, value in pairs:
+            grouped[key].append(value)
+        return list(grouped.items())
+
+    return backend.map_values(
+        col, collect, "Collect values per privacy_id and partition_key")

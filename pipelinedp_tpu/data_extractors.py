@@ -14,11 +14,11 @@ class DataExtractors:
     """Functions projecting an input row onto the three DP-relevant columns.
 
     ``privacy_id_extractor`` maps a row to the unit of privacy (e.g. user id),
-    ``partition_key_extractor`` to the group-by key, ``value_extractor`` to the
+    ``partition_extractor`` to the group-by key, ``value_extractor`` to the
     numeric value being aggregated (may be None for COUNT-only pipelines).
     """
     privacy_id_extractor: Optional[Callable[[Any], Any]] = None
-    partition_key_extractor: Optional[Callable[[Any], Any]] = None
+    partition_extractor: Optional[Callable[[Any], Any]] = None
     value_extractor: Optional[Callable[[Any], Any]] = None
 
 

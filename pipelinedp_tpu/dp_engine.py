@@ -1,0 +1,506 @@
+"""DPEngine: composes backend + combiners + bounders + selection into the
+lazy DP aggregation graph.
+
+Parity: pipeline_dp/dp_engine.py (DPEngine :31, aggregate :65, _aggregate
+:109-187, select_partitions :212, _select_partitions :234, _drop_partitions
+:290, _add_empty_public_partitions :298, _select_private_partitions_internal
+:315-371, _create_contribution_bounder :380-400,
+calculate_private_contribution_bounds :450, add_dp_noise :551, _annotate
+:609).
+
+Graph (aggregate): extract -> drop non-public -> bound contributions ->
+reduce per key -> add empty publics -> select private partitions -> compute
+DP metrics -> post-aggregation threshold. Everything is lazy; budgets
+resolve via BudgetAccountant.compute_budgets() before execution.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+from pipelinedp_tpu import budget_accounting
+from pipelinedp_tpu import combiners
+from pipelinedp_tpu import contribution_bounders
+from pipelinedp_tpu import dp_computations
+from pipelinedp_tpu import partition_selection
+from pipelinedp_tpu import pipeline_functions
+from pipelinedp_tpu import report_generator as report_generator_lib
+from pipelinedp_tpu import sampling_utils
+from pipelinedp_tpu.aggregate_params import (
+    AddDPNoiseParams, AggregateParams,
+    CalculatePrivateContributionBoundsParams, MechanismType, Metric, Metrics,
+    PartitionSelectionStrategy, PrivateContributionBounds,
+    SelectPartitionsParams)
+from pipelinedp_tpu.backends import base
+from pipelinedp_tpu.data_extractors import DataExtractors
+from pipelinedp_tpu.report_generator import ExplainComputationReport
+
+
+class DPEngine:
+    """Performs DP aggregations on a pipeline backend."""
+
+    def __init__(self, budget_accountant: budget_accounting.BudgetAccountant,
+                 backend: base.PipelineBackend):
+        self._budget_accountant = budget_accountant
+        self._backend = backend
+        self._report_generators = []
+
+    # -- explain-computation plumbing ---------------------------------------
+
+    @property
+    def _current_report_generator(self):
+        return self._report_generators[-1]
+
+    def _add_report_generator(self,
+                              params,
+                              method_name: str,
+                              is_public_partition: Optional[bool] = None):
+        self._report_generators.append(
+            report_generator_lib.ReportGenerator(params, method_name,
+                                                 is_public_partition))
+
+    def _add_report_stage(self, stage_description):
+        self._current_report_generator.add_stage(stage_description)
+
+    def _add_report_stages(self, stages_description):
+        for stage in stages_description:
+            self._add_report_stage(stage)
+
+    def explain_computations_report(self):
+        return [generator.report() for generator in self._report_generators]
+
+    # -- aggregate ----------------------------------------------------------
+
+    def aggregate(self,
+                  col,
+                  params: AggregateParams,
+                  data_extractors: DataExtractors,
+                  public_partitions=None,
+                  out_explain_computation_report: Optional[
+                      ExplainComputationReport] = None):
+        """Computes DP metrics per partition key.
+
+        Returns a collection of (partition_key, metrics namedtuple). With
+        public_partitions=None partitions are selected privately.
+        """
+        self._check_aggregate_params(col, params, data_extractors)
+        self._check_budget_accountant_compatibility(
+            public_partitions is not None, params.metrics,
+            params.custom_combiners is not None)
+        with self._budget_accountant.scope(weight=params.budget_weight):
+            self._add_report_generator(params, "aggregate",
+                                       public_partitions is not None)
+            if out_explain_computation_report is not None:
+                out_explain_computation_report._set_report_generator(
+                    self._current_report_generator)
+            col = self._aggregate(col, params, data_extractors,
+                                  public_partitions)
+            budget = self._budget_accountant._compute_budget_for_aggregation(
+                params.budget_weight)
+            return self._annotate(col, params=params, budget=budget)
+
+    def _aggregate(self, col, params: AggregateParams,
+                   data_extractors: DataExtractors, public_partitions):
+        if params.custom_combiners:
+            combiner = combiners.create_compound_combiner_with_custom_combiners(
+                params, self._budget_accountant, params.custom_combiners)
+        else:
+            combiner = self._create_compound_combiner(params)
+
+        col = self._extract_columns(col, data_extractors)
+        # col: (privacy_id, partition_key, value)
+
+        if (public_partitions is not None and
+                not params.public_partitions_already_filtered):
+            col = self._drop_partitions(col,
+                                        public_partitions,
+                                        partition_extractor=lambda row: row[1])
+            self._add_report_stage(
+                "Public partition selection: dropped non public partitions")
+
+        if not params.contribution_bounds_already_enforced:
+            bounder = self._create_contribution_bounder(
+                params, combiner.expects_per_partition_sampling())
+            col = bounder.bound_contributions(col, params, self._backend,
+                                              self._current_report_generator,
+                                              combiner.create_accumulator)
+            # col: ((privacy_id, partition_key), accumulator)
+            col = self._backend.map_tuple(col, lambda pid_pk, acc:
+                                          (pid_pk[1], acc), "Drop privacy id")
+        else:
+            col = self._backend.map(col, lambda row: row[1:],
+                                    "Remove privacy_id")
+            col = self._backend.map_values(
+                col, lambda value: combiner.create_accumulator([value]),
+                "Wrap values into accumulators")
+        # col: (partition_key, accumulator)
+
+        if public_partitions:
+            col = self._add_empty_public_partitions(
+                col, public_partitions, combiner.create_accumulator)
+
+        col = self._backend.combine_accumulators_per_key(
+            col, combiner, "Reduce accumulators per partition key")
+
+        if (public_partitions is None and
+                not params.post_aggregation_thresholding):
+            max_rows_per_privacy_id = 1
+            if params.contribution_bounds_already_enforced:
+                # Without privacy ids in the input we can only lower-bound the
+                # number of privacy units per partition from the row count.
+                max_rows_per_privacy_id = (
+                    params.max_contributions or
+                    params.max_contributions_per_partition)
+            col = self._select_private_partitions_internal(
+                col, params.max_partitions_contributed,
+                max_rows_per_privacy_id, params.partition_selection_strategy,
+                params.pre_threshold)
+
+        self._add_report_stages(combiner.explain_computation())
+        col = self._backend.map_values(col, combiner.compute_metrics,
+                                       "Compute DP metrics")
+
+        if params.post_aggregation_thresholding:
+            col = self._drop_partitions_under_threshold(col)
+        return col
+
+    # -- select_partitions --------------------------------------------------
+
+    def select_partitions(self, col, params: SelectPartitionsParams,
+                          data_extractors: DataExtractors):
+        """Returns a DP-selected collection of partition keys."""
+        self._check_select_private_partitions(col, params, data_extractors)
+        self._check_budget_accountant_compatibility(False, [], False)
+        with self._budget_accountant.scope(weight=params.budget_weight):
+            self._add_report_generator(params, "select_partitions")
+            col = self._select_partitions(col, params, data_extractors)
+            budget = self._budget_accountant._compute_budget_for_aggregation(
+                params.budget_weight)
+            return self._annotate(col, params=params, budget=budget)
+
+    def _select_partitions(self, col, params: SelectPartitionsParams,
+                           data_extractors: DataExtractors):
+        max_partitions = params.max_partitions_contributed
+        col = self._backend.map(
+            col, lambda row: (data_extractors.privacy_id_extractor(row),
+                              data_extractors.partition_extractor(row)),
+            "Extract (privacy_id, partition_key)")
+        col = self._backend.group_by_key(col, "Group by privacy_id")
+
+        # Dedupe each privacy id's partitions and L0-sample them. Note: not
+        # scalable if one privacy id contributes to an extreme number of
+        # partitions (same caveat as the reference, dp_engine.py:252-253).
+        def sample_unique(pid_and_pks):
+            pid, pks = pid_and_pks
+            unique_pks = list(set(pks))
+            sampled = sampling_utils.choose_from_list_without_replacement(
+                unique_pks, max_partitions)
+            return ((pid, pk) for pk in sampled)
+
+        col = self._backend.flat_map(col, sample_unique,
+                                     "Sample cross-partition contributions")
+        compound = combiners.CompoundCombiner([], return_named_tuple=False)
+        col = self._backend.map_tuple(
+            col, lambda pid, pk: (pk, compound.create_accumulator([])),
+            "Drop privacy id and add accumulator")
+        col = self._backend.combine_accumulators_per_key(
+            col, compound, "Combine accumulators per partition key")
+        col = self._select_private_partitions_internal(
+            col, max_partitions, 1, params.partition_selection_strategy,
+            params.pre_threshold)
+        return self._backend.keys(
+            col, "Drop accumulators, keep only partition keys")
+
+    # -- helpers ------------------------------------------------------------
+
+    def _drop_partitions(self, col, partitions,
+                         partition_extractor: Callable):
+        col = pipeline_functions.key_by(self._backend, col,
+                                        partition_extractor,
+                                        "Key by partition")
+        col = self._backend.filter_by_key(col, partitions,
+                                          "Filtering out partitions")
+        return self._backend.values(col, "Drop key")
+
+    def _add_empty_public_partitions(self, col, public_partitions,
+                                     aggregator_fn):
+        self._add_report_stage(
+            "Adding empty partitions for public partitions that are missing "
+            "in data")
+        public_partitions = self._backend.to_collection(
+            public_partitions, col, "Public partitions to collection")
+        empty = self._backend.map(
+            public_partitions, lambda pk: (pk, aggregator_fn([])),
+            "Build empty accumulators")
+        return self._backend.flatten(
+            (col, empty), "Join public partitions with partitions from data")
+
+    def _select_private_partitions_internal(
+            self, col, max_partitions_contributed: int,
+            max_rows_per_privacy_id: int,
+            strategy: PartitionSelectionStrategy,
+            pre_threshold: Optional[int]):
+        """Filters (pk, compound accumulator) by DP partition selection."""
+        budget = self._budget_accountant.request_budget(
+            mechanism_type=MechanismType.GENERIC)
+
+        def filter_fn(budget, max_partitions, max_rows_per_privacy_id,
+                      strategy, pre_threshold, row) -> bool:
+            # Lazily creates the selection strategy (budget resolves after
+            # graph construction, and strategy objects don't serialize).
+            row_count, _ = row[1]
+            privacy_id_count = (row_count + max_rows_per_privacy_id -
+                                1) // max_rows_per_privacy_id
+            selector = partition_selection.create_partition_selection_strategy(
+                strategy, budget.eps, budget.delta, max_partitions,
+                pre_threshold)
+            return selector.should_keep(privacy_id_count)
+
+        filter_fn = functools.partial(filter_fn, budget,
+                                      max_partitions_contributed,
+                                      max_rows_per_privacy_id, strategy,
+                                      pre_threshold)
+        pre_threshold_str = (f", pre_threshold={pre_threshold}"
+                             if pre_threshold else "")
+        self._add_report_stage(
+            lambda: f"Private Partition selection: using {strategy.value} "
+                    f"method with (eps={budget.eps}, delta={budget.delta}"
+                    f"{pre_threshold_str})")
+        return self._backend.filter(col, filter_fn,
+                                    "Filter private partitions")
+
+    def _create_compound_combiner(
+            self, params: AggregateParams) -> combiners.CompoundCombiner:
+        return combiners.create_compound_combiner(params,
+                                                  self._budget_accountant)
+
+    def _create_contribution_bounder(
+            self, params: AggregateParams,
+            expects_per_partition_sampling: bool
+    ) -> contribution_bounders.ContributionBounder:
+        if params.max_contributions:
+            return (contribution_bounders.
+                    SamplingPerPrivacyIdContributionBounder())
+        if params.perform_cross_partition_contribution_bounding:
+            if expects_per_partition_sampling:
+                return (contribution_bounders.
+                        SamplingCrossAndPerPartitionContributionBounder())
+            return (contribution_bounders.
+                    SamplingCrossPartitionContributionBounder())
+        if expects_per_partition_sampling:
+            return contribution_bounders.LinfSampler()
+        return contribution_bounders.NoOpSampler()
+
+    def _extract_columns(self, col, data_extractors: DataExtractors):
+        pid_extractor = data_extractors.privacy_id_extractor
+        if pid_extractor is None:
+            pid_extractor = lambda row: None
+        value_extractor = data_extractors.value_extractor
+        if value_extractor is None:
+            # COUNT-only pipelines don't need values.
+            value_extractor = lambda row: None
+        return self._backend.map(
+            col, lambda row: (pid_extractor(row),
+                              data_extractors.partition_extractor(row),
+                              value_extractor(row)),
+            "Extract (privacy_id, partition_key, value)")
+
+    # -- validation ---------------------------------------------------------
+
+    def _check_aggregate_params(self,
+                                col,
+                                params: AggregateParams,
+                                data_extractors: DataExtractors,
+                                check_data_extractors: bool = True):
+        if params is not None and isinstance(params, AggregateParams) and \
+                params.max_contributions is not None:
+            supported = {
+                Metrics.PRIVACY_ID_COUNT, Metrics.COUNT, Metrics.SUM,
+                Metrics.MEAN
+            }
+            unsupported = set(params.metrics or []) - supported
+            if unsupported:
+                raise NotImplementedError(
+                    f"max_contributions is not supported for {unsupported}")
+        _check_col(col)
+        if params is None:
+            raise ValueError("params must be set to a valid AggregateParams")
+        if not isinstance(params, AggregateParams):
+            raise TypeError("params must be set to a valid AggregateParams")
+        if check_data_extractors:
+            _check_data_extractors(data_extractors)
+        if params.contribution_bounds_already_enforced:
+            if Metrics.PRIVACY_ID_COUNT in params.metrics:
+                raise ValueError(
+                    "PRIVACY_ID_COUNT cannot be computed when "
+                    "contribution_bounds_already_enforced is True.")
+        if params.post_aggregation_thresholding:
+            if Metrics.PRIVACY_ID_COUNT not in params.metrics:
+                raise ValueError("When post_aggregation_thresholding = True, "
+                                 "PRIVACY_ID_COUNT must be in metrics")
+
+    def _check_select_private_partitions(self, col,
+                                         params: SelectPartitionsParams,
+                                         data_extractors: DataExtractors):
+        _check_col(col)
+        if params is None:
+            raise ValueError(
+                "params must be set to a valid SelectPartitionsParams")
+        if not isinstance(params, SelectPartitionsParams):
+            raise TypeError(
+                "params must be set to a valid SelectPartitionsParams")
+        if (not isinstance(params.max_partitions_contributed, int) or
+                params.max_partitions_contributed <= 0):
+            raise ValueError("params.max_partitions_contributed must be set "
+                             "(to a positive integer)")
+        if data_extractors is None:
+            raise ValueError("data_extractors must be set to a DataExtractors")
+        if not isinstance(data_extractors, DataExtractors):
+            raise TypeError("data_extractors must be set to a DataExtractors")
+
+    def _check_budget_accountant_compatibility(
+            self, is_public_partition: bool, metrics: Sequence[Metric],
+            custom_combiner: bool) -> None:
+        if isinstance(self._budget_accountant,
+                      budget_accounting.NaiveBudgetAccountant):
+            return
+        if not is_public_partition:
+            raise NotImplementedError("PLD budget accounting does not support "
+                                      "private partition selection")
+        supported = {
+            Metrics.COUNT, Metrics.PRIVACY_ID_COUNT, Metrics.SUM, Metrics.MEAN
+        }
+        unsupported = set(metrics) - supported
+        if unsupported:
+            raise NotImplementedError(
+                f"Metrics {unsupported} do not support PLD budget accounting")
+        if custom_combiner:
+            raise ValueError(
+                "PLD budget accounting does not support custom combiners")
+
+    # -- private contribution bounds ----------------------------------------
+
+    def calculate_private_contribution_bounds(
+            self,
+            col,
+            params: CalculatePrivateContributionBoundsParams,
+            data_extractors: DataExtractors,
+            partitions: Any,
+            partitions_already_filtered: bool = False):
+        """DP computation of max_partitions_contributed (L0 bound) via the
+        exponential mechanism over dataset histograms.
+
+        Supported for COUNT / PRIVACY_ID_COUNT aggregations. Returns a
+        1-element collection with PrivateContributionBounds.
+        """
+        self._check_calculate_private_contribution_bounds_params(
+            col, params, data_extractors)
+        if not partitions_already_filtered:
+            col = self._drop_partitions(col, partitions,
+                                        data_extractors.partition_extractor)
+        try:
+            from pipelinedp_tpu.dataset_histograms import computing_histograms
+            from pipelinedp_tpu.private_contribution_bounds import (
+                PrivateL0Calculator)
+        except ImportError as e:
+            raise NotImplementedError(
+                "calculate_private_contribution_bounds requires the dataset "
+                "histograms subsystem, which is not available in this "
+                "build.") from e
+        histograms = computing_histograms.compute_dataset_histograms(
+            col, data_extractors, self._backend)
+        l0_calculator = PrivateL0Calculator(params, partitions, histograms,
+                                            self._backend)
+        return pipeline_functions.collect_to_container(
+            self._backend,
+            {"max_partitions_contributed": l0_calculator.calculate()},
+            PrivateContributionBounds,
+            "Collect calculated private contribution bounds into "
+            "PrivateContributionBounds dataclass")
+
+    def _check_calculate_private_contribution_bounds_params(
+            self,
+            col,
+            params: CalculatePrivateContributionBoundsParams,
+            data_extractors: DataExtractors,
+            check_data_extractors: bool = True):
+        _check_col(col)
+        if params is None:
+            raise ValueError(
+                "params must be set to a valid "
+                "CalculatePrivateContributionBoundsParams")
+        if not isinstance(params, CalculatePrivateContributionBoundsParams):
+            raise TypeError(
+                "params must be set to a valid "
+                "CalculatePrivateContributionBoundsParams")
+        if check_data_extractors:
+            _check_data_extractors(data_extractors)
+
+    # -- post-aggregation thresholding / add_dp_noise -----------------------
+
+    def _drop_partitions_under_threshold(self, col):
+        self._add_report_stage("Drop partitions which have noised "
+                               "privacy_id_count less than threshold.")
+        return self._backend.filter(
+            col, lambda row: row[1].privacy_id_count is not None,
+            "Drop partitions under threshold")
+
+    def add_dp_noise(self,
+                     col,
+                     params: AddDPNoiseParams,
+                     out_explain_computation_report: Optional[
+                         ExplainComputationReport] = None):
+        """Adds calibrated DP noise to pre-aggregated (pk, value) pairs.
+
+        Does NOT enforce sensitivity: the caller guarantees the provided
+        l0/linf bounds hold and that partition keys are public/DP-selected.
+        """
+        mechanism_type = params.noise_kind.convert_to_mechanism_type()
+        mechanism_spec = self._budget_accountant.request_budget(mechanism_type)
+        sensitivities = dp_computations.Sensitivities(
+            l0=params.l0_sensitivity, linf=params.linf_sensitivity)
+        self._add_report_generator(params, "add_dp_noise",
+                                   is_public_partition=True)
+        if out_explain_computation_report is not None:
+            out_explain_computation_report._set_report_generator(
+                self._current_report_generator)
+
+        def create_mechanism() -> dp_computations.AdditiveMechanism:
+            return dp_computations.create_additive_mechanism(
+                mechanism_spec, sensitivities)
+
+        self._add_report_stage(
+            lambda: f"Adding {create_mechanism().noise_kind} noise with "
+                    f"parameter {create_mechanism().noise_parameter}")
+        anonymized = self._backend.map_values(
+            col, lambda value: create_mechanism().add_noise(float(value)),
+            "Add noise")
+        budget = self._budget_accountant._compute_budget_for_aggregation(
+            params.budget_weight)
+        return self._annotate(anonymized, params=params, budget=budget)
+
+    def _annotate(self, col, params, budget):
+        return self._backend.annotate(col,
+                                      "annotation",
+                                      params=params,
+                                      budget=budget)
+
+
+def _check_col(col):
+    if col is None or _is_empty_local(col):
+        raise ValueError("col must be non-empty")
+
+
+def _is_empty_local(col) -> bool:
+    try:
+        return len(col) == 0
+    except TypeError:
+        return False
+
+
+def _check_data_extractors(data_extractors: DataExtractors):
+    if data_extractors is None:
+        raise ValueError("data_extractors must be set to a DataExtractors")
+    if not isinstance(data_extractors, DataExtractors):
+        raise TypeError("data_extractors must be set to a DataExtractors")

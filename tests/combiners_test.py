@@ -1,0 +1,280 @@
+"""Tests for combiners (mirrors reference tests/combiners_test.py technique:
+no-noise specs with huge eps so DP output ~ raw output)."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import budget_accounting as ba
+from pipelinedp_tpu import combiners
+from pipelinedp_tpu.aggregate_params import MechanismType
+
+
+def no_noise_spec(mechanism_type=MechanismType.LAPLACE):
+    spec = ba.MechanismSpec(mechanism_type)
+    spec.set_eps_delta(1e8, 1e-15 if mechanism_type != MechanismType.LAPLACE
+                       else 0.0)
+    return spec
+
+
+def count_params(**overrides):
+    kwargs = dict(metrics=[pdp.Metrics.COUNT],
+                  max_partitions_contributed=2,
+                  max_contributions_per_partition=3)
+    kwargs.update(overrides)
+    return pdp.AggregateParams(**kwargs)
+
+
+class TestCountCombiner:
+
+    def test_accumulator_algebra(self):
+        combiner = combiners.CountCombiner(no_noise_spec(), count_params())
+        acc1 = combiner.create_accumulator([1, 2, 3])
+        acc2 = combiner.create_accumulator([4])
+        assert combiner.merge_accumulators(acc1, acc2) == 4
+
+    def test_compute_metrics_no_noise(self):
+        combiner = combiners.CountCombiner(no_noise_spec(), count_params())
+        assert combiner.compute_metrics(7)["count"] == pytest.approx(7,
+                                                                     abs=1e-3)
+
+    def test_pickles_without_mechanism(self):
+        combiner = combiners.CountCombiner(no_noise_spec(), count_params())
+        combiner.compute_metrics(1)  # instantiate the lazy mechanism
+        assert hasattr(combiner, "_mechanism")
+        restored = pickle.loads(pickle.dumps(combiner))
+        assert not hasattr(restored, "_mechanism")
+        # And it still works, recreating the mechanism on demand.
+        assert restored.compute_metrics(5)["count"] == pytest.approx(5,
+                                                                     abs=1e-3)
+
+
+class TestSumCombiner:
+
+    def test_per_contribution_clipping(self):
+        params = count_params(metrics=[pdp.Metrics.SUM],
+                              min_value=0,
+                              max_value=2)
+        combiner = combiners.SumCombiner(no_noise_spec(), params)
+        # 5 clipped to 2, -1 clipped to 0.
+        assert combiner.create_accumulator([1, 5, -1]) == pytest.approx(3.0)
+        assert combiner.expects_per_partition_sampling()
+
+    def test_per_partition_clipping(self):
+        params = count_params(metrics=[pdp.Metrics.SUM],
+                              min_sum_per_partition=0,
+                              max_sum_per_partition=4)
+        combiner = combiners.SumCombiner(no_noise_spec(), params)
+        # Sum 1+5-1=5 clipped to 4.
+        assert combiner.create_accumulator([1, 5, -1]) == pytest.approx(4.0)
+        assert not combiner.expects_per_partition_sampling()
+
+    def test_compute_metrics_no_noise(self):
+        params = count_params(metrics=[pdp.Metrics.SUM],
+                              min_value=0,
+                              max_value=10)
+        combiner = combiners.SumCombiner(no_noise_spec(), params)
+        assert combiner.compute_metrics(42.0)["sum"] == pytest.approx(42,
+                                                                      abs=1e-2)
+
+
+class TestPrivacyIdCountCombiner:
+
+    def test_accumulator(self):
+        combiner = combiners.PrivacyIdCountCombiner(
+            no_noise_spec(), count_params(metrics=[pdp.Metrics.PRIVACY_ID_COUNT]))
+        assert combiner.create_accumulator([1, 2]) == 1
+        assert combiner.create_accumulator([]) == 0
+        assert combiner.merge_accumulators(1, 1) == 2
+        assert not combiner.expects_per_partition_sampling()
+
+
+class TestMeanCombiner:
+
+    def test_mean_no_noise(self):
+        params = count_params(metrics=[pdp.Metrics.MEAN],
+                              min_value=0,
+                              max_value=10)
+        combiner = combiners.MeanCombiner(no_noise_spec(), no_noise_spec(),
+                                          params, ["mean", "count", "sum"])
+        acc = combiner.create_accumulator([1.0, 2.0, 6.0])
+        assert acc[0] == 3
+        assert acc[1] == pytest.approx(-6.0)  # (1-5)+(2-5)+(6-5)
+        metrics = combiner.compute_metrics(acc)
+        assert metrics["mean"] == pytest.approx(3.0, abs=1e-2)
+        assert metrics["count"] == pytest.approx(3, abs=1e-2)
+        assert metrics["sum"] == pytest.approx(9.0, abs=0.1)
+
+    def test_validation(self):
+        params = count_params(metrics=[pdp.Metrics.MEAN],
+                              min_value=0,
+                              max_value=10)
+        with pytest.raises(ValueError, match="mean"):
+            combiners.MeanCombiner(no_noise_spec(), no_noise_spec(), params,
+                                   ["count"])
+        with pytest.raises(ValueError, match="duplicates"):
+            combiners.MeanCombiner(no_noise_spec(), no_noise_spec(), params,
+                                   ["mean", "mean"])
+
+
+class TestVarianceCombiner:
+
+    def test_variance_no_noise(self):
+        params = count_params(metrics=[pdp.Metrics.VARIANCE],
+                              min_value=0,
+                              max_value=8)
+        combiner = combiners.VarianceCombiner(
+            combiners.CombinerParams(no_noise_spec(), params),
+            ["variance", "mean"])
+        values = [1.0, 3.0, 5.0, 7.0]
+        acc = combiner.create_accumulator(values)
+        metrics = combiner.compute_metrics(acc)
+        assert metrics["variance"] == pytest.approx(np.var(values), abs=0.1)
+        assert metrics["mean"] == pytest.approx(4.0, abs=0.1)
+
+
+class TestQuantileCombiner:
+
+    def test_quantiles_no_noise(self):
+        params = count_params(metrics=[pdp.Metrics.PERCENTILE(50)],
+                              min_value=0,
+                              max_value=100)
+        combiner = combiners.QuantileCombiner(
+            combiners.CombinerParams(no_noise_spec(), params), [10, 50, 90])
+        values = list(range(101))
+        acc = combiner.create_accumulator(values)
+        metrics = combiner.compute_metrics(acc)
+        assert metrics["percentile_10"] == pytest.approx(10, abs=2)
+        assert metrics["percentile_50"] == pytest.approx(50, abs=2)
+        assert metrics["percentile_90"] == pytest.approx(90, abs=2)
+
+    def test_merge(self):
+        params = count_params(metrics=[pdp.Metrics.PERCENTILE(50)],
+                              min_value=0,
+                              max_value=10)
+        combiner = combiners.QuantileCombiner(
+            combiners.CombinerParams(no_noise_spec(), params), [50])
+        acc1 = combiner.create_accumulator([1.0] * 50)
+        acc2 = combiner.create_accumulator([9.0] * 50)
+        merged = combiner.merge_accumulators(acc1, acc2)
+        median = combiner.compute_metrics(merged)["percentile_50"]
+        assert 1.0 <= median <= 9.1
+
+    def test_metric_names(self):
+        params = count_params(metrics=[pdp.Metrics.PERCENTILE(50)],
+                              min_value=0,
+                              max_value=10)
+        combiner = combiners.QuantileCombiner(
+            combiners.CombinerParams(no_noise_spec(), params), [90, 99.9])
+        assert combiner.metrics_names() == [
+            "percentile_90", "percentile_99_9"
+        ]
+
+
+class TestVectorSumCombiner:
+
+    def test_accumulate_and_noise(self):
+        params = count_params(metrics=[pdp.Metrics.VECTOR_SUM],
+                              vector_size=2,
+                              vector_max_norm=100.0,
+                              vector_norm_kind=pdp.NormKind.Linf)
+        combiner = combiners.VectorSumCombiner(
+            combiners.CombinerParams(no_noise_spec(), params))
+        acc = combiner.create_accumulator([np.array([1.0, 2.0]),
+                                           np.array([3.0, 4.0])])
+        np.testing.assert_allclose(acc, [4.0, 6.0])
+        result = combiner.compute_metrics(acc)["vector_sum"]
+        np.testing.assert_allclose(result, [4.0, 6.0], atol=0.1)
+
+    def test_shape_mismatch(self):
+        params = count_params(metrics=[pdp.Metrics.VECTOR_SUM],
+                              vector_size=2,
+                              vector_max_norm=1.0,
+                              vector_norm_kind=pdp.NormKind.Linf)
+        combiner = combiners.VectorSumCombiner(
+            combiners.CombinerParams(no_noise_spec(), params))
+        with pytest.raises(TypeError, match="Shape mismatch"):
+            combiner.create_accumulator([np.array([1.0, 2.0, 3.0])])
+
+
+class TestCompoundCombiner:
+
+    def _compound(self):
+        params = count_params(metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+                              min_value=0,
+                              max_value=10)
+        acc = ba.NaiveBudgetAccountant(1e8, 1e-15)
+        compound = combiners.create_compound_combiner(params, acc)
+        acc.compute_budgets()
+        return compound
+
+    def test_accumulator_structure(self):
+        compound = self._compound()
+        row_count, children = compound.create_accumulator([1.0, 2.0])
+        assert row_count == 1
+        assert children == (2, 3.0)
+
+    def test_merge_and_compute(self):
+        compound = self._compound()
+        acc = compound.merge_accumulators(
+            compound.create_accumulator([1.0, 2.0]),
+            compound.create_accumulator([3.0]))
+        assert acc[0] == 2
+        metrics = compound.compute_metrics(acc)
+        assert metrics.count == pytest.approx(3, abs=1e-2)
+        assert metrics.sum == pytest.approx(6.0, abs=0.1)
+
+    def test_metrics_names(self):
+        assert self._compound().metrics_names() == ("count", "sum")
+
+    def test_namedtuple_pickles(self):
+        compound = self._compound()
+        metrics = compound.compute_metrics(compound.create_accumulator([1.0]))
+        restored = pickle.loads(pickle.dumps(metrics))
+        assert restored.count == metrics.count
+
+
+class TestCreateCompoundCombiner:
+
+    def test_budget_requests_per_metric(self):
+        acc = ba.NaiveBudgetAccountant(1.0, 1e-6)
+        params = count_params(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM,
+                     pdp.Metrics.PRIVACY_ID_COUNT],
+            min_value=0,
+            max_value=1)
+        combiners.create_compound_combiner(params, acc)
+        assert len(acc._mechanisms) == 3
+
+    def test_variance_subsumes(self):
+        acc = ba.NaiveBudgetAccountant(1.0, 1e-6)
+        params = count_params(metrics=[
+            pdp.Metrics.VARIANCE, pdp.Metrics.MEAN, pdp.Metrics.COUNT,
+            pdp.Metrics.SUM
+        ],
+                              min_value=0,
+                              max_value=1)
+        compound = combiners.create_compound_combiner(params, acc)
+        # One budget for variance (it computes everything itself).
+        assert len(acc._mechanisms) == 1
+        assert len(compound.combiners) == 1
+
+    def test_mean_two_budgets(self):
+        acc = ba.NaiveBudgetAccountant(1.0, 1e-6)
+        params = count_params(metrics=[pdp.Metrics.MEAN, pdp.Metrics.COUNT],
+                              min_value=0,
+                              max_value=1)
+        combiners.create_compound_combiner(params, acc)
+        assert len(acc._mechanisms) == 2
+
+    def test_post_aggregation_thresholding(self):
+        acc = ba.NaiveBudgetAccountant(1.0, 1e-6)
+        params = count_params(metrics=[pdp.Metrics.PRIVACY_ID_COUNT],
+                              post_aggregation_thresholding=True)
+        compound = combiners.create_compound_combiner(params, acc)
+        assert isinstance(compound.combiners[0],
+                          combiners.PostAggregationThresholdingCombiner)
+        assert (acc._mechanisms[0].mechanism_spec.mechanism_type ==
+                MechanismType.LAPLACE_THRESHOLDING)

@@ -1,0 +1,332 @@
+"""End-to-end DPEngine tests on LocalBackend.
+
+Mirrors the reference's techniques (tests/dp_engine_test.py): huge-eps
+no-noise runs compared against plain groupby, computation-graph assertions
+via explain reports, statistical partition-selection tests, and mock
+partition selection strategies."""
+
+from unittest import mock
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import partition_selection as ps_module
+
+
+def make_engine(eps=1e8, delta=1e-15, accountant_out=None):
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=eps,
+                                           total_delta=delta)
+    engine = pdp.DPEngine(accountant, pdp.LocalBackend())
+    if accountant_out is not None:
+        accountant_out.append(accountant)
+    return engine, accountant
+
+
+def extractors():
+    return pdp.DataExtractors(privacy_id_extractor=lambda row: row[0],
+                              partition_extractor=lambda row: row[1],
+                              value_extractor=lambda row: row[2])
+
+
+def dataset(n_users=10, partitions=("a", "b", "c"), value=2.0):
+    # Each user contributes `value` once to every partition.
+    return [(uid, pk, value) for uid in range(n_users) for pk in partitions]
+
+
+class TestAggregatePublicPartitions:
+
+    def test_count_sum_no_noise_equals_raw(self):
+        engine, accountant = make_engine()
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=3,
+            max_contributions_per_partition=1,
+            min_value=0,
+            max_value=3)
+        result = engine.aggregate(dataset(), params, extractors(),
+                                  public_partitions=["a", "b", "c"])
+        accountant.compute_budgets()
+        result = dict(result)
+        assert set(result) == {"a", "b", "c"}
+        for pk in "abc":
+            assert result[pk].count == pytest.approx(10, abs=1e-2)
+            assert result[pk].sum == pytest.approx(20.0, abs=0.1)
+
+    def test_empty_public_partitions_present_in_output(self):
+        engine, accountant = make_engine()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=3,
+                                     max_contributions_per_partition=1)
+        result = engine.aggregate(dataset(partitions=("a",)), params,
+                                  extractors(),
+                                  public_partitions=["a", "empty_pk"])
+        accountant.compute_budgets()
+        result = dict(result)
+        assert result["empty_pk"].count == pytest.approx(0, abs=1e-2)
+
+    def test_non_public_partitions_dropped(self):
+        engine, accountant = make_engine()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=3,
+                                     max_contributions_per_partition=1)
+        result = engine.aggregate(dataset(), params, extractors(),
+                                  public_partitions=["a"])
+        accountant.compute_budgets()
+        assert set(dict(result)) == {"a"}
+
+    def test_contribution_bounding_caps_count(self):
+        engine, accountant = make_engine()
+        # One user contributes 100 times to one partition; Linf bound is 5.
+        data = [(0, "a", 1.0)] * 100
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=5)
+        result = engine.aggregate(data, params, extractors(),
+                                  public_partitions=["a"])
+        accountant.compute_budgets()
+        assert dict(result)["a"].count == pytest.approx(5, abs=1e-2)
+
+    def test_cross_partition_bounding_caps_partitions(self):
+        engine, accountant = make_engine()
+        # One user contributes to 10 partitions, L0 bound is 2.
+        data = [(0, f"pk{i}", 1.0) for i in range(10)]
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=2,
+                                     max_contributions_per_partition=1)
+        public = [f"pk{i}" for i in range(10)]
+        result = engine.aggregate(data, params, extractors(),
+                                  public_partitions=public)
+        accountant.compute_budgets()
+        total = sum(m.count for m in dict(result).values())
+        assert total == pytest.approx(2, abs=0.1)
+
+    def test_mean_no_noise(self):
+        engine, accountant = make_engine()
+        data = [(u, "a", float(v)) for u, v in enumerate([1, 2, 6])]
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.MEAN],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1,
+                                     min_value=0,
+                                     max_value=10)
+        result = engine.aggregate(data, params, extractors(),
+                                  public_partitions=["a"])
+        accountant.compute_budgets()
+        assert dict(result)["a"].mean == pytest.approx(3.0, abs=0.1)
+
+    def test_multiple_aggregations_same_accountant(self):
+        engine, accountant = make_engine(eps=1e8)
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1)
+        r1 = engine.aggregate(dataset(partitions=("a",)), params, extractors(),
+                              public_partitions=["a"])
+        r2 = engine.aggregate(dataset(partitions=("a",)), params, extractors(),
+                              public_partitions=["a"])
+        accountant.compute_budgets()
+        assert dict(r1)["a"].count == pytest.approx(10, abs=1e-2)
+        assert dict(r2)["a"].count == pytest.approx(10, abs=1e-2)
+
+    def test_contribution_bounds_already_enforced(self):
+        engine, accountant = make_engine()
+        data = [("ignored", "a", 1.0)] * 7
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1,
+                                     contribution_bounds_already_enforced=True)
+        ext = pdp.DataExtractors(partition_extractor=lambda r: r[1],
+                                 value_extractor=lambda r: r[2])
+        result = engine.aggregate(data, params, ext, public_partitions=["a"])
+        accountant.compute_budgets()
+        assert dict(result)["a"].count == pytest.approx(7, abs=1e-2)
+
+
+class TestAggregatePrivatePartitions:
+
+    def test_small_partitions_dropped_large_kept(self):
+        ps_module.seed_rng(0)
+        engine, accountant = make_engine(eps=1.0, delta=1e-6)
+        # 'big' has 1000 users, 'tiny' has 1.
+        data = ([(u, "big", 1.0) for u in range(1000)] +
+                [(9999, "tiny", 1.0)])
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1)
+        result = engine.aggregate(data, params, extractors())
+        accountant.compute_budgets()
+        kept = set(dict(result))
+        assert "big" in kept
+        assert "tiny" not in kept
+
+    def test_mock_partition_selection(self):
+        engine, accountant = make_engine()
+
+        class KeepAll:
+
+            def should_keep(self, n):
+                return True
+
+        with mock.patch.object(ps_module,
+                               "create_partition_selection_strategy",
+                               return_value=KeepAll()):
+            data = [(u, "pk", 1.0) for u in range(3)]
+            params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                         max_partitions_contributed=1,
+                                         max_contributions_per_partition=1)
+            result = engine.aggregate(data, params, extractors())
+            accountant.compute_budgets()
+            assert dict(result)["pk"].count == pytest.approx(3, abs=1e-2)
+
+    def test_post_aggregation_thresholding(self):
+        engine, accountant = make_engine(eps=1.0, delta=1e-6)
+        data = ([(u, "big", 1.0) for u in range(1000)] +
+                [(7777, "tiny", 1.0)])
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.PRIVACY_ID_COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1,
+                                     post_aggregation_thresholding=True)
+        result = engine.aggregate(data, params, extractors())
+        accountant.compute_budgets()
+        result = dict(result)
+        assert "tiny" not in result
+        assert result["big"].privacy_id_count == pytest.approx(1000, rel=0.2)
+
+
+class TestSelectPartitions:
+
+    def test_large_partitions_selected(self):
+        ps_module.seed_rng(0)
+        engine, accountant = make_engine(eps=1.0, delta=1e-6)
+        data = ([(u, "big1", 0) for u in range(500)] +
+                [(u, "big2", 0) for u in range(500)] +
+                [(1, "tiny", 0)])
+        params = pdp.SelectPartitionsParams(max_partitions_contributed=2)
+        result = engine.select_partitions(data, params, extractors())
+        accountant.compute_budgets()
+        selected = set(result)
+        assert {"big1", "big2"} <= selected
+        assert "tiny" not in selected
+
+    def test_validation(self):
+        engine, _ = make_engine()
+        with pytest.raises(ValueError, match="non-empty"):
+            engine.select_partitions(
+                [], pdp.SelectPartitionsParams(max_partitions_contributed=1),
+                extractors())
+
+
+class TestAddDPNoise:
+
+    def test_no_noise_passthrough(self):
+        engine, accountant = make_engine()
+        data = [("a", 10.0), ("b", 20.0)]
+        params = pdp.AddDPNoiseParams(noise_kind=pdp.NoiseKind.LAPLACE,
+                                      l0_sensitivity=1,
+                                      linf_sensitivity=1.0)
+        result = engine.add_dp_noise(data, params)
+        accountant.compute_budgets()
+        result = dict(result)
+        assert result["a"] == pytest.approx(10.0, abs=1e-2)
+        assert result["b"] == pytest.approx(20.0, abs=1e-2)
+
+
+class TestExplainComputation:
+
+    def test_report_stages(self):
+        engine, accountant = make_engine()
+        report = pdp.ExplainComputationReport()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=2,
+                                     max_contributions_per_partition=3)
+        engine.aggregate(dataset(), params, extractors(),
+                         public_partitions=["a"],
+                         out_explain_computation_report=report)
+        accountant.compute_budgets()
+        text = report.text()
+        assert "DPEngine method: aggregate" in text
+        assert "Per-partition contribution bounding" in text
+        assert "Cross-partition contribution bounding" in text
+        assert "Computed DP count" in text
+        assert "public partitions" in text
+
+    def test_private_partition_report_mentions_strategy(self):
+        engine, accountant = make_engine(eps=1.0, delta=1e-6)
+        report = pdp.ExplainComputationReport()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1)
+        engine.aggregate(dataset(), params, extractors(),
+                         out_explain_computation_report=report)
+        accountant.compute_budgets()
+        assert "Truncated Geometric" in report.text()
+
+    def test_report_before_compute_budgets_raises(self):
+        engine, accountant = make_engine()
+        report = pdp.ExplainComputationReport()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=2,
+                                     max_contributions_per_partition=3)
+        engine.aggregate(dataset(), params, extractors(),
+                         public_partitions=["a"],
+                         out_explain_computation_report=report)
+        with pytest.raises(ValueError, match="compute_budgets"):
+            report.text()
+
+
+class TestValidation:
+
+    def test_empty_col(self):
+        engine, _ = make_engine()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1)
+        with pytest.raises(ValueError, match="non-empty"):
+            engine.aggregate([], params, extractors())
+
+    def test_bad_params_type(self):
+        engine, _ = make_engine()
+        with pytest.raises(TypeError):
+            engine.aggregate([1], "not params", extractors())
+
+    def test_post_agg_thresholding_requires_privacy_id_count(self):
+        engine, _ = make_engine()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1,
+                                     post_aggregation_thresholding=True)
+        with pytest.raises(ValueError, match="PRIVACY_ID_COUNT"):
+            engine.aggregate([1], params, extractors())
+
+    def test_pld_with_private_partitions_unsupported(self):
+        accountant = pdp.PLDBudgetAccountant(1.0, 1e-6)
+        engine = pdp.DPEngine(accountant, pdp.LocalBackend())
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1)
+        with pytest.raises(NotImplementedError, match="PLD"):
+            engine.aggregate(dataset(), params, extractors())
+
+
+class TestStatistical:
+
+    def test_count_noise_distribution(self):
+        """e2e with real noise: std of DP count error matches mechanism."""
+        from pipelinedp_tpu import noise_core
+        noise_core.seed_fallback_rng(5)
+        eps = 1.0
+        n_partitions = 300
+        engine, accountant = make_engine(eps=eps, delta=0)
+        data = [(u, f"pk{p}", 1.0) for p in range(n_partitions)
+                for u in range(10)]
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=n_partitions,
+                                     max_contributions_per_partition=1)
+        public = [f"pk{p}" for p in range(n_partitions)]
+        result = engine.aggregate(data, params, extractors(),
+                                  public_partitions=public)
+        accountant.compute_budgets()
+        errors = np.array([m.count - 10 for _, m in result])
+        # Laplace noise: b = l1/eps = n_partitions, std = b*sqrt(2).
+        expected_std = n_partitions * np.sqrt(2)
+        assert abs(errors.mean()) < expected_std / 3
+        assert errors.std() == pytest.approx(expected_std, rel=0.25)
