@@ -45,6 +45,7 @@ from pipelinedp_tpu.backends.base import PipelineBackend
 from pipelinedp_tpu.backends.local import LocalBackend, MultiProcLocalBackend
 from pipelinedp_tpu.combiners import CustomCombiner
 from pipelinedp_tpu.dp_engine import DPEngine
+from pipelinedp_tpu.jax_engine import JaxDPEngine, LazyJaxResult
 
 __version__ = "0.1.0"
 
@@ -58,6 +59,8 @@ __all__ = [
     "CustomCombiner",
     "DPEngine",
     "DataExtractors",
+    "JaxDPEngine",
+    "LazyJaxResult",
     "ExplainComputationReport",
     "LocalBackend",
     "MeanParams",

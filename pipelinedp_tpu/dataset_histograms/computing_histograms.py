@@ -1,0 +1,331 @@
+"""Pipelines computing the seven dataset histograms in one pass.
+
+Parity: pipeline_dp/dataset_histograms/computing_histograms.py (log binning
+:28-47, _compute_frequency_histogram :62, float binning with side inputs
+:135-173, per-histogram builders :242-453, compute_dataset_histograms
+:456-513, pre-aggregated variants :521-758).
+
+Bins are logarithmic for integer histograms — values keep only their 3
+most-significant digits, so histograms stay small no matter the scale — and
+10000 equal-width bins between min and max for float (sum) histograms.
+"""
+
+from __future__ import annotations
+
+import bisect
+import operator
+from typing import List, Tuple
+
+import numpy as np
+
+from pipelinedp_tpu import pipeline_functions
+from pipelinedp_tpu.backends import base
+from pipelinedp_tpu.data_extractors import (DataExtractors,
+                                            PreAggregateExtractors)
+from pipelinedp_tpu.dataset_histograms import histograms as hist
+
+NUMBER_OF_BUCKETS_SUM_HISTOGRAM = 10000
+
+
+def _to_bin_lower_upper_logarithmic(value: int) -> Tuple[int, int]:
+    """Bin bounds for the log-binning scheme: keep 3 significant digits.
+
+    Must stay in sync with
+    private_contribution_bounds.generate_possible_contribution_bounds.
+    """
+    bound = 1000
+    while value > bound:
+        bound *= 10
+    round_base = bound // 1000
+    lower = value // round_base * round_base
+    bin_size = round_base if value != bound else round_base * 10
+    return lower, lower + bin_size
+
+
+def _bin_lower_index(lowers: List[float], value: float) -> int:
+    """Index of the bin lower for a float value given sorted bin lowers."""
+    assert lowers[0] <= value <= lowers[-1]
+    if value == lowers[-1]:
+        return len(lowers) - 2
+    return bisect.bisect_right(lowers, value) - 1
+
+
+def _compute_frequency_histogram(col, backend: base.PipelineBackend,
+                                 name: hist.HistogramType):
+    """collection of positive ints -> 1-element collection of Histogram."""
+    col = backend.count_per_element(col, "Frequency of elements")
+    return _frequency_pairs_to_histogram(col, backend, name)
+
+
+def _compute_weighted_frequency_histogram(col, backend: base.PipelineBackend,
+                                          name: hist.HistogramType):
+    """collection of (positive int, weight) -> 1-element Histogram
+    collection; weights are summed per value and rounded."""
+    col = backend.sum_per_key(col, "Frequency of elements")
+    col = backend.map_values(col, lambda x: int(round(x)), "Round")
+    return _frequency_pairs_to_histogram(col, backend, name)
+
+
+def _frequency_pairs_to_histogram(col, backend: base.PipelineBackend,
+                                  name: hist.HistogramType):
+    """collection of (value:int, frequency:int) -> Histogram collection."""
+
+    def to_bin(value: int, frequency: int):
+        lower, upper = _to_bin_lower_upper_logarithmic(value)
+        return lower, hist.FrequencyBin(lower=lower,
+                                        upper=upper,
+                                        count=frequency,
+                                        sum=frequency * value,
+                                        max=value)
+
+    col = backend.map_tuple(col, to_bin, "To FrequencyBin")
+    return _bins_to_histogram(col, backend, name)
+
+
+def _float_values_to_histogram(col, backend: base.PipelineBackend,
+                               name: hist.HistogramType, lowers_col):
+    """collection of floats -> Histogram with the given bin lowers."""
+
+    def to_bin(value: float, lowers_container):
+        lowers = lowers_container[0]
+        idx = _bin_lower_index(lowers, value)
+        return lowers[idx], hist.FrequencyBin(lower=lowers[idx],
+                                              upper=lowers[idx + 1],
+                                              count=1,
+                                              sum=value,
+                                              max=value)
+
+    col = backend.map_with_side_inputs(col, to_bin, (lowers_col,),
+                                       "To FrequencyBin")
+    return _bins_to_histogram(col, backend, name)
+
+
+def _bins_to_histogram(col, backend: base.PipelineBackend, name):
+    col = backend.reduce_per_key(col, operator.add, "Combine FrequencyBins")
+    col = backend.values(col, "Drop keys")
+    col = backend.to_list(col, "To 1 element collection")
+    return backend.map(
+        col, lambda bins: hist.Histogram(
+            name, sorted(bins, key=lambda b: b.lower)), "To histogram")
+
+
+def _min_max_lowers(col, number_of_buckets, backend: base.PipelineBackend):
+    """Equal bin lowers spanning [min, max] of the collection."""
+    min_max = pipeline_functions.min_max_elements(backend, col,
+                                                  "Min and max value")
+
+    def generate_lowers(mm):
+        lo, hi = mm
+        if lo == hi:
+            return [lo, lo]
+        return list(np.linspace(lo, hi, number_of_buckets + 1))
+
+    return backend.map(min_max, generate_lowers, "map to lowers")
+
+
+# -- raw-dataset builders ----------------------------------------------------
+
+
+def _compute_l0_contributions_histogram(col_distinct,
+                                        backend: base.PipelineBackend):
+    """(pid, pk) distinct pairs -> histogram of #partitions per pid."""
+    col = backend.keys(col_distinct, "Drop partition id")
+    col = backend.count_per_element(col, "Partitions per privacy id")
+    col = backend.values(col, "Drop privacy id")
+    return _compute_frequency_histogram(col, backend,
+                                        hist.HistogramType.L0_CONTRIBUTIONS)
+
+
+def _compute_l1_contributions_histogram(col, backend: base.PipelineBackend):
+    """(pid, pk) pairs -> histogram of #contributions per pid."""
+    col = backend.keys(col, "Drop partition id")
+    col = backend.count_per_element(col, "Contributions per privacy id")
+    col = backend.values(col, "Drop privacy id")
+    return _compute_frequency_histogram(col, backend,
+                                        hist.HistogramType.L1_CONTRIBUTIONS)
+
+
+def _compute_linf_contributions_histogram(col,
+                                          backend: base.PipelineBackend):
+    """(pid, pk) pairs -> histogram of #contributions per (pid, pk)."""
+    col = backend.count_per_element(col, "Contributions per (pid, pk)")
+    col = backend.values(col, "Drop (privacy_id, partition_key)")
+    return _compute_frequency_histogram(
+        col, backend, hist.HistogramType.LINF_CONTRIBUTIONS)
+
+
+def _compute_linf_sum_contributions_histogram(col_with_values,
+                                              backend: base.PipelineBackend):
+    """((pid, pk), value) -> histogram of per-(pid, pk) sums."""
+    col = backend.sum_per_key(col_with_values,
+                              "Sum of contributions per (pid, partition)")
+    col = backend.values(col, "Drop keys")
+    col = backend.to_multi_transformable_collection(col)
+    lowers = _min_max_lowers(col, NUMBER_OF_BUCKETS_SUM_HISTOGRAM, backend)
+    return _float_values_to_histogram(
+        col, backend, hist.HistogramType.LINF_SUM_CONTRIBUTIONS, lowers)
+
+
+def _compute_partition_count_histogram(col, backend: base.PipelineBackend):
+    """(pid, pk) pairs -> histogram of counts per partition."""
+    col = backend.values(col, "Drop privacy keys")
+    col = backend.count_per_element(col, "Count per partition")
+    col = backend.values(col, "Drop partition key")
+    return _compute_frequency_histogram(
+        col, backend, hist.HistogramType.COUNT_PER_PARTITION)
+
+
+def _compute_partition_privacy_id_count_histogram(
+        col_distinct, backend: base.PipelineBackend):
+    """distinct (pid, pk) -> histogram of privacy-id counts per partition."""
+    col = backend.values(col_distinct, "Drop privacy key")
+    col = backend.count_per_element(col, "Privacy ids per partition")
+    col = backend.values(col, "Drop partition key")
+    return _compute_frequency_histogram(
+        col, backend, hist.HistogramType.COUNT_PRIVACY_ID_PER_PARTITION)
+
+
+def _compute_partition_sum_histogram(col_with_values,
+                                     backend: base.PipelineBackend):
+    """((pid, pk), value) -> histogram of sums per partition."""
+    col = backend.map_tuple(col_with_values, lambda pid_pk, v:
+                            (pid_pk[1], v), "Key by partition")
+    col = backend.sum_per_key(col, "Sum per partition")
+    col = backend.values(col, "Drop partition key")
+    col = backend.to_multi_transformable_collection(col)
+    lowers = _min_max_lowers(col, NUMBER_OF_BUCKETS_SUM_HISTOGRAM, backend)
+    return _float_values_to_histogram(col, backend,
+                                      hist.HistogramType.SUM_PER_PARTITION,
+                                      lowers)
+
+
+def _list_to_dataset_histograms(
+        histogram_list: List[hist.Histogram]) -> hist.DatasetHistograms:
+    by_type = {h.name: h for h in histogram_list}
+    return hist.DatasetHistograms(
+        by_type.get(hist.HistogramType.L0_CONTRIBUTIONS),
+        by_type.get(hist.HistogramType.L1_CONTRIBUTIONS),
+        by_type.get(hist.HistogramType.LINF_CONTRIBUTIONS),
+        by_type.get(hist.HistogramType.LINF_SUM_CONTRIBUTIONS),
+        by_type.get(hist.HistogramType.COUNT_PER_PARTITION),
+        by_type.get(hist.HistogramType.COUNT_PRIVACY_ID_PER_PARTITION),
+        by_type.get(hist.HistogramType.SUM_PER_PARTITION))
+
+
+def _to_dataset_histograms(histogram_cols, backend: base.PipelineBackend):
+    col = backend.flatten(histogram_cols, "Histograms to one collection")
+    col = backend.to_list(col, "Histograms to List")
+    return backend.map(col, _list_to_dataset_histograms,
+                       "To DatasetHistograms")
+
+
+def compute_dataset_histograms(col, data_extractors: DataExtractors,
+                               backend: base.PipelineBackend):
+    """Computes all seven histograms; returns a 1-element collection with a
+    DatasetHistograms."""
+    col_with_values = backend.map(
+        col, lambda row: ((data_extractors.privacy_id_extractor(row),
+                           data_extractors.partition_extractor(row)),
+                          data_extractors.value_extractor(row)
+                          if data_extractors.value_extractor else 0),
+        "Extract ((privacy_id, partition_key), value)")
+    col_with_values = backend.to_multi_transformable_collection(
+        col_with_values)
+    col = backend.keys(col_with_values, "Drop values")
+    col = backend.to_multi_transformable_collection(col)
+    col_distinct = backend.distinct(col, "Distinct (pid, pk)")
+    col_distinct = backend.to_multi_transformable_collection(col_distinct)
+
+    return _to_dataset_histograms([
+        _compute_l0_contributions_histogram(col_distinct, backend),
+        _compute_l1_contributions_histogram(col, backend),
+        _compute_linf_contributions_histogram(col, backend),
+        _compute_linf_sum_contributions_histogram(col_with_values, backend),
+        _compute_partition_count_histogram(col, backend),
+        _compute_partition_privacy_id_count_histogram(col_distinct, backend),
+        _compute_partition_sum_histogram(col_with_values, backend),
+    ], backend)
+
+
+# -- pre-aggregated builders -------------------------------------------------
+# Pre-aggregated rows: (pk, (count, sum, n_partitions, n_contributions)) —
+# the output of analysis/pre_aggregation.preaggregate, one row per (pid, pk).
+
+
+def _preagg_l0_histogram(col, backend: base.PipelineBackend):
+    # Each (pid, pk) row carries n_partitions; weighting by 1/n_partitions
+    # counts each privacy unit exactly once.
+    col = backend.map_tuple(col, lambda _, x: (x[2], 1.0 / x[2]),
+                            "Extract n_partitions with weight")
+    return _compute_weighted_frequency_histogram(
+        col, backend, hist.HistogramType.L0_CONTRIBUTIONS)
+
+
+def _preagg_l1_histogram(col, backend: base.PipelineBackend):
+    col = backend.map_tuple(col, lambda _, x: (x[3], 1.0 / x[2]),
+                            "Extract n_contributions with weight")
+    return _compute_weighted_frequency_histogram(
+        col, backend, hist.HistogramType.L1_CONTRIBUTIONS)
+
+
+def _preagg_linf_histogram(col, backend: base.PipelineBackend):
+    col = backend.map_tuple(col, lambda _, x: x[0], "Extract count")
+    return _compute_frequency_histogram(
+        col, backend, hist.HistogramType.LINF_CONTRIBUTIONS)
+
+
+def _preagg_linf_sum_histogram(col, backend: base.PipelineBackend):
+    col = backend.map_tuple(col, lambda _, x: x[1], "Extract sum")
+    col = backend.to_multi_transformable_collection(col)
+    lowers = _min_max_lowers(col, NUMBER_OF_BUCKETS_SUM_HISTOGRAM, backend)
+    return _float_values_to_histogram(
+        col, backend, hist.HistogramType.LINF_SUM_CONTRIBUTIONS, lowers)
+
+
+def _preagg_partition_count_histogram(col, backend: base.PipelineBackend):
+    col = backend.map_values(col, lambda x: x[0], "Extract count")
+    col = backend.sum_per_key(col, "Sum per partition")
+    col = backend.values(col, "Drop partition keys")
+    return _compute_frequency_histogram(
+        col, backend, hist.HistogramType.COUNT_PER_PARTITION)
+
+
+def _preagg_partition_sum_histogram(col, backend: base.PipelineBackend):
+    col = backend.map_values(col, lambda x: x[1], "Extract sum")
+    col = backend.sum_per_key(col, "Sum per partition")
+    col = backend.values(col, "Drop partition keys")
+    col = backend.to_multi_transformable_collection(col)
+    lowers = _min_max_lowers(col, NUMBER_OF_BUCKETS_SUM_HISTOGRAM, backend)
+    return _float_values_to_histogram(col, backend,
+                                      hist.HistogramType.SUM_PER_PARTITION,
+                                      lowers)
+
+
+def _preagg_partition_privacy_id_count_histogram(col,
+                                                 backend: base.PipelineBackend):
+    col = backend.keys(col, "Extract partition keys")
+    col = backend.count_per_element(col, "Privacy IDs per partition")
+    col = backend.values(col, "Drop partition keys")
+    return _compute_frequency_histogram(
+        col, backend, hist.HistogramType.COUNT_PRIVACY_ID_PER_PARTITION)
+
+
+def compute_dataset_histograms_on_preaggregated_data(
+        col, data_extractors: PreAggregateExtractors,
+        backend: base.PipelineBackend):
+    """compute_dataset_histograms for pre-aggregated input."""
+    col = backend.map(
+        col, lambda row: (data_extractors.partition_extractor(row),
+                          data_extractors.preaggregate_extractor(row)),
+        "Extract (partition_key, preaggregate_data)")
+    col = backend.to_multi_transformable_collection(col)
+
+    return _to_dataset_histograms([
+        _preagg_l0_histogram(col, backend),
+        _preagg_l1_histogram(col, backend),
+        _preagg_linf_histogram(col, backend),
+        _preagg_linf_sum_histogram(col, backend),
+        _preagg_partition_count_histogram(col, backend),
+        _preagg_partition_privacy_id_count_histogram(col, backend),
+        _preagg_partition_sum_histogram(col, backend),
+    ], backend)

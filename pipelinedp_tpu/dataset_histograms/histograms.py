@@ -1,0 +1,153 @@
+"""Dataset-histogram types: log-binned frequency histograms of contribution
+structure, used by parameter tuning and utility analysis.
+
+Parity: pipeline_dp/dataset_histograms/histograms.py (FrequencyBin :21,
+HistogramType :60-77, Histogram + quantiles :79-162, compute_ratio_dropped
+:165-204, DatasetHistograms :207-216).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import List, Optional, Sequence, Tuple, Union
+
+Number = Union[int, float]
+
+
+@dataclasses.dataclass
+class FrequencyBin:
+    """One histogram bin over [lower, upper).
+
+    The upper bound is exclusive except for the last bin of float-valued
+    histograms, where it is inclusive. ``count`` is the number of elements in
+    the bin, ``sum`` their total, ``max`` the largest element seen.
+    """
+    lower: Number
+    upper: Number
+    count: int
+    sum: Number
+    max: Number
+
+    def __add__(self, other: "FrequencyBin") -> "FrequencyBin":
+        assert self.lower == other.lower and self.upper == other.upper, (
+            f"Cannot add bins with different bounds: "
+            f"[{self.lower}, {self.upper}) vs [{other.lower}, {other.upper})")
+        return FrequencyBin(self.lower, self.upper, self.count + other.count,
+                            self.sum + other.sum, max(self.max, other.max))
+
+    def __eq__(self, other) -> bool:
+        return (self.lower == other.lower and self.count == other.count and
+                self.sum == other.sum and self.max == other.max)
+
+
+class HistogramType(enum.Enum):
+    # count = #privacy units contributing to [lower, upper) partitions,
+    # sum = total (privacy_unit, partition) pairs for those units.
+    L0_CONTRIBUTIONS = "l0_contributions"
+    L1_CONTRIBUTIONS = "l1_contributions"
+    # count = #(privacy_unit, partition) pairs with [lower, upper)
+    # contributions, sum = total contributions of those pairs.
+    LINF_CONTRIBUTIONS = "linf_contributions"
+    LINF_SUM_CONTRIBUTIONS = "linf_sum_contributions"
+    COUNT_PER_PARTITION = "count_per_partition"
+    COUNT_PRIVACY_ID_PER_PARTITION = "privacy_id_per_partition_count"
+    SUM_PER_PARTITION = "sum_per_partition"
+
+
+@dataclasses.dataclass
+class Histogram:
+    """A frequency histogram: integer (log-binned) or float (equal bins)."""
+    name: HistogramType
+    bins: List[FrequencyBin]
+    lower: Optional[Number] = dataclasses.field(init=False)
+    upper: Optional[Number] = dataclasses.field(init=False)
+
+    def __post_init__(self):
+        if not self.bins:
+            self.lower = self.upper = None
+        else:
+            self.lower = 1 if self.is_integer else self.bins[0].lower
+            self.upper = None if self.is_integer else self.bins[-1].upper
+
+    @property
+    def is_integer(self) -> bool:
+        return self.name not in (HistogramType.LINF_SUM_CONTRIBUTIONS,
+                                 HistogramType.SUM_PER_PARTITION)
+
+    def total_count(self) -> int:
+        return sum(b.count for b in self.bins)
+
+    def total_sum(self) -> Number:
+        return sum(b.sum for b in self.bins)
+
+    def max_value(self) -> Number:
+        return self.bins[-1].max
+
+    def quantiles(self, q: List[float]) -> List[Number]:
+        """Approximate quantiles, chosen among bin lower bounds.
+
+        For each target q returns the lower of the first bin such that the
+        fraction of data strictly left of that bin is <= q. ``q`` must be
+        sorted ascending.
+        """
+        assert sorted(q) == list(q), "Quantiles to compute must be sorted."
+        total = self.total_count()
+        if total == 0:
+            raise ValueError("Cannot compute quantiles of an empty histogram")
+        result = []
+        count_smaller = total
+        i_q = len(q) - 1
+        for bin_ in reversed(self.bins):
+            count_smaller -= bin_.count
+            ratio_smaller = count_smaller / total
+            while i_q >= 0 and q[i_q] >= ratio_smaller:
+                result.append(bin_.lower)
+                i_q -= 1
+        while i_q >= 0:
+            result.append(self.bins[0].lower)
+            i_q -= 1
+        return result[::-1]
+
+
+def compute_ratio_dropped(
+        contribution_histogram: Histogram) -> Sequence[Tuple[int, float]]:
+    """For each candidate bounding threshold (bin lowers + max value),
+    the fraction of data that contribution bounding at that threshold drops.
+
+    An element of size s bounded at threshold t drops (s - t) units; summing
+    over the histogram (using bin counts/sums as sufficient statistics)
+    yields the exact drop ratio at every bin lower. Returns ascending
+    (threshold, ratio) pairs, beginning with (0, 1).
+    """
+    if not contribution_histogram.bins:
+        return []
+    bins = contribution_histogram.bins
+    total_sum = contribution_histogram.total_sum()
+    ratios = []
+    previous_value = bins[-1].lower
+    if contribution_histogram.max_value() != previous_value:
+        ratios.append((contribution_histogram.max_value(), 0.0))
+    dropped = 0.0
+    elements_larger = 0
+    for bin_ in reversed(bins):
+        current = bin_.lower
+        dropped += (elements_larger * (previous_value - current) +
+                    (bin_.sum - bin_.count * current))
+        ratios.append((current, dropped / total_sum))
+        previous_value = current
+        elements_larger += bin_.count
+    ratios.append((0, 1))
+    return ratios[::-1]
+
+
+@dataclasses.dataclass
+class DatasetHistograms:
+    """The seven dataset histograms driving tuning and analysis."""
+    l0_contributions_histogram: Optional[Histogram]
+    l1_contributions_histogram: Optional[Histogram]
+    linf_contributions_histogram: Optional[Histogram]
+    linf_sum_contributions_histogram: Optional[Histogram]
+    count_per_partition_histogram: Optional[Histogram]
+    count_privacy_id_per_partition: Optional[Histogram]
+    sum_per_partition_histogram: Optional[Histogram]

@@ -1,0 +1,452 @@
+"""JaxDPEngine: the TPU-native columnar execution engine.
+
+Same public contract as DPEngine.aggregate (params, extractors, budget
+accounting, explain reports, lazy results) but executes the whole
+aggregation as fused jitted kernels over columnar arrays instead of per-row
+dataflow: dictionary-encode keys on host, one fused bound-and-aggregate
+kernel (sort + segment reductions), one vectorized partition-selection call,
+and one batched noise call per mechanism (SURVEY.md §7 architecture stance).
+
+Budget-accounting parity is structural: the engine builds the exact same
+CompoundCombiner as DPEngine (same request_budget calls in the same order,
+combiners.py:849-922) and then *reads the mechanism specs off the
+combiners* to parameterize the device kernels — so (eps, delta) splits are
+identical to the reference path by construction.
+
+The lazy-budget contract survives jit: noise scales/granularities enter the
+kernels as runtime scalars, computed from the resolved specs at execution
+time (after compute_budgets), so recompilation never depends on budgets.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pipelinedp_tpu import budget_accounting
+from pipelinedp_tpu import combiners as combiners_lib
+from pipelinedp_tpu import dp_computations
+from pipelinedp_tpu import report_generator as report_generator_lib
+from pipelinedp_tpu.aggregate_params import (AggregateParams, MechanismType,
+                                             Metrics, NoiseKind, NormKind,
+                                             SelectPartitionsParams)
+from pipelinedp_tpu import dp_engine as dp_engine_lib
+from pipelinedp_tpu.data_extractors import DataExtractors
+from pipelinedp_tpu.ops import columnar, encoding, noise as noise_ops
+from pipelinedp_tpu.ops import selection as selection_ops
+from pipelinedp_tpu.report_generator import ExplainComputationReport
+from pipelinedp_tpu import noise_core
+
+
+def _mechanism_noise_params(spec: budget_accounting.MechanismSpec,
+                            sensitivities: dp_computations.Sensitivities):
+    """(is_gaussian, scale_or_std, granularity) runtime scalars for a spec."""
+    mech = dp_computations.create_additive_mechanism(spec, sensitivities)
+    if mech.noise_kind == NoiseKind.GAUSSIAN:
+        return True, mech.std, noise_core.gaussian_granularity(mech.std)
+    return False, mech.noise_parameter, noise_core.laplace_granularity(
+        mech.noise_parameter)
+
+
+class LazyJaxResult:
+    """Deferred result of a columnar aggregation.
+
+    Executes on first access — after BudgetAccountant.compute_budgets(), per
+    the lazy-budget contract (accessing unresolved specs raises).
+    """
+
+    def __init__(self, compute_fn, pk_vocab: encoding.Vocabulary):
+        self._compute_fn = compute_fn
+        self._pk_vocab = pk_vocab
+        self._columns = None
+
+    def to_columns(self) -> dict:
+        """Returns {'partition_id', 'keep_mask', metric arrays...} (device
+        arrays, [num_partitions])."""
+        if self._columns is None:
+            self._columns = self._compute_fn()
+        return self._columns
+
+    def partition_keys(self) -> List[Any]:
+        """Keys of the partitions present in the DP output (selection
+        applied — non-kept partitions must not leak)."""
+        cols = self.to_columns()
+        keep = np.asarray(cols["keep_mask"])
+        ids = np.asarray(cols["partition_id"])[keep]
+        return self._pk_vocab.decode_all(ids)
+
+    def __iter__(self):
+        cols = self.to_columns()
+        keep = np.asarray(cols["keep_mask"])
+        ids = np.asarray(cols["partition_id"])
+        metric_names = [
+            name for name in cols
+            if name not in ("partition_id", "keep_mask")
+        ]
+        metric_arrays = [np.asarray(cols[name]) for name in metric_names]
+        tuple_type = combiners_lib._get_or_create_named_tuple(
+            "MetricsTuple", tuple(metric_names))
+
+        def element(arr, i):
+            return float(arr[i]) if arr.ndim == 1 else arr[i]
+
+        for i in range(len(ids)):
+            if keep[i]:
+                yield (self._pk_vocab.decode(int(ids[i])),
+                       tuple_type(*(element(arr, i)
+                                    for arr in metric_arrays)))
+
+
+class JaxDPEngine:
+    """Columnar DP engine. API parity with DPEngine for the aggregation
+    surface; input may be Python rows (encoded on host) or pre-encoded
+    columns."""
+
+    def __init__(self,
+                 budget_accountant: budget_accounting.BudgetAccountant,
+                 seed: int = 0):
+        self._budget_accountant = budget_accountant
+        self._report_generators = []
+        self._root_key = jax.random.PRNGKey(seed)
+        self._key_counter = 0
+
+    def _next_key(self):
+        self._key_counter += 1
+        return jax.random.fold_in(self._root_key, self._key_counter)
+
+    # -- report plumbing (shared shape with DPEngine) -----------------------
+
+    @property
+    def _current_report_generator(self):
+        return self._report_generators[-1]
+
+    def _add_report_stage(self, stage):
+        self._current_report_generator.add_stage(stage)
+
+    def explain_computations_report(self):
+        return [g.report() for g in self._report_generators]
+
+    # -- aggregate ----------------------------------------------------------
+
+    def aggregate(self,
+                  col,
+                  params: AggregateParams,
+                  data_extractors: DataExtractors,
+                  public_partitions: Optional[Sequence[Any]] = None,
+                  out_explain_computation_report: Optional[
+                      ExplainComputationReport] = None) -> LazyJaxResult:
+        dp_engine_lib.DPEngine._check_aggregate_params(
+            self, col, params, data_extractors)
+        dp_engine_lib.DPEngine._check_budget_accountant_compatibility(
+            self, public_partitions is not None, params.metrics,
+            params.custom_combiners is not None)
+        self._check_supported(params)
+        with self._budget_accountant.scope(weight=params.budget_weight):
+            self._report_generators.append(
+                report_generator_lib.ReportGenerator(
+                    params, "aggregate", public_partitions is not None))
+            if out_explain_computation_report is not None:
+                out_explain_computation_report._set_report_generator(
+                    self._current_report_generator)
+            result = self._aggregate(col, params, data_extractors,
+                                     public_partitions)
+            self._budget_accountant._compute_budget_for_aggregation(
+                params.budget_weight)
+            return result
+
+    def _check_supported(self, params: AggregateParams):
+        if params.custom_combiners:
+            raise NotImplementedError(
+                "Custom combiners run on DPEngine with LocalBackend; the "
+                "columnar engine supports the standard metrics.")
+        if any(m.is_percentile for m in params.metrics):
+            raise NotImplementedError(
+                "PERCENTILE on the columnar engine is not implemented yet; "
+                "use DPEngine with LocalBackend.")
+
+    def _aggregate(self, col, params, data_extractors, public_partitions):
+        # Same budget requests as the reference graph.
+        compound = combiners_lib.create_compound_combiner(
+            params, self._budget_accountant)
+        is_vector = Metrics.VECTOR_SUM in params.metrics
+        selection_spec = None
+        if (public_partitions is None and
+                not params.post_aggregation_thresholding):
+            selection_spec = self._budget_accountant.request_budget(
+                mechanism_type=MechanismType.GENERIC)
+
+        # Host-side columnar encoding (the extract + public-filter stages).
+        # With contribution_bounds_already_enforced each row is its own
+        # privacy unit and no bounding is applied (parity: dp_engine.py:122).
+        pid_extractor = data_extractors.privacy_id_extractor
+        if params.contribution_bounds_already_enforced:
+            pid_extractor = None  # encode_rows assigns a unique id per row
+        pid, pk, value, pid_vocab, pk_vocab = encoding.encode_rows(
+            col,
+            pid_extractor,
+            data_extractors.partition_extractor,
+            data_extractors.value_extractor,
+            public_partitions=public_partitions,
+            vector_size=params.vector_size if is_vector else None)
+        num_partitions = max(len(pk_vocab), 1)
+
+        # When no child combiner expects per-partition sampling (e.g. the
+        # per-partition-sum clipping mode), Linf bounding is the combiner's
+        # job — disable the sampler (parity:
+        # DPEngine._create_contribution_bounder, dp_engine.py:380-400).
+        if (compound.expects_per_partition_sampling() and
+                params.max_contributions_per_partition):
+            linf_cap = params.max_contributions_per_partition
+        else:
+            linf_cap = max(len(pid), 1)
+        l0_cap = (params.max_partitions_contributed
+                  if params.max_partitions_contributed else num_partitions)
+        if params.max_contributions is not None:
+            # L1 bounding: cap total contributions. On the columnar path we
+            # enforce it as (linf=max_contributions within a partition,
+            # l0=max_contributions partitions) which is a strictly tighter
+            # bound than the reference's total-sample.
+            linf_cap = params.max_contributions
+            l0_cap = params.max_contributions
+        if params.contribution_bounds_already_enforced:
+            # The input already satisfies the bounds; apply none.
+            linf_cap = max(len(pid), 1)
+            l0_cap = num_partitions
+            self._add_report_stage(
+                "Contribution bounding: skipped (already enforced by the "
+                "caller)")
+        else:
+            self._add_report_stage(
+                f"Per-partition contribution bounding: for each privacy_id "
+                f"and each partition, randomly select max(actual_"
+                f"contributions_per_partition, {linf_cap}) contributions.")
+            self._add_report_stage(
+                f"Cross-partition contribution bounding: for each privacy_id "
+                f"randomly select max(actual_partition_contributed, {l0_cap}) "
+                f"partitions")
+        for stage in compound.explain_computation():
+            self._add_report_stage(stage)
+
+        kernel_key = self._next_key()
+        engine = self
+
+        def compute():
+            return engine._execute(compound, params, selection_spec,
+                                   kernel_key, pid, pk, value,
+                                   num_partitions, linf_cap, l0_cap,
+                                   public_partitions is not None, is_vector)
+
+        return LazyJaxResult(compute, pk_vocab)
+
+    # -- execution (after budgets resolve) ----------------------------------
+
+    def _execute(self, compound, params: AggregateParams, selection_spec,
+                 key, pid, pk, value, num_partitions, linf_cap, l0_cap,
+                 is_public: bool, is_vector: bool) -> dict:
+        k_kernel, k_select, k_noise = jax.random.split(key, 3)
+        valid_rows = np.ones(len(pid), dtype=bool)
+
+        if params.bounds_per_partition_are_set:
+            row_lo, row_hi = -np.inf, np.inf
+            glo, ghi = (params.min_sum_per_partition,
+                        params.max_sum_per_partition)
+        elif params.bounds_per_contribution_are_set:
+            row_lo, row_hi = params.min_value, params.max_value
+            glo, ghi = -np.inf, np.inf
+        else:
+            row_lo, row_hi = -np.inf, np.inf
+            glo, ghi = -np.inf, np.inf
+        middle = (dp_computations.compute_middle(params.min_value,
+                                                 params.max_value)
+                  if params.bounds_per_contribution_are_set else 0.0)
+
+        vector_sums = None
+        if is_vector:
+            vector_sums, accs = columnar.bound_and_aggregate_vector(
+                k_kernel, jnp.asarray(pid), jnp.asarray(pk),
+                jnp.asarray(value), jnp.asarray(valid_rows),
+                num_partitions=num_partitions,
+                linf_cap=linf_cap,
+                l0_cap=l0_cap,
+                max_norm=params.vector_max_norm,
+                norm_ord={NormKind.Linf: 0, NormKind.L1: 1,
+                          NormKind.L2: 2}[params.vector_norm_kind or
+                                          NormKind.Linf])
+        else:
+            accs = columnar.bound_and_aggregate(
+                k_kernel, jnp.asarray(pid), jnp.asarray(pk),
+                jnp.asarray(value), jnp.asarray(valid_rows),
+                num_partitions=num_partitions,
+                linf_cap=linf_cap,
+                l0_cap=l0_cap,
+                row_clip_lo=row_lo,
+                row_clip_hi=row_hi,
+                middle=middle,
+                group_clip_lo=glo,
+                group_clip_hi=ghi)
+
+        partition_exists = accs.pid_count > 0
+
+        # Partition selection.
+        if is_public:
+            keep_mask = jnp.ones(num_partitions, dtype=bool)
+        elif selection_spec is not None:
+            sel_params = selection_ops.create_selection_params(
+                params.partition_selection_strategy, selection_spec.eps,
+                selection_spec.delta, params.max_partitions_contributed or 1,
+                params.pre_threshold)
+            max_rows_per_pid = 1
+            if params.contribution_bounds_already_enforced:
+                max_rows_per_pid = (params.max_contributions or
+                                    params.max_contributions_per_partition)
+            pid_counts_est = jnp.ceil(accs.pid_count / max_rows_per_pid)
+            keep_mask, _ = selection_ops.select_partitions(
+                k_select, pid_counts_est, sel_params, partition_exists)
+        else:
+            keep_mask = partition_exists  # post-agg thresholding prunes below
+
+        # DP metrics per combiner, batched noise.
+        columns = {}
+        for i, combiner in enumerate(compound.combiners):
+            sub_key = jax.random.fold_in(k_noise, i)
+            self._compute_combiner_metrics(combiner, params, accs,
+                                           vector_sums, sub_key, columns)
+            if isinstance(combiner,
+                          combiners_lib.PostAggregationThresholdingCombiner):
+                thresh = dp_computations.create_thresholding_mechanism(
+                    combiner.mechanism_spec(), combiner.sensitivities(),
+                    params.pre_threshold)
+                sel_params = selection_ops.selection_params_from_strategy(
+                    thresh.strategy)
+                thresh_keep, noised = selection_ops.select_partitions(
+                    sub_key, accs.pid_count, sel_params, partition_exists)
+                keep_mask = keep_mask & thresh_keep
+                columns["privacy_id_count"] = noised
+
+        columns["partition_id"] = jnp.arange(num_partitions, dtype=jnp.int32)
+        columns["keep_mask"] = keep_mask
+        return columns
+
+    def _compute_combiner_metrics(self, combiner, params, accs, vector_sums,
+                                  key, columns: dict) -> None:
+        k1, k2, k3 = jax.random.split(key, 3)
+        if isinstance(combiner, combiners_lib.CountCombiner):
+            is_g, scale, gran = _mechanism_noise_params(
+                combiner.mechanism_spec(), combiner.sensitivities())
+            columns["count"] = noise_ops.add_noise(k1, accs.count, is_g,
+                                                   scale, gran)
+        elif isinstance(combiner, combiners_lib.SumCombiner):
+            is_g, scale, gran = _mechanism_noise_params(
+                combiner.mechanism_spec(), combiner.sensitivities())
+            columns["sum"] = noise_ops.add_noise(k1, accs.sum, is_g, scale,
+                                                 gran)
+        elif isinstance(combiner, combiners_lib.PrivacyIdCountCombiner):
+            is_g, scale, gran = _mechanism_noise_params(
+                combiner.mechanism_spec(), combiner.sensitivities())
+            columns["privacy_id_count"] = noise_ops.add_noise(
+                k1, accs.pid_count, is_g, scale, gran)
+        elif isinstance(combiner,
+                        combiners_lib.PostAggregationThresholdingCombiner):
+            pass  # handled by the caller (needs the keep mask)
+        elif isinstance(combiner, combiners_lib.MeanCombiner):
+            count_spec, sum_spec = combiner.mechanism_spec()
+            cg, cs, cgr = _mechanism_noise_params(
+                count_spec, combiner._count_sensitivities)
+            sg, ss, sgr = _mechanism_noise_params(
+                sum_spec, combiner._sum_sensitivities)
+            dp_count = noise_ops.add_noise(k1, accs.count, cg, cs, cgr)
+            dp_norm_sum = noise_ops.add_noise(k2, accs.norm_sum, sg, ss, sgr)
+            middle = dp_computations.compute_middle(params.min_value,
+                                                    params.max_value)
+            dp_mean = middle + dp_norm_sum / jnp.maximum(1.0, dp_count)
+            columns["mean"] = dp_mean
+            if "count" in combiner.metrics_names():
+                columns["count"] = dp_count
+            if "sum" in combiner.metrics_names():
+                columns["sum"] = dp_mean * dp_count
+        elif isinstance(combiner, combiners_lib.VarianceCombiner):
+            self._variance_metrics(combiner, params, accs, (k1, k2, k3),
+                                   columns)
+        elif isinstance(combiner, combiners_lib.VectorSumCombiner):
+            p = combiner._params
+            noise_params = p.additive_vector_noise_params
+            if noise_params.noise_kind == NoiseKind.LAPLACE:
+                l1 = (noise_params.l0_sensitivity *
+                      noise_params.linf_sensitivity)
+                scale = l1 / noise_params.eps_per_coordinate
+                gran = noise_core.laplace_granularity(scale)
+                columns["vector_sum"] = noise_ops.add_laplace_noise(
+                    k1, vector_sums, scale, gran)
+            else:
+                l2 = (math.sqrt(noise_params.l0_sensitivity) *
+                      noise_params.linf_sensitivity)
+                sigma = noise_core.analytic_gaussian_sigma(
+                    noise_params.eps_per_coordinate,
+                    noise_params.delta_per_coordinate, l2)
+                gran = noise_core.gaussian_granularity(sigma)
+                columns["vector_sum"] = noise_ops.add_gaussian_noise(
+                    k1, vector_sums, sigma, gran)
+        else:
+            raise NotImplementedError(
+                f"Combiner {type(combiner).__name__} is not supported on the "
+                f"columnar engine.")
+
+    def _variance_metrics(self, combiner, params, accs, keys, columns):
+        """Vectorized twin of dp_computations.compute_dp_var."""
+        k1, k2, k3 = keys
+        p = combiner._params
+        eps, delta = p.eps, p.delta
+        (b_count, b_sum, b_sq) = dp_computations.equally_split_budget(
+            eps, delta, 3)
+        l0 = params.max_partitions_contributed
+        linf = params.max_contributions_per_partition
+        noise_kind = params.noise_kind
+        middle = dp_computations.compute_middle(params.min_value,
+                                                params.max_value)
+
+        def noise_arr(k, arr, eps_delta, linf_sens):
+            if linf_sens == 0:
+                return arr
+            if noise_kind == NoiseKind.GAUSSIAN:
+                sigma = noise_core.analytic_gaussian_sigma(
+                    eps_delta[0], eps_delta[1],
+                    dp_computations.compute_l2_sensitivity(l0, linf_sens))
+                return noise_ops.add_gaussian_noise(
+                    k, arr, sigma, noise_core.gaussian_granularity(sigma))
+            scale = noise_core.laplace_diversity(
+                eps_delta[0],
+                dp_computations.compute_l1_sensitivity(l0, linf_sens))
+            return noise_ops.add_laplace_noise(
+                k, arr, scale, noise_core.laplace_granularity(scale))
+
+        dp_count = noise_arr(k1, accs.count, b_count, linf)
+        count_clamped = jnp.maximum(1.0, dp_count)
+        sum_linf = linf * abs(middle - params.min_value)
+        dp_mean_normalized = noise_arr(k2, accs.norm_sum, b_sum,
+                                       sum_linf) / count_clamped
+        # Noise calibration for the sum of squares uses the squares interval
+        # of the raw values (scalar twin: compute_dp_var,
+        # dp_computations.py:306-365 — interval feeds sensitivity only, the
+        # accumulated normalized sum of squares itself is noised as-is).
+        sq_lo, sq_hi = dp_computations.compute_squares_interval(
+            params.min_value, params.max_value)
+        sq_middle = dp_computations.compute_middle(sq_lo, sq_hi)
+        sq_linf = linf * abs(sq_middle - sq_lo)
+        dp_mean_sq = noise_arr(k3, accs.norm_sq_sum, b_sq,
+                               sq_linf) / count_clamped
+        dp_var = dp_mean_sq - dp_mean_normalized**2
+        # Parity with compute_dp_var: the middle is added only for a proper
+        # range (when min == max the normalized mean is reported as-is).
+        dp_mean = dp_mean_normalized + (
+            middle if params.min_value != params.max_value else 0.0)
+        columns["variance"] = dp_var
+        if "mean" in combiner.metrics_names():
+            columns["mean"] = dp_mean
+        if "count" in combiner.metrics_names():
+            columns["count"] = dp_count
+        if "sum" in combiner.metrics_names():
+            columns["sum"] = dp_mean * dp_count

@@ -1,0 +1,256 @@
+"""The fused columnar DP-aggregation kernels.
+
+This is the TPU-native replacement for the reference's per-row dataflow
+(contribution_bounders.py + combiners.py + the per-key shuffle of
+pipeline_backend.py): the whole bound-and-aggregate stage is two sorts and a
+handful of segment reductions over fixed-shape arrays, entirely inside jit.
+
+Dataflow (bound_and_aggregate):
+  1. lexsort rows by (privacy_id, partition_key, uniform) — the uniform
+     tiebreak makes each (pid, pk) group a random permutation, so "rank <
+     cap" is exact sampling without replacement (the sample_fixed_per_key of
+     the reference, done once for all keys).
+  2. rank rows within (pid, pk) via a cummax over group-start indices; keep
+     rank < max_contributions_per_partition  (Linf bounding).
+  3. reduce rows -> (pid, pk) group accumulators with segment sums.
+  4. lexsort groups by (pid, uniform); rank within pid; keep rank <
+     max_partitions_contributed  (L0 bounding).
+  5. reduce kept groups -> per-partition accumulators (count, clipped sum,
+     normalized sum, normalized sum of squares, privacy-id count) with
+     segment sums into [num_partitions] arrays.
+
+All shapes static; caps and clip bounds are runtime scalars. Padding rows
+(for sharding) carry valid=False and are routed to the end of the sort.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+_INT32_MAX = jnp.iinfo(jnp.int32).max
+
+
+class PartitionAccumulators(NamedTuple):
+    """Per-partition accumulators, each of shape [num_partitions]."""
+    pid_count: jnp.ndarray  # distinct privacy units contributing
+    count: jnp.ndarray  # kept contributions
+    sum: jnp.ndarray  # clipped sum
+    norm_sum: jnp.ndarray  # sum of (clip(v) - middle)
+    norm_sq_sum: jnp.ndarray  # sum of (clip(v) - middle)^2
+
+
+def _segment_rank(sorted_is_start: jnp.ndarray) -> jnp.ndarray:
+    """Rank of each element within its (contiguous) segment."""
+    n = sorted_is_start.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    seg_start = jax.lax.cummax(jnp.where(sorted_is_start, idx, 0))
+    return idx - seg_start
+
+
+@functools.partial(jax.jit, static_argnames=("num_partitions",))
+def bound_and_aggregate(key: jax.Array,
+                        pid: jnp.ndarray,
+                        pk: jnp.ndarray,
+                        value: jnp.ndarray,
+                        valid: jnp.ndarray,
+                        *,
+                        num_partitions: int,
+                        linf_cap,
+                        l0_cap,
+                        row_clip_lo,
+                        row_clip_hi,
+                        middle,
+                        group_clip_lo,
+                        group_clip_hi) -> PartitionAccumulators:
+    """Contribution bounding + per-partition aggregation, fully fused.
+
+    Args:
+      key: PRNG key for the sampling tiebreaks.
+      pid, pk: int32[N] dense ids; pk in [0, num_partitions).
+      value: float32[N].
+      valid: bool[N] — False for padding rows.
+      num_partitions: static partition-vocabulary size.
+      linf_cap: max contributions kept per (pid, pk) — pass N to disable.
+      l0_cap: max partitions kept per pid.
+      row_clip_lo/hi: per-contribution clip bounds (+-inf to disable).
+      middle: normalization midpoint for mean/variance sums.
+      group_clip_lo/hi: per-partition-sum clip bounds (+-inf to disable) —
+        the min/max_sum_per_partition mode of SumCombiner.
+    """
+    n = pid.shape[0]
+    if n == 0:
+        zeros = jnp.zeros((num_partitions,), dtype=value.dtype)
+        return PartitionAccumulators(zeros, zeros, zeros, zeros, zeros)
+    k1, k2, = jax.random.split(key)
+
+    # Padding rows sort to the very end.
+    pid_key = jnp.where(valid, pid, _INT32_MAX)
+    pk_key = jnp.where(valid, pk, _INT32_MAX)
+
+    # -- step 1: sort rows by (pid, pk, uniform) ---------------------------
+    tiebreak = jax.random.uniform(k1, (n,))
+    order = jnp.lexsort((tiebreak, pk_key, pid_key))
+    spid = pid_key[order]
+    spk = pk_key[order]
+    sval = value[order]
+    svalid = valid[order]
+
+    # -- step 2: Linf bounding ---------------------------------------------
+    is_start = jnp.concatenate([
+        jnp.ones((1,), dtype=bool),
+        (spid[1:] != spid[:-1]) | (spk[1:] != spk[:-1])
+    ])
+    rank = _segment_rank(is_start)
+    keep_row = svalid & (rank < linf_cap)
+
+    # -- step 3: rows -> (pid, pk) group accumulators ----------------------
+    group_id = (jnp.cumsum(is_start) - 1).astype(jnp.int32)
+    w = keep_row.astype(sval.dtype)
+    vclip = jnp.clip(sval, row_clip_lo, row_clip_hi)
+    vnorm = vclip - middle
+    seg = functools.partial(jax.ops.segment_sum,
+                            segment_ids=group_id,
+                            num_segments=n)
+    g_count = seg(w)
+    g_sum = jnp.clip(seg(vclip * w), group_clip_lo, group_clip_hi)
+    g_norm = seg(vnorm * w)
+    g_norm_sq = seg(vnorm * vnorm * w)
+    start_w = (is_start & svalid).astype(jnp.int32)
+    g_pid = seg(spid * start_w)
+    g_pk = seg(spk * start_w)
+    g_valid = seg(start_w.astype(sval.dtype)) > 0
+
+    # -- step 4: L0 bounding over groups -----------------------------------
+    g_rand = jax.random.uniform(k2, (n,))
+    g_pid_key = jnp.where(g_valid, g_pid, _INT32_MAX)
+    order2 = jnp.lexsort((g_rand, g_pid_key))
+    sg_pid = g_pid_key[order2]
+    is_start2 = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), sg_pid[1:] != sg_pid[:-1]])
+    rank2 = _segment_rank(is_start2)
+    keep_sorted = rank2 < l0_cap
+    keep_group = jnp.zeros((n,), dtype=bool).at[order2].set(keep_sorted)
+    keep_group = keep_group & g_valid
+
+    # -- step 5: groups -> per-partition accumulators ----------------------
+    gw = keep_group.astype(sval.dtype)
+    g_pk_safe = jnp.where(keep_group, g_pk, 0).astype(jnp.int32)
+    pseg = functools.partial(jax.ops.segment_sum,
+                             segment_ids=g_pk_safe,
+                             num_segments=num_partitions)
+    return PartitionAccumulators(
+        pid_count=pseg(gw),
+        count=pseg(g_count * gw),
+        sum=pseg(g_sum * gw),
+        norm_sum=pseg(g_norm * gw),
+        norm_sq_sum=pseg(g_norm_sq * gw),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("num_partitions", "norm_ord"))
+def bound_and_aggregate_vector(key: jax.Array,
+                               pid: jnp.ndarray,
+                               pk: jnp.ndarray,
+                               value: jnp.ndarray,
+                               valid: jnp.ndarray,
+                               *,
+                               num_partitions: int,
+                               linf_cap,
+                               l0_cap,
+                               max_norm,
+                               norm_ord: int) -> jnp.ndarray:
+    """VECTOR_SUM path: per-row norm clipping + the same two-stage sampling.
+
+    value: float32[N, D]. norm_ord: 0 => Linf clip per coordinate, 1/2 =>
+    L1/L2 norm scaling. Returns (vector_sums [num_partitions, D],
+    scalar PartitionAccumulators) — the scalar accumulators ride along so
+    callers never need a second pass over the rows.
+    """
+    n = pid.shape[0]
+    d = value.shape[1]
+    if n == 0:
+        zeros = jnp.zeros((num_partitions,), dtype=value.dtype)
+        return (jnp.zeros((num_partitions, d), dtype=value.dtype),
+                PartitionAccumulators(zeros, zeros, zeros, zeros, zeros))
+    k1, k2 = jax.random.split(key)
+    pid_key = jnp.where(valid, pid, _INT32_MAX)
+    pk_key = jnp.where(valid, pk, _INT32_MAX)
+    tiebreak = jax.random.uniform(k1, (n,))
+    order = jnp.lexsort((tiebreak, pk_key, pid_key))
+    spid, spk, svalid = pid_key[order], pk_key[order], valid[order]
+    sval = value[order]
+
+    if norm_ord == 0:
+        sval = jnp.clip(sval, -max_norm, max_norm)
+    else:
+        norms = jnp.linalg.norm(sval, ord=norm_ord, axis=1, keepdims=True)
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(norms, 1e-30))
+        sval = sval * scale
+
+    is_start = jnp.concatenate([
+        jnp.ones((1,), dtype=bool),
+        (spid[1:] != spid[:-1]) | (spk[1:] != spk[:-1])
+    ])
+    rank = _segment_rank(is_start)
+    keep_row = svalid & (rank < linf_cap)
+
+    group_id = (jnp.cumsum(is_start) - 1).astype(jnp.int32)
+    w1 = keep_row.astype(sval.dtype)
+    w = w1[:, None]
+    g_vec = jax.ops.segment_sum(sval * w, group_id, num_segments=n)
+    g_count = jax.ops.segment_sum(w1, group_id, num_segments=n)
+    start_w = (is_start & svalid).astype(jnp.int32)
+    g_pid = jax.ops.segment_sum(spid * start_w, group_id, num_segments=n)
+    g_pk = jax.ops.segment_sum(spk * start_w, group_id, num_segments=n)
+    g_valid = jax.ops.segment_sum(start_w, group_id, num_segments=n) > 0
+
+    g_rand = jax.random.uniform(k2, (n,))
+    g_pid_key = jnp.where(g_valid, g_pid, _INT32_MAX)
+    order2 = jnp.lexsort((g_rand, g_pid_key))
+    is_start2 = jnp.concatenate([
+        jnp.ones((1,), dtype=bool),
+        g_pid_key[order2][1:] != g_pid_key[order2][:-1]
+    ])
+    keep_sorted = _segment_rank(is_start2) < l0_cap
+    keep_group = jnp.zeros((n,), dtype=bool).at[order2].set(keep_sorted)
+    keep_group = keep_group & g_valid
+
+    gw = keep_group.astype(sval.dtype)
+    g_pk_safe = jnp.where(keep_group, g_pk, 0).astype(jnp.int32)
+    pseg = functools.partial(jax.ops.segment_sum,
+                             segment_ids=g_pk_safe,
+                             num_segments=num_partitions)
+    vector_sums = pseg(g_vec * gw[:, None])
+    zeros = jnp.zeros((num_partitions,), dtype=sval.dtype)
+    accs = PartitionAccumulators(pid_count=pseg(gw),
+                                 count=pseg(g_count * gw),
+                                 sum=zeros,
+                                 norm_sum=zeros,
+                                 norm_sq_sum=zeros)
+    return vector_sums, accs
+
+
+@functools.partial(jax.jit, static_argnames=("num_partitions",))
+def count_distinct_pids_per_partition(pid: jnp.ndarray, pk: jnp.ndarray,
+                                      valid: jnp.ndarray, key: jax.Array,
+                                      l0_cap, *,
+                                      num_partitions: int) -> jnp.ndarray:
+    """select_partitions fast path: L0-bounded distinct-pid counts per pk."""
+    accs = bound_and_aggregate(key,
+                               pid,
+                               pk,
+                               jnp.zeros_like(pid, dtype=jnp.float32),
+                               valid,
+                               num_partitions=num_partitions,
+                               linf_cap=1,
+                               l0_cap=l0_cap,
+                               row_clip_lo=-jnp.inf,
+                               row_clip_hi=jnp.inf,
+                               middle=0.0,
+                               group_clip_lo=-jnp.inf,
+                               group_clip_hi=jnp.inf)
+    return accs.pid_count

@@ -1,0 +1,98 @@
+"""Host-side dictionary encoding of arbitrary keys to dense int32 ids.
+
+The columnar engine works on fixed-shape integer arrays; arbitrary privacy
+ids and partition keys (strings, tuples, ...) are encoded on host to dense
+ids (SURVEY.md §7 "String keys"). Public-partition filtering becomes a
+vocabulary-membership test during encoding, so non-public rows never reach
+the device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class Vocabulary:
+    """Bidirectional key <-> dense id mapping."""
+
+    def __init__(self, keys: Optional[Sequence[Any]] = None):
+        self._key_to_id: Dict[Any, int] = {}
+        self._keys: List[Any] = []
+        if keys is not None:
+            for key in keys:
+                self.add(key)
+
+    def add(self, key: Any) -> int:
+        idx = self._key_to_id.get(key)
+        if idx is None:
+            idx = len(self._keys)
+            self._key_to_id[key] = idx
+            self._keys.append(key)
+        return idx
+
+    def lookup(self, key: Any) -> int:
+        """Returns the id or -1 if unknown."""
+        return self._key_to_id.get(key, -1)
+
+    def decode(self, idx: int) -> Any:
+        return self._keys[idx]
+
+    def decode_all(self, ids: Sequence[int]) -> List[Any]:
+        return [self._keys[i] for i in ids]
+
+    @property
+    def keys(self) -> List[Any]:
+        return list(self._keys)
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+
+def encode_rows(
+    rows,
+    privacy_id_extractor,
+    partition_extractor,
+    value_extractor,
+    public_partitions: Optional[Sequence[Any]] = None,
+    vector_size: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Vocabulary, Vocabulary]:
+    """Encodes Python rows into (pid_ids, pk_ids, values) numpy columns.
+
+    With ``public_partitions`` the partition vocabulary is frozen up front
+    and rows with non-public partitions are dropped (the public-path
+    filter_by_key of the reference graph, dp_engine.py:290).
+    """
+    pid_vocab = Vocabulary()
+    if public_partitions is not None:
+        pk_vocab = Vocabulary(public_partitions)
+    else:
+        pk_vocab = Vocabulary()
+    pids: List[int] = []
+    pks: List[int] = []
+    values: List[Any] = []
+    public = public_partitions is not None
+    for row in rows:
+        pk = partition_extractor(row)
+        if public:
+            pk_id = pk_vocab.lookup(pk)
+            if pk_id < 0:
+                continue
+        else:
+            pk_id = pk_vocab.add(pk)
+        pid = privacy_id_extractor(row) if privacy_id_extractor else len(pids)
+        pids.append(pid_vocab.add(pid))
+        pks.append(pk_id)
+        if value_extractor is not None:
+            values.append(value_extractor(row))
+        else:
+            values.append(0.0)
+    pid_arr = np.asarray(pids, dtype=np.int32)
+    pk_arr = np.asarray(pks, dtype=np.int32)
+    if vector_size is not None:
+        value_arr = np.asarray(values, dtype=np.float32).reshape(
+            len(values), vector_size)
+    else:
+        value_arr = np.asarray(values, dtype=np.float32)
+    return pid_arr, pk_arr, value_arr, pid_vocab, pk_vocab

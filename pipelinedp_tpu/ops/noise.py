@@ -1,0 +1,50 @@
+"""Batched on-device noise with granularity snapping.
+
+The device twin of pipelinedp_tpu/noise_core.py: one `jax.random` call
+noises every partition at once (vs. the reference's per-partition C++ calls,
+combiners.py:262-263). The same power-of-two granularity snapping is applied
+— value and noise are both rounded to a granularity derived from the noise
+scale — with JAX's counter-based threefry PRNG supplying the randomness.
+Scales and granularities are runtime scalars, so budget resolution never
+forces a recompile (SURVEY.md §7 "Lazy budget vs. jit").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def snap(values: jnp.ndarray, granularity) -> jnp.ndarray:
+    return jnp.round(values / granularity) * granularity
+
+
+def add_laplace_noise(key: jax.Array, values: jnp.ndarray, scale,
+                      granularity) -> jnp.ndarray:
+    """values snapped + Laplace(scale) noise snapped to granularity.
+
+    Noise is sampled in float32 (TPU-native); snapping quantizes the
+    mantissa tail which is the float-attack mitigation (Mironov 2012).
+    """
+    noise = jax.random.laplace(key, values.shape, dtype=values.dtype) * scale
+    return snap(values, granularity) + snap(noise, granularity)
+
+
+def add_gaussian_noise(key: jax.Array, values: jnp.ndarray, stddev,
+                       granularity) -> jnp.ndarray:
+    noise = jax.random.normal(key, values.shape, dtype=values.dtype) * stddev
+    return snap(values, granularity) + snap(noise, granularity)
+
+
+def add_noise(key: jax.Array, values: jnp.ndarray, is_gaussian,
+              scale_or_std, granularity) -> jnp.ndarray:
+    """Branchless noise: is_gaussian selects the distribution.
+
+    All parameters may be traced scalars, so one compiled kernel serves both
+    noise kinds and any budget.
+    """
+    lap = jax.random.laplace(key, values.shape, dtype=values.dtype)
+    gauss = jax.random.normal(jax.random.fold_in(key, 1), values.shape,
+                              dtype=values.dtype)
+    noise = jnp.where(is_gaussian, gauss, lap) * scale_or_std
+    return snap(values, granularity) + snap(noise, granularity)

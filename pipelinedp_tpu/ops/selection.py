@@ -1,0 +1,131 @@
+"""Vectorized private partition selection for the columnar engine.
+
+One call decides every partition at once (vs. the reference's per-partition
+C++ strategy objects inside a filter, dp_engine.py:335-371). The
+truncated-geometric keep probabilities use the same closed forms as
+pipelinedp_tpu/partition_selection.py, with the segment constants
+precomputed on host and passed as runtime scalars so the kernel never
+recompiles across budgets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pipelinedp_tpu import partition_selection as ps_lib
+from pipelinedp_tpu.aggregate_params import PartitionSelectionStrategy
+
+TRUNCATED_GEOMETRIC = 0
+LAPLACE_THRESHOLDING = 1
+GAUSSIAN_THRESHOLDING = 2
+
+
+@dataclasses.dataclass
+class SelectionParams:
+    """Runtime scalars describing a selection strategy for the device kernel.
+
+    ``kind`` is static (selects the code path); everything else is traced.
+    """
+    kind: int
+    # Truncated geometric (segment constants):
+    eps_p: float = 0.0
+    delta_p: float = 0.0
+    n1: float = 0.0
+    pi_n1: float = 0.0
+    pi_inf: float = 0.0
+    # Thresholding:
+    noise_scale: float = 0.0  # Laplace scale b or Gaussian sigma
+    threshold_shifted: float = 0.0
+    # Common:
+    pre_threshold_shift: float = 0.0  # pre_threshold - 1, or 0
+
+
+def selection_params_from_strategy(
+        strategy: ps_lib.PartitionSelection) -> SelectionParams:
+    """Extracts device-kernel scalars from a host strategy object."""
+    shift = float((strategy.pre_threshold or 1) - 1)
+    if isinstance(strategy, ps_lib.TruncatedGeometricPartitionSelection):
+        return SelectionParams(
+            kind=TRUNCATED_GEOMETRIC,
+            eps_p=strategy._eps_p,
+            delta_p=strategy._delta_p,
+            n1=float(strategy._n1),
+            pi_n1=float(strategy._pi_n1),
+            pi_inf=float(strategy._pi_inf),
+            pre_threshold_shift=shift,
+        )
+    if isinstance(strategy, ps_lib.LaplaceThresholdingPartitionSelection):
+        return SelectionParams(
+            kind=LAPLACE_THRESHOLDING,
+            noise_scale=strategy._scale,
+            threshold_shifted=strategy._threshold_shifted,
+            pre_threshold_shift=shift,
+        )
+    if isinstance(strategy, ps_lib.GaussianThresholdingPartitionSelection):
+        return SelectionParams(
+            kind=GAUSSIAN_THRESHOLDING,
+            noise_scale=strategy.sigma,
+            threshold_shifted=strategy._threshold_shifted,
+            pre_threshold_shift=shift,
+        )
+    raise TypeError(f"Unknown strategy type: {type(strategy)}")
+
+
+def create_selection_params(strategy: PartitionSelectionStrategy, eps: float,
+                            delta: float, max_partitions_contributed: int,
+                            pre_threshold: Optional[int]) -> SelectionParams:
+    host = ps_lib.create_partition_selection_strategy(
+        strategy, eps, delta, max_partitions_contributed, pre_threshold)
+    return selection_params_from_strategy(host)
+
+
+def truncated_geometric_keep_prob(pid_counts: jnp.ndarray, eps_p, delta_p, n1,
+                                  pi_n1, pi_inf) -> jnp.ndarray:
+    """pi(n) via the two closed-form segments (floats in, probs out)."""
+    n = pid_counts.astype(jnp.float32)
+    seg_a = delta_p * jnp.expm1(jnp.minimum(n, n1) * eps_p) / jnp.expm1(eps_p)
+    seg_b = pi_inf - (pi_inf - pi_n1) * jnp.exp(-(n - n1) * eps_p)
+    probs = jnp.where(n <= n1, seg_a, seg_b)
+    return jnp.clip(probs, 0.0, 1.0)
+
+
+def select_partitions(key: jax.Array, pid_counts: jnp.ndarray,
+                      params: SelectionParams, valid: jnp.ndarray):
+    """Returns (keep_mask, noised_counts).
+
+    ``pid_counts``: per-partition privacy-unit counts (float or int array).
+    ``valid``: mask of partitions that exist in the data.
+    ``noised_counts`` is meaningful for thresholding strategies (the DP
+    privacy-id count estimate); for truncated geometric it echoes the raw
+    count (no noised value is defined — parity with PyDP).
+    """
+    n = pid_counts.astype(jnp.float32) - params.pre_threshold_shift
+    positive = (n > 0) & valid
+    if params.kind == TRUNCATED_GEOMETRIC:
+        probs = truncated_geometric_keep_prob(jnp.maximum(n, 1.0),
+                                              params.eps_p, params.delta_p,
+                                              params.n1, params.pi_n1,
+                                              params.pi_inf)
+        uniforms = jax.random.uniform(key, pid_counts.shape)
+        keep = positive & (uniforms < probs)
+        return keep, pid_counts.astype(jnp.float32)
+    if params.kind == LAPLACE_THRESHOLDING:
+        noise = jax.random.laplace(key, pid_counts.shape) * params.noise_scale
+    elif params.kind == GAUSSIAN_THRESHOLDING:
+        noise = jax.random.normal(key, pid_counts.shape) * params.noise_scale
+    else:
+        raise ValueError(f"Unknown selection kind: {params.kind}")
+    noised = n + noise
+    keep = positive & (noised >= params.threshold_shifted)
+    return keep, noised + params.pre_threshold_shift
+
+
+def probability_of_keep_np(strategy: ps_lib.PartitionSelection,
+                           counts: np.ndarray) -> np.ndarray:
+    """Host-side reference for testing the device path."""
+    return strategy.probability_of_keep_vec(counts)

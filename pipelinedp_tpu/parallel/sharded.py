@@ -1,0 +1,175 @@
+"""Multi-chip execution: shard_map over a 2D ('dp', 'mp') device mesh.
+
+This is the TPU-native replacement for the reference's distributed shuffle
+(Beam runner / Spark shuffle behind group_by_key and
+combine_accumulators_per_key, pipeline_backend.py:223-474; SURVEY.md §2.5):
+
+  * rows are sharded over all mesh devices (data parallelism across both
+    axes) — the host loader hash-shards rows by privacy id, so each privacy
+    unit's rows are local to one device and contribution bounding is exact
+    without any cross-device exchange;
+  * each device runs the fused bound-and-aggregate kernel on its shard,
+    producing per-partition partial accumulators [num_partitions];
+  * partials are combined with `psum_scatter` over 'mp' then 'dp' — the
+    reduce-scatter rides ICI and leaves every device holding the *full* sum
+    for a distinct 1/(dp*mp) slice of the partition space (this is the
+    shuffle);
+  * partition selection and noise generation then run fully sharded — every
+    chip noises only its partition slice — and results are all-gathered.
+
+The same step compiles for any mesh shape; __graft_entry__.dryrun_multichip
+exercises it on a virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pipelinedp_tpu.ops import columnar, noise as noise_ops
+from pipelinedp_tpu.ops import selection as selection_ops
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              dp: Optional[int] = None,
+              mp: Optional[int] = None,
+              devices=None) -> Mesh:
+    """Builds a ('dp', 'mp') mesh over the available devices.
+
+    Default factorization puts the larger factor on 'dp' (rows usually
+    outnumber partitions per device).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = n_devices or len(devices)
+    if dp is None or mp is None:
+        mp = 1
+        for candidate in range(int(np.sqrt(n)), 0, -1):
+            if n % candidate == 0:
+                mp = candidate
+                break
+        dp = n // mp
+    if dp * mp != n:
+        raise ValueError(f"dp*mp={dp*mp} != n_devices={n}")
+    return Mesh(np.asarray(devices[:n]).reshape(dp, mp), ("dp", "mp"))
+
+
+def shard_rows_by_pid(pid: np.ndarray, pk: np.ndarray, value: np.ndarray,
+                      n_shards: int
+                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                 np.ndarray]:
+    """Host-side loader step: hash-shard rows by privacy id and pad shards
+    to equal length.
+
+    Returns arrays of shape [n_shards * shard_len] laid out shard-major,
+    plus the validity mask for padding rows. Keeping each pid on one shard
+    makes L0/Linf bounding exact with zero cross-device row exchange.
+    """
+    shard_of_row = pid % n_shards
+    order = np.argsort(shard_of_row, kind="stable")
+    pid, pk, value = pid[order], pk[order], value[order]
+    shard_of_row = shard_of_row[order]
+    counts = np.bincount(shard_of_row, minlength=n_shards)
+    shard_len = int(counts.max()) if len(pid) else 1
+    total = n_shards * shard_len
+    out_pid = np.zeros(total, dtype=pid.dtype)
+    out_pk = np.zeros(total, dtype=pk.dtype)
+    out_val = np.zeros((total,) + value.shape[1:], dtype=value.dtype)
+    out_valid = np.zeros(total, dtype=bool)
+    offsets = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    for s in range(n_shards):
+        lo, n_rows = offsets[s], counts[s]
+        dst = s * shard_len
+        out_pid[dst:dst + n_rows] = pid[lo:lo + n_rows]
+        out_pk[dst:dst + n_rows] = pk[lo:lo + n_rows]
+        out_val[dst:dst + n_rows] = value[lo:lo + n_rows]
+        out_valid[dst:dst + n_rows] = True
+    return out_pid, out_pk, out_val, out_valid
+
+
+class ShardedDPResult(NamedTuple):
+    """Per-partition outputs, global [num_partitions_padded] arrays."""
+    count: jnp.ndarray
+    sum: jnp.ndarray
+    pid_count: jnp.ndarray
+    keep_mask: jnp.ndarray
+
+
+def build_sharded_aggregate_step(mesh: Mesh, num_partitions: int):
+    """Compiles the full sharded DP aggregation step for a mesh.
+
+    num_partitions is padded to a multiple of the device count so the
+    partition dimension shards evenly.
+    """
+    n_dev = mesh.devices.size
+    padded_p = ((num_partitions + n_dev - 1) // n_dev) * n_dev
+
+    def local_step(key, pid, pk, value, valid, linf_cap, l0_cap, clip_lo,
+                   clip_hi, noise_scale, noise_granularity, is_gaussian,
+                   sel_scalars):
+        # Per-device PRNG stream.
+        dp_idx = jax.lax.axis_index("dp")
+        mp_idx = jax.lax.axis_index("mp")
+        dev_key = jax.random.fold_in(jax.random.fold_in(key, dp_idx), mp_idx)
+        k_kernel, k_sel, k_noise1, k_noise2 = jax.random.split(dev_key, 4)
+
+        accs = columnar.bound_and_aggregate(
+            k_kernel, pid, pk, value, valid,
+            num_partitions=padded_p,
+            linf_cap=linf_cap,
+            l0_cap=l0_cap,
+            row_clip_lo=clip_lo,
+            row_clip_hi=clip_hi,
+            middle=0.0,
+            group_clip_lo=-jnp.inf,
+            group_clip_hi=jnp.inf)
+
+        # The distributed shuffle: reduce partials over all devices while
+        # scattering the partition dimension (ICI reduce-scatter).
+        def reduce_scatter(x):
+            # 'dp' first, then 'mp', so the slice held by device (d, m) is
+            # chunk d*mp + m — matching the P(('dp','mp')) output layout.
+            x = jax.lax.psum_scatter(x, "dp", scatter_dimension=0, tiled=True)
+            return jax.lax.psum_scatter(x, "mp", scatter_dimension=0,
+                                        tiled=True)
+
+        count = reduce_scatter(accs.count)
+        total = reduce_scatter(accs.sum)
+        pid_count = reduce_scatter(accs.pid_count)
+
+        # Selection + noise, sharded over the partition slice.
+        sel_params = selection_ops.SelectionParams(
+            kind=selection_ops.TRUNCATED_GEOMETRIC,
+            eps_p=sel_scalars[0], delta_p=sel_scalars[1], n1=sel_scalars[2],
+            pi_n1=sel_scalars[3], pi_inf=sel_scalars[4])
+        keep, _ = selection_ops.select_partitions(k_sel, pid_count,
+                                                  sel_params, pid_count > 0)
+        dp_count = noise_ops.add_noise(k_noise1, count, is_gaussian,
+                                       noise_scale, noise_granularity)
+        dp_sum = noise_ops.add_noise(k_noise2, total, is_gaussian,
+                                     noise_scale, noise_granularity)
+        return ShardedDPResult(dp_count, dp_sum, pid_count, keep)
+
+    row_spec = P(("dp", "mp"))
+    part_spec = P(("dp", "mp"))
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(), row_spec, row_spec, row_spec, row_spec, P(), P(), P(),
+                  P(), P(), P(), P(), P()),
+        out_specs=ShardedDPResult(part_spec, part_spec, part_spec, part_spec),
+        check_vma=False)
+
+    @jax.jit
+    def step(key, pid, pk, value, valid, linf_cap, l0_cap, clip_lo, clip_hi,
+             noise_scale, noise_granularity, is_gaussian, sel_scalars):
+        return sharded(key, pid, pk, value, valid, linf_cap, l0_cap, clip_lo,
+                       clip_hi, noise_scale, noise_granularity, is_gaussian,
+                       sel_scalars)
+
+    return step, padded_p

@@ -1,0 +1,152 @@
+"""DP computation of contribution bounds (currently the L0 bound,
+max_partitions_contributed) via the exponential mechanism over dataset
+histograms.
+
+Parity: pipeline_dp/private_contribution_bounds.py (PrivateL0Calculator
+:27-87, L0ScoringFunction :90-176, generate_possible_contribution_bounds
+:179-196).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import lru_cache
+from typing import List
+
+from pipelinedp_tpu import dp_computations
+from pipelinedp_tpu import pipeline_functions
+from pipelinedp_tpu.aggregate_params import (
+    CalculatePrivateContributionBoundsParams)
+from pipelinedp_tpu.dataset_histograms.histograms import Histogram
+
+
+class PrivateL0Calculator:
+    """Chooses max_partitions_contributed in a DP way.
+
+    Scores candidate bounds k by the trade-off between added noise
+    (proportional to the count-noise std at l0=k, over all partitions) and
+    data dropped by bounding at k (from the L0 contribution histogram), then
+    samples a bound with the exponential mechanism.
+    """
+
+    def __init__(self, params: CalculatePrivateContributionBoundsParams,
+                 partitions, histograms, backend) -> None:
+        self._params = params
+        self._backend = backend
+        self._partitions = partitions
+        self._histograms = histograms
+
+    @dataclasses.dataclass
+    class Inputs:
+        l0_histogram: Histogram
+        number_of_partitions: int
+
+    @lru_cache(maxsize=None)
+    def calculate(self):
+        """Returns a 1-element collection with the chosen l0 bound."""
+        l0_histogram = self._backend.to_multi_transformable_collection(
+            self._backend.map(self._histograms,
+                              lambda h: h.l0_contributions_histogram,
+                              "Extract l0_contributions_histogram"))
+        number_of_partitions = self._calculate_number_of_partitions()
+        inputs_col = pipeline_functions.collect_to_container(
+            self._backend, {
+                "l0_histogram": l0_histogram,
+                "number_of_partitions": number_of_partitions,
+            }, PrivateL0Calculator.Inputs,
+            "Collect L0 calculation inputs")
+        return self._backend.map(inputs_col, self._calculate_l0,
+                                 "Calculate private l0 bound")
+
+    def _calculate_l0(self, inputs: "PrivateL0Calculator.Inputs") -> int:
+        scoring = L0ScoringFunction(self._params,
+                                    inputs.number_of_partitions,
+                                    inputs.l0_histogram)
+        candidates = generate_possible_contribution_bounds(
+            scoring.max_partitions_contributed_best_upper_bound())
+        return dp_computations.ExponentialMechanism(scoring).apply(
+            self._params.calculation_eps, candidates)
+
+    def _calculate_number_of_partitions(self):
+        distinct = self._backend.distinct(self._partitions,
+                                          "Keep only distinct partitions")
+        return pipeline_functions.size(self._backend, distinct,
+                                       "Calculate number of partitions")
+
+
+class L0ScoringFunction(dp_computations.ExponentialMechanism.ScoringFunction):
+    """score(k) = -0.5 * noise_impact(k) - 0.5 * dropped_data(k).
+
+    noise_impact(k) = number_of_partitions * count_noise_std(l0=k, linf=1);
+    dropped_data(k) = sum over privacy units of
+    max(min(#partitions_contributed, upper_bound) - k, 0), read off the L0
+    histogram. Suitable for COUNT / PRIVACY_ID_COUNT.
+    """
+
+    def __init__(self, params: CalculatePrivateContributionBoundsParams,
+                 number_of_partitions: int, l0_histogram: Histogram):
+        super().__init__()
+        self._params = params
+        self._number_of_partitions = number_of_partitions
+        self._l0_histogram = l0_histogram
+
+    def max_partitions_contributed_best_upper_bound(self) -> int:
+        return min(self._params.max_partitions_contributed_upper_bound,
+                   self._number_of_partitions)
+
+    # Kept for parity with the reference's private name (used in tests).
+    _max_partitions_contributed_best_upper_bound = (
+        max_partitions_contributed_best_upper_bound)
+
+    def score(self, k: int) -> float:
+        impact_noise_weight = 0.5
+        return -(impact_noise_weight * self._l0_impact_noise(k) +
+                 (1 - impact_noise_weight) * self._l0_impact_dropped(k))
+
+    @property
+    def global_sensitivity(self) -> float:
+        # One privacy unit can change dropped_data(k) by at most
+        # upper_bound - k <= upper_bound; noise impact is data-independent.
+        return self.max_partitions_contributed_best_upper_bound()
+
+    @property
+    def is_monotonic(self) -> bool:
+        return True
+
+    def _l0_impact_noise(self, k: int) -> float:
+        noise_params = dp_computations.ScalarNoiseParams(
+            eps=self._params.aggregation_eps,
+            delta=self._params.aggregation_delta,
+            max_partitions_contributed=k,
+            max_contributions_per_partition=1,
+            noise_kind=self._params.aggregation_noise_kind,
+            min_value=None,
+            max_value=None,
+            min_sum_per_partition=None,
+            max_sum_per_partition=None)
+        return (self._number_of_partitions *
+                dp_computations.compute_dp_count_noise_std(noise_params))
+
+    def _l0_impact_dropped(self, k: int) -> float:
+        upper = self.max_partitions_contributed_best_upper_bound()
+        return sum(
+            max(min(bin_.lower, upper) - k, 0) * bin_.count
+            for bin_ in self._l0_histogram.bins)
+
+
+def generate_possible_contribution_bounds(upper_bound: int) -> List[int]:
+    """All integers <= upper_bound with at most 3 significant digits:
+    1..999, 1000, 1010, ..., 9990, 10000, 10100, ... (log-size list).
+
+    Kept in sync with the histogram log-binning
+    (computing_histograms._to_bin_lower_upper_logarithmic).
+    """
+    bounds = []
+    current = 1
+    power = 10
+    while current <= upper_bound:
+        bounds.append(current)
+        if current >= power:
+            power *= 10
+        current += max(1, power // 1000)
+    return bounds

@@ -3,12 +3,20 @@
 Multi-chip TPU hardware is not available in CI; sharding correctness is
 validated on a virtual 8-device CPU mesh (the driver separately dry-run
 compiles the multi-chip path via __graft_entry__.dryrun_multichip).
+
+The environment may pre-register a hardware TPU platform at interpreter
+startup, so setting JAX_PLATFORMS here can be too late; instead the flags
+are set before the (lazy) CPU client initializes and the default platform is
+switched via jax.config.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

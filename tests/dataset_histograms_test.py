@@ -1,0 +1,148 @@
+"""Tests for dataset histograms (mirrors tests/dataset_histograms/ in the
+reference)."""
+
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu.dataset_histograms import computing_histograms as ch
+from pipelinedp_tpu.dataset_histograms import histograms as hist
+
+
+def extractors():
+    return pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                              partition_extractor=lambda r: r[1],
+                              value_extractor=lambda r: r[2])
+
+
+def compute(data):
+    backend = pdp.LocalBackend()
+    result = list(ch.compute_dataset_histograms(data, extractors(), backend))
+    assert len(result) == 1
+    return result[0]
+
+
+class TestLogBinning:
+
+    @pytest.mark.parametrize("value,lower,upper", [
+        (1, 1, 2),
+        (999, 999, 1000),
+        (1000, 1000, 1010),
+        (1001, 1000, 1010),
+        (1234, 1230, 1240),
+        (12345, 12300, 12400),
+        (1000000, 1000000, 1010000),
+    ])
+    def test_bin_bounds(self, value, lower, upper):
+        assert ch._to_bin_lower_upper_logarithmic(value) == (lower, upper)
+
+    def test_bin_lower_index(self):
+        lowers = [0.0, 1.0, 2.0, 3.0]
+        assert ch._bin_lower_index(lowers, 0.0) == 0
+        assert ch._bin_lower_index(lowers, 1.5) == 1
+        assert ch._bin_lower_index(lowers, 3.0) == 2  # last value -> last bin
+
+
+class TestDatasetHistograms:
+
+    def test_small_dataset(self):
+        # user 0: 3 contributions to 'a' (sum 6), 1 to 'b'.
+        # user 1: 1 contribution to 'a'.
+        data = [(0, "a", 1.0), (0, "a", 2.0), (0, "a", 3.0), (0, "b", 4.0),
+                (1, "a", 5.0)]
+        h = compute(data)
+
+        # L0: user0 -> 2 partitions, user1 -> 1 partition.
+        l0 = {b.lower: b.count for b in h.l0_contributions_histogram.bins}
+        assert l0 == {1: 1, 2: 1}
+        # L1: user0 -> 4 contributions, user1 -> 1.
+        l1 = {b.lower: b.count for b in h.l1_contributions_histogram.bins}
+        assert l1 == {1: 1, 4: 1}
+        # Linf: pairs (0,a)->3, (0,b)->1, (1,a)->1.
+        linf = {b.lower: b.count for b in h.linf_contributions_histogram.bins}
+        assert linf == {1: 2, 3: 1}
+        # Count per partition: a->4, b->1.
+        cpp = {b.lower: b.count for b in h.count_per_partition_histogram.bins}
+        assert cpp == {1: 1, 4: 1}
+        # Privacy ids per partition: a->2, b->1.
+        pidpp = {b.lower: b.count
+                 for b in h.count_privacy_id_per_partition.bins}
+        assert pidpp == {1: 1, 2: 1}
+        # Sum histograms exist and account for all mass.
+        assert h.linf_sum_contributions_histogram.total_count() == 3
+        assert h.linf_sum_contributions_histogram.total_sum() == pytest.approx(
+            15.0)
+        assert h.sum_per_partition_histogram.total_count() == 2
+        assert h.sum_per_partition_histogram.total_sum() == pytest.approx(15.0)
+
+    def test_large_values_binned_logarithmically(self):
+        # One user contributes 12345 times to one partition.
+        data = [(0, "a", 1.0)] * 12345
+        h = compute(data)
+        linf_bins = h.linf_contributions_histogram.bins
+        assert len(linf_bins) == 1
+        assert linf_bins[0].lower == 12300
+        assert linf_bins[0].max == 12345
+
+    def test_preaggregated_matches_raw(self):
+        data = [(0, "a", 1.0), (0, "a", 2.0), (0, "b", 4.0), (1, "a", 5.0)]
+        raw = compute(data)
+        # Pre-aggregate by hand: (pk, (count, sum, n_partitions, n_contrib)).
+        preagg = [
+            ("a", (2, 3.0, 2, 3)),  # user0 in 'a'
+            ("b", (1, 4.0, 2, 3)),  # user0 in 'b'
+            ("a", (1, 5.0, 1, 1)),  # user1 in 'a'
+        ]
+        ext = pdp.PreAggregateExtractors(
+            partition_extractor=lambda r: r[0],
+            preaggregate_extractor=lambda r: r[1])
+        backend = pdp.LocalBackend()
+        pre = list(
+            ch.compute_dataset_histograms_on_preaggregated_data(
+                preagg, ext, backend))[0]
+        raw_l0 = {b.lower: b.count
+                  for b in raw.l0_contributions_histogram.bins}
+        pre_l0 = {b.lower: b.count
+                  for b in pre.l0_contributions_histogram.bins}
+        assert raw_l0 == pre_l0
+        raw_linf = {b.lower: b.count
+                    for b in raw.linf_contributions_histogram.bins}
+        pre_linf = {b.lower: b.count
+                    for b in pre.linf_contributions_histogram.bins}
+        assert raw_linf == pre_linf
+
+
+class TestHistogramMethods:
+
+    def _histogram(self, counts):
+        """Builds an integer histogram from a {value: frequency} dict."""
+        bins = []
+        for value, freq in sorted(counts.items()):
+            lower, upper = ch._to_bin_lower_upper_logarithmic(value)
+            bins.append(
+                hist.FrequencyBin(lower=lower, upper=upper, count=freq,
+                                  sum=freq * value, max=value))
+        return hist.Histogram(hist.HistogramType.L0_CONTRIBUTIONS, bins)
+
+    def test_quantiles(self):
+        h = self._histogram({1: 10, 2: 10, 3: 10, 10: 10})
+        assert h.quantiles([0.0, 0.5, 0.99]) == [1, 3, 10]
+
+    def test_total_count_sum(self):
+        h = self._histogram({1: 5, 10: 2})
+        assert h.total_count() == 7
+        assert h.total_sum() == 25
+        assert h.max_value() == 10
+
+    def test_ratio_dropped(self):
+        # 10 elements of size 1, 10 of size 4.
+        h = self._histogram({1: 10, 4: 10})
+        ratios = dict(hist.compute_ratio_dropped(h))
+        assert ratios[0] == 1
+        assert ratios[4] == 0.0
+        # Threshold 1: drops 3 units from each of the 10 size-4 elements.
+        assert ratios[1] == pytest.approx(30 / 50)
+
+    def test_empty_histogram_quantiles_raises(self):
+        h = hist.Histogram(hist.HistogramType.L0_CONTRIBUTIONS, [])
+        with pytest.raises(ValueError):
+            h.quantiles([0.5])

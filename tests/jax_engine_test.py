@@ -1,0 +1,267 @@
+"""Conformance tests: JaxDPEngine vs DPEngine(LocalBackend) oracle.
+
+The columnar engine must produce the same results as the local path: exact
+equality with no noise (huge eps), matching noise calibration, matching
+budget splits, and matching partition-selection behavior."""
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+
+
+def extractors():
+    return pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                              partition_extractor=lambda r: r[1],
+                              value_extractor=lambda r: r[2])
+
+
+def run_jax(data, params, public=None, eps=1e8, delta=1e-15, seed=0):
+    accountant = pdp.NaiveBudgetAccountant(eps, delta)
+    engine = pdp.JaxDPEngine(accountant, seed=seed)
+    result = engine.aggregate(data, params, extractors(),
+                              public_partitions=public)
+    accountant.compute_budgets()
+    return dict(result), accountant, engine
+
+
+def run_local(data, params, public=None, eps=1e8, delta=1e-15):
+    accountant = pdp.NaiveBudgetAccountant(eps, delta)
+    engine = pdp.DPEngine(accountant, pdp.LocalBackend())
+    result = engine.aggregate(data, params, extractors(),
+                              public_partitions=public)
+    accountant.compute_budgets()
+    return dict(result), accountant
+
+
+def simple_data(n_users=20, partitions=("a", "b", "c")):
+    return [(u, pk, float(u % 5)) for u in range(n_users) for pk in partitions]
+
+
+class TestNoNoiseConformance:
+
+    def test_count_sum_match_local(self):
+        data = simple_data()
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=3,
+            max_contributions_per_partition=1,
+            min_value=0,
+            max_value=5)
+        jax_res, _, _ = run_jax(data, params, public=["a", "b", "c"])
+        local_res, _ = run_local(data, params, public=["a", "b", "c"])
+        assert set(jax_res) == set(local_res)
+        for pk in local_res:
+            assert jax_res[pk].count == pytest.approx(local_res[pk].count,
+                                                      abs=1e-2)
+            assert jax_res[pk].sum == pytest.approx(local_res[pk].sum,
+                                                    abs=0.1)
+
+    def test_privacy_id_count(self):
+        data = simple_data(n_users=13)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PRIVACY_ID_COUNT],
+            max_partitions_contributed=3,
+            max_contributions_per_partition=1)
+        jax_res, _, _ = run_jax(data, params, public=["a", "b", "c"])
+        for pk in "abc":
+            assert jax_res[pk].privacy_id_count == pytest.approx(13,
+                                                                 abs=1e-2)
+
+    def test_mean(self):
+        data = [(u, "a", float(v)) for u, v in enumerate([1, 2, 6, 7])]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.MEAN, pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            min_value=0,
+            max_value=10)
+        jax_res, _, _ = run_jax(data, params, public=["a"])
+        assert jax_res["a"].mean == pytest.approx(4.0, abs=0.05)
+        assert jax_res["a"].count == pytest.approx(4, abs=0.05)
+        assert jax_res["a"].sum == pytest.approx(16.0, abs=0.3)
+
+    def test_variance(self):
+        values = [1.0, 3.0, 5.0, 7.0]
+        data = [(u, "a", v) for u, v in enumerate(values)]
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.VARIANCE,
+                                              pdp.Metrics.MEAN],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1,
+                                     min_value=0,
+                                     max_value=8)
+        jax_res, _, _ = run_jax(data, params, public=["a"])
+        assert jax_res["a"].variance == pytest.approx(np.var(values),
+                                                      abs=0.2)
+        assert jax_res["a"].mean == pytest.approx(4.0, abs=0.1)
+
+    def test_vector_sum(self):
+        data = [(0, "a", (1.0, 2.0)), (1, "a", (3.0, -1.0))]
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.VECTOR_SUM],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1,
+                                     vector_size=2,
+                                     vector_max_norm=100.0,
+                                     vector_norm_kind=pdp.NormKind.Linf)
+        accountant = pdp.NaiveBudgetAccountant(1e8, 1e-15)
+        engine = pdp.JaxDPEngine(accountant)
+        ext = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                 partition_extractor=lambda r: r[1],
+                                 value_extractor=lambda r: np.asarray(r[2]))
+        result = engine.aggregate(data, params, ext, public_partitions=["a"])
+        accountant.compute_budgets()
+        cols = result.to_columns()
+        np.testing.assert_allclose(np.asarray(cols["vector_sum"])[0],
+                                   [4.0, 1.0], atol=0.05)
+
+    def test_empty_public_partition_zero(self):
+        data = simple_data(partitions=("a",))
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1)
+        jax_res, _, _ = run_jax(data, params, public=["a", "ghost"])
+        assert jax_res["ghost"].count == pytest.approx(0, abs=1e-2)
+
+    def test_contribution_bounding(self):
+        data = [(0, "a", 1.0)] * 50 + [(1, "a", 1.0)]
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=4)
+        jax_res, _, _ = run_jax(data, params, public=["a"])
+        assert jax_res["a"].count == pytest.approx(5, abs=1e-2)
+
+    def test_sum_per_partition_clipping(self):
+        data = [(0, "a", 3.0)] * 10
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.SUM],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1,
+                                     min_sum_per_partition=0.0,
+                                     max_sum_per_partition=7.0)
+        jax_res, _, _ = run_jax(data, params, public=["a"])
+        assert jax_res["a"].sum == pytest.approx(7.0, abs=0.1)
+
+
+class TestBudgetParity:
+
+    def test_same_budget_split_as_local_engine(self):
+        data = simple_data()
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=3,
+            max_contributions_per_partition=1,
+            min_value=0,
+            max_value=5)
+        _, jax_acc, _ = run_jax(data, params, eps=1.0, delta=1e-6)
+        _, local_acc = run_local(data, params, eps=1.0, delta=1e-6)
+        jax_specs = [(m.mechanism_spec.mechanism_type, m.mechanism_spec._eps,
+                      m.mechanism_spec._delta, m.weight)
+                     for m in jax_acc._mechanisms]
+        local_specs = [(m.mechanism_spec.mechanism_type,
+                        m.mechanism_spec._eps, m.mechanism_spec._delta,
+                        m.weight) for m in local_acc._mechanisms]
+        assert jax_specs == local_specs
+
+
+class TestNoise:
+
+    def test_count_noise_std(self):
+        eps = 1.0
+        n_partitions = 256
+        data = [(u, f"p{i}", 1.0) for i in range(n_partitions)
+                for u in range(10)]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT],
+            max_partitions_contributed=n_partitions,
+            max_contributions_per_partition=1)
+        public = [f"p{i}" for i in range(n_partitions)]
+        jax_res, _, _ = run_jax(data, params, public=public, eps=eps,
+                                delta=0.0, seed=7)
+        errors = np.array([m.count - 10 for m in jax_res.values()])
+        expected_std = n_partitions * np.sqrt(2) / eps
+        assert abs(errors.mean()) < expected_std / 3
+        assert errors.std() == pytest.approx(expected_std, rel=0.25)
+
+    def test_gaussian_noise_std(self):
+        from pipelinedp_tpu import dp_computations
+        eps, delta = 1.0, 1e-6
+        n_partitions = 256
+        data = [(u, f"p{i}", 1.0) for i in range(n_partitions)
+                for u in range(10)]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT],
+            noise_kind=pdp.NoiseKind.GAUSSIAN,
+            max_partitions_contributed=4,
+            max_contributions_per_partition=1)
+        public = [f"p{i}" for i in range(n_partitions)]
+        jax_res, _, _ = run_jax(data, params, public=public, eps=eps,
+                                delta=delta, seed=3)
+        errors = np.array([m.count - 10 for m in jax_res.values()])
+        # Note: L0 bounding drops most contributions (users contribute to
+        # 256 partitions, capped at 4), so compare std only.
+        expected_std = dp_computations.compute_sigma(eps, delta, 2.0)
+        assert errors.std() == pytest.approx(expected_std, rel=0.3)
+
+    def test_different_seeds_different_noise(self):
+        data = simple_data()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=3,
+                                     max_contributions_per_partition=1)
+        r1, _, _ = run_jax(data, params, public=["a"], eps=1.0, seed=1)
+        r2, _, _ = run_jax(data, params, public=["a"], eps=1.0, seed=2)
+        assert r1["a"].count != r2["a"].count
+
+
+class TestPrivatePartitionSelection:
+
+    def test_large_kept_small_dropped(self):
+        data = ([(u, "big", 1.0) for u in range(2000)] +
+                [(5555, "tiny", 1.0)])
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1)
+        jax_res, _, _ = run_jax(data, params, eps=1.0, delta=1e-6)
+        assert "big" in jax_res
+        assert "tiny" not in jax_res
+
+    def test_post_aggregation_thresholding(self):
+        data = ([(u, "big", 1.0) for u in range(2000)] +
+                [(5555, "tiny", 1.0)])
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.PRIVACY_ID_COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1,
+                                     post_aggregation_thresholding=True)
+        jax_res, _, _ = run_jax(data, params, eps=1.0, delta=1e-6)
+        assert "tiny" not in jax_res
+        assert jax_res["big"].privacy_id_count == pytest.approx(2000,
+                                                                rel=0.1)
+
+
+class TestLazyContract:
+
+    def test_iterating_before_compute_budgets_raises(self):
+        data = simple_data()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=3,
+                                     max_contributions_per_partition=1)
+        accountant = pdp.NaiveBudgetAccountant(1.0, 1e-6)
+        engine = pdp.JaxDPEngine(accountant)
+        result = engine.aggregate(data, params, extractors(),
+                                  public_partitions=["a"])
+        with pytest.raises(AssertionError, match="not calculated"):
+            dict(result)
+
+    def test_explain_report(self):
+        data = simple_data()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=3,
+                                     max_contributions_per_partition=1)
+        accountant = pdp.NaiveBudgetAccountant(1.0, 1e-6)
+        engine = pdp.JaxDPEngine(accountant)
+        report = pdp.ExplainComputationReport()
+        engine.aggregate(data, params, extractors(),
+                         public_partitions=["a"],
+                         out_explain_computation_report=report)
+        accountant.compute_budgets()
+        text = report.text()
+        assert "Cross-partition contribution bounding" in text
+        assert "Computed DP count" in text

@@ -1,0 +1,235 @@
+"""Tests for the fused columnar kernels against numpy oracles."""
+
+import collections
+
+import jax
+import numpy as np
+import pytest
+
+from pipelinedp_tpu.ops import columnar, selection
+from pipelinedp_tpu import partition_selection as ps_lib
+
+
+def oracle_bound_aggregate(pid, pk, value, P, linf, l0, lo, hi, middle,
+                           rng):
+    """Reference implementation with explicit Python sampling."""
+    groups = collections.defaultdict(list)
+    for i in range(len(pid)):
+        groups[(pid[i], pk[i])].append(value[i])
+    # Linf sampling + group accumulators.
+    gaccs = {}
+    for (u, p), vals in groups.items():
+        if len(vals) > linf:
+            vals = list(rng.choice(vals, linf, replace=False))
+        clipped = np.clip(vals, lo, hi)
+        gaccs[(u, p)] = (len(vals), clipped.sum(),
+                         (clipped - middle).sum(),
+                         ((clipped - middle)**2).sum())
+    # L0 sampling per pid.
+    per_pid = collections.defaultdict(list)
+    for (u, p) in gaccs:
+        per_pid[u].append(p)
+    kept = set()
+    for u, pks in per_pid.items():
+        chosen = pks if len(pks) <= l0 else list(
+            rng.choice(pks, l0, replace=False))
+        kept.update((u, p) for p in chosen)
+    out = np.zeros((5, P))
+    for (u, p), (cnt, s, ns, nss) in gaccs.items():
+        if (u, p) in kept:
+            out[0, p] += 1
+            out[1, p] += cnt
+            out[2, p] += s
+            out[3, p] += ns
+            out[4, p] += nss
+    return out
+
+
+class TestBoundAndAggregate:
+
+    def _run(self, pid, pk, value, P, linf, l0, lo=-np.inf, hi=np.inf,
+             middle=0.0, glo=-np.inf, ghi=np.inf, seed=0):
+        n = len(pid)
+        return columnar.bound_and_aggregate(
+            jax.random.PRNGKey(seed),
+            np.asarray(pid, np.int32), np.asarray(pk, np.int32),
+            np.asarray(value, np.float32), np.ones(n, bool),
+            num_partitions=P, linf_cap=linf, l0_cap=l0,
+            row_clip_lo=lo, row_clip_hi=hi, middle=middle,
+            group_clip_lo=glo, group_clip_hi=ghi)
+
+    def test_no_caps_matches_plain_groupby(self):
+        rng = np.random.default_rng(0)
+        n, P, U = 5000, 13, 97
+        pid = rng.integers(0, U, n)
+        pk = rng.integers(0, P, n)
+        value = rng.uniform(-1, 2, n)
+        accs = self._run(pid, pk, value, P, linf=n, l0=P)
+        np.testing.assert_allclose(
+            np.asarray(accs.count),
+            np.bincount(pk, minlength=P), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(accs.sum),
+            np.bincount(pk, weights=value, minlength=P), rtol=1e-4, atol=1e-3)
+        expected_pid_count = np.zeros(P)
+        for p in range(P):
+            expected_pid_count[p] = len(set(pid[pk == p]))
+        np.testing.assert_allclose(np.asarray(accs.pid_count),
+                                   expected_pid_count)
+
+    def test_linf_cap(self):
+        # One user, one partition, 100 rows, cap 7.
+        accs = self._run([3] * 100, [2] * 100, [1.0] * 100, P=5, linf=7,
+                         l0=5)
+        assert accs.count[2] == 7
+        assert accs.sum[2] == pytest.approx(7.0)
+        assert accs.pid_count[2] == 1
+
+    def test_l0_cap(self):
+        # One user contributes once to each of 10 partitions, cap 4.
+        accs = self._run(
+            [1] * 10, list(range(10)), [1.0] * 10, P=10, linf=5, l0=4)
+        assert np.asarray(accs.count).sum() == 4
+        assert np.asarray(accs.pid_count).sum() == 4
+        # Each kept partition has exactly one contribution.
+        assert set(np.asarray(accs.count)) <= {0.0, 1.0}
+
+    def test_l0_sampling_is_uniform(self):
+        # Across many seeds, each partition kept ~ l0/n_partitions of runs.
+        keeps = np.zeros(5)
+        for seed in range(200):
+            accs = self._run([1] * 5, list(range(5)), [1.0] * 5, P=5,
+                             linf=1, l0=2, seed=seed)
+            keeps += np.asarray(accs.count)
+        np.testing.assert_allclose(keeps / 200, [0.4] * 5, atol=0.12)
+
+    def test_clipping(self):
+        accs = self._run([0, 1, 2], [0, 0, 0], [-5.0, 0.5, 9.0], P=1,
+                         linf=1, l0=1, lo=0.0, hi=1.0, middle=0.5)
+        assert accs.sum[0] == pytest.approx(0.0 + 0.5 + 1.0)
+        assert accs.norm_sum[0] == pytest.approx(-0.5 + 0.0 + 0.5)
+        assert accs.norm_sq_sum[0] == pytest.approx(0.25 + 0 + 0.25)
+
+    def test_group_clip_per_partition_sum(self):
+        # User 0 contributes 10 to pk0 (sum clipped to 4), user 1 adds 1.
+        accs = self._run([0] * 10 + [1], [0] * 11, [1.0] * 11, P=1,
+                         linf=100, l0=1, glo=0.0, ghi=4.0)
+        assert accs.sum[0] == pytest.approx(5.0)
+
+    def test_padding_rows_ignored(self):
+        pid = np.array([0, 1, 2, 3], np.int32)
+        pk = np.array([0, 0, 0, 0], np.int32)
+        value = np.array([1.0, 1.0, 50.0, 50.0], np.float32)
+        valid = np.array([True, True, False, False])
+        accs = columnar.bound_and_aggregate(
+            jax.random.PRNGKey(0), pid, pk, value, valid,
+            num_partitions=1, linf_cap=10, l0_cap=10,
+            row_clip_lo=-np.inf, row_clip_hi=np.inf, middle=0.0,
+            group_clip_lo=-np.inf, group_clip_hi=np.inf)
+        assert accs.count[0] == 2
+        assert accs.sum[0] == pytest.approx(2.0)
+        assert accs.pid_count[0] == 2
+
+    def test_statistical_match_with_oracle(self):
+        rng = np.random.default_rng(42)
+        n, P, U = 2000, 7, 29
+        pid = rng.integers(0, U, n)
+        pk = rng.integers(0, P, n)
+        value = rng.uniform(0, 1, n)
+        linf, l0 = 3, 2
+        # Aggregate totals are random (sampling), but expected totals match
+        # across many seeds.
+        device_total = np.zeros(P)
+        oracle_total = np.zeros(P)
+        for seed in range(20):
+            accs = self._run(pid, pk, value, P, linf, l0, seed=seed)
+            device_total += np.asarray(accs.count)
+            oracle = oracle_bound_aggregate(pid, pk, value, P, linf, l0,
+                                            -np.inf, np.inf, 0.0,
+                                            np.random.default_rng(seed))
+            oracle_total += oracle[1]
+        # Both sides are 20-draw Monte-Carlo means; compare loosely.
+        np.testing.assert_allclose(device_total / 20, oracle_total / 20,
+                                   rtol=0.3)
+        assert device_total.sum() / 20 == pytest.approx(
+            oracle_total.sum() / 20, rel=0.05)
+
+
+class TestVectorKernel:
+
+    def test_vector_sum_linf_clip(self):
+        pid = np.array([0, 0, 1], np.int32)
+        pk = np.array([0, 0, 0], np.int32)
+        value = np.array([[1.0, 5.0], [1.0, 1.0], [2.0, -3.0]], np.float32)
+        out = columnar.bound_and_aggregate_vector(
+            jax.random.PRNGKey(0), pid, pk, value, np.ones(3, bool),
+            num_partitions=1, linf_cap=10, l0_cap=10, max_norm=2.0,
+            norm_ord=0)
+        np.testing.assert_allclose(np.asarray(out[0]), [4.0, 1.0])
+
+    def test_vector_sum_l2_clip(self):
+        pid = np.array([0], np.int32)
+        pk = np.array([0], np.int32)
+        value = np.array([[3.0, 4.0]], np.float32)
+        out = columnar.bound_and_aggregate_vector(
+            jax.random.PRNGKey(0), pid, pk, value, np.ones(1, bool),
+            num_partitions=1, linf_cap=10, l0_cap=10, max_norm=1.0,
+            norm_ord=2)
+        np.testing.assert_allclose(np.asarray(out[0]), [0.6, 0.8], rtol=1e-5)
+
+
+class TestSelectionKernel:
+
+    @pytest.mark.parametrize("strategy_cls,kind", [
+        (ps_lib.TruncatedGeometricPartitionSelection,
+         selection.TRUNCATED_GEOMETRIC),
+        (ps_lib.LaplaceThresholdingPartitionSelection,
+         selection.LAPLACE_THRESHOLDING),
+        (ps_lib.GaussianThresholdingPartitionSelection,
+         selection.GAUSSIAN_THRESHOLDING),
+    ])
+    def test_keep_rates_match_host_probabilities(self, strategy_cls, kind):
+        host = strategy_cls(1.0, 1e-4, 2)
+        params = selection.selection_params_from_strategy(host)
+        assert params.kind == kind
+        counts = np.arange(1, 200, dtype=np.float32)
+        # Empirical keep rate over many seeds ~ host probability.
+        n_trials = 500
+        valid = np.ones(len(counts), bool)
+        keys = jax.random.split(jax.random.PRNGKey(0), n_trials)
+        keep = jax.jit(jax.vmap(
+            lambda k: selection.select_partitions(k, counts, params, valid)[0]
+        ))(keys)
+        keeps = np.asarray(keep).sum(axis=0)
+        expected = host.probability_of_keep_vec(counts.astype(int))
+        np.testing.assert_allclose(keeps / n_trials, expected, atol=0.08)
+
+    def test_truncated_geometric_probs_exact(self):
+        host = ps_lib.TruncatedGeometricPartitionSelection(1.0, 1e-6, 4)
+        params = selection.selection_params_from_strategy(host)
+        counts = np.arange(1, 500, dtype=np.float32)
+        probs = selection.truncated_geometric_keep_prob(
+            counts, params.eps_p, params.delta_p, params.n1, params.pi_n1,
+            params.pi_inf)
+        expected = host.probability_of_keep_vec(counts.astype(int))
+        np.testing.assert_allclose(np.asarray(probs), expected, rtol=2e-4,
+                                   atol=1e-9)
+
+    def test_invalid_partitions_never_kept(self):
+        host = ps_lib.TruncatedGeometricPartitionSelection(1.0, 1e-2, 1)
+        params = selection.selection_params_from_strategy(host)
+        counts = np.full(10, 1e6, np.float32)
+        valid = np.zeros(10, bool)
+        keep, _ = selection.select_partitions(jax.random.PRNGKey(0), counts,
+                                              params, valid)
+        assert not np.asarray(keep).any()
+
+    def test_pre_threshold(self):
+        host = ps_lib.TruncatedGeometricPartitionSelection(1.0, 1e-2, 1,
+                                                           pre_threshold=50)
+        params = selection.selection_params_from_strategy(host)
+        counts = np.array([49.0, 1e6], np.float32)
+        keep, _ = selection.select_partitions(jax.random.PRNGKey(0), counts,
+                                              params, np.ones(2, bool))
+        assert not bool(keep[0])
+        assert bool(keep[1])

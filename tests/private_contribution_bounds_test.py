@@ -1,0 +1,114 @@
+"""Tests for DP contribution-bound calculation."""
+
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import dp_computations
+from pipelinedp_tpu import private_contribution_bounds as pcb
+from pipelinedp_tpu.dataset_histograms import computing_histograms as ch
+from pipelinedp_tpu.dataset_histograms import histograms as hist
+
+
+def params(calc_eps=10.0, upper=100):
+    return pdp.CalculatePrivateContributionBoundsParams(
+        aggregation_noise_kind=pdp.NoiseKind.LAPLACE,
+        aggregation_eps=1.0,
+        aggregation_delta=0.0,
+        calculation_eps=calc_eps,
+        max_partitions_contributed_upper_bound=upper)
+
+
+def l0_histogram(counts):
+    bins = []
+    for value, freq in sorted(counts.items()):
+        lower, upper = ch._to_bin_lower_upper_logarithmic(value)
+        bins.append(
+            hist.FrequencyBin(lower=lower, upper=upper, count=freq,
+                              sum=freq * value, max=value))
+    return hist.Histogram(hist.HistogramType.L0_CONTRIBUTIONS, bins)
+
+
+class TestGenerateBounds:
+
+    def test_small(self):
+        bounds = pcb.generate_possible_contribution_bounds(12)
+        assert bounds == list(range(1, 13))
+
+    def test_three_significant_digits(self):
+        bounds = pcb.generate_possible_contribution_bounds(10200)
+        assert 999 in bounds
+        assert 1000 in bounds
+        assert 1001 not in bounds
+        assert 1010 in bounds
+        assert 10100 in bounds
+        assert bounds[-1] == 10200
+
+    def test_logarithmic_size(self):
+        bounds = pcb.generate_possible_contribution_bounds(10**7)
+        assert len(bounds) < 5000
+
+
+class TestL0ScoringFunction:
+
+    def test_monotonic_tradeoff(self):
+        # Most users contribute to ~10 partitions.
+        scoring = pcb.L0ScoringFunction(params(), 50, l0_histogram({10: 100}))
+        # Dropped data decreases with k, noise increases with k.
+        assert scoring._l0_impact_dropped(1) > scoring._l0_impact_dropped(5)
+        assert scoring._l0_impact_dropped(10) == 0
+        assert scoring._l0_impact_noise(10) > scoring._l0_impact_noise(1)
+
+    def test_noise_impact_formula(self):
+        scoring = pcb.L0ScoringFunction(params(), 50, l0_histogram({10: 100}))
+        noise_params = dp_computations.ScalarNoiseParams(
+            eps=1.0, delta=0.0, min_value=None, max_value=None,
+            min_sum_per_partition=None, max_sum_per_partition=None,
+            max_partitions_contributed=5, max_contributions_per_partition=1,
+            noise_kind=pdp.NoiseKind.LAPLACE)
+        expected = 50 * dp_computations.compute_dp_count_noise_std(
+            noise_params)
+        assert scoring._l0_impact_noise(5) == pytest.approx(expected)
+
+    def test_upper_bound_capped_by_partitions(self):
+        scoring = pcb.L0ScoringFunction(params(upper=1000), 7,
+                                        l0_histogram({3: 10}))
+        assert scoring.max_partitions_contributed_best_upper_bound() == 7
+        assert scoring.global_sensitivity == 7
+
+
+class TestPrivateL0Calculator:
+
+    def test_picks_reasonable_bound(self):
+        dp_computations.ExponentialMechanism.seed_rng(0)
+        # 100 users each contributing to exactly 8 partitions of 20.
+        data = [(u, f"pk{i}", 1.0) for u in range(100) for i in range(8)]
+        extractors = pdp.DataExtractors(
+            privacy_id_extractor=lambda r: r[0],
+            partition_extractor=lambda r: r[1],
+            value_extractor=lambda r: r[2])
+        backend = pdp.LocalBackend()
+        histograms = ch.compute_dataset_histograms(data, extractors, backend)
+        partitions = [f"pk{i}" for i in range(20)]
+        calc = pcb.PrivateL0Calculator(params(calc_eps=20.0), partitions,
+                                       histograms, backend)
+        result = list(calc.calculate())
+        assert len(result) == 1
+        # With high calculation eps the mechanism should pick close to the
+        # true optimum (8 = actual contributions; more just adds noise).
+        assert 4 <= result[0] <= 10
+
+    def test_engine_integration(self):
+        dp_computations.ExponentialMechanism.seed_rng(0)
+        data = [(u, f"pk{i}", 1.0) for u in range(50) for i in range(4)]
+        extractors = pdp.DataExtractors(
+            privacy_id_extractor=lambda r: r[0],
+            partition_extractor=lambda r: r[1],
+            value_extractor=lambda r: r[2])
+        accountant = pdp.NaiveBudgetAccountant(1.0, 1e-6)
+        engine = pdp.DPEngine(accountant, pdp.LocalBackend())
+        result = engine.calculate_private_contribution_bounds(
+            data, params(calc_eps=20.0, upper=10), extractors,
+            partitions=[f"pk{i}" for i in range(4)])
+        bounds = list(result)[0]
+        assert isinstance(bounds, pdp.PrivateContributionBounds)
+        assert 1 <= bounds.max_partitions_contributed <= 10
