@@ -162,7 +162,8 @@ def bound_and_aggregate_vector(key: jax.Array,
                                linf_cap,
                                l0_cap,
                                max_norm,
-                               norm_ord: int) -> jnp.ndarray:
+                               norm_ord: int
+                               ) -> tuple[jnp.ndarray, PartitionAccumulators]:
     """VECTOR_SUM path: per-row norm clipping + the same two-stage sampling.
 
     value: float32[N, D]. norm_ord: 0 => Linf clip per coordinate, 1/2 =>

@@ -165,7 +165,7 @@ class TestVectorKernel:
             jax.random.PRNGKey(0), pid, pk, value, np.ones(3, bool),
             num_partitions=1, linf_cap=10, l0_cap=10, max_norm=2.0,
             norm_ord=0)
-        np.testing.assert_allclose(np.asarray(out[0]), [4.0, 1.0])
+        np.testing.assert_allclose(np.asarray(out[0])[0], [4.0, 1.0])
 
     def test_vector_sum_l2_clip(self):
         pid = np.array([0], np.int32)
@@ -175,7 +175,8 @@ class TestVectorKernel:
             jax.random.PRNGKey(0), pid, pk, value, np.ones(1, bool),
             num_partitions=1, linf_cap=10, l0_cap=10, max_norm=1.0,
             norm_ord=2)
-        np.testing.assert_allclose(np.asarray(out[0]), [0.6, 0.8], rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(out[0])[0], [0.6, 0.8],
+                                   rtol=1e-5)
 
 
 class TestSelectionKernel:
