@@ -38,6 +38,7 @@ from pipelinedp_tpu import dp_engine as dp_engine_lib
 from pipelinedp_tpu.data_extractors import DataExtractors
 from pipelinedp_tpu.ops import columnar, encoding, noise as noise_ops
 from pipelinedp_tpu.ops import selection as selection_ops
+from pipelinedp_tpu import partition_selection as ps_lib
 from pipelinedp_tpu.report_generator import ExplainComputationReport
 from pipelinedp_tpu import noise_core
 
@@ -65,8 +66,13 @@ class LazyJaxResult:
         self._columns = None
 
     def to_columns(self) -> dict:
-        """Returns {'partition_id', 'keep_mask', metric arrays...} (device
-        arrays, [num_partitions])."""
+        """Returns {'partition_id', 'keep_mask', metric arrays...}
+        ([num_partitions] arrays).
+
+        Metric values of partitions dropped by partition selection are
+        masked to NaN, so consuming the columns directly cannot leak
+        non-kept partitions (keep_mask says which rows are real output).
+        """
         if self._columns is None:
             self._columns = self._compute_fn()
         return self._columns
@@ -104,15 +110,34 @@ class LazyJaxResult:
 class JaxDPEngine:
     """Columnar DP engine. API parity with DPEngine for the aggregation
     surface; input may be Python rows (encoded on host) or pre-encoded
-    columns."""
+    columns.
+
+    secure_host_noise: when True (default), the heavy bound-and-aggregate
+    stage runs on device but the released noise (and thresholding/selection
+    draws) are finalized on host in float64 with the full granularity
+    snapping of noise_core — the Mironov-2012 mitigation float32 cannot
+    provide (see ops/noise.py). The host step is O(num_partitions), off the
+    hot path. Set False to keep everything on device (fastest; noise is
+    distributionally correct but without bit-level guarantees).
+
+    seed controls the device kernels: contribution-bounding sampling, and
+    noise/selection in device mode. In secure_host_noise mode the released
+    noise comes from the host secure sampler, which is deliberately NOT
+    seedable through the engine (secure noise must not be replayable —
+    same stance as the reference's PyDP path); tests can reseed the
+    fallback RNGs via noise_core.seed_fallback_rng / partition_selection
+    .seed_rng.
+    """
 
     def __init__(self,
                  budget_accountant: budget_accounting.BudgetAccountant,
-                 seed: int = 0):
+                 seed: int = 0,
+                 secure_host_noise: bool = True):
         self._budget_accountant = budget_accountant
         self._report_generators = []
         self._root_key = jax.random.PRNGKey(seed)
         self._key_counter = 0
+        self._secure_host_noise = secure_host_noise
 
     def _next_key(self):
         self._key_counter += 1
@@ -291,21 +316,32 @@ class JaxDPEngine:
 
         partition_exists = accs.pid_count > 0
 
-        # Partition selection.
+        # Partition selection. The selection strategy's L0 sensitivity is
+        # the *declared* cross-partition bound: max_partitions_contributed,
+        # or max_contributions in L1 mode (which caps partitions at the same
+        # value — the kernel's l0_cap matches).
         if is_public:
             keep_mask = jnp.ones(num_partitions, dtype=bool)
         elif selection_spec is not None:
-            sel_params = selection_ops.create_selection_params(
-                params.partition_selection_strategy, selection_spec.eps,
-                selection_spec.delta, params.max_partitions_contributed or 1,
-                params.pre_threshold)
+            declared_l0 = (params.max_partitions_contributed
+                           or params.max_contributions or 1)
             max_rows_per_pid = 1
             if params.contribution_bounds_already_enforced:
                 max_rows_per_pid = (params.max_contributions or
                                     params.max_contributions_per_partition)
             pid_counts_est = jnp.ceil(accs.pid_count / max_rows_per_pid)
-            keep_mask, _ = selection_ops.select_partitions(
-                k_select, pid_counts_est, sel_params, partition_exists)
+            if self._secure_host_noise:
+                strategy = ps_lib.create_partition_selection_strategy(
+                    params.partition_selection_strategy, selection_spec.eps,
+                    selection_spec.delta, declared_l0, params.pre_threshold)
+                keep_np, _ = strategy.select_vec(np.asarray(pid_counts_est))
+                keep_mask = keep_np & np.asarray(partition_exists)
+            else:
+                sel_params = selection_ops.create_selection_params(
+                    params.partition_selection_strategy, selection_spec.eps,
+                    selection_spec.delta, declared_l0, params.pre_threshold)
+                keep_mask, _ = selection_ops.select_partitions(
+                    k_select, pid_counts_est, sel_params, partition_exists)
         else:
             keep_mask = partition_exists  # post-agg thresholding prunes below
 
@@ -320,16 +356,50 @@ class JaxDPEngine:
                 thresh = dp_computations.create_thresholding_mechanism(
                     combiner.mechanism_spec(), combiner.sensitivities(),
                     params.pre_threshold)
-                sel_params = selection_ops.selection_params_from_strategy(
-                    thresh.strategy)
-                thresh_keep, noised = selection_ops.select_partitions(
-                    sub_key, accs.pid_count, sel_params, partition_exists)
+                if self._secure_host_noise:
+                    keep_np, noised = thresh.strategy.select_vec(
+                        np.asarray(accs.pid_count))
+                    thresh_keep = keep_np & np.asarray(partition_exists)
+                else:
+                    sel_params = selection_ops.selection_params_from_strategy(
+                        thresh.strategy)
+                    thresh_keep, noised = selection_ops.select_partitions(
+                        sub_key, accs.pid_count, sel_params, partition_exists)
                 keep_mask = keep_mask & thresh_keep
                 columns["privacy_id_count"] = noised
 
-        columns["partition_id"] = jnp.arange(num_partitions, dtype=jnp.int32)
-        columns["keep_mask"] = keep_mask
+        # Mask metrics of non-kept partitions: direct consumers of the
+        # columns must not see values partition selection dropped.
+        keep_np = np.asarray(keep_mask)
+        for name, col in columns.items():
+            arr = np.asarray(col)
+            mask = keep_np if arr.ndim == 1 else keep_np[:, None]
+            columns[name] = np.where(mask, arr, np.nan)
+        columns["partition_id"] = np.arange(num_partitions, dtype=np.int32)
+        columns["keep_mask"] = keep_np
         return columns
+
+    # -- noise dispatch: device kernels or float64 host finalization --------
+
+    def _add_noise(self, key, values, is_gaussian, scale_or_std, granularity):
+        if self._secure_host_noise:
+            return noise_core.add_noise_array(np.asarray(values),
+                                              bool(is_gaussian),
+                                              float(scale_or_std))
+        return noise_ops.add_noise(key, values, is_gaussian, scale_or_std,
+                                   granularity)
+
+    def _add_laplace(self, key, values, scale, granularity):
+        if self._secure_host_noise:
+            return noise_core.add_laplace_noise_array(np.asarray(values),
+                                                      float(scale))
+        return noise_ops.add_laplace_noise(key, values, scale, granularity)
+
+    def _add_gaussian(self, key, values, stddev, granularity):
+        if self._secure_host_noise:
+            return noise_core.add_gaussian_noise_array(np.asarray(values),
+                                                       float(stddev))
+        return noise_ops.add_gaussian_noise(key, values, stddev, granularity)
 
     def _compute_combiner_metrics(self, combiner, params, accs, vector_sums,
                                   key, columns: dict) -> None:
@@ -337,17 +407,16 @@ class JaxDPEngine:
         if isinstance(combiner, combiners_lib.CountCombiner):
             is_g, scale, gran = _mechanism_noise_params(
                 combiner.mechanism_spec(), combiner.sensitivities())
-            columns["count"] = noise_ops.add_noise(k1, accs.count, is_g,
-                                                   scale, gran)
+            columns["count"] = self._add_noise(k1, accs.count, is_g, scale,
+                                               gran)
         elif isinstance(combiner, combiners_lib.SumCombiner):
             is_g, scale, gran = _mechanism_noise_params(
                 combiner.mechanism_spec(), combiner.sensitivities())
-            columns["sum"] = noise_ops.add_noise(k1, accs.sum, is_g, scale,
-                                                 gran)
+            columns["sum"] = self._add_noise(k1, accs.sum, is_g, scale, gran)
         elif isinstance(combiner, combiners_lib.PrivacyIdCountCombiner):
             is_g, scale, gran = _mechanism_noise_params(
                 combiner.mechanism_spec(), combiner.sensitivities())
-            columns["privacy_id_count"] = noise_ops.add_noise(
+            columns["privacy_id_count"] = self._add_noise(
                 k1, accs.pid_count, is_g, scale, gran)
         elif isinstance(combiner,
                         combiners_lib.PostAggregationThresholdingCombiner):
@@ -358,11 +427,14 @@ class JaxDPEngine:
                 count_spec, combiner._count_sensitivities)
             sg, ss, sgr = _mechanism_noise_params(
                 sum_spec, combiner._sum_sensitivities)
-            dp_count = noise_ops.add_noise(k1, accs.count, cg, cs, cgr)
-            dp_norm_sum = noise_ops.add_noise(k2, accs.norm_sum, sg, ss, sgr)
+            dp_count = self._add_noise(k1, accs.count, cg, cs, cgr)
+            dp_norm_sum = self._add_noise(k2, accs.norm_sum, sg, ss, sgr)
             middle = dp_computations.compute_middle(params.min_value,
                                                     params.max_value)
-            dp_mean = middle + dp_norm_sum / jnp.maximum(1.0, dp_count)
+            # np on the host path keeps the float64 width of the secure
+            # noise; jnp would silently downcast to float32.
+            xp = np if self._secure_host_noise else jnp
+            dp_mean = middle + dp_norm_sum / xp.maximum(1.0, dp_count)
             columns["mean"] = dp_mean
             if "count" in combiner.metrics_names():
                 columns["count"] = dp_count
@@ -379,7 +451,7 @@ class JaxDPEngine:
                       noise_params.linf_sensitivity)
                 scale = l1 / noise_params.eps_per_coordinate
                 gran = noise_core.laplace_granularity(scale)
-                columns["vector_sum"] = noise_ops.add_laplace_noise(
+                columns["vector_sum"] = self._add_laplace(
                     k1, vector_sums, scale, gran)
             else:
                 l2 = (math.sqrt(noise_params.l0_sensitivity) *
@@ -388,7 +460,7 @@ class JaxDPEngine:
                     noise_params.eps_per_coordinate,
                     noise_params.delta_per_coordinate, l2)
                 gran = noise_core.gaussian_granularity(sigma)
-                columns["vector_sum"] = noise_ops.add_gaussian_noise(
+                columns["vector_sum"] = self._add_gaussian(
                     k1, vector_sums, sigma, gran)
         else:
             raise NotImplementedError(
@@ -415,16 +487,17 @@ class JaxDPEngine:
                 sigma = noise_core.analytic_gaussian_sigma(
                     eps_delta[0], eps_delta[1],
                     dp_computations.compute_l2_sensitivity(l0, linf_sens))
-                return noise_ops.add_gaussian_noise(
+                return self._add_gaussian(
                     k, arr, sigma, noise_core.gaussian_granularity(sigma))
             scale = noise_core.laplace_diversity(
                 eps_delta[0],
                 dp_computations.compute_l1_sensitivity(l0, linf_sens))
-            return noise_ops.add_laplace_noise(
+            return self._add_laplace(
                 k, arr, scale, noise_core.laplace_granularity(scale))
 
+        xp = np if self._secure_host_noise else jnp
         dp_count = noise_arr(k1, accs.count, b_count, linf)
-        count_clamped = jnp.maximum(1.0, dp_count)
+        count_clamped = xp.maximum(1.0, dp_count)
         sum_linf = linf * abs(middle - params.min_value)
         dp_mean_normalized = noise_arr(k2, accs.norm_sum, b_sum,
                                        sum_linf) / count_clamped

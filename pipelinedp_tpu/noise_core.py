@@ -166,3 +166,26 @@ def add_laplace_noise(value: float, scale: float) -> float:
 def add_gaussian_noise(value: float, stddev: float) -> float:
     g = gaussian_granularity(stddev)
     return float(round_to_granularity(value, g) + sample_gaussian(stddev))
+
+
+def add_laplace_noise_array(values: np.ndarray, scale: float) -> np.ndarray:
+    """Vectorized float64 host noise (the secure finalization path for the
+    columnar engine: O(num_partitions), off the TPU hot path)."""
+    g = laplace_granularity(scale)
+    values = np.asarray(values, dtype=np.float64)
+    return round_to_granularity(values, g) + sample_laplace(scale,
+                                                            values.shape)
+
+
+def add_gaussian_noise_array(values: np.ndarray, stddev: float) -> np.ndarray:
+    g = gaussian_granularity(stddev)
+    values = np.asarray(values, dtype=np.float64)
+    return round_to_granularity(values, g) + sample_gaussian(stddev,
+                                                             values.shape)
+
+
+def add_noise_array(values: np.ndarray, is_gaussian: bool,
+                    scale_or_std: float) -> np.ndarray:
+    if is_gaussian:
+        return add_gaussian_noise_array(values, scale_or_std)
+    return add_laplace_noise_array(values, scale_or_std)

@@ -2,17 +2,48 @@
 
 The device twin of pipelinedp_tpu/noise_core.py: one `jax.random` call
 noises every partition at once (vs. the reference's per-partition C++ calls,
-combiners.py:262-263). The same power-of-two granularity snapping is applied
-— value and noise are both rounded to a granularity derived from the noise
-scale — with JAX's counter-based threefry PRNG supplying the randomness.
-Scales and granularities are runtime scalars, so budget resolution never
-forces a recompile (SURVEY.md §7 "Lazy budget vs. jit").
+combiners.py:262-263). Scales and granularities are runtime scalars, so
+budget resolution never forces a recompile (SURVEY.md §7 "Lazy budget vs.
+jit").
+
+Security note — float32 limits. The host path (noise_core.py) snaps value
+and noise to a power-of-two granularity ~scale*2^-40 in float64, the
+Mironov-2012 mitigation. float32 cannot represent that grid: the integer
+`round(x / g)` is exact only for |x| < 2^24 * g, so a 2^-40-relative
+granularity would make `snap` an identity and provide no mitigation at all.
+The device path therefore clamps the effective granularity to
+scale * 2^-18 (`F32_GRANULARITY_BITS`), which keeps the noise grid
+representable (Laplace/Gaussian tails stay within 2^6 * scale), and snaps
+the *sum* value+noise on that grid. This quantizes the released value to
+the same public grid the noise lives on — but values with magnitude above
+2^24 * g still round to themselves, so bit-level security for outputs much
+larger than ~2^5 * scale is NOT provided by this path. For bit-level
+guarantees use the host finalization path (JaxDPEngine's secure_host_noise
+mode / noise_core), which runs in float64 and is O(num_partitions), off the
+hot path.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+# Effective relative granularity for float32 device snapping: the grid must
+# stay representable (see module docstring).
+F32_GRANULARITY_BITS = 18
+
+
+def effective_granularity(scale_or_std, granularity, dtype) -> jnp.ndarray:
+    """Granularity actually usable for snapping in ``dtype``.
+
+    For float32, clamps the host-computed granularity (~scale*2^-40) up to
+    scale * 2^-18 so that round(noise / g) is exact. float64 (x64 mode)
+    keeps the host granularity.
+    """
+    if jnp.dtype(dtype) == jnp.float32:
+        return jnp.maximum(granularity,
+                           scale_or_std * (2.0**-F32_GRANULARITY_BITS))
+    return jnp.asarray(granularity)
 
 
 def snap(values: jnp.ndarray, granularity) -> jnp.ndarray:
@@ -21,19 +52,21 @@ def snap(values: jnp.ndarray, granularity) -> jnp.ndarray:
 
 def add_laplace_noise(key: jax.Array, values: jnp.ndarray, scale,
                       granularity) -> jnp.ndarray:
-    """values snapped + Laplace(scale) noise snapped to granularity.
+    """(values + Laplace(scale) noise) snapped to the effective granularity.
 
-    Noise is sampled in float32 (TPU-native); snapping quantizes the
-    mantissa tail which is the float-attack mitigation (Mironov 2012).
+    See the module docstring for what the float32 snap does and does not
+    guarantee.
     """
+    g = effective_granularity(scale, granularity, values.dtype)
     noise = jax.random.laplace(key, values.shape, dtype=values.dtype) * scale
-    return snap(values, granularity) + snap(noise, granularity)
+    return snap(values + noise, g)
 
 
 def add_gaussian_noise(key: jax.Array, values: jnp.ndarray, stddev,
                        granularity) -> jnp.ndarray:
+    g = effective_granularity(stddev, granularity, values.dtype)
     noise = jax.random.normal(key, values.shape, dtype=values.dtype) * stddev
-    return snap(values, granularity) + snap(noise, granularity)
+    return snap(values + noise, g)
 
 
 def add_noise(key: jax.Array, values: jnp.ndarray, is_gaussian,
@@ -43,8 +76,9 @@ def add_noise(key: jax.Array, values: jnp.ndarray, is_gaussian,
     All parameters may be traced scalars, so one compiled kernel serves both
     noise kinds and any budget.
     """
+    g = effective_granularity(scale_or_std, granularity, values.dtype)
     lap = jax.random.laplace(key, values.shape, dtype=values.dtype)
     gauss = jax.random.normal(jax.random.fold_in(key, 1), values.shape,
                               dtype=values.dtype)
     noise = jnp.where(is_gaussian, gauss, lap) * scale_or_std
-    return snap(values, granularity) + snap(noise, granularity)
+    return snap(values + noise, g)

@@ -137,6 +137,18 @@ class PartitionSelection(abc.ABC):
         raise NotImplementedError(
             f"{type(self).__name__} does not produce noised values.")
 
+    def select_vec(self, num_privacy_units):
+        """Vectorized host selection: (keep bool[N], noised float[N]).
+
+        The float64 twin of ops/selection.select_partitions, used by the
+        columnar engine's secure host-noise finalization. For strategies
+        without noised values the second array echoes the raw counts.
+        """
+        counts = np.asarray(num_privacy_units)
+        probs = self.probability_of_keep_vec(counts)
+        keep = _rng.random(counts.shape) < probs
+        return keep, counts.astype(np.float64)
+
 
 class TruncatedGeometricPartitionSelection(PartitionSelection):
     """Optimal partition selection via the generalized geometric mechanism.
@@ -246,6 +258,19 @@ class _ThresholdingPartitionSelection(PartitionSelection):
             noised += self._pre_threshold - 1
         return float(noised)
 
+    def select_vec(self, num_privacy_units):
+        counts = np.asarray(num_privacy_units)
+        n = self._pre_threshold_shift(counts).astype(np.float64)
+        noised = n + self._sample_noise_vec(counts.shape)
+        keep = (n > 0) & (noised >= self._threshold_shifted)
+        if self._pre_threshold is not None:
+            noised = noised + (self._pre_threshold - 1)
+        return keep, noised
+
+    @abc.abstractmethod
+    def _sample_noise_vec(self, shape) -> np.ndarray:
+        ...
+
 
 class LaplaceThresholdingPartitionSelection(_ThresholdingPartitionSelection):
     """Keep iff count + Lap(m/eps) >= T, T calibrated so that a partition
@@ -270,6 +295,9 @@ class LaplaceThresholdingPartitionSelection(_ThresholdingPartitionSelection):
 
     def _sample_noise(self) -> float:
         return float(noise_core.sample_laplace(self._scale))
+
+    def _sample_noise_vec(self, shape) -> np.ndarray:
+        return np.asarray(noise_core.sample_laplace(self._scale, shape))
 
     def _noise_sf(self, x: np.ndarray) -> np.ndarray:
         b = self._scale
@@ -305,6 +333,9 @@ class GaussianThresholdingPartitionSelection(_ThresholdingPartitionSelection):
 
     def _sample_noise(self) -> float:
         return float(noise_core.sample_gaussian(self._sigma))
+
+    def _sample_noise_vec(self, shape) -> np.ndarray:
+        return np.asarray(noise_core.sample_gaussian(self._sigma, shape))
 
     def _noise_sf(self, x: np.ndarray) -> np.ndarray:
         return stats.norm.sf(np.asarray(x, dtype=np.float64) / self._sigma)

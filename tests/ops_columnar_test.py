@@ -3,6 +3,7 @@
 import collections
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -234,3 +235,31 @@ class TestSelectionKernel:
                                               params, np.ones(2, bool))
         assert not bool(keep[0])
         assert bool(keep[1])
+
+
+class TestNoiseSnapping:
+
+    def test_f32_effective_granularity_is_representable(self):
+        from pipelinedp_tpu.ops import noise as noise_ops
+        scale = 16.0
+        host_g = 16.0 * 2.0**-40
+        g = float(noise_ops.effective_granularity(scale, host_g, jnp.float32))
+        assert g == scale * 2.0**-noise_ops.F32_GRANULARITY_BITS
+        # The snap must be non-identity on typical noise magnitudes.
+        vals = jnp.asarray([1.2345678, -3.3219], jnp.float32) * scale
+        snapped = noise_ops.snap(vals, g)
+        assert not np.array_equal(np.asarray(snapped), np.asarray(vals))
+        # And every snapped value is an exact multiple of g.
+        ratio = np.asarray(snapped, np.float64) / g
+        np.testing.assert_allclose(ratio, np.round(ratio), atol=1e-6)
+
+    def test_device_noise_std_matches_scale(self):
+        from pipelinedp_tpu.ops import noise as noise_ops
+        key = jax.random.PRNGKey(0)
+        zeros = jnp.zeros(200_000, jnp.float32)
+        lap = np.asarray(noise_ops.add_laplace_noise(key, zeros, 3.0,
+                                                     3.0 * 2.0**-40))
+        assert np.std(lap) == pytest.approx(3.0 * np.sqrt(2.0), rel=0.02)
+        gau = np.asarray(noise_ops.add_gaussian_noise(key, zeros, 2.5,
+                                                      2.5 * 2.0**-57))
+        assert np.std(gau) == pytest.approx(2.5, rel=0.02)
