@@ -40,6 +40,7 @@ from pipelinedp_tpu.data_extractors import (
     MultiValueDataExtractors,
     PreAggregateExtractors,
 )
+from pipelinedp_tpu.ops.encoding import ColumnarData, EncodedColumns
 from pipelinedp_tpu.report_generator import ExplainComputationReport
 from pipelinedp_tpu.backends.base import PipelineBackend
 from pipelinedp_tpu.backends.local import LocalBackend, MultiProcLocalBackend
@@ -55,10 +56,12 @@ __all__ = [
     "Budget",
     "BudgetAccountant",
     "CalculatePrivateContributionBoundsParams",
+    "ColumnarData",
     "CountParams",
     "CustomCombiner",
     "DPEngine",
     "DataExtractors",
+    "EncodedColumns",
     "JaxDPEngine",
     "LazyJaxResult",
     "ExplainComputationReport",

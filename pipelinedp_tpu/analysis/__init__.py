@@ -1,0 +1,58 @@
+"""Utility analysis & parameter tuning for pipelinedp_tpu.
+
+TPU-first redesign of the reference's analysis/ package
+(analysis/__init__.py in lagodiuk/PipelineDP): instead of per-row Python
+combiner objects multiplied across parameter configurations
+(analysis/per_partition_combiners.py:359-451), the whole multi-configuration
+sweep is evaluated as vectorized array math over a
+[n_configurations, n_partitions] grid on columnar pre-aggregates.
+"""
+
+from pipelinedp_tpu.analysis.data_structures import (
+    MultiParameterConfiguration,
+    UtilityAnalysisOptions,
+    get_aggregate_params,
+    get_partition_selection_strategy,
+)
+from pipelinedp_tpu.analysis import metrics
+from pipelinedp_tpu.analysis.utility_analysis import perform_utility_analysis
+from pipelinedp_tpu.analysis.utility_analysis_engine import (
+    UtilityAnalysisEngine,)
+from pipelinedp_tpu.analysis.parameter_tuning import (
+    MinimizingFunction,
+    ParametersToTune,
+    TuneOptions,
+    TuneResult,
+    tune,
+)
+from pipelinedp_tpu.analysis.dp_strategy_selector import (
+    DPStrategy,
+    DPStrategySelector,
+    DPStrategySelectorFactory,
+)
+from pipelinedp_tpu.analysis.pre_aggregation import preaggregate
+from pipelinedp_tpu.analysis.dataset_summary import (
+    PublicPartitionsSummary,
+    compute_public_partitions_summary,
+)
+
+__all__ = [
+    "DPStrategy",
+    "DPStrategySelector",
+    "DPStrategySelectorFactory",
+    "MinimizingFunction",
+    "MultiParameterConfiguration",
+    "ParametersToTune",
+    "PublicPartitionsSummary",
+    "TuneOptions",
+    "TuneResult",
+    "UtilityAnalysisEngine",
+    "UtilityAnalysisOptions",
+    "compute_public_partitions_summary",
+    "get_aggregate_params",
+    "get_partition_selection_strategy",
+    "metrics",
+    "perform_utility_analysis",
+    "preaggregate",
+    "tune",
+]

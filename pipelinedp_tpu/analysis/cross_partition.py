@@ -1,0 +1,215 @@
+"""Vectorized cross-partition aggregation into UtilityReports.
+
+Replaces the reference's dataclass-arithmetic combiner
+(analysis/cross_partition_combiners.py:296-343, recursive field add/multiply
+:142-191) with direct weighted reductions over the
+[n_configurations, n_partitions] error arrays: one numpy sum per report
+field instead of one combiner merge per partition.
+
+Semantics (matching the reference):
+  * per-partition weight = keep probability (1 for public partitions);
+  * every ValueErrors field is the weighted mean over partitions;
+  * relative errors divide by the partition's raw value before weighting
+    (partitions with raw value 0 contribute 0);
+  * data-dropped ratios are summed raw and divided by the total raw value;
+  * kept_partitions is the Poisson-binomial mean/variance of the number of
+    kept partitions; noise_std passes through unaveraged.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from pipelinedp_tpu.aggregate_params import Metric
+from pipelinedp_tpu.analysis import metrics as metrics_lib
+from pipelinedp_tpu.analysis.per_partition import (MetricErrorArrays,
+                                                   PerPartitionArrays)
+
+
+def _weighted_mean(values: np.ndarray, weights: np.ndarray,
+                   total_weight: float) -> float:
+    if total_weight == 0:
+        return 0.0
+    return float(np.dot(values, weights) / total_weight)
+
+
+def _metric_utility(err: MetricErrorArrays, c: int, part_mask: np.ndarray,
+                    keep_prob: Optional[np.ndarray]) -> metrics_lib.MetricUtility:
+    """Cross-partition MetricUtility for configuration c over the masked
+    partition subset."""
+    raw = err.raw[c][part_mask]
+    clip_min = err.clip_min_err[c][part_mask]
+    clip_max = err.clip_max_err[c][part_mask]
+    exp_l0 = err.exp_l0_err[c][part_mask]
+    var_l0 = err.var_l0_err[c][part_mask]
+    std_noise = float(err.std_noise[c])
+    keep = (np.ones(len(raw))
+            if keep_prob is None else keep_prob[c][part_mask])
+
+    bias = exp_l0 + clip_min + clip_max
+    variance = var_l0 + std_noise**2
+    rmse = np.sqrt(bias**2 + variance)
+    rmse_dropped = keep * rmse + (1 - keep) * np.abs(raw)
+
+    weights = keep
+    total_weight = float(weights.sum())
+
+    def abs_errors():
+        return metrics_lib.ValueErrors(
+            bounding_errors=metrics_lib.ContributionBoundingErrors(
+                l0=metrics_lib.MeanVariance(
+                    _weighted_mean(exp_l0, weights, total_weight),
+                    _weighted_mean(var_l0, weights, total_weight)),
+                linf_min=_weighted_mean(clip_min, weights, total_weight),
+                linf_max=_weighted_mean(clip_max, weights, total_weight)),
+            mean=_weighted_mean(bias, weights, total_weight),
+            variance=_weighted_mean(variance, weights, total_weight),
+            rmse=_weighted_mean(rmse, weights, total_weight),
+            l1=0.0,
+            rmse_with_dropped_partitions=_weighted_mean(
+                rmse_dropped, weights, total_weight),
+            l1_with_dropped_partitions=0.0)
+
+    def rel_errors():
+        # Divide per-partition values by raw before weighting; raw == 0
+        # partitions contribute zero (ValueErrors.to_relative semantics).
+        safe_raw = np.where(raw == 0, 1.0, raw)
+        nz = (raw != 0).astype(np.float64)
+        return metrics_lib.ValueErrors(
+            bounding_errors=metrics_lib.ContributionBoundingErrors(
+                l0=metrics_lib.MeanVariance(
+                    _weighted_mean(exp_l0 / safe_raw * nz, weights,
+                                   total_weight),
+                    _weighted_mean(var_l0 / safe_raw**2 * nz, weights,
+                                   total_weight)),
+                linf_min=_weighted_mean(clip_min / safe_raw * nz, weights,
+                                        total_weight),
+                linf_max=_weighted_mean(clip_max / safe_raw * nz, weights,
+                                        total_weight)),
+            mean=_weighted_mean(bias / safe_raw * nz, weights, total_weight),
+            variance=_weighted_mean(variance / safe_raw**2 * nz, weights,
+                                    total_weight),
+            rmse=_weighted_mean(rmse / safe_raw * nz, weights, total_weight),
+            l1=0.0,
+            rmse_with_dropped_partitions=_weighted_mean(
+                rmse_dropped / safe_raw * nz, weights, total_weight),
+            l1_with_dropped_partitions=0.0)
+
+    # Data dropped: attribute raw mass to bounding stages, then partition
+    # selection takes (1 - keep) of what survives; normalize by total raw.
+    linf_dropped = clip_min - clip_max  # negate max (negative) side
+    l0_dropped = -exp_l0
+    survived = raw - l0_dropped - linf_dropped
+    selection_dropped = survived * (1 - keep)
+    total_raw = float(raw.sum())
+    denom = total_raw if total_raw != 0 else 1.0
+    data_dropped = metrics_lib.DataDropInfo(
+        l0=float(l0_dropped.sum()) / denom,
+        linf=float(linf_dropped.sum()) / denom,
+        partition_selection=float(selection_dropped.sum()) / denom)
+
+    return metrics_lib.MetricUtility(metric=err.metric,
+                                     noise_std=std_noise,
+                                     noise_kind=err.noise_kind[c],
+                                     ratio_data_dropped=data_dropped,
+                                     absolute_error=abs_errors(),
+                                     relative_error=rel_errors())
+
+
+def _partitions_info(arrays: PerPartitionArrays, c: int,
+                     part_mask: np.ndarray,
+                     public_partitions: bool) -> metrics_lib.PartitionsInfo:
+    if public_partitions:
+        raw_count = arrays.raw_count[part_mask]
+        empty = int((raw_count == 0).sum())
+        return metrics_lib.PartitionsInfo(public_partitions=True,
+                                          num_dataset_partitions=int(
+                                              (raw_count > 0).sum()),
+                                          num_non_public_partitions=0,
+                                          num_empty_partitions=empty)
+    keep = arrays.keep_prob[c][part_mask]
+    kept = metrics_lib.MeanVariance(float(keep.sum()),
+                                    float((keep * (1 - keep)).sum()))
+    return metrics_lib.PartitionsInfo(public_partitions=False,
+                                      num_dataset_partitions=int(
+                                          part_mask.sum()),
+                                      kept_partitions=kept)
+
+
+def build_utility_report(arrays: PerPartitionArrays, c: int,
+                         part_mask: np.ndarray, dp_metrics: Sequence[Metric],
+                         public_partitions: bool) -> metrics_lib.UtilityReport:
+    """UtilityReport for configuration c restricted to part_mask."""
+    metric_errors = None
+    if dp_metrics:
+        metric_errors = [
+            _metric_utility(err, c, part_mask,
+                            None if public_partitions else arrays.keep_prob)
+            for err in arrays.metric_errors
+        ]
+    return metrics_lib.UtilityReport(configuration_index=c,
+                                     partitions_info=_partitions_info(
+                                         arrays, c, part_mask,
+                                         public_partitions),
+                                     metric_errors=metric_errors)
+
+
+def _generate_bucket_bounds() -> List[int]:
+    bounds = [0, 1]
+    for decade in range(1, 13):
+        bounds.extend(
+            (10**decade, 2 * 10**decade, 5 * 10**decade))
+    return bounds
+
+
+# Logarithmic 1-2-5 bucket lower bounds for the report-by-partition-size
+# histogram (parity: analysis/utility_analysis.py:28-39).
+BUCKET_BOUNDS = _generate_bucket_bounds()
+
+
+def partition_size_buckets(sizes: np.ndarray) -> np.ndarray:
+    """Lower bucket bound of each partition size."""
+    sizes = np.maximum(np.asarray(sizes), 0)
+    idx = np.searchsorted(BUCKET_BOUNDS, sizes, side="right") - 1
+    return np.asarray(BUCKET_BOUNDS)[np.maximum(idx, 0)]
+
+
+def bucket_upper_bound(lower: int) -> int:
+    idx = BUCKET_BOUNDS.index(lower) + 1
+    return BUCKET_BOUNDS[idx] if idx < len(BUCKET_BOUNDS) else -1
+
+
+def build_reports_with_histogram(
+        arrays: PerPartitionArrays, dp_metrics: Sequence[Metric],
+        public_partitions: bool) -> List[metrics_lib.UtilityReport]:
+    """Global report + report-by-size-bucket histogram per configuration.
+
+    Partition size is the raw value of the first analyzed metric in the
+    first configuration (raw privacy-id count when only partition selection
+    is analyzed).
+    """
+    if arrays.metric_errors:
+        sizes = arrays.metric_errors[0].raw[0]
+    else:
+        sizes = arrays.raw_pid_count
+    buckets = partition_size_buckets(sizes)
+    all_mask = np.ones(arrays.n_partitions, dtype=bool)
+    reports = []
+    for c in range(arrays.n_configs):
+        report = build_utility_report(arrays, c, all_mask, dp_metrics,
+                                      public_partitions)
+        histogram = []
+        for lower in sorted(set(buckets.tolist())):
+            mask = buckets == lower
+            histogram.append(
+                metrics_lib.UtilityReportBin(
+                    partition_size_from=int(lower),
+                    partition_size_to=int(bucket_upper_bound(int(lower))),
+                    report=build_utility_report(arrays, c, mask, dp_metrics,
+                                                public_partitions)))
+        if dp_metrics:
+            report.utility_report_histogram = histogram
+        reports.append(report)
+    return reports

@@ -1,0 +1,116 @@
+"""Configuration dataclasses for the utility analysis.
+
+Parity: analysis/data_structures.py (MultiParameterConfiguration :25,
+UtilityAnalysisOptions :100, get_aggregate_params :124,
+get_partition_selection_strategy :137). The multi-parameter sweep here is
+the leading axis of the vectorized analysis grid (per_partition.py), not a
+list of combiner objects.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Iterator, List, Optional, Sequence
+
+from pipelinedp_tpu import input_validators
+from pipelinedp_tpu.aggregate_params import (AggregateParams, NoiseKind,
+                                             PartitionSelectionStrategy)
+
+
+@dataclasses.dataclass
+class MultiParameterConfiguration:
+    """A sweep over AggregateParams attributes.
+
+    Every non-None attribute is a sequence of per-configuration values; all
+    set attributes must have equal length. Configuration i is the blueprint
+    AggregateParams with attribute i substituted.
+    """
+    max_partitions_contributed: Optional[Sequence[int]] = None
+    max_contributions_per_partition: Optional[Sequence[int]] = None
+    min_sum_per_partition: Optional[Sequence[float]] = None
+    max_sum_per_partition: Optional[Sequence[float]] = None
+    noise_kind: Optional[Sequence[NoiseKind]] = None
+    partition_selection_strategy: Optional[
+        Sequence[PartitionSelectionStrategy]] = None
+
+    def __post_init__(self):
+        lengths = {
+            len(v)
+            for v in dataclasses.asdict(self).values() if v
+        }
+        if not lengths:
+            raise ValueError("MultiParameterConfiguration requires at least "
+                             "one non-empty attribute.")
+        if len(lengths) > 1:
+            raise ValueError("All set MultiParameterConfiguration attributes "
+                             "must have the same length.")
+        if (self.min_sum_per_partition is None) != (
+                self.max_sum_per_partition is None):
+            raise ValueError(
+                "min_sum_per_partition and max_sum_per_partition must be "
+                "both set or both None.")
+        self._size = lengths.pop()
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    def get_aggregate_params(self, blueprint: AggregateParams,
+                             index: int) -> AggregateParams:
+        """Blueprint with the index-th swept values substituted."""
+        params = copy.copy(blueprint)
+        for field in ("max_partitions_contributed",
+                      "max_contributions_per_partition",
+                      "min_sum_per_partition", "max_sum_per_partition",
+                      "noise_kind", "partition_selection_strategy"):
+            values = getattr(self, field)
+            if values:
+                setattr(params, field, values[index])
+        return params
+
+
+@dataclasses.dataclass
+class UtilityAnalysisOptions:
+    """Options for the utility analysis."""
+    epsilon: float
+    delta: float
+    aggregate_params: AggregateParams
+    multi_param_configuration: Optional[MultiParameterConfiguration] = None
+    partitions_sampling_prob: float = 1
+    pre_aggregated_data: bool = False
+
+    def __post_init__(self):
+        input_validators.validate_epsilon_delta(self.epsilon, self.delta,
+                                                "UtilityAnalysisOptions")
+        if not 0 < self.partitions_sampling_prob <= 1:
+            raise ValueError("partitions_sampling_prob must be in (0, 1], "
+                             f"got {self.partitions_sampling_prob}.")
+
+    @property
+    def n_configurations(self) -> int:
+        if self.multi_param_configuration is None:
+            return 1
+        return self.multi_param_configuration.size
+
+
+def get_aggregate_params(
+        options: UtilityAnalysisOptions) -> Iterator[AggregateParams]:
+    """Yields the AggregateParams of every configuration in the sweep."""
+    config = options.multi_param_configuration
+    if config is None:
+        yield options.aggregate_params
+        return
+    for i in range(config.size):
+        yield config.get_aggregate_params(options.aggregate_params, i)
+
+
+def get_partition_selection_strategy(
+    options: UtilityAnalysisOptions
+) -> List[PartitionSelectionStrategy]:
+    """Per-configuration partition selection strategies."""
+    config = options.multi_param_configuration
+    if config is not None and config.partition_selection_strategy is not None:
+        return list(config.partition_selection_strategy)
+    n = 1 if config is None else config.size
+    return [options.aggregate_params.partition_selection_strategy] * n

@@ -1,0 +1,38 @@
+"""Public-partitions vs dataset overlap statistics.
+
+Parity: analysis/dataset_summary.py:21-108 — the reference's
+distinct/flatten/group-by dataflow reduces to two set operations on the
+distinct partition keys.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable, Optional
+
+from pipelinedp_tpu.data_extractors import DataExtractors
+
+
+@dataclasses.dataclass
+class PublicPartitionsSummary:
+    num_dataset_public_partitions: int
+    num_dataset_non_public_partitions: int
+    num_empty_public_partitions: int
+
+
+def compute_public_partitions_summary(
+        col,
+        backend=None,
+        extractors: Optional[DataExtractors] = None,
+        public_partitions: Iterable[Any] = None) -> PublicPartitionsSummary:
+    """Counts dataset∩public, dataset\\public and public\\dataset partitions.
+
+    ``backend`` accepted for signature parity and ignored.
+    """
+    del backend
+    dataset = {extractors.partition_extractor(row) for row in col}
+    public = set(public_partitions)
+    return PublicPartitionsSummary(
+        num_dataset_public_partitions=len(dataset & public),
+        num_dataset_non_public_partitions=len(dataset - public),
+        num_empty_public_partitions=len(public - dataset))

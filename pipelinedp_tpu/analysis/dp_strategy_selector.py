@@ -1,0 +1,133 @@
+"""Automatic DP-strategy selection (noise kind, partition selection).
+
+Parity: analysis/dp_strategy_selector.py:25-196. Chooses the noise kind
+with the smaller standard deviation and the partition-selection strategy
+with the smaller release threshold; PRIVACY_ID_COUNT routes to
+post-aggregation thresholding with the delta split of
+Delta_For_Thresholding.pdf.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from pipelinedp_tpu import dp_computations
+from pipelinedp_tpu import input_validators
+from pipelinedp_tpu.aggregate_params import (Metric, Metrics, NoiseKind,
+                                             PartitionSelectionStrategy,
+                                             noise_to_thresholding)
+
+
+@dataclasses.dataclass
+class DPStrategy:
+    noise_kind: Optional[NoiseKind]
+    partition_selection_strategy: Optional[PartitionSelectionStrategy]
+    post_aggregation_thresholding: bool
+
+
+class DPStrategySelector:
+    """Chooses a DPStrategy from budget, metric and sensitivities."""
+
+    def __init__(self, epsilon: float, delta: float, metric: Optional[Metric],
+                 is_public_partitions: bool):
+        input_validators.validate_epsilon_delta(epsilon, delta,
+                                               "DPStrategySelector")
+        if delta == 0 and not is_public_partitions:
+            raise ValueError("Private partition selection requires delta > 0")
+        self._epsilon = epsilon
+        self._delta = delta
+        self._metric = metric
+        self._is_public_partitions = is_public_partitions
+
+    @property
+    def is_public_partitions(self) -> bool:
+        return self._is_public_partitions
+
+    @property
+    def metric(self) -> Optional[Metric]:
+        return self._metric
+
+    def get_dp_strategy(
+            self,
+            sensitivities: dp_computations.Sensitivities) -> DPStrategy:
+        if self._metric is None:
+            # select_partitions: all budget goes to selection.
+            return DPStrategy(
+                noise_kind=None,
+                partition_selection_strategy=self.
+                select_partition_selection_strategy(self._epsilon,
+                                                    self._delta,
+                                                    sensitivities.l0),
+                post_aggregation_thresholding=False)
+        if self._is_public_partitions:
+            return DPStrategy(noise_kind=self.select_noise_kind(
+                self._epsilon, self._delta, sensitivities),
+                              partition_selection_strategy=None,
+                              post_aggregation_thresholding=False)
+        if self.use_post_aggregation_thresholding(self._metric):
+            # Delta split per Delta_For_Thresholding.pdf: half to noise,
+            # half to the threshold.
+            noise_kind = self.select_noise_kind(
+                self._epsilon, self._delta / 2,
+                dp_computations.Sensitivities(l0=sensitivities.l0, linf=1))
+            return DPStrategy(noise_kind=noise_kind,
+                              partition_selection_strategy=noise_to_thresholding(
+                                  noise_kind).to_partition_selection_strategy(),
+                              post_aggregation_thresholding=True)
+        # Private selection: budget halved between noise and selection.
+        half_eps, half_delta = self._epsilon / 2, self._delta / 2
+        return DPStrategy(
+            noise_kind=self.select_noise_kind(half_eps, half_delta,
+                                              sensitivities),
+            partition_selection_strategy=self.
+            select_partition_selection_strategy(half_eps, half_delta,
+                                                sensitivities.l0),
+            post_aggregation_thresholding=False)
+
+    def select_noise_kind(
+            self, epsilon: float, delta: float,
+            sensitivities: dp_computations.Sensitivities) -> NoiseKind:
+        """The noise kind with the smaller standard deviation."""
+        if delta == 0:
+            return NoiseKind.LAPLACE
+        gaussian_std = dp_computations.GaussianMechanism.\
+            create_from_epsilon_delta(epsilon, delta, sensitivities.l2).std
+        laplace_std = dp_computations.LaplaceMechanism.create_from_epsilon(
+            epsilon, sensitivities.l1).std
+        return (NoiseKind.GAUSSIAN
+                if gaussian_std < laplace_std else NoiseKind.LAPLACE)
+
+    def use_post_aggregation_thresholding(self, metric: Metric) -> bool:
+        return metric == Metrics.PRIVACY_ID_COUNT
+
+    def select_partition_selection_strategy(
+            self, epsilon: float, delta: float,
+            l0_sensitivity: int) -> PartitionSelectionStrategy:
+        """The strategy with the smaller release threshold.
+
+        Laplace and Gaussian thresholding are compared by threshold; when
+        Laplace wins, truncated geometric (strictly better than Laplace
+        thresholding) is returned in its place.
+        """
+
+        def threshold(strategy: PartitionSelectionStrategy) -> float:
+            return dp_computations.ThresholdingMechanism(
+                epsilon, delta, strategy, l0_sensitivity,
+                pre_threshold=None).threshold()
+
+        laplace_t = threshold(
+            PartitionSelectionStrategy.LAPLACE_THRESHOLDING)
+        gaussian_t = threshold(
+            PartitionSelectionStrategy.GAUSSIAN_THRESHOLDING)
+        if laplace_t < gaussian_t:
+            return PartitionSelectionStrategy.TRUNCATED_GEOMETRIC
+        return PartitionSelectionStrategy.GAUSSIAN_THRESHOLDING
+
+
+class DPStrategySelectorFactory:
+
+    def create(self, epsilon: float, delta: float, metric: Optional[Metric],
+               is_public_partitions: bool) -> DPStrategySelector:
+        return DPStrategySelector(epsilon, delta, metric,
+                                  is_public_partitions)

@@ -1,0 +1,159 @@
+"""Result dataclasses of the utility analysis.
+
+Parity: analysis/metrics.py (SumMetrics :23, RawStatistics :62,
+PerPartitionMetrics :68, MeanVariance :75, ContributionBoundingErrors :81,
+ValueErrors :106, DataDropInfo :172, MetricUtility :191, PartitionsInfo
+:219, UtilityReport :248, UtilityReportBin :267). These are plain output
+records; the math that fills them lives in per_partition.py /
+cross_partition.py as vectorized array code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional
+
+from pipelinedp_tpu.aggregate_params import (Metric, NoiseKind,
+                                             PartitionSelectionStrategy)
+
+
+@dataclasses.dataclass
+class SumMetrics:
+    """Per-partition error decomposition for one additive metric.
+
+    Used for SUM, COUNT and PRIVACY_ID_COUNT alike (COUNT is the sum of
+    per-(pid, pk) counts, PRIVACY_ID_COUNT the sum of indicators). The
+    invariant the fields satisfy:
+      E(dp value) = sum + clipping_to_min_error + clipping_to_max_error
+                    + expected_l0_bounding_error  (+ zero-mean noise)
+    """
+    aggregation: Metric
+    sum: float
+    clipping_to_min_error: float
+    clipping_to_max_error: float
+    expected_l0_bounding_error: float
+    std_l0_bounding_error: float
+    std_noise: float
+    noise_kind: NoiseKind
+
+
+@dataclasses.dataclass
+class RawStatistics:
+    """Raw (non-DP) per-partition statistics."""
+    privacy_id_count: int
+    count: int
+
+
+@dataclasses.dataclass
+class PerPartitionMetrics:
+    partition_selection_probability_to_keep: float
+    raw_statistics: RawStatistics
+    metric_errors: Optional[List[SumMetrics]] = None
+
+
+@dataclasses.dataclass
+class MeanVariance:
+    mean: float
+    var: float
+
+
+@dataclasses.dataclass
+class ContributionBoundingErrors:
+    """Error breakdown by bounding stage: l0 (cross-partition, random) and
+    linf min/max clipping (per-partition, deterministic)."""
+    l0: MeanVariance
+    linf_min: float
+    linf_max: float
+
+    def to_relative(self, value: float) -> "ContributionBoundingErrors":
+        return ContributionBoundingErrors(
+            l0=MeanVariance(self.l0.mean / value, self.l0.var / value**2),
+            linf_min=self.linf_min / value,
+            linf_max=self.linf_max / value)
+
+
+@dataclasses.dataclass
+class ValueErrors:
+    """Statistics of (dp_value - actual_value), averaged across partitions.
+
+    The *_with_dropped_partitions variants fold in partitions lost to
+    private partition selection: with keep probability p the error is
+    p*err + (1-p)*|actual|.
+    """
+    bounding_errors: ContributionBoundingErrors
+    mean: float
+    variance: float
+    rmse: float
+    l1: float
+    rmse_with_dropped_partitions: float
+    l1_with_dropped_partitions: float
+
+    def to_relative(self, value: float) -> "ValueErrors":
+        if value == 0:
+            zero_bounding = ContributionBoundingErrors(MeanVariance(0, 0), 0,
+                                                       0)
+            return ValueErrors(zero_bounding, 0, 0, 0, 0, 0, 0)
+        return ValueErrors(
+            bounding_errors=self.bounding_errors.to_relative(value),
+            mean=self.mean / value,
+            variance=self.variance / value**2,
+            rmse=self.rmse / value,
+            l1=self.l1 / value,
+            rmse_with_dropped_partitions=(self.rmse_with_dropped_partitions /
+                                          value),
+            l1_with_dropped_partitions=(self.l1_with_dropped_partitions /
+                                        value))
+
+
+@dataclasses.dataclass
+class DataDropInfo:
+    """Ratio of data dropped per DP stage."""
+    l0: float
+    linf: float
+    partition_selection: float
+
+
+@dataclasses.dataclass
+class MetricUtility:
+    """Cross-partition utility of one DP metric."""
+    metric: Metric
+    noise_std: float
+    noise_kind: Optional[NoiseKind]
+    ratio_data_dropped: Optional[DataDropInfo]
+    absolute_error: ValueErrors
+    relative_error: ValueErrors
+
+
+@dataclasses.dataclass
+class PartitionsInfo:
+    """Aggregate statistics about partitions and partition selection."""
+    public_partitions: bool
+    num_dataset_partitions: int
+    num_non_public_partitions: Optional[int] = None
+    num_empty_partitions: Optional[int] = None
+    strategy: Optional[PartitionSelectionStrategy] = None
+    kept_partitions: Optional[MeanVariance] = None
+
+
+@dataclasses.dataclass
+class UtilityReport:
+    """Result of the utility analysis for one parameter configuration."""
+    configuration_index: int
+    partitions_info: PartitionsInfo
+    metric_errors: Optional[List[MetricUtility]] = None
+    utility_report_histogram: Optional[List["UtilityReportBin"]] = None
+
+
+@dataclasses.dataclass
+class UtilityReportBin:
+    """UtilityReport restricted to partitions whose size falls in
+    [partition_size_from, partition_size_to)."""
+    partition_size_from: int
+    partition_size_to: int
+    report: UtilityReport
+
+
+def rmse_from_moments(bias: float, variance: float) -> float:
+    """sqrt(bias^2 + variance) — the per-partition RMSE identity."""
+    return math.sqrt(bias * bias + variance)

@@ -1,0 +1,276 @@
+"""Parameter tuning: candidate grids from dataset histograms + vectorized
+utility-analysis sweep + RMSE argmin.
+
+Parity: analysis/parameter_tuning.py (TuneOptions :57, TuneResult :97,
+candidate generation :120-312, tune :315-440). Candidates are generated
+from the dataset-histogram quantile structure; the whole candidate grid is
+then evaluated in one vectorized utility-analysis pass (the reference runs
+one combiner set per candidate per row).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import math
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from pipelinedp_tpu import input_validators
+from pipelinedp_tpu.aggregate_params import (AggregateParams, Metric,
+                                             Metrics, NoiseKind)
+from pipelinedp_tpu import dp_computations
+from pipelinedp_tpu.data_extractors import (DataExtractors,
+                                            PreAggregateExtractors)
+from pipelinedp_tpu.dataset_histograms import histograms as hist_lib
+from pipelinedp_tpu.analysis import data_structures
+from pipelinedp_tpu.analysis import dp_strategy_selector as selector_lib
+from pipelinedp_tpu.analysis import metrics as metrics_lib
+from pipelinedp_tpu.analysis import utility_analysis
+
+
+class MinimizingFunction(enum.Enum):
+    ABSOLUTE_ERROR = "absolute_error"
+    RELATIVE_ERROR = "relative_error"
+
+
+@dataclasses.dataclass
+class ParametersToTune:
+    """Which AggregateParams attributes the tuner may vary."""
+    max_partitions_contributed: bool = False
+    max_contributions_per_partition: bool = False
+    min_sum_per_partition: bool = False
+    max_sum_per_partition: bool = False
+    noise_kind: bool = True
+
+    def __post_init__(self):
+        if not any(dataclasses.asdict(self).values()):
+            raise ValueError("ParametersToTune needs at least one parameter.")
+
+
+@dataclasses.dataclass
+class TuneOptions:
+    epsilon: float
+    delta: float
+    aggregate_params: AggregateParams
+    function_to_minimize: Union[MinimizingFunction, Callable]
+    parameters_to_tune: ParametersToTune
+    partitions_sampling_prob: float = 1
+    pre_aggregated_data: bool = False
+    number_of_parameter_candidates: int = 100
+
+    def __post_init__(self):
+        input_validators.validate_epsilon_delta(self.epsilon, self.delta,
+                                                "TuneOptions")
+
+
+@dataclasses.dataclass
+class TuneResult:
+    options: TuneOptions
+    contribution_histograms: hist_lib.DatasetHistograms
+    utility_analysis_parameters: data_structures.MultiParameterConfiguration
+    index_best: int
+    utility_reports: List[metrics_lib.UtilityReport]
+
+
+def candidates_constant_relative_step(histogram: hist_lib.Histogram,
+                                      max_candidates: int) -> List[int]:
+    """Integer candidates 1..max with a constant relative step: the i-th
+    candidate is ~max^(i/(k-1)), deduplicated upward."""
+    max_value = histogram.max_value()
+    if max_value < 1:
+        raise ValueError("histogram max_value must be >= 1")
+    max_candidates = min(max_candidates, max_value)
+    if max_candidates <= 1:
+        return [1]
+    step = max_value**(1.0 / (max_candidates - 1))
+    out = [1]
+    acc = 1.0
+    for _ in range(1, max_candidates):
+        if out[-1] >= max_value:
+            break
+        acc *= step
+        out.append(max(out[-1] + 1, math.ceil(acc)))
+    out[-1] = max_value
+    return out
+
+
+def candidates_bin_maximums(histogram: hist_lib.Histogram,
+                            max_candidates: int) -> List[float]:
+    """Evenly subsampled bin maximums (for sum bounds)."""
+    n_bins = len(histogram.bins)
+    max_candidates = min(max_candidates, n_bins)
+    ids = np.round(np.linspace(0, n_bins - 1, num=max_candidates)).astype(int)
+    return [histogram.bins[i].max for i in ids]
+
+
+def candidates_2d_grid(hist1: hist_lib.Histogram, hist2: hist_lib.Histogram,
+                       fn1: Callable, fn2: Callable,
+                       max_candidates: int) -> Tuple[List, List]:
+    """Cross product of per-parameter candidate lists, rebalanced so a
+    parameter with few distinct values frees budget for the other."""
+    per_param = int(math.sqrt(max_candidates))
+    c1 = fn1(hist1, per_param)
+    c2 = fn2(hist2, per_param)
+    if len(c2) < per_param and len(c1) == per_param:
+        c1 = fn1(hist1, max_candidates // len(c2))
+    elif len(c1) < per_param and len(c2) == per_param:
+        c2 = fn2(hist2, max_candidates // len(c1))
+    grid1, grid2 = [], []
+    for a in c1:
+        for b in c2:
+            grid1.append(a)
+            grid2.append(b)
+    return grid1, grid2
+
+
+def find_candidate_parameters(
+        hist: hist_lib.DatasetHistograms,
+        parameters_to_tune: ParametersToTune,
+        metric: Optional[Metric],
+        max_candidates: int) -> data_structures.MultiParameterConfiguration:
+    """Candidate (l0, linf | max_sum) grid from the dataset histograms."""
+    tune_l0 = parameters_to_tune.max_partitions_contributed
+    tune_linf = (parameters_to_tune.max_contributions_per_partition and
+                 metric == Metrics.COUNT)
+    tune_max_sum = (parameters_to_tune.max_sum_per_partition and
+                    metric == Metrics.SUM)
+    l0 = linf = max_sum = min_sum = None
+    if tune_l0 and tune_linf:
+        l0, linf = candidates_2d_grid(hist.l0_contributions_histogram,
+                                      hist.linf_contributions_histogram,
+                                      candidates_constant_relative_step,
+                                      candidates_constant_relative_step,
+                                      max_candidates)
+    elif tune_l0 and tune_max_sum:
+        l0, max_sum = candidates_2d_grid(hist.l0_contributions_histogram,
+                                         hist.linf_sum_contributions_histogram,
+                                         candidates_constant_relative_step,
+                                         candidates_bin_maximums,
+                                         max_candidates)
+        min_sum = [0.0] * len(max_sum)
+    elif tune_l0:
+        l0 = candidates_constant_relative_step(
+            hist.l0_contributions_histogram, max_candidates)
+    elif tune_linf:
+        linf = candidates_constant_relative_step(
+            hist.linf_contributions_histogram, max_candidates)
+    elif tune_max_sum:
+        max_sum = candidates_bin_maximums(
+            hist.linf_sum_contributions_histogram, max_candidates)
+        min_sum = [0.0] * len(max_sum)
+    else:
+        raise ValueError("Nothing to tune.")
+    return data_structures.MultiParameterConfiguration(
+        max_partitions_contributed=l0,
+        max_contributions_per_partition=linf,
+        min_sum_per_partition=min_sum,
+        max_sum_per_partition=max_sum)
+
+
+def _attach_dp_strategies(
+        config: data_structures.MultiParameterConfiguration,
+        blueprint: AggregateParams, fixed_noise_kind: Optional[NoiseKind],
+        selector: selector_lib.DPStrategySelector) -> None:
+    """Fills per-candidate noise kind / selection strategy in place."""
+    # Materialize the candidate params before mutating the swept fields —
+    # get_aggregate_params reads them.
+    all_params = [
+        config.get_aggregate_params(blueprint, i) for i in range(config.size)
+    ]
+    config.noise_kind = []
+    if not selector.is_public_partitions:
+        config.partition_selection_strategy = []
+    for params in all_params:
+        if selector.metric is None:
+            sensitivities = dp_computations.Sensitivities(
+                l0=params.max_partitions_contributed, linf=1)
+        else:
+            sensitivities = dp_computations.compute_sensitivities(
+                selector.metric, params)
+        strategy = selector.get_dp_strategy(sensitivities)
+        config.noise_kind.append(fixed_noise_kind or strategy.noise_kind)
+        if not selector.is_public_partitions:
+            config.partition_selection_strategy.append(
+                strategy.partition_selection_strategy)
+
+
+def tune(col,
+         backend=None,
+         contribution_histograms: hist_lib.DatasetHistograms = None,
+         options: TuneOptions = None,
+         data_extractors: Union[DataExtractors,
+                                PreAggregateExtractors] = None,
+         public_partitions=None,
+         strategy_selector_factory: Optional[
+             selector_lib.DPStrategySelectorFactory] = None
+         ) -> Tuple[TuneResult, List]:
+    """Finds the best contribution-bounding parameters.
+
+    1. Candidate grid from the dataset histograms.
+    2. One vectorized utility-analysis sweep over all candidates.
+    3. argmin RMSE of the analyzed metric.
+
+    Returns (TuneResult, per-partition utility analysis results).
+    ``backend`` is accepted for signature parity and ignored.
+    """
+    _check_tune_args(options, public_partitions is not None)
+    if strategy_selector_factory is None:
+        strategy_selector_factory = selector_lib.DPStrategySelectorFactory()
+    metric = (options.aggregate_params.metrics[0]
+              if options.aggregate_params.metrics else None)
+    candidates = find_candidate_parameters(
+        contribution_histograms, options.parameters_to_tune, metric,
+        options.number_of_parameter_candidates)
+    fixed_noise_kind = (None if options.parameters_to_tune.noise_kind else
+                        options.aggregate_params.noise_kind)
+    selector = strategy_selector_factory.create(
+        options.epsilon,
+        options.delta,
+        metric,
+        is_public_partitions=public_partitions is not None)
+    _attach_dp_strategies(candidates, options.aggregate_params,
+                          fixed_noise_kind, selector)
+
+    analysis_options = data_structures.UtilityAnalysisOptions(
+        epsilon=options.epsilon,
+        delta=options.delta,
+        aggregate_params=options.aggregate_params,
+        multi_param_configuration=candidates,
+        partitions_sampling_prob=options.partitions_sampling_prob,
+        pre_aggregated_data=options.pre_aggregated_data)
+    reports, per_partition = utility_analysis.perform_utility_analysis(
+        col, backend, analysis_options, data_extractors, public_partitions)
+
+    reports.sort(key=lambda r: r.configuration_index)
+    index_best = -1
+    if options.aggregate_params.metrics:
+        rmse = [r.metric_errors[0].absolute_error.rmse for r in reports]
+        index_best = int(np.argmin(rmse))
+    result = TuneResult(options=options,
+                        contribution_histograms=contribution_histograms,
+                        utility_analysis_parameters=candidates,
+                        index_best=index_best,
+                        utility_reports=reports)
+    return result, per_partition
+
+
+def _check_tune_args(options: TuneOptions, is_public_partitions: bool):
+    metrics = options.aggregate_params.metrics
+    if not metrics:
+        if is_public_partitions:
+            raise ValueError(
+                "Empty metrics tunes partition selection, which is "
+                "incompatible with public partitions.")
+    elif len(metrics) > 1:
+        raise ValueError(f"Tuning supports one metric; got {metrics}.")
+    elif metrics[0] not in (Metrics.COUNT, Metrics.PRIVACY_ID_COUNT,
+                            Metrics.SUM):
+        raise ValueError("Tuning supports COUNT, PRIVACY_ID_COUNT and SUM; "
+                         f"got {metrics[0]}.")
+    if options.parameters_to_tune.min_sum_per_partition:
+        raise ValueError("Tuning min_sum_per_partition is not supported.")
+    if options.function_to_minimize != MinimizingFunction.ABSOLUTE_ERROR:
+        raise NotImplementedError(
+            f"Only {MinimizingFunction.ABSOLUTE_ERROR} is implemented.")

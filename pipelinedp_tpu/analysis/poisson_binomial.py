@@ -1,0 +1,60 @@
+"""Poisson-binomial PMF: exact PGF convolution + refined normal approximation.
+
+Parity: analysis/poisson_binomial.py (compute_pmf :39,
+compute_exp_std_skewness :53, compute_pmf_approximation :62). Used by the
+partition-selection error model to turn per-privacy-unit keep
+probabilities into a distribution over the post-bounding privacy-unit
+count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence, Tuple
+
+import numpy as np
+from scipy import stats
+
+
+@dataclasses.dataclass
+class PMF:
+    """PMF of an integer distribution: P(X = start + i) = probabilities[i]."""
+    start: int
+    probabilities: np.ndarray
+
+
+def compute_pmf(probabilities: Sequence[float]) -> PMF:
+    """Exact Poisson-binomial PMF via probability-generating-function
+    products: PGF(x) = prod_p (1 - p + p x)."""
+    coeffs = np.ones(1)
+    for p in probabilities:
+        nxt = np.zeros(len(coeffs) + 1)
+        nxt[:-1] = coeffs * (1.0 - p)
+        nxt[1:] += coeffs * p
+        coeffs = nxt
+    return PMF(0, coeffs)
+
+
+def compute_exp_std_skewness(
+        probabilities: Sequence[float]) -> Tuple[float, float, float]:
+    p = np.asarray(probabilities, dtype=np.float64)
+    exp = float(p.sum())
+    var = float((p * (1 - p)).sum())
+    std = np.sqrt(var)
+    skew = float((p * (1 - p) * (1 - 2 * p)).sum()) / std**3 if std else 0.0
+    return exp, std, skew
+
+
+def compute_pmf_approximation(mean: float, sigma: float, skewness: float,
+                              n: int) -> PMF:
+    """Refined normal approximation (Edgeworth-corrected CDF) of the
+    Poisson-binomial PMF; tails below ~1e-15 are truncated at 8 sigma."""
+    if sigma == 0:
+        return PMF(int(round(mean)), np.ones(1))
+    lo = max(0, int(np.floor(mean - 8 * sigma)))
+    hi = min(n, int(np.round(mean + 8 * sigma)))
+    grid = np.arange(lo - 1, hi + 1)
+    z = (grid + 0.5 - mean) / sigma
+    cdf = stats.norm.cdf(z) + skewness * (1 - z * z) * stats.norm.pdf(z) / 6.0
+    cdf = np.clip(cdf, 0.0, 1.0)
+    return PMF(lo, np.diff(cdf))

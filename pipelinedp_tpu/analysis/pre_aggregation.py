@@ -1,0 +1,150 @@
+"""Columnar pre-aggregation: (pid, pk, value) rows -> per-(pid, pk) groups.
+
+This is the TPU-native replacement for the reference's
+AnalysisContributionBounder + preaggregate (analysis/contribution_bounders
+.py:19-77, analysis/pre_aggregation.py:19-61): one lexsort + segment
+reductions produce, for every (privacy_id, partition) pair, the
+contribution count, contribution sum and the number of distinct partitions
+the privacy id touches. Those three arrays are the entire input of the
+utility-analysis error models — no per-row combiner objects exist.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from pipelinedp_tpu import sampling_utils
+from pipelinedp_tpu.data_extractors import DataExtractors
+from pipelinedp_tpu.ops import encoding
+
+
+@dataclasses.dataclass
+class PreAggregates:
+    """Per-(privacy_id, partition) group columns, all of equal length G.
+
+    pk_ids: dense partition id of each group.
+    counts: number of contributions in the group.
+    sums: sum of contributed values in the group.
+    n_partitions: number of distinct partitions the group's privacy id
+      contributes to (the L0 load of that privacy id).
+    pk_vocab: id -> partition key.
+    """
+    pk_ids: np.ndarray
+    counts: np.ndarray
+    sums: np.ndarray
+    n_partitions: np.ndarray
+    pk_vocab: encoding.Vocabulary
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.pk_ids)
+
+
+def preaggregate_columns(pid: np.ndarray, pk: np.ndarray, value: np.ndarray,
+                         pk_vocab: encoding.Vocabulary) -> PreAggregates:
+    """Groups encoded columns by (pid, pk) with one lexsort + reduceat."""
+    n = len(pid)
+    if n == 0:
+        empty = np.zeros(0)
+        return PreAggregates(empty.astype(np.int32), empty, empty,
+                             empty.astype(np.int32), pk_vocab)
+    order = np.lexsort((pk, pid))
+    spid, spk, sval = pid[order], pk[order], value[order]
+    is_start = np.empty(n, dtype=bool)
+    is_start[0] = True
+    np.not_equal(spid[1:], spid[:-1], out=is_start[1:])
+    is_start[1:] |= spk[1:] != spk[:-1]
+    starts = np.flatnonzero(is_start)
+    counts = np.diff(np.append(starts, n)).astype(np.float64)
+    sums = np.add.reduceat(sval.astype(np.float64), starts)
+    g_pid = spid[starts]
+    g_pk = spk[starts]
+    # Distinct partitions per privacy id, broadcast back onto the groups.
+    pid_start = np.empty(len(g_pid), dtype=bool)
+    pid_start[0] = True
+    np.not_equal(g_pid[1:], g_pid[:-1], out=pid_start[1:])
+    pid_group = np.cumsum(pid_start) - 1
+    partitions_per_pid = np.bincount(pid_group)
+    n_partitions = partitions_per_pid[pid_group].astype(np.int32)
+    return PreAggregates(g_pk.astype(np.int32), counts, sums, n_partitions,
+                         pk_vocab)
+
+
+def sample_partitions(pre: PreAggregates,
+                      sampling_prob: float) -> PreAggregates:
+    """Deterministic partition subsampling (ValueSampler keyed by partition
+    key): every group of a sampled-out partition is removed."""
+    if sampling_prob >= 1:
+        return pre
+    sampler = sampling_utils.ValueSampler(sampling_prob)
+    keep_by_id = np.fromiter(
+        (sampler.keep(pre.pk_vocab.decode(i)) for i in range(
+            len(pre.pk_vocab))),
+        dtype=bool,
+        count=len(pre.pk_vocab))
+    keep = keep_by_id[pre.pk_ids]
+    return PreAggregates(pre.pk_ids[keep], pre.counts[keep], pre.sums[keep],
+                         pre.n_partitions[keep], pre.pk_vocab)
+
+
+def preaggregate_from_rows(col,
+                           data_extractors: DataExtractors,
+                           public_partitions=None) -> PreAggregates:
+    """Encodes rows/ColumnarData and groups them (the analyze entry path)."""
+    pid, pk, value, _, pk_vocab = encoding.encode_rows(
+        col,
+        getattr(data_extractors, "privacy_id_extractor", True),
+        getattr(data_extractors, "partition_extractor", None),
+        getattr(data_extractors, "value_extractor", None),
+        public_partitions=public_partitions)
+    return preaggregate_columns(pid, pk, value, pk_vocab)
+
+
+def preaggregates_from_pre_aggregated_rows(col,
+                                           partition_extractor,
+                                           preaggregate_extractor,
+                                           public_partitions=None
+                                           ) -> PreAggregates:
+    """Builds PreAggregates from rows that are already
+    (partition_key, (count, sum, n_partitions)) shaped (the
+    pre_aggregated_data mode; extractors per PreAggregateExtractors)."""
+    rows = list(col)
+    pk_col = encoding._column_from_list(
+        [partition_extractor(row) for row in rows])
+    data = [preaggregate_extractor(row) for row in rows]
+    counts = np.asarray([d[0] for d in data], dtype=np.float64)
+    sums = np.asarray([d[1] for d in data], dtype=np.float64)
+    n_partitions = np.asarray([d[2] for d in data], dtype=np.int32)
+    if public_partitions is not None:
+        pk_vocab = encoding.Vocabulary(public_partitions)
+        pk_ids = encoding._lookup_ids(pk_col, pk_vocab)
+        keep = pk_ids >= 0
+        return PreAggregates(pk_ids[keep], counts[keep], sums[keep],
+                             n_partitions[keep], pk_vocab)
+    pk_ids, uniques = encoding._factorize(pk_col)
+    return PreAggregates(pk_ids, counts, sums, n_partitions,
+                         encoding.Vocabulary.from_unique(uniques))
+
+
+def preaggregate(col,
+                 backend=None,
+                 data_extractors: Optional[DataExtractors] = None,
+                 partitions_sampling_prob: float = 1
+                 ) -> List[Tuple[Any, Tuple[int, float, int]]]:
+    """Materializes (partition_key, (count, sum, n_partitions)) rows.
+
+    API parity with analysis/pre_aggregation.py:19-61 — the output can be
+    fed back through PreAggregateExtractors for repeated analysis runs.
+    ``backend`` is accepted for signature compatibility and ignored: the
+    computation is columnar.
+    """
+    del backend
+    pre = preaggregate_from_rows(col, data_extractors)
+    pre = sample_partitions(pre, partitions_sampling_prob)
+    keys = pre.pk_vocab.decode_all(pre.pk_ids)
+    return [(keys[i], (int(pre.counts[i]), float(pre.sums[i]),
+                       int(pre.n_partitions[i])))
+            for i in range(pre.num_groups)]

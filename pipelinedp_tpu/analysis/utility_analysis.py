@@ -1,0 +1,67 @@
+"""Top-level utility-analysis orchestration.
+
+Parity: analysis/utility_analysis.py:42-145 (perform_utility_analysis
+returning (UtilityReports, per-partition metrics)); the packing /
+unnesting / combine-per-key dataflow of the reference collapses into
+direct vectorized reductions over the analysis arrays
+(cross_partition.build_reports_with_histogram).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple, Union
+
+from pipelinedp_tpu.data_extractors import (DataExtractors,
+                                            PreAggregateExtractors)
+from pipelinedp_tpu.analysis import data_structures
+from pipelinedp_tpu.analysis import cross_partition
+from pipelinedp_tpu.analysis import metrics as metrics_lib
+from pipelinedp_tpu.analysis import per_partition
+from pipelinedp_tpu.analysis import utility_analysis_engine
+
+BUCKET_BOUNDS = cross_partition.BUCKET_BOUNDS
+
+
+def perform_utility_analysis(
+    col,
+    backend=None,
+    options: data_structures.UtilityAnalysisOptions = None,
+    data_extractors: Union[DataExtractors, PreAggregateExtractors] = None,
+    public_partitions=None,
+) -> Tuple[List[metrics_lib.UtilityReport], List[Tuple[Tuple[
+        Any, int], metrics_lib.PerPartitionMetrics]]]:
+    """Runs utility analysis for every parameter configuration.
+
+    Returns:
+      (utility_reports, per_partition_result):
+        utility_reports — one UtilityReport per configuration, with the
+          report-by-partition-size histogram attached;
+        per_partition_result — ((partition_key, configuration_index),
+          PerPartitionMetrics) for every partition and configuration.
+      ``backend`` is accepted for signature parity and ignored (execution
+      is columnar).
+    """
+    del backend
+    engine = utility_analysis_engine.UtilityAnalysisEngine()
+    analysis_result = engine.analyze(col, options, data_extractors,
+                                     public_partitions)
+    is_public = public_partitions is not None
+    metrics = [
+        m for m in per_partition.METRIC_ORDER
+        if m in (options.aggregate_params.metrics or [])
+    ]
+    reports = cross_partition.build_reports_with_histogram(
+        analysis_result.arrays, metrics, is_public)
+    if not is_public:
+        strategies = data_structures.get_partition_selection_strategy(options)
+        for report in reports:
+            strategy = strategies[report.configuration_index]
+            report.partitions_info.strategy = strategy
+            for bin_ in report.utility_report_histogram or []:
+                bin_.report.partitions_info.strategy = strategy
+
+    per_partition_result = []
+    for pk, per_config in analysis_result:
+        for c, ppm in enumerate(per_config):
+            per_partition_result.append(((pk, c), ppm))
+    return reports, per_partition_result

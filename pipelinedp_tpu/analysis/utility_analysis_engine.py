@@ -1,0 +1,149 @@
+"""UtilityAnalysisEngine: per-partition utility analysis, vectorized.
+
+API parity with the reference engine (analysis/utility_analysis_engine
+.py:29-185: analyze() takes UtilityAnalysisOptions + extractors + optional
+public partitions and yields per-partition error estimates), but the
+execution model is columnar: one pre-aggregation pass over the data, then
+the whole multi-parameter sweep as array math on a
+[n_configurations, n_partitions] grid (per_partition.py) — no per-row
+combiner objects and no deep-copied accumulator graphs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, List, Optional, Tuple, Union
+
+import numpy as np
+
+from pipelinedp_tpu.aggregate_params import Metrics
+from pipelinedp_tpu.data_extractors import (DataExtractors,
+                                            PreAggregateExtractors)
+from pipelinedp_tpu.analysis import data_structures
+from pipelinedp_tpu.analysis import metrics as metrics_lib
+from pipelinedp_tpu.analysis import per_partition
+from pipelinedp_tpu.analysis import pre_aggregation
+
+_SUPPORTED_METRICS = {Metrics.COUNT, Metrics.SUM, Metrics.PRIVACY_ID_COUNT}
+
+
+class AnalysisResult:
+    """Result of analyze(): per-partition metrics for every configuration.
+
+    Iterating yields (partition_key, Tuple[PerPartitionMetrics]) with one
+    entry per configuration. `arrays` exposes the underlying
+    [n_configurations, n_partitions] error grids for vectorized consumers
+    (utility_analysis.py aggregates straight from them).
+    """
+
+    def __init__(self, arrays: per_partition.PerPartitionArrays, pk_vocab,
+                 ordered_metrics, public_partitions: bool):
+        self.arrays = arrays
+        self.pk_vocab = pk_vocab
+        self.ordered_metrics = ordered_metrics
+        self.public_partitions = public_partitions
+
+    def per_partition_metrics(
+            self, p: int) -> Tuple[metrics_lib.PerPartitionMetrics, ...]:
+        arrays = self.arrays
+        result = []
+        for c in range(arrays.n_configs):
+            keep = (1.0 if arrays.keep_prob is None else float(
+                arrays.keep_prob[c, p]))
+            errors = [
+                metrics_lib.SumMetrics(
+                    aggregation=err.metric,
+                    sum=float(err.raw[c, p]),
+                    clipping_to_min_error=float(err.clip_min_err[c, p]),
+                    clipping_to_max_error=float(err.clip_max_err[c, p]),
+                    expected_l0_bounding_error=float(err.exp_l0_err[c, p]),
+                    std_l0_bounding_error=float(
+                        np.sqrt(err.var_l0_err[c, p])),
+                    std_noise=float(err.std_noise[c]),
+                    noise_kind=err.noise_kind[c])
+                for err in arrays.metric_errors
+            ]
+            result.append(
+                metrics_lib.PerPartitionMetrics(
+                    partition_selection_probability_to_keep=keep,
+                    raw_statistics=metrics_lib.RawStatistics(
+                        privacy_id_count=int(arrays.raw_pid_count[p]),
+                        count=int(arrays.raw_count[p])),
+                    metric_errors=errors))
+        return tuple(result)
+
+    def __iter__(
+        self
+    ) -> Iterator[Tuple[Any, Tuple[metrics_lib.PerPartitionMetrics, ...]]]:
+        for p in range(self.arrays.n_partitions):
+            if p < len(self.pk_vocab):
+                yield self.pk_vocab.decode(p), self.per_partition_metrics(p)
+
+
+class UtilityAnalysisEngine:
+    """Computes error estimates (not DP results) for DP aggregations."""
+
+    def __init__(self, budget_accountant=None, backend=None):
+        # Accepted for signature parity; the analysis splits budgets with
+        # per-configuration accountants (per_partition.resolve_config_budgets)
+        # and executes columnar, so neither is used.
+        del budget_accountant, backend
+
+    def aggregate(self, *args, **kwargs):
+        raise ValueError(
+            "UtilityAnalysisEngine computes error estimates, not DP results: "
+            "call analyze(); for DP aggregation use DPEngine/JaxDPEngine.")
+
+    def analyze(self,
+                col,
+                options: data_structures.UtilityAnalysisOptions,
+                data_extractors: Union[DataExtractors,
+                                       PreAggregateExtractors],
+                public_partitions: Optional[List[Any]] = None
+                ) -> AnalysisResult:
+        """Per-partition utility analysis over every configuration."""
+        _check_analyze_params(options, data_extractors)
+        is_public = public_partitions is not None
+        if options.pre_aggregated_data:
+            pre = pre_aggregation.preaggregates_from_pre_aggregated_rows(
+                col, data_extractors.partition_extractor,
+                data_extractors.preaggregate_extractor, public_partitions)
+        else:
+            pre = pre_aggregation.preaggregate_from_rows(
+                col, data_extractors, public_partitions)
+        pre = pre_aggregation.sample_partitions(
+            pre, options.partitions_sampling_prob)
+        configs = per_partition.resolve_config_budgets(options, is_public)
+        metrics = options.aggregate_params.metrics or []
+        ordered = [m for m in per_partition.METRIC_ORDER if m in metrics]
+        arrays = per_partition.compute_per_partition_arrays(
+            pre, configs, metrics, is_public,
+            n_partitions=max(len(pre.pk_vocab), 1))
+        return AnalysisResult(arrays, pre.pk_vocab, ordered, is_public)
+
+
+def _check_analyze_params(
+        options: data_structures.UtilityAnalysisOptions,
+        data_extractors: Union[DataExtractors, PreAggregateExtractors]):
+    if options.pre_aggregated_data:
+        if not isinstance(data_extractors, PreAggregateExtractors):
+            raise ValueError(
+                "pre_aggregated_data=True requires PreAggregateExtractors.")
+    elif not isinstance(data_extractors,
+                        (DataExtractors,)) and data_extractors is not None:
+        raise ValueError("DataExtractors required for raw data.")
+    params = options.aggregate_params
+    if params.custom_combiners is not None:
+        raise NotImplementedError(
+            "Utility analysis of custom combiners is not supported.")
+    unsupported = set(params.metrics or []) - _SUPPORTED_METRICS
+    if unsupported:
+        raise NotImplementedError(
+            f"Utility analysis does not support metrics {unsupported}.")
+    if params.contribution_bounds_already_enforced:
+        raise NotImplementedError(
+            "Utility analysis with contribution_bounds_already_enforced is "
+            "not supported.")
+    if params.post_aggregation_thresholding:
+        raise NotImplementedError(
+            "Utility analysis with post_aggregation_thresholding is not "
+            "supported.")

@@ -160,12 +160,15 @@ class JaxDPEngine:
     def aggregate(self,
                   col,
                   params: AggregateParams,
-                  data_extractors: DataExtractors,
+                  data_extractors: Optional[DataExtractors] = None,
                   public_partitions: Optional[Sequence[Any]] = None,
                   out_explain_computation_report: Optional[
                       ExplainComputationReport] = None) -> LazyJaxResult:
+        is_columnar = isinstance(
+            col, (encoding.ColumnarData, encoding.EncodedColumns))
         dp_engine_lib.DPEngine._check_aggregate_params(
-            self, col, params, data_extractors)
+            self, col, params, data_extractors,
+            check_data_extractors=not is_columnar)
         dp_engine_lib.DPEngine._check_budget_accountant_compatibility(
             self, public_partitions is not None, params.metrics,
             params.custom_combiners is not None)
@@ -207,14 +210,17 @@ class JaxDPEngine:
         # Host-side columnar encoding (the extract + public-filter stages).
         # With contribution_bounds_already_enforced each row is its own
         # privacy unit and no bounding is applied (parity: dp_engine.py:122).
-        pid_extractor = data_extractors.privacy_id_extractor
+        # Columnar inputs carry their own pid column; any non-None marker
+        # tells encode_rows to use it.
+        pid_extractor = (data_extractors.privacy_id_extractor
+                         if data_extractors is not None else True)
         if params.contribution_bounds_already_enforced:
             pid_extractor = None  # encode_rows assigns a unique id per row
         pid, pk, value, pid_vocab, pk_vocab = encoding.encode_rows(
             col,
             pid_extractor,
-            data_extractors.partition_extractor,
-            data_extractors.value_extractor,
+            data_extractors.partition_extractor if data_extractors else None,
+            data_extractors.value_extractor if data_extractors else None,
             public_partitions=public_partitions,
             vector_size=params.vector_size if is_vector else None)
         num_partitions = max(len(pk_vocab), 1)

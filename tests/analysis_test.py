@@ -1,0 +1,393 @@
+"""Tests for the vectorized utility-analysis layer.
+
+Mirrors the reference's analysis/tests strategy: per-partition error
+models pinned against hand-computed values, exact Poisson-binomial
+cross-checks, tolerance-compared report dataclasses, and an e2e tune() on
+movie-view-shaped data."""
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+import pipelinedp_tpu.analysis as analysis
+from pipelinedp_tpu import partition_selection as ps_lib
+from pipelinedp_tpu.analysis import (cross_partition, per_partition,
+                                     poisson_binomial, pre_aggregation)
+from pipelinedp_tpu.dataset_histograms import computing_histograms
+
+
+def extractors():
+    return pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                              partition_extractor=lambda r: r[1],
+                              value_extractor=lambda r: r[2])
+
+
+def count_params(l0=1, linf=1, **kwargs):
+    return pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                               max_partitions_contributed=l0,
+                               max_contributions_per_partition=linf,
+                               **kwargs)
+
+
+class TestPoissonBinomial:
+
+    def test_exact_pmf_two_bernoullis(self):
+        pmf = poisson_binomial.compute_pmf([0.5, 0.5])
+        np.testing.assert_allclose(pmf.probabilities, [0.25, 0.5, 0.25])
+
+    def test_exact_pmf_sums_to_one(self):
+        rng = np.random.default_rng(0)
+        pmf = poisson_binomial.compute_pmf(rng.uniform(0, 1, 30))
+        assert pmf.probabilities.sum() == pytest.approx(1.0)
+
+    def test_approximation_close_to_exact(self):
+        rng = np.random.default_rng(1)
+        probs = rng.uniform(0.3, 0.9, 80)
+        exact = poisson_binomial.compute_pmf(probs)
+        exp, std, skew = poisson_binomial.compute_exp_std_skewness(probs)
+        approx = poisson_binomial.compute_pmf_approximation(
+            exp, std, skew, len(probs))
+        # Compare on the approximation's support.
+        exact_slice = exact.probabilities[approx.start:approx.start +
+                                          len(approx.probabilities)]
+        np.testing.assert_allclose(approx.probabilities, exact_slice,
+                                   atol=2e-3)
+
+
+class TestPreAggregation:
+
+    def test_groups_and_n_partitions(self):
+        # user 1 -> pk a (2 contributions), pk b (1); user 2 -> pk a (1).
+        rows = [(1, "a", 1.0), (1, "a", 2.0), (1, "b", 3.0), (2, "a", 4.0)]
+        result = analysis.preaggregate(rows, data_extractors=extractors())
+        as_dict = {}
+        for pk, (count, s, n_part) in result:
+            as_dict.setdefault(pk, []).append((count, s, n_part))
+        assert sorted(as_dict["a"]) == [(1, 4.0, 1), (2, 3.0, 2)]
+        assert as_dict["b"] == [(1, 3.0, 2)]
+
+    def test_partition_sampling_deterministic(self):
+        rows = [(u, f"pk{u % 50}", 1.0) for u in range(500)]
+        r1 = analysis.preaggregate(rows, data_extractors=extractors(),
+                                   partitions_sampling_prob=0.5)
+        r2 = analysis.preaggregate(rows, data_extractors=extractors(),
+                                   partitions_sampling_prob=0.5)
+        assert [pk for pk, _ in r1] == [pk for pk, _ in r2]
+        kept = {pk for pk, _ in r1}
+        assert 0 < len(kept) < 50
+
+
+class TestPerPartitionErrorModel:
+
+    def _analyze(self, rows, params, eps=1.0, delta=1e-6, public=None,
+                 multi=None):
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=eps, delta=delta, aggregate_params=params,
+            multi_param_configuration=multi)
+        engine = analysis.UtilityAnalysisEngine()
+        return engine.analyze(rows, options, extractors(),
+                              public_partitions=public)
+
+    def test_count_clipping_and_l0_errors(self):
+        # One user contributes 5 rows to "a" and 1 row to "b"; linf=3, l0=1.
+        rows = [(1, "a", 0.0)] * 5 + [(1, "b", 0.0)]
+        result = self._analyze(rows, count_params(l0=1, linf=3),
+                               public=["a", "b"])
+        per_pk = dict(result)
+        err_a = per_pk["a"][0].metric_errors[0]
+        assert err_a.sum == 5.0
+        # count 5 clipped to 3: clipping_to_max_error = -2.
+        assert err_a.clipping_to_max_error == pytest.approx(-2.0)
+        # q = 1/2 (2 partitions, l0=1): E[l0 err] = -3 * 0.5.
+        assert err_a.expected_l0_bounding_error == pytest.approx(-1.5)
+        # Var = 3^2 * 0.25.
+        assert err_a.std_l0_bounding_error == pytest.approx(1.5)
+
+    def test_count_noise_std_matches_mechanism(self):
+        rows = [(1, "a", 0.0)]
+        result = self._analyze(rows, count_params(l0=2, linf=3),
+                               eps=2.0, delta=1e-8, public=["a"])
+        err = dict(result)["a"][0].metric_errors[0]
+        # All budget to COUNT (public partitions, one metric): Laplace
+        # b = l0*linf/eps, std = sqrt(2) b.
+        expected = np.sqrt(2.0) * 2 * 3 / 2.0
+        assert err.std_noise == pytest.approx(expected)
+
+    def test_sum_clipping(self):
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.SUM],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1,
+                                     min_sum_per_partition=0.0,
+                                     max_sum_per_partition=2.0)
+        rows = [(1, "a", 5.0), (2, "a", -1.0)]
+        result = self._analyze(rows, params, public=["a"])
+        err = dict(result)["a"][0].metric_errors[0]
+        assert err.sum == 4.0
+        assert err.clipping_to_max_error == pytest.approx(-3.0)
+        assert err.clipping_to_min_error == pytest.approx(1.0)
+
+    def test_keep_probability_exact_matches_strategy(self):
+        # 20 users, each contributing to exactly this partition (q=1):
+        # the keep probability equals the strategy's probability_of_keep(20).
+        rows = [(u, "a", 0.0) for u in range(20)]
+        result = self._analyze(rows, count_params(), eps=1.0, delta=1e-4)
+        ppm = dict(result)["a"][0]
+        # Budget split: eps halved between GENERIC selection and COUNT;
+        # Laplace COUNT consumes no delta, so selection gets all of it.
+        strategy = ps_lib.create_partition_selection_strategy(
+            pdp.PartitionSelectionStrategy.TRUNCATED_GEOMETRIC, 0.5, 1e-4, 1)
+        assert ppm.partition_selection_probability_to_keep == pytest.approx(
+            strategy.probability_of_keep(20), rel=1e-6)
+
+    def test_keep_probability_approx_matches_exact(self):
+        # 150 users (above the exact cutoff) with q=1: approximation must
+        # agree with the exact strategy value.
+        rows = [(u, "a", 0.0) for u in range(150)]
+        result = self._analyze(rows, count_params(), eps=1.0, delta=1e-4)
+        ppm = dict(result)["a"][0]
+        strategy = ps_lib.create_partition_selection_strategy(
+            pdp.PartitionSelectionStrategy.TRUNCATED_GEOMETRIC, 0.5, 5e-5, 1)
+        assert ppm.partition_selection_probability_to_keep == pytest.approx(
+            strategy.probability_of_keep(150), rel=1e-3)
+
+    def test_multi_config_sweep_shapes(self):
+        rows = [(u, f"pk{u % 3}", 1.0) for u in range(30)]
+        multi = analysis.MultiParameterConfiguration(
+            max_partitions_contributed=[1, 2, 3],
+            max_contributions_per_partition=[1, 1, 2])
+        result = self._analyze(rows, count_params(), multi=multi)
+        arrays = result.arrays
+        assert arrays.n_configs == 3
+        assert arrays.metric_errors[0].raw.shape == (3, 3)
+        per_config = dict(result)["pk0"]
+        assert len(per_config) == 3
+
+    def test_raw_statistics(self):
+        rows = [(1, "a", 0.0), (1, "a", 0.0), (2, "a", 0.0)]
+        result = self._analyze(rows, count_params(), public=["a"])
+        stats = dict(result)["a"][0].raw_statistics
+        assert stats.privacy_id_count == 2
+        assert stats.count == 3
+
+
+class TestPerformUtilityAnalysis:
+
+    def test_public_report_averaging(self):
+        # Two partitions, both kept (public): report averages per-partition
+        # errors equally.
+        rows = ([(u, "a", 0.0) for u in range(4)] +
+                [(u + 100, "b", 0.0) for u in range(2)])
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=1.0, delta=1e-6, aggregate_params=count_params())
+        reports, per_partition_result = analysis.perform_utility_analysis(
+            rows, options=options, data_extractors=extractors(),
+            public_partitions=["a", "b"])
+        assert len(reports) == 1
+        report = reports[0]
+        assert report.partitions_info.public_partitions
+        assert report.partitions_info.num_dataset_partitions == 2
+        err = report.metric_errors[0]
+        # No clipping/l0 error (l0=1 but each user contributes to exactly 1
+        # partition): bias 0, variance = noise^2, rmse = noise std.
+        assert err.absolute_error.mean == pytest.approx(0.0)
+        assert err.absolute_error.rmse == pytest.approx(err.noise_std)
+        # ((pk, config), PerPartitionMetrics) entries: 2 partitions x 1 cfg.
+        assert len(per_partition_result) == 2
+
+    def test_private_report_weighted_by_keep_prob(self):
+        rows = ([(u, "big", 0.0) for u in range(1000)] +
+                [(1, "small", 0.0)])
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=1.0, delta=1e-4, aggregate_params=count_params())
+        reports, _ = analysis.perform_utility_analysis(
+            rows, options=options, data_extractors=extractors())
+        info = reports[0].partitions_info
+        assert not info.public_partitions
+        assert info.num_dataset_partitions == 2
+        # big is kept ~surely, small ~never.
+        assert info.kept_partitions.mean == pytest.approx(1.0, abs=0.05)
+        assert info.strategy is not None
+
+    def test_histogram_buckets(self):
+        sizes = np.array([0, 1, 5, 10, 20, 50, 100, 999])
+        buckets = cross_partition.partition_size_buckets(sizes)
+        assert list(buckets) == [0, 1, 1, 10, 20, 50, 100, 500]
+        assert cross_partition.bucket_upper_bound(10) == 20
+
+
+class TestDPStrategySelector:
+
+    def test_gaussian_wins_for_large_l0(self):
+        selector = analysis.DPStrategySelector(
+            epsilon=1.0, delta=1e-6, metric=pdp.Metrics.COUNT,
+            is_public_partitions=True)
+        import pipelinedp_tpu.dp_computations as dp_computations
+        strategy = selector.get_dp_strategy(
+            dp_computations.Sensitivities(l0=100, linf=1))
+        assert strategy.noise_kind == pdp.NoiseKind.GAUSSIAN
+
+    def test_laplace_wins_for_small_l0(self):
+        selector = analysis.DPStrategySelector(
+            epsilon=1.0, delta=1e-6, metric=pdp.Metrics.COUNT,
+            is_public_partitions=True)
+        import pipelinedp_tpu.dp_computations as dp_computations
+        strategy = selector.get_dp_strategy(
+            dp_computations.Sensitivities(l0=1, linf=1))
+        assert strategy.noise_kind == pdp.NoiseKind.LAPLACE
+
+    def test_privacy_id_count_uses_post_aggregation_thresholding(self):
+        selector = analysis.DPStrategySelector(
+            epsilon=1.0, delta=1e-6, metric=pdp.Metrics.PRIVACY_ID_COUNT,
+            is_public_partitions=False)
+        import pipelinedp_tpu.dp_computations as dp_computations
+        strategy = selector.get_dp_strategy(
+            dp_computations.Sensitivities(l0=10, linf=1))
+        assert strategy.post_aggregation_thresholding
+        assert strategy.partition_selection_strategy is not None
+
+    def test_select_partitions_case(self):
+        selector = analysis.DPStrategySelector(epsilon=1.0, delta=1e-6,
+                                               metric=None,
+                                               is_public_partitions=False)
+        import pipelinedp_tpu.dp_computations as dp_computations
+        strategy = selector.get_dp_strategy(
+            dp_computations.Sensitivities(l0=5, linf=1))
+        assert strategy.noise_kind is None
+        assert strategy.partition_selection_strategy is not None
+
+
+class TestTune:
+
+    def _movie_shaped_rows(self, n_users=400, n_movies=40, seed=0):
+        rng = np.random.default_rng(seed)
+        rows = []
+        for u in range(n_users):
+            n_watched = 1 + rng.integers(0, 8)
+            movies = rng.choice(n_movies, size=min(n_watched, n_movies),
+                                replace=False)
+            for m in movies:
+                rows.append((u, int(m), float(rng.integers(1, 6))))
+        return rows
+
+    def test_tune_count_returns_rmse_ranked_result(self):
+        rows = self._movie_shaped_rows()
+        histograms = list(computing_histograms.compute_dataset_histograms(
+            rows, extractors(), pdp.LocalBackend()))[0]
+        options = analysis.TuneOptions(
+            epsilon=1.0,
+            delta=1e-6,
+            aggregate_params=count_params(l0=1, linf=1),
+            function_to_minimize=analysis.MinimizingFunction.ABSOLUTE_ERROR,
+            parameters_to_tune=analysis.ParametersToTune(
+                max_partitions_contributed=True,
+                max_contributions_per_partition=True),
+            number_of_parameter_candidates=16)
+        result, per_partition_result = analysis.tune(
+            rows, contribution_histograms=histograms, options=options,
+            data_extractors=extractors())
+        assert isinstance(result, analysis.TuneResult)
+        candidates = result.utility_analysis_parameters
+        assert candidates.size <= 16
+        assert len(result.utility_reports) == candidates.size
+        assert 0 <= result.index_best < candidates.size
+        # Reports carry RMSE; best really is the argmin.
+        rmse = [r.metric_errors[0].absolute_error.rmse
+                for r in result.utility_reports]
+        assert result.index_best == int(np.argmin(rmse))
+        # Strategies were attached per candidate.
+        assert len(candidates.noise_kind) == candidates.size
+        assert len(candidates.partition_selection_strategy) == candidates.size
+        assert per_partition_result
+
+    def test_tune_sum(self):
+        rows = self._movie_shaped_rows()
+        histograms = list(computing_histograms.compute_dataset_histograms(
+            rows, extractors(), pdp.LocalBackend()))[0]
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.SUM],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1,
+                                     min_sum_per_partition=0.0,
+                                     max_sum_per_partition=1.0)
+        options = analysis.TuneOptions(
+            epsilon=1.0,
+            delta=1e-6,
+            aggregate_params=params,
+            function_to_minimize=analysis.MinimizingFunction.ABSOLUTE_ERROR,
+            parameters_to_tune=analysis.ParametersToTune(
+                max_partitions_contributed=True,
+                max_sum_per_partition=True),
+            number_of_parameter_candidates=9)
+        result, _ = analysis.tune(rows, contribution_histograms=histograms,
+                                  options=options,
+                                  data_extractors=extractors())
+        assert result.index_best >= 0
+        best = result.utility_analysis_parameters.get_aggregate_params(
+            params, result.index_best)
+        assert best.max_sum_per_partition > 0
+
+    def test_tune_rejects_two_metrics(self):
+        options_kwargs = dict(
+            epsilon=1.0, delta=1e-6,
+            function_to_minimize=analysis.MinimizingFunction.ABSOLUTE_ERROR,
+            parameters_to_tune=analysis.ParametersToTune(
+                max_partitions_contributed=True))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            min_value=0, max_value=1)
+        with pytest.raises(ValueError, match="one metric"):
+            analysis.tune(
+                [], contribution_histograms=None,
+                options=analysis.TuneOptions(aggregate_params=params,
+                                             **options_kwargs),
+                data_extractors=extractors())
+
+
+class TestCandidateGeneration:
+
+    def test_constant_relative_step(self):
+        from pipelinedp_tpu.dataset_histograms import histograms as h
+        bins = [h.FrequencyBin(1, 2, 10, 5, 1), h.FrequencyBin(
+            99, 100, 3, 1, 100)]
+        hist = h.Histogram(h.HistogramType.L0_CONTRIBUTIONS, bins)
+        candidates = analysis.parameter_tuning.\
+            candidates_constant_relative_step(hist, 5)
+        assert candidates[0] == 1
+        assert candidates[-1] == 100
+        assert candidates == sorted(set(candidates))
+
+    def test_2d_grid_size(self):
+        from pipelinedp_tpu.analysis.parameter_tuning import candidates_2d_grid
+        fn = lambda hist, k: list(range(1, k + 1))
+        g1, g2 = candidates_2d_grid(None, None, fn, fn, 16)
+        assert len(g1) == len(g2) == 16
+
+
+class TestDatasetSummary:
+
+    def test_overlap_counts(self):
+        rows = [(1, "a", 0.0), (2, "b", 0.0), (3, "c", 0.0)]
+        summary = analysis.compute_public_partitions_summary(
+            rows, extractors=extractors(),
+            public_partitions=["a", "b", "zzz"])
+        assert summary.num_dataset_public_partitions == 2
+        assert summary.num_dataset_non_public_partitions == 1
+        assert summary.num_empty_public_partitions == 1
+
+
+class TestMultiParameterConfiguration:
+
+    def test_size_validation(self):
+        with pytest.raises(ValueError, match="same length"):
+            analysis.MultiParameterConfiguration(
+                max_partitions_contributed=[1, 2],
+                max_contributions_per_partition=[1])
+
+    def test_get_aggregate_params(self):
+        config = analysis.MultiParameterConfiguration(
+            max_partitions_contributed=[1, 5],
+            noise_kind=[pdp.NoiseKind.LAPLACE, pdp.NoiseKind.GAUSSIAN])
+        params = config.get_aggregate_params(count_params(), 1)
+        assert params.max_partitions_contributed == 5
+        assert params.noise_kind == pdp.NoiseKind.GAUSSIAN

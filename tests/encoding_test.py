@@ -1,0 +1,206 @@
+"""Tests for vectorized host encoding (ops/encoding.py)."""
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu.ops import encoding
+
+
+class TestFactorize:
+
+    def test_int_keys(self):
+        ids, uniques = encoding._factorize(np.array([5, 3, 5, 9, 3]))
+        assert list(uniques[ids]) == [5, 3, 5, 9, 3]
+        assert len(uniques) == 3
+
+    def test_string_keys(self):
+        ids, uniques = encoding._factorize(np.array(["b", "a", "b"]))
+        assert list(uniques[ids]) == ["b", "a", "b"]
+
+    def test_object_keys(self):
+        col = np.empty(3, dtype=object)
+        col[:] = [("x", 1), ("y", 2), ("x", 1)]
+        ids, uniques = encoding._factorize(col)
+        assert ids[0] == ids[2] != ids[1]
+        assert uniques[ids[1]] == ("y", 2)
+
+
+class TestEncodeRows:
+
+    def test_matches_per_row_semantics(self):
+        rows = [(u, f"pk{u % 7}", float(u)) for u in range(1000)]
+        pid, pk, value, pid_vocab, pk_vocab = encoding.encode_rows(
+            rows, lambda r: r[0], lambda r: r[1], lambda r: r[2])
+        assert len(pid) == 1000
+        # Round trip: decode gives back original keys.
+        for i in (0, 13, 999):
+            assert pk_vocab.decode(int(pk[i])) == rows[i][1]
+            assert pid_vocab.decode(int(pid[i])) == rows[i][0]
+            assert value[i] == pytest.approx(rows[i][2])
+
+    def test_public_partition_filter(self):
+        rows = [(1, "a", 1.0), (2, "b", 2.0), (3, "c", 3.0)]
+        pid, pk, value, _, pk_vocab = encoding.encode_rows(
+            rows, lambda r: r[0], lambda r: r[1], lambda r: r[2],
+            public_partitions=["a", "c", "zzz"])
+        assert len(pid) == 2
+        assert pk_vocab.keys == ["a", "c", "zzz"]
+        decoded = [pk_vocab.decode(int(p)) for p in pk]
+        assert decoded == ["a", "c"]
+
+
+class TestColumnarData:
+
+    def test_raw_columns_equal_rows(self):
+        n = 500
+        rng = np.random.default_rng(0)
+        pids = rng.integers(100, 150, n)
+        pks = rng.integers(0, 11, n)
+        vals = rng.uniform(0, 1, n)
+        rows = list(zip(pids.tolist(), pks.tolist(), vals.tolist()))
+        r1 = encoding.encode_rows(rows, lambda r: r[0], lambda r: r[1],
+                                  lambda r: r[2])
+        r2 = encoding.encode_rows(
+            encoding.ColumnarData(pid=pids, pk=pks, value=vals),
+            lambda r: r[0], lambda r: r[1], lambda r: r[2])
+        # Same grouping structure (vocab order may differ).
+        for (a_pid, a_pk, a_val, _, a_vocab), (b_pid, b_pk, b_val, _,
+                                               b_vocab) in [(r1, r2)]:
+            a_keys = [a_vocab.decode(int(i)) for i in a_pk]
+            b_keys = [b_vocab.decode(int(i)) for i in b_pk]
+            assert a_keys == b_keys
+            np.testing.assert_allclose(a_val, b_val)
+
+    def test_engine_accepts_columnar_without_extractors(self):
+        n = 300
+        rng = np.random.default_rng(1)
+        data = pdp.ColumnarData(pid=rng.integers(0, 50, n),
+                                pk=rng.integers(0, 3, n),
+                                value=rng.uniform(0, 5, n))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=3,
+            max_contributions_per_partition=100,
+            min_value=0, max_value=5)
+        accountant = pdp.NaiveBudgetAccountant(1e8, 1e-15)
+        engine = pdp.JaxDPEngine(accountant)
+        result = engine.aggregate(data, params, public_partitions=[0, 1, 2])
+        accountant.compute_budgets()
+        out = dict(result)
+        raw = np.bincount(np.asarray(data.pk), minlength=3)
+        for k in range(3):
+            assert out[k].count == pytest.approx(raw[k], abs=0.01)
+
+
+class TestEncodedColumns:
+
+    def test_zero_copy_path(self):
+        n = 200
+        rng = np.random.default_rng(2)
+        data = pdp.EncodedColumns(pid=rng.integers(0, 40, n, dtype=np.int32),
+                                  pk=rng.integers(0, 5, n, dtype=np.int32),
+                                  num_partitions=5,
+                                  value=rng.uniform(0, 1, n))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT],
+            max_partitions_contributed=5,
+            max_contributions_per_partition=100)
+        accountant = pdp.NaiveBudgetAccountant(1e8, 1e-15)
+        engine = pdp.JaxDPEngine(accountant)
+        result = engine.aggregate(data, params,
+                                  public_partitions=[0, 1, 2, 3, 4])
+        accountant.compute_budgets()
+        out = dict(result)
+        raw = np.bincount(np.asarray(data.pk), minlength=5)
+        for k in range(5):
+            assert out[k].count == pytest.approx(raw[k], abs=0.01)
+
+    def test_public_filter_drops_non_public_ids(self):
+        data = pdp.EncodedColumns(pid=np.arange(6, dtype=np.int32),
+                                  pk=np.array([0, 1, 2, 0, 1, 2], np.int32),
+                                  num_partitions=3,
+                                  pk_keys=["a", "b", "c"])
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT],
+            max_partitions_contributed=3,
+            max_contributions_per_partition=1)
+        accountant = pdp.NaiveBudgetAccountant(1e8, 1e-15)
+        engine = pdp.JaxDPEngine(accountant)
+        result = engine.aggregate(data, params, public_partitions=["a", "b"])
+        accountant.compute_budgets()
+        out = dict(result)
+        assert set(out) == {"a", "b"}
+        assert out["a"].count == pytest.approx(2, abs=0.01)
+
+
+class TestEncodingThroughput:
+
+    def test_vectorized_encoding_is_fast(self):
+        # 2M rows must encode in well under a second (the round-1 per-row
+        # loop took ~10s at this size).
+        import time
+        n = 2_000_000
+        rng = np.random.default_rng(3)
+        pid = rng.integers(0, 200_000, n)
+        pk = rng.integers(0, 20_000, n)
+        value = rng.uniform(0, 5, n)
+        t0 = time.perf_counter()
+        out = encoding.encode_columns(pid, pk, value)
+        elapsed = time.perf_counter() - t0
+        assert len(out[0]) == n
+        assert elapsed < 2.0
+
+
+class TestCompositeKeys:
+
+    def test_tuple_partition_keys(self):
+        rows = [(1, ("us", 5), 1.0), (2, ("de", 3), 2.0), (3, ("us", 5), 3.0)]
+        pid, pk, value, _, pk_vocab = encoding.encode_rows(
+            rows, lambda r: r[0], lambda r: r[1], lambda r: r[2])
+        assert pk.shape == (3,)
+        assert pk[0] == pk[2] != pk[1]
+        assert pk_vocab.decode(int(pk[0])) == ("us", 5)
+
+    def test_mixed_type_keys_not_coerced(self):
+        rows = [(1, 1, 1.0), (2, "a", 2.0), (3, 1, 3.0)]
+        _, pk, _, _, pk_vocab = encoding.encode_rows(
+            rows, lambda r: r[0], lambda r: r[1], lambda r: r[2])
+        assert pk[0] == pk[2] != pk[1]
+        assert pk_vocab.decode(int(pk[0])) == 1  # stays int, not "1"
+
+
+class TestBoundsAlreadyEnforced:
+
+    def _params(self):
+        return pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                   max_partitions_contributed=2,
+                                   max_contributions_per_partition=1,
+                                   contribution_bounds_already_enforced=True)
+
+    def test_rows_path(self):
+        rows = [("a",), ("a",), ("b",)]
+        accountant = pdp.NaiveBudgetAccountant(1e8, 1e-15)
+        engine = pdp.JaxDPEngine(accountant)
+        result = engine.aggregate(
+            rows, self._params(),
+            pdp.DataExtractors(privacy_id_extractor=lambda r: None,
+                               partition_extractor=lambda r: r[0],
+                               value_extractor=lambda r: 0.0),
+            public_partitions=["a", "b"])
+        accountant.compute_budgets()
+        out = dict(result)
+        assert out["a"].count == pytest.approx(2, abs=0.01)
+
+    def test_columnar_path(self):
+        data = pdp.ColumnarData(pid=np.zeros(3, np.int32),
+                                pk=np.array([0, 0, 1], np.int32),
+                                value=np.zeros(3, np.float32))
+        accountant = pdp.NaiveBudgetAccountant(1e8, 1e-15)
+        engine = pdp.JaxDPEngine(accountant)
+        result = engine.aggregate(data, self._params(),
+                                  public_partitions=[0, 1])
+        accountant.compute_budgets()
+        out = dict(result)
+        # Each row its own unit: both rows of pk 0 counted.
+        assert out[0].count == pytest.approx(2, abs=0.01)
