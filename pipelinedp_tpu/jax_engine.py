@@ -127,17 +127,30 @@ class JaxDPEngine:
     same stance as the reference's PyDP path); tests can reseed the
     fallback RNGs via noise_core.seed_fallback_rng / partition_selection
     .seed_rng.
+
+    mesh: a jax.sharding.Mesh with ('dp', 'mp') axes (see
+    parallel.sharded.make_mesh). When set, the fused bound-and-aggregate
+    kernel runs shard_map'ed over all mesh devices: rows are hash-sharded
+    by privacy id on host (so contribution bounding needs no cross-device
+    exchange), per-partition partials ride an ICI reduce-scatter, and the
+    resulting accumulators stay sharded over the partition dimension — so
+    selection and noise also run distributed under XLA's SPMD partitioner.
+    Every metric and selection strategy works identically on any mesh; this
+    is the framework's replacement for the reference's Beam/Spark cluster
+    execution (pipeline_backend.py:223-474).
     """
 
     def __init__(self,
                  budget_accountant: budget_accounting.BudgetAccountant,
                  seed: int = 0,
-                 secure_host_noise: bool = True):
+                 secure_host_noise: bool = True,
+                 mesh=None):
         self._budget_accountant = budget_accountant
         self._report_generators = []
         self._root_key = jax.random.PRNGKey(seed)
         self._key_counter = 0
         self._secure_host_noise = secure_host_noise
+        self._mesh = mesh
 
     def _next_key(self):
         self._key_counter += 1
@@ -296,7 +309,30 @@ class JaxDPEngine:
                   if params.bounds_per_contribution_are_set else 0.0)
 
         vector_sums = None
-        if is_vector:
+        norm_ord = {NormKind.Linf: 0, NormKind.L1: 1,
+                    NormKind.L2: 2}[params.vector_norm_kind or NormKind.Linf]
+        if self._mesh is not None:
+            from pipelinedp_tpu.parallel import sharded
+            if is_vector:
+                vector_sums, accs = sharded.bound_and_aggregate_vector(
+                    self._mesh, k_kernel, pid, pk, value, valid_rows,
+                    num_partitions=num_partitions,
+                    linf_cap=linf_cap,
+                    l0_cap=l0_cap,
+                    max_norm=params.vector_max_norm,
+                    norm_ord=norm_ord)
+            else:
+                accs = sharded.bound_and_aggregate(
+                    self._mesh, k_kernel, pid, pk, value, valid_rows,
+                    num_partitions=num_partitions,
+                    linf_cap=linf_cap,
+                    l0_cap=l0_cap,
+                    row_clip_lo=row_lo,
+                    row_clip_hi=row_hi,
+                    middle=middle,
+                    group_clip_lo=glo,
+                    group_clip_hi=ghi)
+        elif is_vector:
             vector_sums, accs = columnar.bound_and_aggregate_vector(
                 k_kernel, jnp.asarray(pid), jnp.asarray(pk),
                 jnp.asarray(value), jnp.asarray(valid_rows),
@@ -304,9 +340,7 @@ class JaxDPEngine:
                 linf_cap=linf_cap,
                 l0_cap=l0_cap,
                 max_norm=params.vector_max_norm,
-                norm_ord={NormKind.Linf: 0, NormKind.L1: 1,
-                          NormKind.L2: 2}[params.vector_norm_kind or
-                                          NormKind.Linf])
+                norm_ord=norm_ord)
         else:
             accs = columnar.bound_and_aggregate(
                 k_kernel, jnp.asarray(pid), jnp.asarray(pk),
@@ -320,6 +354,10 @@ class JaxDPEngine:
                 group_clip_lo=glo,
                 group_clip_hi=ghi)
 
+        # On a mesh the accumulators are padded so the partition dimension
+        # shards evenly; all downstream math runs on the padded arrays and
+        # the final columns are trimmed back to num_partitions.
+        num_out = int(accs.pid_count.shape[0])
         partition_exists = accs.pid_count > 0
 
         # Partition selection. The selection strategy's L0 sensitivity is
@@ -327,7 +365,7 @@ class JaxDPEngine:
         # or max_contributions in L1 mode (which caps partitions at the same
         # value — the kernel's l0_cap matches).
         if is_public:
-            keep_mask = jnp.ones(num_partitions, dtype=bool)
+            keep_mask = jnp.arange(num_out) < num_partitions
         elif selection_spec is not None:
             declared_l0 = (params.max_partitions_contributed
                            or params.max_contributions or 1)
@@ -375,10 +413,11 @@ class JaxDPEngine:
                 columns["privacy_id_count"] = noised
 
         # Mask metrics of non-kept partitions: direct consumers of the
-        # columns must not see values partition selection dropped.
-        keep_np = np.asarray(keep_mask)
+        # columns must not see values partition selection dropped. Mesh
+        # padding partitions are trimmed here.
+        keep_np = np.asarray(keep_mask)[:num_partitions]
         for name, col in columns.items():
-            arr = np.asarray(col)
+            arr = np.asarray(col)[:num_partitions]
             mask = keep_np if arr.ndim == 1 else keep_np[:, None]
             columns[name] = np.where(mask, arr, np.nan)
         columns["partition_id"] = np.arange(num_partitions, dtype=np.int32)

@@ -9,30 +9,36 @@ combine_accumulators_per_key, pipeline_backend.py:223-474; SURVEY.md §2.5):
     unit's rows are local to one device and contribution bounding is exact
     without any cross-device exchange;
   * each device runs the fused bound-and-aggregate kernel on its shard,
-    producing per-partition partial accumulators [num_partitions];
-  * partials are combined with `psum_scatter` over 'mp' then 'dp' — the
+    producing per-partition partial accumulators [padded_p];
+  * partials are combined with `psum_scatter` over 'dp' then 'mp' — the
     reduce-scatter rides ICI and leaves every device holding the *full* sum
     for a distinct 1/(dp*mp) slice of the partition space (this is the
     shuffle);
-  * partition selection and noise generation then run fully sharded — every
-    chip noises only its partition slice — and results are all-gathered.
+  * the returned accumulators are global jax.Arrays sharded over the
+    partition dimension, so everything downstream — partition selection,
+    per-mechanism noise, metric math — runs sharded too under XLA's SPMD
+    partitioner without further shard_map plumbing.
 
-The same step compiles for any mesh shape; __graft_entry__.dryrun_multichip
-exercises it on a virtual CPU mesh.
+JaxDPEngine(mesh=...) routes its fused kernel through here; every metric,
+selection strategy, and noise mechanism the engine supports works on any
+mesh shape unchanged. __graft_entry__.dryrun_multichip exercises the full
+engine path on a virtual CPU mesh.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from pipelinedp_tpu.ops import columnar, noise as noise_ops
-from pipelinedp_tpu.ops import selection as selection_ops
+from pipelinedp_tpu.ops import columnar
+
+ROW_SPEC = P(("dp", "mp"))
+PART_SPEC = P(("dp", "mp"))
 
 
 def make_mesh(n_devices: Optional[int] = None,
@@ -59,8 +65,17 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(np.asarray(devices[:n]).reshape(dp, mp), ("dp", "mp"))
 
 
-def shard_rows_by_pid(pid: np.ndarray, pk: np.ndarray, value: np.ndarray,
-                      n_shards: int
+def padded_num_partitions(mesh: Mesh, num_partitions: int) -> int:
+    """num_partitions rounded up so the partition dim shards evenly."""
+    n_dev = mesh.devices.size
+    return ((num_partitions + n_dev - 1) // n_dev) * n_dev
+
+
+def shard_rows_by_pid(pid: np.ndarray,
+                      pk: np.ndarray,
+                      value: np.ndarray,
+                      n_shards: int,
+                      valid: Optional[np.ndarray] = None
                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
                                  np.ndarray]:
     """Host-side loader step: hash-shard rows by privacy id and pad shards
@@ -73,6 +88,8 @@ def shard_rows_by_pid(pid: np.ndarray, pk: np.ndarray, value: np.ndarray,
     shard_of_row = pid % n_shards
     order = np.argsort(shard_of_row, kind="stable")
     pid, pk, value = pid[order], pk[order], value[order]
+    valid = (np.ones(len(pid), dtype=bool)
+             if valid is None else np.asarray(valid)[order])
     shard_of_row = shard_of_row[order]
     counts = np.bincount(shard_of_row, minlength=n_shards)
     shard_len = int(counts.max()) if len(pid) else 1
@@ -88,88 +105,128 @@ def shard_rows_by_pid(pid: np.ndarray, pk: np.ndarray, value: np.ndarray,
         out_pid[dst:dst + n_rows] = pid[lo:lo + n_rows]
         out_pk[dst:dst + n_rows] = pk[lo:lo + n_rows]
         out_val[dst:dst + n_rows] = value[lo:lo + n_rows]
-        out_valid[dst:dst + n_rows] = True
+        out_valid[dst:dst + n_rows] = valid[lo:lo + n_rows]
     return out_pid, out_pk, out_val, out_valid
 
 
-class ShardedDPResult(NamedTuple):
-    """Per-partition outputs, global [num_partitions_padded] arrays."""
-    count: jnp.ndarray
-    sum: jnp.ndarray
-    pid_count: jnp.ndarray
-    keep_mask: jnp.ndarray
+def _device_key(key):
+    """Independent PRNG stream per mesh position."""
+    dp_idx = jax.lax.axis_index("dp")
+    mp_idx = jax.lax.axis_index("mp")
+    return jax.random.fold_in(jax.random.fold_in(key, dp_idx), mp_idx)
 
 
-def build_sharded_aggregate_step(mesh: Mesh, num_partitions: int):
-    """Compiles the full sharded DP aggregation step for a mesh.
+def _reduce_scatter(x):
+    # 'dp' first, then 'mp', so the slice held by device (d, m) is chunk
+    # d*mp + m — matching the P(('dp','mp')) output layout.
+    x = jax.lax.psum_scatter(x, "dp", scatter_dimension=0, tiled=True)
+    return jax.lax.psum_scatter(x, "mp", scatter_dimension=0, tiled=True)
 
-    num_partitions is padded to a multiple of the device count so the
-    partition dimension shards evenly.
-    """
-    n_dev = mesh.devices.size
-    padded_p = ((num_partitions + n_dev - 1) // n_dev) * n_dev
 
-    def local_step(key, pid, pk, value, valid, linf_cap, l0_cap, clip_lo,
-                   clip_hi, noise_scale, noise_granularity, is_gaussian,
-                   sel_scalars):
-        # Per-device PRNG stream.
-        dp_idx = jax.lax.axis_index("dp")
-        mp_idx = jax.lax.axis_index("mp")
-        dev_key = jax.random.fold_in(jax.random.fold_in(key, dp_idx), mp_idx)
-        k_kernel, k_sel, k_noise1, k_noise2 = jax.random.split(dev_key, 4)
+@functools.lru_cache(maxsize=None)
+def _scalar_kernel(mesh: Mesh, padded_p: int):
+    """Sharded twin of columnar.bound_and_aggregate for a given mesh."""
 
+    def local_step(key, pid, pk, value, valid, linf_cap, l0_cap, row_clip_lo,
+                   row_clip_hi, middle, group_clip_lo, group_clip_hi):
         accs = columnar.bound_and_aggregate(
-            k_kernel, pid, pk, value, valid,
+            _device_key(key), pid, pk, value, valid,
             num_partitions=padded_p,
             linf_cap=linf_cap,
             l0_cap=l0_cap,
-            row_clip_lo=clip_lo,
-            row_clip_hi=clip_hi,
-            middle=0.0,
-            group_clip_lo=-jnp.inf,
-            group_clip_hi=jnp.inf)
+            row_clip_lo=row_clip_lo,
+            row_clip_hi=row_clip_hi,
+            middle=middle,
+            group_clip_lo=group_clip_lo,
+            group_clip_hi=group_clip_hi)
+        return jax.tree.map(_reduce_scatter, accs)
 
-        # The distributed shuffle: reduce partials over all devices while
-        # scattering the partition dimension (ICI reduce-scatter).
-        def reduce_scatter(x):
-            # 'dp' first, then 'mp', so the slice held by device (d, m) is
-            # chunk d*mp + m — matching the P(('dp','mp')) output layout.
-            x = jax.lax.psum_scatter(x, "dp", scatter_dimension=0, tiled=True)
-            return jax.lax.psum_scatter(x, "mp", scatter_dimension=0,
-                                        tiled=True)
-
-        count = reduce_scatter(accs.count)
-        total = reduce_scatter(accs.sum)
-        pid_count = reduce_scatter(accs.pid_count)
-
-        # Selection + noise, sharded over the partition slice.
-        sel_params = selection_ops.SelectionParams(
-            kind=selection_ops.TRUNCATED_GEOMETRIC,
-            eps_p=sel_scalars[0], delta_p=sel_scalars[1], n1=sel_scalars[2],
-            pi_n1=sel_scalars[3], pi_inf=sel_scalars[4])
-        keep, _ = selection_ops.select_partitions(k_sel, pid_count,
-                                                  sel_params, pid_count > 0)
-        dp_count = noise_ops.add_noise(k_noise1, count, is_gaussian,
-                                       noise_scale, noise_granularity)
-        dp_sum = noise_ops.add_noise(k_noise2, total, is_gaussian,
-                                     noise_scale, noise_granularity)
-        return ShardedDPResult(dp_count, dp_sum, pid_count, keep)
-
-    row_spec = P(("dp", "mp"))
-    part_spec = P(("dp", "mp"))
-    sharded = jax.shard_map(
+    fn = jax.shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(P(), row_spec, row_spec, row_spec, row_spec, P(), P(), P(),
-                  P(), P(), P(), P(), P()),
-        out_specs=ShardedDPResult(part_spec, part_spec, part_spec, part_spec),
+        in_specs=(P(),) + (ROW_SPEC,) * 4 + (P(),) * 7,
+        out_specs=columnar.PartitionAccumulators(*([PART_SPEC] * 5)),
         check_vma=False)
+    return jax.jit(fn)
 
-    @jax.jit
-    def step(key, pid, pk, value, valid, linf_cap, l0_cap, clip_lo, clip_hi,
-             noise_scale, noise_granularity, is_gaussian, sel_scalars):
-        return sharded(key, pid, pk, value, valid, linf_cap, l0_cap, clip_lo,
-                       clip_hi, noise_scale, noise_granularity, is_gaussian,
-                       sel_scalars)
 
-    return step, padded_p
+@functools.lru_cache(maxsize=None)
+def _vector_kernel(mesh: Mesh, padded_p: int, norm_ord: int):
+    """Sharded twin of columnar.bound_and_aggregate_vector."""
+
+    def local_step(key, pid, pk, value, valid, linf_cap, l0_cap, max_norm):
+        vector_sums, accs = columnar.bound_and_aggregate_vector(
+            _device_key(key), pid, pk, value, valid,
+            num_partitions=padded_p,
+            linf_cap=linf_cap,
+            l0_cap=l0_cap,
+            max_norm=max_norm,
+            norm_ord=norm_ord)
+        return (_reduce_scatter(vector_sums),
+                jax.tree.map(_reduce_scatter, accs))
+
+    fn = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(),) + (ROW_SPEC,) * 4 + (P(),) * 3,
+        out_specs=(PART_SPEC,
+                   columnar.PartitionAccumulators(*([PART_SPEC] * 5))),
+        check_vma=False)
+    return jax.jit(fn)
+
+
+def _shard_and_put(mesh: Mesh, pid, pk, value, valid):
+    n_dev = mesh.devices.size
+    spid, spk, sval, svalid = shard_rows_by_pid(np.asarray(pid),
+                                                np.asarray(pk),
+                                                np.asarray(value), n_dev,
+                                                np.asarray(valid))
+    sharding = NamedSharding(mesh, ROW_SPEC)
+    return tuple(
+        jax.device_put(a, sharding) for a in (spid, spk, sval, svalid))
+
+
+def bound_and_aggregate(mesh: Mesh,
+                        key: jax.Array,
+                        pid: np.ndarray,
+                        pk: np.ndarray,
+                        value: np.ndarray,
+                        valid: np.ndarray,
+                        *,
+                        num_partitions: int,
+                        linf_cap,
+                        l0_cap,
+                        row_clip_lo,
+                        row_clip_hi,
+                        middle,
+                        group_clip_lo,
+                        group_clip_hi) -> columnar.PartitionAccumulators:
+    """Multi-chip bound-and-aggregate: host rows in, global sharded
+    [padded_p] accumulators out (padding partitions are all-zero; callers
+    trim to num_partitions when materializing)."""
+    padded_p = padded_num_partitions(mesh, num_partitions)
+    dpid, dpk, dval, dvalid = _shard_and_put(mesh, pid, pk, value, valid)
+    kernel = _scalar_kernel(mesh, padded_p)
+    return kernel(key, dpid, dpk, dval, dvalid, linf_cap, l0_cap,
+                  float(row_clip_lo), float(row_clip_hi), float(middle),
+                  float(group_clip_lo), float(group_clip_hi))
+
+
+def bound_and_aggregate_vector(mesh: Mesh,
+                               key: jax.Array,
+                               pid: np.ndarray,
+                               pk: np.ndarray,
+                               value: np.ndarray,
+                               valid: np.ndarray,
+                               *,
+                               num_partitions: int,
+                               linf_cap,
+                               l0_cap,
+                               max_norm,
+                               norm_ord: int):
+    """Multi-chip VECTOR_SUM path; see bound_and_aggregate."""
+    padded_p = padded_num_partitions(mesh, num_partitions)
+    dpid, dpk, dval, dvalid = _shard_and_put(mesh, pid, pk, value, valid)
+    kernel = _vector_kernel(mesh, padded_p, norm_ord)
+    return kernel(key, dpid, dpk, dval, dvalid, linf_cap, l0_cap,
+                  float(max_norm))

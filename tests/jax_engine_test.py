@@ -4,10 +4,22 @@ The columnar engine must produce the same results as the local path: exact
 equality with no noise (huge eps), matching noise calibration, matching
 budget splits, and matching partition-selection behavior."""
 
+import jax
 import numpy as np
 import pytest
 
 import pipelinedp_tpu as pdp
+from pipelinedp_tpu.parallel import sharded
+
+
+@pytest.fixture(params=["single_device", "mesh8"], scope="module")
+def engine_mesh(request):
+    """Same assertions run on one device and on an 8-device mesh."""
+    if request.param == "single_device":
+        return None
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return sharded.make_mesh(8)
 
 
 def extractors():
@@ -16,9 +28,10 @@ def extractors():
                               value_extractor=lambda r: r[2])
 
 
-def run_jax(data, params, public=None, eps=1e8, delta=1e-15, seed=0):
+def run_jax(data, params, public=None, eps=1e8, delta=1e-15, seed=0,
+            mesh=None):
     accountant = pdp.NaiveBudgetAccountant(eps, delta)
-    engine = pdp.JaxDPEngine(accountant, seed=seed)
+    engine = pdp.JaxDPEngine(accountant, seed=seed, mesh=mesh)
     result = engine.aggregate(data, params, extractors(),
                               public_partitions=public)
     accountant.compute_budgets()
@@ -40,7 +53,7 @@ def simple_data(n_users=20, partitions=("a", "b", "c")):
 
 class TestNoNoiseConformance:
 
-    def test_count_sum_match_local(self):
+    def test_count_sum_match_local(self, engine_mesh):
         data = simple_data()
         params = pdp.AggregateParams(
             metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
@@ -48,7 +61,7 @@ class TestNoNoiseConformance:
             max_contributions_per_partition=1,
             min_value=0,
             max_value=5)
-        jax_res, _, _ = run_jax(data, params, public=["a", "b", "c"])
+        jax_res, _, _ = run_jax(data, params, public=["a", "b", "c"], mesh=engine_mesh)
         local_res, _ = run_local(data, params, public=["a", "b", "c"])
         assert set(jax_res) == set(local_res)
         for pk in local_res:
@@ -57,18 +70,18 @@ class TestNoNoiseConformance:
             assert jax_res[pk].sum == pytest.approx(local_res[pk].sum,
                                                     abs=0.1)
 
-    def test_privacy_id_count(self):
+    def test_privacy_id_count(self, engine_mesh):
         data = simple_data(n_users=13)
         params = pdp.AggregateParams(
             metrics=[pdp.Metrics.PRIVACY_ID_COUNT],
             max_partitions_contributed=3,
             max_contributions_per_partition=1)
-        jax_res, _, _ = run_jax(data, params, public=["a", "b", "c"])
+        jax_res, _, _ = run_jax(data, params, public=["a", "b", "c"], mesh=engine_mesh)
         for pk in "abc":
             assert jax_res[pk].privacy_id_count == pytest.approx(13,
                                                                  abs=1e-2)
 
-    def test_mean(self):
+    def test_mean(self, engine_mesh):
         data = [(u, "a", float(v)) for u, v in enumerate([1, 2, 6, 7])]
         params = pdp.AggregateParams(
             metrics=[pdp.Metrics.MEAN, pdp.Metrics.COUNT, pdp.Metrics.SUM],
@@ -76,12 +89,12 @@ class TestNoNoiseConformance:
             max_contributions_per_partition=1,
             min_value=0,
             max_value=10)
-        jax_res, _, _ = run_jax(data, params, public=["a"])
+        jax_res, _, _ = run_jax(data, params, public=["a"], mesh=engine_mesh)
         assert jax_res["a"].mean == pytest.approx(4.0, abs=0.05)
         assert jax_res["a"].count == pytest.approx(4, abs=0.05)
         assert jax_res["a"].sum == pytest.approx(16.0, abs=0.3)
 
-    def test_variance(self):
+    def test_variance(self, engine_mesh):
         values = [1.0, 3.0, 5.0, 7.0]
         data = [(u, "a", v) for u, v in enumerate(values)]
         params = pdp.AggregateParams(metrics=[pdp.Metrics.VARIANCE,
@@ -90,12 +103,12 @@ class TestNoNoiseConformance:
                                      max_contributions_per_partition=1,
                                      min_value=0,
                                      max_value=8)
-        jax_res, _, _ = run_jax(data, params, public=["a"])
+        jax_res, _, _ = run_jax(data, params, public=["a"], mesh=engine_mesh)
         assert jax_res["a"].variance == pytest.approx(np.var(values),
                                                       abs=0.2)
         assert jax_res["a"].mean == pytest.approx(4.0, abs=0.1)
 
-    def test_vector_sum(self):
+    def test_vector_sum(self, engine_mesh):
         data = [(0, "a", (1.0, 2.0)), (1, "a", (3.0, -1.0))]
         params = pdp.AggregateParams(metrics=[pdp.Metrics.VECTOR_SUM],
                                      max_partitions_contributed=1,
@@ -104,7 +117,7 @@ class TestNoNoiseConformance:
                                      vector_max_norm=100.0,
                                      vector_norm_kind=pdp.NormKind.Linf)
         accountant = pdp.NaiveBudgetAccountant(1e8, 1e-15)
-        engine = pdp.JaxDPEngine(accountant)
+        engine = pdp.JaxDPEngine(accountant, mesh=engine_mesh)
         ext = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
                                  partition_extractor=lambda r: r[1],
                                  value_extractor=lambda r: np.asarray(r[2]))
@@ -114,30 +127,30 @@ class TestNoNoiseConformance:
         np.testing.assert_allclose(np.asarray(cols["vector_sum"])[0],
                                    [4.0, 1.0], atol=0.05)
 
-    def test_empty_public_partition_zero(self):
+    def test_empty_public_partition_zero(self, engine_mesh):
         data = simple_data(partitions=("a",))
         params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
                                      max_partitions_contributed=1,
                                      max_contributions_per_partition=1)
-        jax_res, _, _ = run_jax(data, params, public=["a", "ghost"])
+        jax_res, _, _ = run_jax(data, params, public=["a", "ghost"], mesh=engine_mesh)
         assert jax_res["ghost"].count == pytest.approx(0, abs=1e-2)
 
-    def test_contribution_bounding(self):
+    def test_contribution_bounding(self, engine_mesh):
         data = [(0, "a", 1.0)] * 50 + [(1, "a", 1.0)]
         params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
                                      max_partitions_contributed=1,
                                      max_contributions_per_partition=4)
-        jax_res, _, _ = run_jax(data, params, public=["a"])
+        jax_res, _, _ = run_jax(data, params, public=["a"], mesh=engine_mesh)
         assert jax_res["a"].count == pytest.approx(5, abs=1e-2)
 
-    def test_sum_per_partition_clipping(self):
+    def test_sum_per_partition_clipping(self, engine_mesh):
         data = [(0, "a", 3.0)] * 10
         params = pdp.AggregateParams(metrics=[pdp.Metrics.SUM],
                                      max_partitions_contributed=1,
                                      max_contributions_per_partition=1,
                                      min_sum_per_partition=0.0,
                                      max_sum_per_partition=7.0)
-        jax_res, _, _ = run_jax(data, params, public=["a"])
+        jax_res, _, _ = run_jax(data, params, public=["a"], mesh=engine_mesh)
         assert jax_res["a"].sum == pytest.approx(7.0, abs=0.1)
 
 
@@ -164,7 +177,7 @@ class TestBudgetParity:
 
 class TestNoise:
 
-    def test_count_noise_std(self):
+    def test_count_noise_std(self, engine_mesh):
         eps = 1.0
         n_partitions = 256
         data = [(u, f"p{i}", 1.0) for i in range(n_partitions)
@@ -175,13 +188,13 @@ class TestNoise:
             max_contributions_per_partition=1)
         public = [f"p{i}" for i in range(n_partitions)]
         jax_res, _, _ = run_jax(data, params, public=public, eps=eps,
-                                delta=0.0, seed=7)
+                                delta=0.0, seed=7, mesh=engine_mesh)
         errors = np.array([m.count - 10 for m in jax_res.values()])
         expected_std = n_partitions * np.sqrt(2) / eps
         assert abs(errors.mean()) < expected_std / 3
         assert errors.std() == pytest.approx(expected_std, rel=0.25)
 
-    def test_gaussian_noise_std(self):
+    def test_gaussian_noise_std(self, engine_mesh):
         from pipelinedp_tpu import dp_computations
         eps, delta = 1.0, 1e-6
         n_partitions = 256
@@ -194,43 +207,47 @@ class TestNoise:
             max_contributions_per_partition=1)
         public = [f"p{i}" for i in range(n_partitions)]
         jax_res, _, _ = run_jax(data, params, public=public, eps=eps,
-                                delta=delta, seed=3)
+                                delta=delta, seed=3, mesh=engine_mesh)
         errors = np.array([m.count - 10 for m in jax_res.values()])
         # Note: L0 bounding drops most contributions (users contribute to
         # 256 partitions, capped at 4), so compare std only.
         expected_std = dp_computations.compute_sigma(eps, delta, 2.0)
         assert errors.std() == pytest.approx(expected_std, rel=0.3)
 
-    def test_different_seeds_different_noise(self):
+    def test_different_seeds_different_noise(self, engine_mesh):
         data = simple_data()
         params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
                                      max_partitions_contributed=3,
                                      max_contributions_per_partition=1)
-        r1, _, _ = run_jax(data, params, public=["a"], eps=1.0, seed=1)
-        r2, _, _ = run_jax(data, params, public=["a"], eps=1.0, seed=2)
+        r1, _, _ = run_jax(data, params, public=["a"], eps=1.0, seed=1,
+                            mesh=engine_mesh)
+        r2, _, _ = run_jax(data, params, public=["a"], eps=1.0, seed=2,
+                            mesh=engine_mesh)
         assert r1["a"].count != r2["a"].count
 
 
 class TestPrivatePartitionSelection:
 
-    def test_large_kept_small_dropped(self):
+    def test_large_kept_small_dropped(self, engine_mesh):
         data = ([(u, "big", 1.0) for u in range(2000)] +
                 [(5555, "tiny", 1.0)])
         params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
                                      max_partitions_contributed=1,
                                      max_contributions_per_partition=1)
-        jax_res, _, _ = run_jax(data, params, eps=1.0, delta=1e-6)
+        jax_res, _, _ = run_jax(data, params, eps=1.0, delta=1e-6,
+                                mesh=engine_mesh)
         assert "big" in jax_res
         assert "tiny" not in jax_res
 
-    def test_post_aggregation_thresholding(self):
+    def test_post_aggregation_thresholding(self, engine_mesh):
         data = ([(u, "big", 1.0) for u in range(2000)] +
                 [(5555, "tiny", 1.0)])
         params = pdp.AggregateParams(metrics=[pdp.Metrics.PRIVACY_ID_COUNT],
                                      max_partitions_contributed=1,
                                      max_contributions_per_partition=1,
                                      post_aggregation_thresholding=True)
-        jax_res, _, _ = run_jax(data, params, eps=1.0, delta=1e-6)
+        jax_res, _, _ = run_jax(data, params, eps=1.0, delta=1e-6,
+                                mesh=engine_mesh)
         assert "tiny" not in jax_res
         assert jax_res["big"].privacy_id_count == pytest.approx(2000,
                                                                 rel=0.1)

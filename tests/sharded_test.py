@@ -1,16 +1,18 @@
 """Multi-device sharding tests on the virtual 8-device CPU mesh.
 
-Validates that the sharded step (shard_map + psum_scatter over the
-('dp','mp') mesh) produces the same per-partition accumulators as the
-single-device kernel."""
+Validates that the sharded kernels (shard_map + psum_scatter over the
+('dp','mp') mesh) produce the same per-partition accumulators as the
+single-device kernel, and that JaxDPEngine(mesh=...) runs the full public
+API multi-chip."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from pipelinedp_tpu.ops import selection as selection_ops
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu.ops import columnar
 from pipelinedp_tpu.parallel import sharded
-from pipelinedp_tpu import partition_selection as ps_lib
 
 
 @pytest.fixture(scope="module")
@@ -46,32 +48,36 @@ class TestShardRowsByPid:
         assert svalid.sum() == len(pid)
         assert sval[svalid].sum() == pytest.approx(value.sum(), rel=1e-5)
 
+    def test_incoming_invalid_rows_stay_invalid(self):
+        pid, pk, value = make_inputs(n_rows=100)
+        valid = np.ones(100, dtype=bool)
+        valid[::3] = False
+        _, _, _, svalid = sharded.shard_rows_by_pid(pid, pk, value, 8, valid)
+        assert svalid.sum() == valid.sum()
 
-class TestShardedStep:
 
-    def test_matches_single_device_no_caps(self, mesh):
+class TestShardedKernel:
+
+    def test_matches_bincount_no_caps(self, mesh):
         pid, pk, value = make_inputs()
         n_parts = 64
-        spid, spk, sval, svalid = sharded.shard_rows_by_pid(pid, pk, value, 8)
-        step, padded_p = sharded.build_sharded_aggregate_step(mesh, n_parts)
-        host = ps_lib.TruncatedGeometricPartitionSelection(1.0, 1e-6, 4)
-        sp = selection_ops.selection_params_from_strategy(host)
-        sel_scalars = np.array(
-            [sp.eps_p, sp.delta_p, sp.n1, sp.pi_n1, sp.pi_inf], np.float32)
-        result = step(jax.random.PRNGKey(0), spid, spk, sval, svalid,
-                      len(spid), padded_p, -np.inf, np.inf,
-                      0.0, 2.0**-40, False, sel_scalars)
-        # No caps, near-zero noise scale: counts equal plain bincount.
+        accs = sharded.bound_and_aggregate(
+            mesh, jax.random.PRNGKey(0), pid, pk, value,
+            np.ones(len(pid), bool),
+            num_partitions=n_parts,
+            linf_cap=len(pid), l0_cap=n_parts,
+            row_clip_lo=-np.inf, row_clip_hi=np.inf, middle=0.0,
+            group_clip_lo=-np.inf, group_clip_hi=np.inf)
         np.testing.assert_allclose(
-            np.asarray(result.count)[:n_parts],
-            np.bincount(pk, minlength=n_parts), atol=1e-3)
+            np.asarray(accs.count)[:n_parts],
+            np.bincount(pk, minlength=n_parts))
         np.testing.assert_allclose(
-            np.asarray(result.sum)[:n_parts],
-            np.bincount(pk, weights=value, minlength=n_parts), atol=0.1)
+            np.asarray(accs.sum)[:n_parts],
+            np.bincount(pk, weights=value, minlength=n_parts), rtol=1e-4)
         expected_pid_count = np.array(
             [len(set(pid[pk == p])) for p in range(n_parts)])
         np.testing.assert_allclose(
-            np.asarray(result.pid_count)[:n_parts], expected_pid_count)
+            np.asarray(accs.pid_count)[:n_parts], expected_pid_count)
 
     def test_l0_bounding_across_shards(self, mesh):
         # Every user contributes to 16 partitions; l0 cap 4 must hold
@@ -80,33 +86,120 @@ class TestShardedStep:
         pid = np.repeat(np.arange(n_users, dtype=np.int32), n_parts)
         pk = np.tile(np.arange(n_parts, dtype=np.int32), n_users)
         value = np.ones(len(pid), np.float32)
-        spid, spk, sval, svalid = sharded.shard_rows_by_pid(pid, pk, value, 8)
-        step, padded_p = sharded.build_sharded_aggregate_step(mesh, n_parts)
-        sel_scalars = np.zeros(5, np.float32)
-        host = ps_lib.TruncatedGeometricPartitionSelection(1.0, 1e-6, 4)
-        sp = selection_ops.selection_params_from_strategy(host)
-        sel_scalars = np.array(
-            [sp.eps_p, sp.delta_p, sp.n1, sp.pi_n1, sp.pi_inf], np.float32)
-        result = step(jax.random.PRNGKey(1), spid, spk, sval, svalid,
-                      1, 4, -np.inf, np.inf, 0.0, 2.0**-40, False,
-                      sel_scalars)
-        total = np.asarray(result.count)[:n_parts].sum()
-        assert total == pytest.approx(n_users * 4, abs=1e-2)
+        accs = sharded.bound_and_aggregate(
+            mesh, jax.random.PRNGKey(1), pid, pk, value,
+            np.ones(len(pid), bool),
+            num_partitions=n_parts,
+            linf_cap=1, l0_cap=4,
+            row_clip_lo=-np.inf, row_clip_hi=np.inf, middle=0.0,
+            group_clip_lo=-np.inf, group_clip_hi=np.inf)
+        assert float(np.asarray(accs.count)[:n_parts].sum()) == n_users * 4
 
-    def test_noise_applied_per_shard(self, mesh):
+    def test_output_is_sharded_over_partitions(self, mesh):
         pid, pk, value = make_inputs()
-        spid, spk, sval, svalid = sharded.shard_rows_by_pid(pid, pk, value, 8)
-        step, padded_p = sharded.build_sharded_aggregate_step(mesh, 64)
-        host = ps_lib.TruncatedGeometricPartitionSelection(1.0, 1e-6, 4)
-        sp = selection_ops.selection_params_from_strategy(host)
-        sel_scalars = np.array(
-            [sp.eps_p, sp.delta_p, sp.n1, sp.pi_n1, sp.pi_inf], np.float32)
-        scale = 5.0
-        result = step(jax.random.PRNGKey(2), spid, spk, sval, svalid,
-                      len(spid), padded_p, -np.inf, np.inf,
-                      scale, 2.0**-20, False, sel_scalars)
-        errors = (np.asarray(result.count)[:64] -
-                  np.bincount(pk, minlength=64))
-        # Laplace(scale=5) => std ~ 7.07; all-zero errors would mean noise
-        # was lost in the collective.
-        assert errors.std() == pytest.approx(scale * np.sqrt(2), rel=0.4)
+        accs = sharded.bound_and_aggregate(
+            mesh, jax.random.PRNGKey(0), pid, pk, value,
+            np.ones(len(pid), bool),
+            num_partitions=64,
+            linf_cap=4, l0_cap=8,
+            row_clip_lo=-np.inf, row_clip_hi=np.inf, middle=0.0,
+            group_clip_lo=-np.inf, group_clip_hi=np.inf)
+        # Each device must hold a distinct 1/8 slice, not a replica.
+        shards = accs.count.addressable_shards
+        assert len(shards) == 8
+        assert all(s.data.shape == (8,) for s in shards)
+
+    def test_vector_kernel_matches_single_device(self, mesh):
+        rng = np.random.default_rng(3)
+        n_rows, n_parts, d = 500, 16, 3
+        pid = rng.integers(0, 50, n_rows).astype(np.int32)
+        pk = rng.integers(0, n_parts, n_rows).astype(np.int32)
+        value = rng.uniform(-1, 1, (n_rows, d)).astype(np.float32)
+        vec, accs = sharded.bound_and_aggregate_vector(
+            mesh, jax.random.PRNGKey(0), pid, pk, value,
+            np.ones(n_rows, bool),
+            num_partitions=n_parts,
+            linf_cap=n_rows, l0_cap=n_parts,
+            max_norm=100.0, norm_ord=0)
+        expected = np.zeros((n_parts, d), np.float32)
+        np.add.at(expected, pk, value)
+        np.testing.assert_allclose(np.asarray(vec)[:n_parts], expected,
+                                   atol=1e-3)
+
+
+class TestEngineOnMesh:
+    """The public API end-to-end on a mesh (the VERDICT round-2 item 1)."""
+
+    def _run(self, mesh, data, params, public=None, eps=1e8, delta=1e-15,
+             secure_host_noise=True, seed=0):
+        ext = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                 partition_extractor=lambda r: r[1],
+                                 value_extractor=lambda r: r[2])
+        accountant = pdp.NaiveBudgetAccountant(eps, delta)
+        engine = pdp.JaxDPEngine(accountant, seed=seed, mesh=mesh,
+                                 secure_host_noise=secure_host_noise)
+        result = engine.aggregate(data, params, ext, public_partitions=public)
+        accountant.compute_budgets()
+        return dict(result)
+
+    def test_count_sum_private_selection(self, mesh):
+        data = ([(u, "big", 1.0) for u in range(2000)] +
+                [(5555, "tiny", 1.0)])
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            min_value=0.0,
+            max_value=2.0)
+        res = self._run(mesh, data, params, eps=1.0, delta=1e-6)
+        assert "big" in res and "tiny" not in res
+        assert res["big"].count == pytest.approx(2000, rel=0.1)
+
+    def test_device_noise_std_on_mesh(self, mesh):
+        # The noise statistical check of TestNoise, but on the mesh with
+        # device-side noise — per-shard streams must deliver the calibrated
+        # std (noise lost in the collective would show as std ~ 0).
+        eps = 1.0
+        n_partitions = 512
+        data = [(u, f"p{i}", 1.0) for i in range(n_partitions)
+                for u in range(5)]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT],
+            max_partitions_contributed=n_partitions,
+            max_contributions_per_partition=1)
+        public = [f"p{i}" for i in range(n_partitions)]
+        res = self._run(mesh, data, params, public=public, eps=eps,
+                        delta=1e-15, secure_host_noise=False, seed=11)
+        errors = np.array([m.count - 5 for m in res.values()])
+        expected_std = n_partitions * np.sqrt(2) / eps
+        assert abs(errors.mean()) < expected_std / 3
+        assert errors.std() == pytest.approx(expected_std, rel=0.25)
+
+    def test_mesh_matches_single_device_no_noise(self, mesh):
+        pid, pk, value = make_inputs(n_rows=2000, n_users=100, n_parts=32)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM,
+                     pdp.Metrics.MEAN],
+            max_partitions_contributed=32,
+            max_contributions_per_partition=100,
+            min_value=0.0,
+            max_value=1.0)
+        public = list(range(32))
+
+        def run(m):
+            accountant = pdp.NaiveBudgetAccountant(1e8, 1e-15)
+            engine = pdp.JaxDPEngine(accountant, mesh=m)
+            result = engine.aggregate(
+                pdp.ColumnarData(pid=pid.copy(), pk=pk.copy(),
+                                 value=value.copy()), params,
+                public_partitions=public)
+            accountant.compute_budgets()
+            return dict(result)
+
+        mesh_res, single_res = run(mesh), run(None)
+        assert set(mesh_res) == set(single_res)
+        for k in single_res:
+            assert mesh_res[k].count == pytest.approx(single_res[k].count,
+                                                      abs=0.05)
+            assert mesh_res[k].sum == pytest.approx(single_res[k].sum,
+                                                    abs=0.2)
