@@ -37,7 +37,9 @@ from pipelinedp_tpu.aggregate_params import (AggregateParams, MechanismType,
 from pipelinedp_tpu import dp_engine as dp_engine_lib
 from pipelinedp_tpu.data_extractors import DataExtractors
 from pipelinedp_tpu.ops import columnar, encoding, noise as noise_ops
+from pipelinedp_tpu.ops import quantiles as quantile_ops
 from pipelinedp_tpu.ops import selection as selection_ops
+from pipelinedp_tpu import quantile_tree as quantile_tree_lib
 from pipelinedp_tpu import partition_selection as ps_lib
 from pipelinedp_tpu.report_generator import ExplainComputationReport
 from pipelinedp_tpu import noise_core
@@ -205,9 +207,14 @@ class JaxDPEngine:
                 "Custom combiners run on DPEngine with LocalBackend; the "
                 "columnar engine supports the standard metrics.")
         if any(m.is_percentile for m in params.metrics):
-            raise NotImplementedError(
-                "PERCENTILE on the columnar engine is not implemented yet; "
-                "use DPEngine with LocalBackend.")
+            if Metrics.VECTOR_SUM in params.metrics:
+                raise NotImplementedError(
+                    "PERCENTILE cannot be combined with VECTOR_SUM: the "
+                    "quantile tree needs scalar values.")
+            if params.min_value is None or params.max_value is None:
+                raise ValueError(
+                    "PERCENTILE requires min_value and max_value (the "
+                    "quantile tree range).")
 
     def _aggregate(self, col, params, data_extractors, public_partitions):
         # Same budget requests as the reference graph.
@@ -313,6 +320,10 @@ class JaxDPEngine:
                     NormKind.L2: 2}[params.vector_norm_kind or NormKind.Linf]
         if self._mesh is not None:
             from pipelinedp_tpu.parallel import sharded
+            # Stage (hash-shard + device_put) once; both the aggregate and
+            # the quantile-histogram kernels reuse the staged arrays.
+            pid, pk, value, valid_rows = sharded.stage_rows(
+                self._mesh, pid, pk, value, valid_rows)
             if is_vector:
                 vector_sums, accs = sharded.bound_and_aggregate_vector(
                     self._mesh, k_kernel, pid, pk, value, valid_rows,
@@ -360,6 +371,48 @@ class JaxDPEngine:
         num_out = int(accs.pid_count.shape[0])
         partition_exists = accs.pid_count > 0
 
+        # PERCENTILE: one dense [num_partitions, leaves] histogram of the
+        # bounded rows feeds every partition's quantile tree at once
+        # (ops/quantiles.py). The row keep mask replays the exact sampling
+        # decisions of the fused kernel (same PRNG key).
+        quantile_hist = None
+        if any(
+                isinstance(c, combiners_lib.QuantileCombiner)
+                for c in compound.combiners):
+            num_leaves = (quantile_tree_lib.DEFAULT_BRANCHING_FACTOR**
+                          quantile_tree_lib.DEFAULT_TREE_HEIGHT)
+            if num_out * num_leaves > quantile_ops.MAX_HISTOGRAM_ELEMENTS:
+                raise ValueError(
+                    f"PERCENTILE over {num_out} partitions needs a "
+                    f"{num_out}x{num_leaves} histogram, above the "
+                    f"{quantile_ops.MAX_HISTOGRAM_ELEMENTS}-element device "
+                    f"budget; use DPEngine with LocalBackend for this "
+                    f"workload.")
+            if self._mesh is not None:
+                from pipelinedp_tpu.parallel import sharded
+                quantile_hist = sharded.quantile_leaf_histograms(
+                    self._mesh, k_kernel, pid, pk, value, valid_rows,
+                    num_partitions=num_partitions,
+                    num_leaves=num_leaves,
+                    lower=params.min_value,
+                    upper=params.max_value,
+                    linf_cap=linf_cap,
+                    l0_cap=l0_cap)
+            else:
+                row_keep = columnar.bound_row_mask(k_kernel,
+                                                   jnp.asarray(pid),
+                                                   jnp.asarray(pk),
+                                                   jnp.asarray(valid_rows),
+                                                   linf_cap, l0_cap)
+                quantile_hist = quantile_ops.leaf_histograms(
+                    jnp.asarray(pk),
+                    jnp.asarray(value),
+                    row_keep,
+                    num_partitions=num_out,
+                    num_leaves=num_leaves,
+                    lower=params.min_value,
+                    upper=params.max_value)
+
         # Partition selection. The selection strategy's L0 sensitivity is
         # the *declared* cross-partition bound: max_partitions_contributed,
         # or max_contributions in L1 mode (which caps partitions at the same
@@ -394,7 +447,8 @@ class JaxDPEngine:
         for i, combiner in enumerate(compound.combiners):
             sub_key = jax.random.fold_in(k_noise, i)
             self._compute_combiner_metrics(combiner, params, accs,
-                                           vector_sums, sub_key, columns)
+                                           vector_sums, sub_key, columns,
+                                           quantile_hist=quantile_hist)
             if isinstance(combiner,
                           combiners_lib.PostAggregationThresholdingCombiner):
                 thresh = dp_computations.create_thresholding_mechanism(
@@ -447,7 +501,8 @@ class JaxDPEngine:
         return noise_ops.add_gaussian_noise(key, values, stddev, granularity)
 
     def _compute_combiner_metrics(self, combiner, params, accs, vector_sums,
-                                  key, columns: dict) -> None:
+                                  key, columns: dict,
+                                  quantile_hist=None) -> None:
         k1, k2, k3 = jax.random.split(key, 3)
         if isinstance(combiner, combiners_lib.CountCombiner):
             is_g, scale, gran = _mechanism_noise_params(
@@ -488,6 +543,28 @@ class JaxDPEngine:
         elif isinstance(combiner, combiners_lib.VarianceCombiner):
             self._variance_metrics(combiner, params, accs, (k1, k2, k3),
                                    columns)
+        elif isinstance(combiner, combiners_lib.QuantileCombiner):
+            p = combiner._params.aggregate_params
+            eps, delta = combiner._params.eps, combiner._params.delta
+            is_gaussian = p.noise_kind == NoiseKind.GAUSSIAN
+            branching = quantile_tree_lib.DEFAULT_BRANCHING_FACTOR
+            height = quantile_tree_lib.DEFAULT_TREE_HEIGHT
+            levels = quantile_ops.level_counts(quantile_hist, branching,
+                                               height)
+            if self._secure_host_noise:
+                noised = quantile_ops.noised_levels_host(
+                    [np.asarray(lvl) for lvl in levels], eps, delta,
+                    p.max_partitions_contributed,
+                    p.max_contributions_per_partition, is_gaussian)
+            else:
+                noised = quantile_ops.noised_levels_device(
+                    k1, levels, eps, delta, p.max_partitions_contributed,
+                    p.max_contributions_per_partition, is_gaussian)
+            qcols = quantile_ops.walk_quantiles(
+                noised, combiner._quantiles_to_compute, p.min_value,
+                p.max_value, branching)
+            for i, name in enumerate(combiner.metrics_names()):
+                columns[name] = qcols[:, i]
         elif isinstance(combiner, combiners_lib.VectorSumCombiner):
             p = combiner._params
             noise_params = p.additive_vector_noise_params

@@ -235,6 +235,56 @@ def bound_and_aggregate_vector(key: jax.Array,
     return vector_sums, accs
 
 
+@functools.partial(jax.jit)
+def bound_row_mask(key: jax.Array, pid: jnp.ndarray, pk: jnp.ndarray,
+                   valid: jnp.ndarray, linf_cap, l0_cap) -> jnp.ndarray:
+    """Per-row keep mask (original row order) after Linf + L0 bounding.
+
+    Identical sampling decisions to bound_and_aggregate for the same key
+    (same splits, same lexsort keys, same tiebreak draws), but returns which
+    rows survive instead of aggregates — the row-level view needed by
+    consumers that histogram individual contributions (e.g. the batched
+    quantile trees of ops/quantiles.py).
+    """
+    n = pid.shape[0]
+    if n == 0:
+        return jnp.zeros((0,), dtype=bool)
+    k1, k2 = jax.random.split(key)
+    pid_key = jnp.where(valid, pid, _INT32_MAX)
+    pk_key = jnp.where(valid, pk, _INT32_MAX)
+
+    tiebreak = jax.random.uniform(k1, (n,))
+    order = jnp.lexsort((tiebreak, pk_key, pid_key))
+    spid = pid_key[order]
+    spk = pk_key[order]
+    svalid = valid[order]
+
+    is_start = jnp.concatenate([
+        jnp.ones((1,), dtype=bool),
+        (spid[1:] != spid[:-1]) | (spk[1:] != spk[:-1])
+    ])
+    rank = _segment_rank(is_start)
+    keep_row = svalid & (rank < linf_cap)
+
+    group_id = (jnp.cumsum(is_start) - 1).astype(jnp.int32)
+    start_w = (is_start & svalid).astype(jnp.int32)
+    g_pid = jax.ops.segment_sum(spid * start_w, group_id, num_segments=n)
+    g_valid = jax.ops.segment_sum(start_w, group_id, num_segments=n) > 0
+
+    g_rand = jax.random.uniform(k2, (n,))
+    g_pid_key = jnp.where(g_valid, g_pid, _INT32_MAX)
+    order2 = jnp.lexsort((g_rand, g_pid_key))
+    is_start2 = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool),
+         g_pid_key[order2][1:] != g_pid_key[order2][:-1]])
+    keep_sorted = _segment_rank(is_start2) < l0_cap
+    keep_group = jnp.zeros((n,), dtype=bool).at[order2].set(keep_sorted)
+    keep_group = keep_group & g_valid
+
+    keep_sorted_rows = keep_row & keep_group[group_id]
+    return jnp.zeros((n,), dtype=bool).at[order].set(keep_sorted_rows)
+
+
 @functools.partial(jax.jit, static_argnames=("num_partitions",))
 def count_distinct_pids_per_partition(pid: jnp.ndarray, pk: jnp.ndarray,
                                       valid: jnp.ndarray, key: jax.Array,

@@ -1,0 +1,163 @@
+"""Batched per-partition DP quantile trees for the columnar engine.
+
+The reference computes PERCENTILE with one C++ QuantileTree object per
+partition, built row by row and noised node by node during the quantile
+walk (combiners.py:590-669 via PyDP). The TPU-native formulation builds
+EVERY partition's tree at once: the leaf level is a single
+[num_partitions, branching**height] histogram produced by one segment-sum
+over the (already contribution-bounded) rows, upper levels are reshape-sums
+of the leaf level, and each level gets one batched noise call. The quantile
+walk is then pure post-processing of DP-released node counts — no privacy
+left in it — so it runs as vectorized numpy over all partitions and all
+requested quantiles at once.
+
+Budget semantics match pipelinedp_tpu/quantile_tree.py (the host twin, and
+through it the PyDP algorithm): eps/delta split evenly across tree levels;
+per-level noise uses L1 sensitivity l0*linf (Laplace) or L2 sensitivity
+sqrt(l0)*linf (Gaussian), since each contribution increments exactly one
+node per level.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pipelinedp_tpu import noise_core
+from pipelinedp_tpu.ops import noise as noise_ops
+
+# Guard for the dense [num_partitions, leaves] layout: above this many
+# histogram elements (2^28 floats = 1 GiB), fall back to the host engine.
+MAX_HISTOGRAM_ELEMENTS = 2**28
+
+
+@functools.partial(jax.jit, static_argnames=("num_partitions", "num_leaves"))
+def leaf_histograms(pk: jnp.ndarray, value: jnp.ndarray,
+                    weights: jnp.ndarray, *, num_partitions: int,
+                    num_leaves: int, lower, upper) -> jnp.ndarray:
+    """[num_partitions, num_leaves] leaf counts of every partition's tree.
+
+    ``weights`` is the per-row keep mask from contribution bounding
+    (columnar.bound_row_mask); lower/upper are runtime scalars.
+    """
+    frac = (jnp.clip(value, lower, upper) - lower) / (upper - lower)
+    leaf = jnp.minimum((frac * num_leaves).astype(jnp.int32), num_leaves - 1)
+    seg = pk * num_leaves + leaf
+    counts = jax.ops.segment_sum(weights.astype(jnp.float32), seg,
+                                 num_segments=num_partitions * num_leaves)
+    return counts.reshape(num_partitions, num_leaves)
+
+
+def level_counts(leaf_hist: np.ndarray, branching: int,
+                 height: int) -> List[np.ndarray]:
+    """Per-level node counts derived from the leaf level by reshape-sums.
+
+    Level l (0-based, children of the root first) has branching**(l+1)
+    nodes per partition — same convention as QuantileTree._level_counts.
+    """
+    num_partitions = leaf_hist.shape[0]
+    levels = []
+    for level in range(height):
+        nodes = branching**(level + 1)
+        levels.append(
+            leaf_hist.reshape(num_partitions, nodes, -1).sum(axis=2))
+    return levels
+
+
+def walk_quantiles(noised_levels: Sequence[np.ndarray],
+                   quantiles: Sequence[float], lower: float, upper: float,
+                   branching: int) -> np.ndarray:
+    """[num_partitions, num_quantiles] quantile estimates from noised levels.
+
+    Vectorized twin of QuantileTree._locate_quantile: descend level by
+    level following the target rank; partitions whose subtree total drops
+    to <= 0 resolve to the middle of their current range.
+    """
+    b = branching
+    num_partitions = noised_levels[0].shape[0]
+    num_q = len(quantiles)
+    node = np.zeros((num_partitions, num_q), dtype=np.int64)
+    lo = np.full((num_partitions, num_q), lower, dtype=np.float64)
+    hi = np.full((num_partitions, num_q), upper, dtype=np.float64)
+    target = np.tile(np.asarray(quantiles, dtype=np.float64),
+                     (num_partitions, 1))
+    dead = np.zeros((num_partitions, num_q), dtype=bool)
+    dead_result = np.zeros((num_partitions, num_q), dtype=np.float64)
+
+    for level_nodes in noised_levels:
+        lvl = np.maximum(np.asarray(level_nodes, dtype=np.float64), 0.0)
+        idx = node[:, :, None] * b + np.arange(b)  # [P, Q, b]
+        children = np.take_along_axis(lvl[:, None, :], idx, axis=2)
+        total = children.sum(axis=2)
+        newly_dead = ~dead & (total <= 0)
+        dead_result = np.where(newly_dead, lo + (hi - lo) / 2, dead_result)
+        dead |= newly_dead
+        cum = np.cumsum(children, axis=2)
+        rank = target * total
+        # searchsorted(cum, rank, side="right"), clipped to the last child.
+        child = np.minimum((cum <= rank[:, :, None]).sum(axis=2), b - 1)
+        child_count = np.take_along_axis(children, child[:, :, None],
+                                         axis=2)[:, :, 0]
+        below = np.take_along_axis(cum, child[:, :, None],
+                                   axis=2)[:, :, 0] - child_count
+        target = np.where(child_count > 0,
+                          (rank - below) / np.maximum(child_count, 1e-300),
+                          0.5)
+        target = np.clip(target, 0.0, 1.0)
+        width = (hi - lo) / b
+        lo = lo + child * width
+        hi = lo + width
+        node = node * b + child
+    out = lo + target * (hi - lo)
+    return np.where(dead, dead_result, out)
+
+
+def noised_levels_host(levels: Sequence[np.ndarray], eps: float, delta: float,
+                       l0: int, linf: float,
+                       is_gaussian: bool) -> List[np.ndarray]:
+    """Secure host noise per level (float64, granularity-snapped sampler) —
+    identical budget math to QuantileTree._noise_counts."""
+    height = len(levels)
+    eps_l, delta_l = eps / height, delta / height
+    out = []
+    for counts in levels:
+        counts = np.asarray(counts, dtype=np.float64)
+        if is_gaussian:
+            sigma = noise_core.analytic_gaussian_sigma(
+                eps_l, delta_l, np.sqrt(l0) * linf)
+            out.append(counts + noise_core.sample_gaussian(
+                sigma, counts.shape))
+        else:
+            scale = noise_core.laplace_diversity(eps_l, l0 * linf)
+            out.append(counts + noise_core.sample_laplace(
+                scale, counts.shape))
+    return out
+
+
+def noised_levels_device(key: jax.Array, levels: Sequence[jnp.ndarray],
+                         eps: float, delta: float, l0: int, linf: float,
+                         is_gaussian: bool) -> List[np.ndarray]:
+    """Device-side batched noise per level (fast mode)."""
+    height = len(levels)
+    eps_l, delta_l = eps / height, delta / height
+    if is_gaussian:
+        sigma = noise_core.analytic_gaussian_sigma(eps_l, delta_l,
+                                                   np.sqrt(l0) * linf)
+        gran = noise_core.gaussian_granularity(sigma)
+    else:
+        scale = noise_core.laplace_diversity(eps_l, l0 * linf)
+        gran = noise_core.laplace_granularity(scale)
+    out = []
+    for i, counts in enumerate(levels):
+        k = jax.random.fold_in(key, i)
+        if is_gaussian:
+            out.append(np.asarray(
+                noise_ops.add_gaussian_noise(k, counts, sigma, gran)))
+        else:
+            out.append(np.asarray(
+                noise_ops.add_laplace_noise(k, counts, scale, gran)))
+    return out

@@ -36,6 +36,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pipelinedp_tpu.ops import columnar
+from pipelinedp_tpu.ops import quantiles as quantile_ops
 
 ROW_SPEC = P(("dp", "mp"))
 PART_SPEC = P(("dp", "mp"))
@@ -175,7 +176,48 @@ def _vector_kernel(mesh: Mesh, padded_p: int, norm_ord: int):
     return jax.jit(fn)
 
 
+@functools.lru_cache(maxsize=None)
+def _quantile_kernel(mesh: Mesh, padded_p: int, num_leaves: int):
+    """Sharded leaf-histogram kernel for the batched quantile trees."""
+
+    def local_step(key, pid, pk, value, valid, linf_cap, l0_cap, lower,
+                   upper):
+        mask = columnar.bound_row_mask(_device_key(key), pid, pk, valid,
+                                       linf_cap, l0_cap)
+        hist = quantile_ops.leaf_histograms(pk, value, mask,
+                                            num_partitions=padded_p,
+                                            num_leaves=num_leaves,
+                                            lower=lower,
+                                            upper=upper)
+        return _reduce_scatter(hist)
+
+    fn = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(),) + (ROW_SPEC,) * 4 + (P(),) * 4,
+        out_specs=PART_SPEC,
+        check_vma=False)
+    return jax.jit(fn)
+
+
+def quantile_leaf_histograms(mesh: Mesh, key, pid, pk, value, valid, *,
+                             num_partitions: int, num_leaves: int, lower,
+                             upper, linf_cap, l0_cap):
+    """Multi-chip [padded_p, num_leaves] quantile-tree leaf counts."""
+    padded_p = padded_num_partitions(mesh, num_partitions)
+    dpid, dpk, dval, dvalid = _shard_and_put(mesh, pid, pk, value, valid)
+    kernel = _quantile_kernel(mesh, padded_p, num_leaves)
+    return kernel(key, dpid, dpk, dval, dvalid, linf_cap, l0_cap,
+                  float(lower), float(upper))
+
+
 def _shard_and_put(mesh: Mesh, pid, pk, value, valid):
+    """Stages host rows onto the mesh; passes through already-staged
+    jax.Arrays so callers running several kernels over the same rows (e.g.
+    aggregate + quantile histogram) pay the host shuffle and transfer once.
+    """
+    if isinstance(pid, jax.Array):
+        return pid, pk, value, valid
     n_dev = mesh.devices.size
     spid, spk, sval, svalid = shard_rows_by_pid(np.asarray(pid),
                                                 np.asarray(pk),
@@ -184,6 +226,12 @@ def _shard_and_put(mesh: Mesh, pid, pk, value, valid):
     sharding = NamedSharding(mesh, ROW_SPEC)
     return tuple(
         jax.device_put(a, sharding) for a in (spid, spk, sval, svalid))
+
+
+def stage_rows(mesh: Mesh, pid, pk, value, valid):
+    """Public staging step: hash-shard + device_put once, reuse across
+    kernels."""
+    return _shard_and_put(mesh, pid, pk, value, valid)
 
 
 def bound_and_aggregate(mesh: Mesh,

@@ -154,6 +154,90 @@ class TestNoNoiseConformance:
         assert jax_res["a"].sum == pytest.approx(7.0, abs=0.1)
 
 
+class TestPercentile:
+    """PERCENTILE on the columnar engine: batched per-partition quantile
+    trees (ops/quantiles.py) must match the host QuantileTree path."""
+
+    def _params(self):
+        return pdp.AggregateParams(
+            metrics=[pdp.Metrics.PERCENTILE(50), pdp.Metrics.PERCENTILE(90)],
+            max_partitions_contributed=2,
+            max_contributions_per_partition=200,
+            min_value=0.0,
+            max_value=100.0)
+
+    def test_matches_local_engine_no_noise(self, engine_mesh):
+        rng = np.random.default_rng(5)
+        data = [(u, "a", float(v))
+                for u, v in enumerate(rng.uniform(0, 100, 400))]
+        data += [(u, "b", float(v))
+                 for u, v in enumerate(rng.uniform(40, 60, 300))]
+        jax_res, _, _ = run_jax(data, self._params(), public=["a", "b"],
+                                mesh=engine_mesh)
+        local_res, _ = run_local(data, self._params(), public=["a", "b"])
+        for pk in ("a", "b"):
+            assert jax_res[pk].percentile_50 == pytest.approx(
+                local_res[pk].percentile_50, abs=0.5)
+            assert jax_res[pk].percentile_90 == pytest.approx(
+                local_res[pk].percentile_90, abs=0.5)
+
+    def test_accuracy_against_raw_quantiles(self, engine_mesh):
+        rng = np.random.default_rng(6)
+        values = rng.uniform(0, 100, 500)
+        data = [(u, "a", float(v)) for u, v in enumerate(values)]
+        jax_res, _, _ = run_jax(data, self._params(), public=["a"],
+                                mesh=engine_mesh)
+        # Tree resolution is (100 - 0) / 16^4 per leaf; no-noise estimates
+        # land within a leaf width of the true quantiles.
+        assert jax_res["a"].percentile_50 == pytest.approx(
+            np.quantile(values, 0.5), abs=1.0)
+        assert jax_res["a"].percentile_90 == pytest.approx(
+            np.quantile(values, 0.9), abs=1.0)
+
+    def test_empty_partition_stays_in_range(self, engine_mesh):
+        # An empty public partition has all-zero counts; the walk follows
+        # residual noise (same as the host tree: max(noised, 0) rarely sums
+        # to exactly 0), so the only guarantee is the output range.
+        data = [(0, "a", 50.0)]
+        jax_res, _, _ = run_jax(data, self._params(), public=["a", "ghost"],
+                                mesh=engine_mesh)
+        assert 0.0 <= jax_res["ghost"].percentile_50 <= 100.0
+
+    def test_device_noise_mode(self, engine_mesh):
+        rng = np.random.default_rng(7)
+        values = rng.uniform(0, 100, 2000)
+        data = [(u, "a", float(v)) for u, v in enumerate(values)]
+        accountant = pdp.NaiveBudgetAccountant(5.0, 1e-6)
+        engine = pdp.JaxDPEngine(accountant, secure_host_noise=False,
+                                 mesh=engine_mesh)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PERCENTILE(50)],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            min_value=0.0,
+            max_value=100.0)
+        result = engine.aggregate(data, params, extractors(),
+                                  public_partitions=["a"])
+        accountant.compute_budgets()
+        res = dict(result)
+        assert res["a"].percentile_50 == pytest.approx(
+            np.quantile(values, 0.5), abs=10.0)
+
+    def test_mixed_with_count(self, engine_mesh):
+        data = [(u, "a", float(u % 10)) for u in range(100)]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.PERCENTILE(50)],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            min_value=0.0,
+            max_value=10.0)
+        jax_res, _, _ = run_jax(data, params, public=["a"], mesh=engine_mesh)
+        assert jax_res["a"].count == pytest.approx(100, abs=0.05)
+        # The true median (4.5) sits exactly on a leaf boundary; the walk
+        # resolves to the boundary leaf edge (5.0) ± residual noise.
+        assert jax_res["a"].percentile_50 == pytest.approx(4.5, abs=0.6)
+
+
 class TestBudgetParity:
 
     def test_same_budget_split_as_local_engine(self):

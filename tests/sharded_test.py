@@ -6,7 +6,6 @@ single-device kernel, and that JaxDPEngine(mesh=...) runs the full public
 API multi-chip."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
