@@ -1,0 +1,70 @@
+"""Shared helpers for the movie-view-ratings examples.
+
+Data loading / synthesis only — all privacy logic lives in the example
+scripts. Input format is the Netflix-prize text layout the reference
+examples consume (movie_view_ratings/common_utils.py: "movie_id:" header
+lines followed by "user_id,rating,date" lines); when no input file is given
+the examples synthesize a workload of the same shape so they run anywhere.
+"""
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class MovieView:
+    user_id: int
+    movie_id: int
+    rating: int
+
+
+def parse_file(filename):
+    """Parses the Netflix-prize text format into MovieView rows."""
+    views = []
+    movie_id = None
+    with open(filename) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            if line[-1] == ":":
+                movie_id = int(line[:-1])
+            else:
+                parts = line.split(",")
+                views.append(
+                    MovieView(user_id=int(parts[0]),
+                              movie_id=movie_id,
+                              rating=int(parts[1])))
+    return views
+
+
+def synthesize_columns(n_rows=2_000_000, n_movies=10_000, n_users=200_000,
+                       seed=0):
+    """Synthetic movie-view columns with a Zipf-ish popularity head.
+
+    Returns (user_id, movie_id, rating) int numpy columns — the columnar
+    shape the TPU engine ingests directly.
+    """
+    rng = np.random.default_rng(seed)
+    movie_id = np.minimum((n_movies * rng.random(n_rows)**3).astype(np.int64),
+                          n_movies - 1)
+    user_id = rng.integers(0, n_users, n_rows)
+    rating = rng.integers(1, 6, n_rows)
+    return user_id, movie_id, rating
+
+
+def synthesize_views(n_rows=200_000, n_movies=1_000, n_users=20_000, seed=0):
+    """Synthetic MovieView rows (the per-row shape the host engine eats)."""
+    user_id, movie_id, rating = synthesize_columns(n_rows, n_movies, n_users,
+                                                   seed)
+    return [
+        MovieView(int(u), int(m), int(r))
+        for u, m, r in zip(user_id, movie_id, rating)
+    ]
+
+
+def write_to_file(rows, filename):
+    with open(filename, "w") as f:
+        for row in rows:
+            f.write(f"{row}\n")

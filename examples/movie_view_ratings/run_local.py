@@ -1,0 +1,81 @@
+"""DP movie-view statistics on the host engine (correctness oracle).
+
+Mirror of the reference's run_without_frameworks.py:101-113: the same
+aggregation as run_on_tpu.py, executed by DPEngine over the lazy
+LocalBackend. Useful for small data and for diffing against the TPU path.
+
+    python run_local.py [--input_file=...] [--output_file=...]
+"""
+
+import argparse
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import pipelinedp_tpu as pdp
+
+from common_utils import parse_file, synthesize_views, write_to_file
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--input_file", default=None)
+    parser.add_argument("--output_file", default=None)
+    parser.add_argument("--multiproc", action="store_true",
+                        help="Use the multi-process local backend")
+    args = parser.parse_args()
+
+    # Small synthetic default: the host engine is the small-data /
+    # correctness path (use run_on_tpu.py for scale).
+    movie_views = (parse_file(args.input_file) if args.input_file else
+                   synthesize_views(n_rows=20_000, n_movies=200,
+                                    n_users=5_000))
+
+    backend = (pdp.MultiProcLocalBackend()
+               if args.multiproc else pdp.LocalBackend())
+    budget_accountant = pdp.NaiveBudgetAccountant(total_epsilon=1,
+                                                  total_delta=1e-6)
+    dp_engine = pdp.DPEngine(budget_accountant, backend)
+
+    params = pdp.AggregateParams(
+        metrics=[
+            pdp.Metrics.COUNT,
+            pdp.Metrics.SUM,
+            pdp.Metrics.PRIVACY_ID_COUNT,
+            pdp.Metrics.PERCENTILE(50),
+            pdp.Metrics.PERCENTILE(90),
+        ],
+        noise_kind=pdp.NoiseKind.GAUSSIAN,
+        max_partitions_contributed=2,
+        max_contributions_per_partition=1,
+        min_value=1,
+        max_value=5)
+
+    data_extractors = pdp.DataExtractors(
+        partition_extractor=lambda mv: mv.movie_id,
+        privacy_id_extractor=lambda mv: mv.user_id,
+        value_extractor=lambda mv: mv.rating)
+
+    explain_computation_report = pdp.ExplainComputationReport()
+    dp_result = dp_engine.aggregate(
+        movie_views,
+        params,
+        data_extractors,
+        out_explain_computation_report=explain_computation_report)
+    budget_accountant.compute_budgets()
+
+    print(explain_computation_report.text())
+
+    dp_result = list(dp_result)
+    print(f"{len(dp_result)} partitions released")
+    for movie, stats in dp_result[:5]:
+        print(movie, stats)
+    if args.output_file:
+        write_to_file(dp_result, args.output_file)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""DP restaurant-visit statistics through the QueryBuilder API.
+
+Role of the reference's examples/restaurant_visits demos, using the
+high-level frame API instead of hand-built AggregateParams: visits per
+weekday and money spent, with public weekday keys.
+
+    python run_query_builder.py
+"""
+
+import numpy as np
+import pandas as pd
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import dataframes
+
+
+def synthesize_visits(n_visitors=5_000, seed=0) -> pd.DataFrame:
+    rng = np.random.default_rng(seed)
+    rows = []
+    for visitor in range(n_visitors):
+        # Each visitor eats out on a few random weekdays.
+        for day in rng.choice(7, size=rng.integers(1, 5), replace=False):
+            rows.append((visitor, int(day), float(rng.uniform(5, 40))))
+    return pd.DataFrame(rows, columns=["visitor_id", "day", "spent_money"])
+
+
+def main():
+    df = synthesize_visits()
+
+    query = (pdp.QueryBuilder(df, "visitor_id").groupby(
+        "day",
+        max_groups_contributed=3,
+        max_contributions_per_group=1,
+        public_keys=list(range(7))).count().sum(
+            "spent_money", min_value=0,
+            max_value=40).mean("spent_money").build_query())
+
+    result = query.run_query(dataframes.Budget(epsilon=1, delta=1e-6),
+                             noise_kind=pdp.NoiseKind.GAUSSIAN)
+    print(result.sort_values("day").to_string(index=False))
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,8 @@ from pipelinedp_tpu.backends.local import LocalBackend, MultiProcLocalBackend
 from pipelinedp_tpu.combiners import CustomCombiner
 from pipelinedp_tpu.dp_engine import DPEngine
 from pipelinedp_tpu.jax_engine import JaxDPEngine, LazyJaxResult
+from pipelinedp_tpu import dataframes
+from pipelinedp_tpu.dataframes import QueryBuilder
 
 __version__ = "0.1.0"
 
@@ -82,6 +84,7 @@ __all__ = [
     "PreAggregateExtractors",
     "PrivacyIdCountParams",
     "PrivateContributionBounds",
+    "QueryBuilder",
     "SelectPartitionsParams",
     "SumParams",
     "VarianceParams",

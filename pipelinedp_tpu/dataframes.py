@@ -1,0 +1,447 @@
+"""DP QueryBuilder over columnar frames (pandas or dict-of-arrays).
+
+High-level SQL-ish API: ``QueryBuilder(df, "user_id").groupby(...).count()
+.sum(...).mean(...).build_query().run_query(Budget(...))``. Role parity with
+the reference's Spark-DataFrame query builder
+(/root/reference/pipeline_dp/dataframes.py:264-495), redesigned for the
+columnar TPU engine: the input is a pandas DataFrame or a plain
+``{column: np.ndarray}`` dict, the columns feed ``JaxDPEngine`` as
+``ColumnarData`` with no per-row conversion, and the DP result comes back
+as a frame of the same kind.
+
+Extras over the reference builder: ``variance``, ``privacy_id_count`` and
+``percentile`` aggregations (the engine supports them, so the builder
+exposes them), and an ``engine=`` knob on ``run_query`` to run the same
+query on the host oracle (``DPEngine`` + ``LocalBackend``) instead of the
+TPU path.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from pipelinedp_tpu import aggregate_params as agg
+from pipelinedp_tpu import budget_accounting
+from pipelinedp_tpu import input_validators
+from pipelinedp_tpu.aggregate_params import Metric, Metrics, NoiseKind
+from pipelinedp_tpu.data_extractors import DataExtractors
+from pipelinedp_tpu.ops.encoding import ColumnarData
+
+
+@dataclasses.dataclass
+class Budget:
+    """Total (epsilon, delta) for one query."""
+    epsilon: float
+    delta: float = 0
+
+    def __post_init__(self):
+        input_validators.validate_epsilon_delta(self.epsilon, self.delta,
+                                                "Budget")
+
+
+@dataclasses.dataclass
+class Columns:
+    privacy_key: str
+    partition_key: Union[str, Sequence[str]]
+    value: Optional[str]
+
+
+@dataclasses.dataclass
+class ContributionBounds:
+    max_partitions_contributed: Optional[int] = None
+    max_contributions_per_partition: Optional[int] = None
+    min_value: Optional[float] = None
+    max_value: Optional[float] = None
+
+
+class FrameConverter(abc.ABC):
+    """Conversion between a user frame type and engine columns."""
+
+    @abc.abstractmethod
+    def frame_to_columns(self, df, columns: Columns) -> ColumnarData:
+        """Extracts (pid, pk, value) columns from the frame."""
+
+    @abc.abstractmethod
+    def columns_to_frame(self, data: Dict[str, np.ndarray]):
+        """Builds an output frame from named result columns."""
+
+    @abc.abstractmethod
+    def column_names(self, df) -> List[str]:
+        """Column names present in the frame."""
+
+
+class PandasConverter(FrameConverter):
+    """pandas.DataFrame <-> engine columns."""
+
+    def frame_to_columns(self, df, columns: Columns) -> ColumnarData:
+        pid = df[columns.privacy_key].to_numpy()
+        pk = _combine_key_columns(
+            [df[c].to_numpy() for c in _as_list(columns.partition_key)])
+        value = (df[columns.value].to_numpy()
+                 if columns.value is not None else None)
+        return ColumnarData(pid=pid, pk=pk, value=value)
+
+    def columns_to_frame(self, data: Dict[str, np.ndarray]):
+        import pandas as pd
+        return pd.DataFrame(data)
+
+    def column_names(self, df) -> List[str]:
+        return list(df.columns)
+
+
+class DictConverter(FrameConverter):
+    """{name: np.ndarray} <-> engine columns."""
+
+    def frame_to_columns(self, df, columns: Columns) -> ColumnarData:
+        pk = _combine_key_columns(
+            [np.asarray(df[c]) for c in _as_list(columns.partition_key)])
+        value = (np.asarray(df[columns.value])
+                 if columns.value is not None else None)
+        return ColumnarData(pid=np.asarray(df[columns.privacy_key]),
+                            pk=pk,
+                            value=value)
+
+    def columns_to_frame(self, data: Dict[str, np.ndarray]):
+        return data
+
+    def column_names(self, df) -> List[str]:
+        return list(df.keys())
+
+
+def _as_list(key: Union[str, Sequence[str]]) -> List[str]:
+    return [key] if isinstance(key, str) else list(key)
+
+
+def _combine_key_columns(arrays: List[np.ndarray]) -> np.ndarray:
+    """One partition-key column from one or more key columns.
+
+    A single column passes through (fully vectorized encoding downstream).
+    Multiple columns become an object array of tuples — the composite key
+    stays a real tuple so public keys and decoded output keys round-trip
+    exactly.
+    """
+    if len(arrays) == 1:
+        return arrays[0]
+    out = np.empty(len(arrays[0]), dtype=object)
+    out[:] = list(zip(*(a.tolist() for a in arrays)))
+    return out
+
+
+def _create_converter(df) -> FrameConverter:
+    try:
+        import pandas as pd
+        if isinstance(df, pd.DataFrame):
+            return PandasConverter()
+    except ImportError:
+        pass
+    if isinstance(df, dict):
+        return DictConverter()
+    raise NotImplementedError(
+        f"Frames of type {type(df)} are not supported; pass a pandas "
+        f"DataFrame or a dict of numpy columns")
+
+
+@dataclasses.dataclass
+class _AggregationSpec:
+    """One aggregation of the query (metric + input/output columns)."""
+    metric: Metric
+    input_column: Optional[str]
+    output_column: Optional[str]
+    min_value: Optional[float] = None
+    max_value: Optional[float] = None
+
+
+class Query:
+    """A built DP query. Create through QueryBuilder."""
+
+    def __init__(self, df, columns: Columns,
+                 metrics_output_columns: Dict[Metric, Optional[str]],
+                 contribution_bounds: ContributionBounds,
+                 public_partitions: Optional[Iterable]):
+        self._df = df
+        self._columns = columns
+        self._metrics_output_columns = metrics_output_columns
+        self._contribution_bounds = contribution_bounds
+        self._public_partitions = public_partitions
+
+    def run_query(self,
+                  budget: Budget,
+                  noise_kind: NoiseKind = NoiseKind.LAPLACE,
+                  engine: str = "jax",
+                  seed: int = 0):
+        """Runs the query and returns a frame of the input's kind.
+
+        engine: "jax" (columnar TPU engine, default) or "local" (host
+          oracle, DPEngine over LocalBackend).
+        """
+        converter = _create_converter(self._df)
+        accountant = budget_accounting.NaiveBudgetAccountant(
+            total_epsilon=budget.epsilon, total_delta=budget.delta)
+        metrics = list(self._metrics_output_columns.keys())
+        params = agg.AggregateParams(
+            noise_kind=noise_kind,
+            metrics=metrics,
+            max_partitions_contributed=self._contribution_bounds.
+            max_partitions_contributed,
+            max_contributions_per_partition=self._contribution_bounds.
+            max_contributions_per_partition,
+            min_value=self._contribution_bounds.min_value,
+            max_value=self._contribution_bounds.max_value)
+        public = (list(self._public_partitions)
+                  if self._public_partitions is not None else None)
+        data = converter.frame_to_columns(self._df, self._columns)
+
+        if engine == "jax":
+            from pipelinedp_tpu import jax_engine
+            eng = jax_engine.JaxDPEngine(accountant, seed=seed)
+            result = eng.aggregate(data, params, public_partitions=public)
+            accountant.compute_budgets()
+            rows = list(result)
+        elif engine == "local":
+            from pipelinedp_tpu import dp_engine
+            from pipelinedp_tpu.backends import LocalBackend
+            eng = dp_engine.DPEngine(accountant, LocalBackend())
+            value_col = (data.value if data.value is not None else
+                         np.zeros(len(data.pk)))
+            row_iter = list(zip(data.pid.tolist(), data.pk.tolist(),
+                                np.asarray(value_col).tolist()))
+            extractors = DataExtractors(
+                privacy_id_extractor=lambda r: r[0],
+                partition_extractor=lambda r: r[1],
+                value_extractor=lambda r: r[2])
+            result = eng.aggregate(row_iter, params, extractors,
+                                   public_partitions=public)
+            accountant.compute_budgets()
+            rows = list(result)
+        else:
+            raise ValueError(f"Unknown engine {engine!r}; use 'jax' or "
+                             f"'local'")
+        return self._rows_to_frame(converter, rows)
+
+    def _rows_to_frame(self, converter: FrameConverter, rows):
+        key_columns = _as_list(self._columns.partition_key)
+        name_map = {}  # engine metric name -> output column
+        for metric, output_column in self._metrics_output_columns.items():
+            engine_name = _metric_output_name(metric)
+            name_map[engine_name] = output_column or engine_name
+        out: Dict[str, list] = {c: [] for c in key_columns}
+        for name in name_map.values():
+            out[name] = []
+        for pk, metrics_tuple in rows:
+            if len(key_columns) == 1:
+                out[key_columns[0]].append(pk)
+            else:
+                for col, part in zip(key_columns, pk):
+                    out[col].append(part)
+            for engine_name, value in metrics_tuple._asdict().items():
+                if engine_name in name_map:
+                    out[name_map[engine_name]].append(value)
+        return converter.columns_to_frame(
+            {name: np.asarray(vals) for name, vals in out.items()})
+
+
+def _metric_output_name(metric: Metric) -> str:
+    if metric.is_percentile:
+        # Must match QuantileCombiner.metrics_names formatting exactly
+        # (combiners.py), e.g. percentile_90 but percentile_99_5.
+        p = metric.parameter
+        int_p = int(round(p))
+        text = str(int_p) if int_p == p else str(p).replace(".", "_")
+        return f"percentile_{text}"
+    return metric.name.lower()
+
+
+class QueryBuilder:
+    """Builds DP queries over a pandas DataFrame or a dict of columns.
+
+    Builder pattern — every method except build_query returns self:
+
+        query = (QueryBuilder(df, "user_id")
+                 .groupby("day", max_groups_contributed=3,
+                          max_contributions_per_group=1)
+                 .count()
+                 .sum("spent_money", min_value=0, max_value=100)
+                 .mean("spent_money")
+                 .build_query())
+        result = query.run_query(Budget(epsilon=1, delta=1e-6))
+    """
+
+    def __init__(self, df, privacy_unit_column: str):
+        self._converter = _create_converter(df)
+        if privacy_unit_column not in self._converter.column_names(df):
+            raise ValueError(
+                f"Column {privacy_unit_column} is not present in the frame")
+        self._df = df
+        self._privacy_unit_column = privacy_unit_column
+        self._by: Optional[Union[str, Sequence[str]]] = None
+        self._public_keys = None
+        self._aggregations_specs: List[_AggregationSpec] = []
+        self._max_partitions_contributed: Optional[int] = None
+        self._max_contributions_per_partition: Optional[int] = None
+
+    def groupby(self,
+                by: Union[str, Sequence[str]],
+                *,
+                max_groups_contributed: int,
+                max_contributions_per_group: int,
+                public_keys: Optional[Iterable[Any]] = None) -> "QueryBuilder":
+        """Sets the partition key column(s) and the contribution bounds.
+
+        With public_keys the output keys coincide exactly with the given
+        keys (missing ones get noise-only values); otherwise keys are
+        selected with DP.
+        """
+        if self._by is not None:
+            raise ValueError("groupby can be called only once")
+        names = self._converter.column_names(self._df)
+        for column in _as_list(by):
+            if column not in names:
+                raise ValueError(
+                    f"Column {column} is not present in the frame")
+        self._by = by
+        self._max_partitions_contributed = max_groups_contributed
+        self._max_contributions_per_partition = max_contributions_per_group
+        self._public_keys = public_keys
+        return self
+
+    def count(self, name: Optional[str] = None) -> "QueryBuilder":
+        return self._add_aggregation(
+            _AggregationSpec(metric=Metrics.COUNT,
+                             input_column=None,
+                             output_column=name))
+
+    def privacy_id_count(self, name: Optional[str] = None) -> "QueryBuilder":
+        return self._add_aggregation(
+            _AggregationSpec(metric=Metrics.PRIVACY_ID_COUNT,
+                             input_column=None,
+                             output_column=name))
+
+    def sum(self,
+            column: str,
+            *,
+            min_value: Optional[float] = None,
+            max_value: Optional[float] = None,
+            name: Optional[str] = None) -> "QueryBuilder":
+        return self._add_aggregation(
+            _AggregationSpec(metric=Metrics.SUM,
+                             input_column=column,
+                             output_column=name,
+                             min_value=min_value,
+                             max_value=max_value))
+
+    def mean(self,
+             column: str,
+             *,
+             min_value: Optional[float] = None,
+             max_value: Optional[float] = None,
+             name: Optional[str] = None) -> "QueryBuilder":
+        return self._add_aggregation(
+            _AggregationSpec(metric=Metrics.MEAN,
+                             input_column=column,
+                             output_column=name,
+                             min_value=min_value,
+                             max_value=max_value))
+
+    def variance(self,
+                 column: str,
+                 *,
+                 min_value: Optional[float] = None,
+                 max_value: Optional[float] = None,
+                 name: Optional[str] = None) -> "QueryBuilder":
+        return self._add_aggregation(
+            _AggregationSpec(metric=Metrics.VARIANCE,
+                             input_column=column,
+                             output_column=name,
+                             min_value=min_value,
+                             max_value=max_value))
+
+    def percentile(self,
+                   column: str,
+                   percentile: float,
+                   *,
+                   min_value: Optional[float] = None,
+                   max_value: Optional[float] = None,
+                   name: Optional[str] = None) -> "QueryBuilder":
+        return self._add_aggregation(
+            _AggregationSpec(metric=Metrics.PERCENTILE(percentile),
+                             input_column=column,
+                             output_column=name,
+                             min_value=min_value,
+                             max_value=max_value))
+
+    def build_query(self) -> Query:
+        self._check_by()
+        if not self._aggregations_specs:
+            raise ValueError(
+                "No aggregations in the query. Call count, sum, mean etc")
+        metrics = [spec.metric for spec in self._aggregations_specs]
+        if len(set(metrics)) != len(metrics):
+            raise ValueError("Each aggregation can be added only once.")
+        input_column = self._get_input_column()
+        min_value, max_value = self._get_value_caps()
+        contribution_bounds = ContributionBounds(
+            max_partitions_contributed=self._max_partitions_contributed,
+            max_contributions_per_partition=self.
+            _max_contributions_per_partition,
+            min_value=min_value,
+            max_value=max_value)
+        metric_to_output_column = dict(
+            (spec.metric, spec.output_column)
+            for spec in self._aggregations_specs)
+        return Query(self._df,
+                     Columns(self._privacy_unit_column, self._by,
+                             input_column), metric_to_output_column,
+                     contribution_bounds, self._public_keys)
+
+    def _add_aggregation(self, spec: _AggregationSpec) -> "QueryBuilder":
+        self._check_by()
+        if spec.input_column is not None:
+            if spec.input_column not in self._converter.column_names(
+                    self._df):
+                raise ValueError(
+                    f"Column {spec.input_column} is not present in the frame")
+        self._aggregations_specs.append(spec)
+        return self
+
+    def _check_by(self) -> None:
+        if self._by is None:
+            raise NotImplementedError(
+                "Global aggregations are not implemented yet. Call groupby")
+
+    def _get_input_column(self) -> Optional[str]:
+        input_columns = [
+            spec.input_column for spec in self._aggregations_specs
+            if spec.input_column is not None
+        ]
+        if len(set(input_columns)) > 1:
+            raise NotImplementedError(
+                f"Aggregation of only one column is supported, but "
+                f"{input_columns} given")
+        return input_columns[0] if input_columns else None
+
+    def _get_value_caps(self) -> Tuple[Optional[float], Optional[float]]:
+        metrics = set(spec.metric for spec in self._aggregations_specs)
+        needs_caps = metrics.difference(
+            [Metrics.COUNT, Metrics.PRIVACY_ID_COUNT])
+        if not needs_caps:
+            return None, None
+        min_values = [
+            spec.min_value for spec in self._aggregations_specs
+            if spec.min_value is not None
+        ]
+        max_values = [
+            spec.max_value for spec in self._aggregations_specs
+            if spec.max_value is not None
+        ]
+        if not min_values or not max_values:
+            raise ValueError("min_value and max_value must be given at least "
+                             "once as arguments of sum or mean")
+        if min(min_values) != max(min_values) or (min(max_values) !=
+                                                  max(max_values)):
+            raise ValueError("If min_value and max_value provided multiple "
+                             "times they must be the same")
+        return min_values[0], max_values[0]

@@ -37,6 +37,7 @@ from pipelinedp_tpu.aggregate_params import (AggregateParams, MechanismType,
 from pipelinedp_tpu import dp_engine as dp_engine_lib
 from pipelinedp_tpu.data_extractors import DataExtractors
 from pipelinedp_tpu.ops import columnar, encoding, noise as noise_ops
+from pipelinedp_tpu.ops import streaming
 from pipelinedp_tpu.ops import quantiles as quantile_ops
 from pipelinedp_tpu.ops import selection as selection_ops
 from pipelinedp_tpu import quantile_tree as quantile_tree_lib
@@ -146,13 +147,23 @@ class JaxDPEngine:
                  budget_accountant: budget_accounting.BudgetAccountant,
                  seed: int = 0,
                  secure_host_noise: bool = True,
-                 mesh=None):
+                 mesh=None,
+                 stream_chunks: Optional[int] = None,
+                 value_transfer_dtype=None):
         self._budget_accountant = budget_accountant
         self._report_generators = []
         self._root_key = jax.random.PRNGKey(seed)
         self._key_counter = 0
         self._secure_host_noise = secure_host_noise
         self._mesh = mesh
+        # Streaming execution: large single-device inputs are hash-sharded
+        # by privacy id into pid-disjoint chunks so the host->device
+        # transfer overlaps the kernel (ops/streaming.py). stream_chunks=1
+        # forces the single-shot path; None = auto.
+        self._stream_chunks = stream_chunks
+        # np.float16 halves the value-column transfer (lossy ingest,
+        # opt-in; see ops/streaming.py).
+        self._value_transfer_dtype = value_transfer_dtype
 
     def _next_key(self):
         self._key_counter += 1
@@ -215,6 +226,12 @@ class JaxDPEngine:
                 raise ValueError(
                     "PERCENTILE requires min_value and max_value (the "
                     "quantile tree range).")
+            if params.min_value >= params.max_value:
+                # A zero-width tree range would produce NaN leaf indices
+                # on device; fail loudly like the host quantile tree does.
+                raise ValueError(
+                    "PERCENTILE requires min_value < max_value (got "
+                    f"[{params.min_value}, {params.max_value}]).")
 
     def _aggregate(self, col, params, data_extractors, public_partitions):
         # Same budget requests as the reference graph.
@@ -242,7 +259,8 @@ class JaxDPEngine:
             data_extractors.partition_extractor if data_extractors else None,
             data_extractors.value_extractor if data_extractors else None,
             public_partitions=public_partitions,
-            vector_size=params.vector_size if is_vector else None)
+            vector_size=params.vector_size if is_vector else None,
+            factorize_pid=False)
         num_partitions = max(len(pk_vocab), 1)
 
         # When no child combiner expects per-partition sampling (e.g. the
@@ -299,7 +317,10 @@ class JaxDPEngine:
                  key, pid, pk, value, num_partitions, linf_cap, l0_cap,
                  is_public: bool, is_vector: bool) -> dict:
         k_kernel, k_select, k_noise = jax.random.split(key, 3)
-        valid_rows = np.ones(len(pid), dtype=bool)
+        n_rows = len(pid)
+        has_quantile = any(
+            isinstance(c, combiners_lib.QuantileCombiner)
+            for c in compound.combiners)
 
         if params.bounds_per_partition_are_set:
             row_lo, row_hi = -np.inf, np.inf
@@ -322,6 +343,7 @@ class JaxDPEngine:
             from pipelinedp_tpu.parallel import sharded
             # Stage (hash-shard + device_put) once; both the aggregate and
             # the quantile-histogram kernels reuse the staged arrays.
+            valid_rows = np.ones(n_rows, dtype=bool)
             pid, pk, value, valid_rows = sharded.stage_rows(
                 self._mesh, pid, pk, value, valid_rows)
             if is_vector:
@@ -346,16 +368,34 @@ class JaxDPEngine:
         elif is_vector:
             vector_sums, accs = columnar.bound_and_aggregate_vector(
                 k_kernel, jnp.asarray(pid), jnp.asarray(pk),
-                jnp.asarray(value), jnp.asarray(valid_rows),
+                jnp.asarray(value), jnp.ones(n_rows, dtype=bool),
                 num_partitions=num_partitions,
                 linf_cap=linf_cap,
                 l0_cap=l0_cap,
                 max_norm=params.vector_max_norm,
                 norm_ord=norm_ord)
+        elif (not has_quantile and self._stream_chunks != 1 and
+              (self._stream_chunks is not None or
+               n_rows >= streaming.MIN_STREAM_ROWS)):
+            # Large single-device input: pid-disjoint chunked pipeline so
+            # the host->device transfer overlaps the kernel and ships
+            # byte-packed columns (ops/streaming.py; exact, see module doc).
+            accs = streaming.stream_bound_and_aggregate(
+                k_kernel, pid, pk, value,
+                num_partitions=num_partitions,
+                linf_cap=linf_cap,
+                l0_cap=l0_cap,
+                row_clip_lo=row_lo,
+                row_clip_hi=row_hi,
+                middle=middle,
+                group_clip_lo=glo,
+                group_clip_hi=ghi,
+                n_chunks=self._stream_chunks,
+                value_transfer_dtype=self._value_transfer_dtype)
         else:
             accs = columnar.bound_and_aggregate(
                 k_kernel, jnp.asarray(pid), jnp.asarray(pk),
-                jnp.asarray(value), jnp.asarray(valid_rows),
+                jnp.asarray(value), jnp.ones(n_rows, dtype=bool),
                 num_partitions=num_partitions,
                 linf_cap=linf_cap,
                 l0_cap=l0_cap,
@@ -402,7 +442,8 @@ class JaxDPEngine:
                 row_keep = columnar.bound_row_mask(k_kernel,
                                                    jnp.asarray(pid),
                                                    jnp.asarray(pk),
-                                                   jnp.asarray(valid_rows),
+                                                   jnp.ones(n_rows,
+                                                            dtype=bool),
                                                    linf_cap, l0_cap)
                 quantile_hist = quantile_ops.leaf_histograms(
                     jnp.asarray(pk),

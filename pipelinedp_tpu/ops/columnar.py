@@ -51,6 +51,70 @@ def _segment_rank(sorted_is_start: jnp.ndarray) -> jnp.ndarray:
     return idx - seg_start
 
 
+class SampledRows(NamedTuple):
+    """The Linf/L0 sampling decisions, in (pid, pk, uniform)-sorted order.
+
+    The single source of truth for contribution bounding: every kernel
+    (scalar, vector, row-mask) derives from this so their sampling stays
+    bit-identical for the same PRNG key.
+    """
+    order: jnp.ndarray  # row permutation into sorted order
+    spid: jnp.ndarray  # sorted pid keys (padding -> INT32_MAX)
+    spk: jnp.ndarray  # sorted pk keys (padding -> INT32_MAX)
+    svalid: jnp.ndarray  # sorted validity
+    is_start: jnp.ndarray  # (pid, pk)-group start marker
+    group_id: jnp.ndarray  # dense (pid, pk)-group index per sorted row
+    keep_row: jnp.ndarray  # Linf sampling decision per sorted row
+    keep_group: jnp.ndarray  # L0 sampling decision per group slot
+    g_valid: jnp.ndarray  # group slot holds a real group
+
+
+def _sample_rows_and_groups(key: jax.Array, pid: jnp.ndarray,
+                            pk: jnp.ndarray, valid: jnp.ndarray, linf_cap,
+                            l0_cap) -> SampledRows:
+    """Sorts rows by (pid, pk, uniform) and samples Linf rows / L0 groups.
+
+    The uniform tiebreak makes each (pid, pk) group a random permutation,
+    so "rank < cap" is exact sampling without replacement (the
+    sample_fixed_per_key of the reference, done once for all keys).
+    """
+    n = pid.shape[0]
+    k1, k2 = jax.random.split(key)
+
+    # Padding rows sort to the very end.
+    pid_key = jnp.where(valid, pid, _INT32_MAX)
+    pk_key = jnp.where(valid, pk, _INT32_MAX)
+
+    # -- sort rows by (pid, pk, uniform), rank within (pid, pk) -----------
+    tiebreak = jax.random.uniform(k1, (n,))
+    order = jnp.lexsort((tiebreak, pk_key, pid_key))
+    spid = pid_key[order]
+    spk = pk_key[order]
+    svalid = valid[order]
+    is_start = jnp.concatenate([
+        jnp.ones((1,), dtype=bool),
+        (spid[1:] != spid[:-1]) | (spk[1:] != spk[:-1])
+    ])
+    keep_row = svalid & (_segment_rank(is_start) < linf_cap)
+    group_id = (jnp.cumsum(is_start) - 1).astype(jnp.int32)
+
+    # -- L0 sampling over (pid, pk) groups ---------------------------------
+    start_w = (is_start & svalid).astype(jnp.int32)
+    g_pid = jax.ops.segment_sum(spid * start_w, group_id, num_segments=n)
+    g_valid = jax.ops.segment_sum(start_w, group_id, num_segments=n) > 0
+    g_rand = jax.random.uniform(k2, (n,))
+    g_pid_key = jnp.where(g_valid, g_pid, _INT32_MAX)
+    order2 = jnp.lexsort((g_rand, g_pid_key))
+    sg_pid = g_pid_key[order2]
+    is_start2 = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), sg_pid[1:] != sg_pid[:-1]])
+    keep_sorted = _segment_rank(is_start2) < l0_cap
+    keep_group = jnp.zeros((n,), dtype=bool).at[order2].set(keep_sorted)
+    keep_group = keep_group & g_valid
+    return SampledRows(order, spid, spk, svalid, is_start, group_id,
+                       keep_row, keep_group, g_valid)
+
+
 @functools.partial(jax.jit, static_argnames=("num_partitions",))
 def bound_and_aggregate(key: jax.Array,
                         pid: jnp.ndarray,
@@ -85,60 +149,26 @@ def bound_and_aggregate(key: jax.Array,
     if n == 0:
         zeros = jnp.zeros((num_partitions,), dtype=value.dtype)
         return PartitionAccumulators(zeros, zeros, zeros, zeros, zeros)
-    k1, k2, = jax.random.split(key)
+    s = _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap)
+    sval = value[s.order]
 
-    # Padding rows sort to the very end.
-    pid_key = jnp.where(valid, pid, _INT32_MAX)
-    pk_key = jnp.where(valid, pk, _INT32_MAX)
-
-    # -- step 1: sort rows by (pid, pk, uniform) ---------------------------
-    tiebreak = jax.random.uniform(k1, (n,))
-    order = jnp.lexsort((tiebreak, pk_key, pid_key))
-    spid = pid_key[order]
-    spk = pk_key[order]
-    sval = value[order]
-    svalid = valid[order]
-
-    # -- step 2: Linf bounding ---------------------------------------------
-    is_start = jnp.concatenate([
-        jnp.ones((1,), dtype=bool),
-        (spid[1:] != spid[:-1]) | (spk[1:] != spk[:-1])
-    ])
-    rank = _segment_rank(is_start)
-    keep_row = svalid & (rank < linf_cap)
-
-    # -- step 3: rows -> (pid, pk) group accumulators ----------------------
-    group_id = (jnp.cumsum(is_start) - 1).astype(jnp.int32)
-    w = keep_row.astype(sval.dtype)
+    # -- rows -> (pid, pk) group accumulators ------------------------------
+    w = s.keep_row.astype(sval.dtype)
     vclip = jnp.clip(sval, row_clip_lo, row_clip_hi)
     vnorm = vclip - middle
     seg = functools.partial(jax.ops.segment_sum,
-                            segment_ids=group_id,
+                            segment_ids=s.group_id,
                             num_segments=n)
     g_count = seg(w)
     g_sum = jnp.clip(seg(vclip * w), group_clip_lo, group_clip_hi)
     g_norm = seg(vnorm * w)
     g_norm_sq = seg(vnorm * vnorm * w)
-    start_w = (is_start & svalid).astype(jnp.int32)
-    g_pid = seg(spid * start_w)
-    g_pk = seg(spk * start_w)
-    g_valid = seg(start_w.astype(sval.dtype)) > 0
+    start_w = (s.is_start & s.svalid).astype(jnp.int32)
+    g_pk = seg(s.spk * start_w)
 
-    # -- step 4: L0 bounding over groups -----------------------------------
-    g_rand = jax.random.uniform(k2, (n,))
-    g_pid_key = jnp.where(g_valid, g_pid, _INT32_MAX)
-    order2 = jnp.lexsort((g_rand, g_pid_key))
-    sg_pid = g_pid_key[order2]
-    is_start2 = jnp.concatenate(
-        [jnp.ones((1,), dtype=bool), sg_pid[1:] != sg_pid[:-1]])
-    rank2 = _segment_rank(is_start2)
-    keep_sorted = rank2 < l0_cap
-    keep_group = jnp.zeros((n,), dtype=bool).at[order2].set(keep_sorted)
-    keep_group = keep_group & g_valid
-
-    # -- step 5: groups -> per-partition accumulators ----------------------
-    gw = keep_group.astype(sval.dtype)
-    g_pk_safe = jnp.where(keep_group, g_pk, 0).astype(jnp.int32)
+    # -- kept groups -> per-partition accumulators -------------------------
+    gw = s.keep_group.astype(sval.dtype)
+    g_pk_safe = jnp.where(s.keep_group, g_pk, 0).astype(jnp.int32)
     pseg = functools.partial(jax.ops.segment_sum,
                              segment_ids=g_pk_safe,
                              num_segments=num_partitions)
@@ -177,13 +207,8 @@ def bound_and_aggregate_vector(key: jax.Array,
         zeros = jnp.zeros((num_partitions,), dtype=value.dtype)
         return (jnp.zeros((num_partitions, d), dtype=value.dtype),
                 PartitionAccumulators(zeros, zeros, zeros, zeros, zeros))
-    k1, k2 = jax.random.split(key)
-    pid_key = jnp.where(valid, pid, _INT32_MAX)
-    pk_key = jnp.where(valid, pk, _INT32_MAX)
-    tiebreak = jax.random.uniform(k1, (n,))
-    order = jnp.lexsort((tiebreak, pk_key, pid_key))
-    spid, spk, svalid = pid_key[order], pk_key[order], valid[order]
-    sval = value[order]
+    s = _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap)
+    sval = value[s.order]
 
     if norm_ord == 0:
         sval = jnp.clip(sval, -max_norm, max_norm)
@@ -192,34 +217,15 @@ def bound_and_aggregate_vector(key: jax.Array,
         scale = jnp.minimum(1.0, max_norm / jnp.maximum(norms, 1e-30))
         sval = sval * scale
 
-    is_start = jnp.concatenate([
-        jnp.ones((1,), dtype=bool),
-        (spid[1:] != spid[:-1]) | (spk[1:] != spk[:-1])
-    ])
-    rank = _segment_rank(is_start)
-    keep_row = svalid & (rank < linf_cap)
-
-    group_id = (jnp.cumsum(is_start) - 1).astype(jnp.int32)
-    w1 = keep_row.astype(sval.dtype)
+    group_id = s.group_id
+    w1 = s.keep_row.astype(sval.dtype)
     w = w1[:, None]
     g_vec = jax.ops.segment_sum(sval * w, group_id, num_segments=n)
     g_count = jax.ops.segment_sum(w1, group_id, num_segments=n)
-    start_w = (is_start & svalid).astype(jnp.int32)
-    g_pid = jax.ops.segment_sum(spid * start_w, group_id, num_segments=n)
-    g_pk = jax.ops.segment_sum(spk * start_w, group_id, num_segments=n)
-    g_valid = jax.ops.segment_sum(start_w, group_id, num_segments=n) > 0
+    start_w = (s.is_start & s.svalid).astype(jnp.int32)
+    g_pk = jax.ops.segment_sum(s.spk * start_w, group_id, num_segments=n)
 
-    g_rand = jax.random.uniform(k2, (n,))
-    g_pid_key = jnp.where(g_valid, g_pid, _INT32_MAX)
-    order2 = jnp.lexsort((g_rand, g_pid_key))
-    is_start2 = jnp.concatenate([
-        jnp.ones((1,), dtype=bool),
-        g_pid_key[order2][1:] != g_pid_key[order2][:-1]
-    ])
-    keep_sorted = _segment_rank(is_start2) < l0_cap
-    keep_group = jnp.zeros((n,), dtype=bool).at[order2].set(keep_sorted)
-    keep_group = keep_group & g_valid
-
+    keep_group = s.keep_group
     gw = keep_group.astype(sval.dtype)
     g_pk_safe = jnp.where(keep_group, g_pk, 0).astype(jnp.int32)
     pseg = functools.partial(jax.ops.segment_sum,
@@ -240,49 +246,19 @@ def bound_row_mask(key: jax.Array, pid: jnp.ndarray, pk: jnp.ndarray,
                    valid: jnp.ndarray, linf_cap, l0_cap) -> jnp.ndarray:
     """Per-row keep mask (original row order) after Linf + L0 bounding.
 
-    Identical sampling decisions to bound_and_aggregate for the same key
-    (same splits, same lexsort keys, same tiebreak draws), but returns which
-    rows survive instead of aggregates — the row-level view needed by
-    consumers that histogram individual contributions (e.g. the batched
-    quantile trees of ops/quantiles.py).
+    Identical sampling decisions to bound_and_aggregate for the same key —
+    guaranteed structurally: all bounding kernels derive from the shared
+    _sample_rows_and_groups pipeline. This one returns which rows survive
+    instead of aggregates — the row-level view needed by consumers that
+    histogram individual contributions (e.g. the batched quantile trees of
+    ops/quantiles.py).
     """
     n = pid.shape[0]
     if n == 0:
         return jnp.zeros((0,), dtype=bool)
-    k1, k2 = jax.random.split(key)
-    pid_key = jnp.where(valid, pid, _INT32_MAX)
-    pk_key = jnp.where(valid, pk, _INT32_MAX)
-
-    tiebreak = jax.random.uniform(k1, (n,))
-    order = jnp.lexsort((tiebreak, pk_key, pid_key))
-    spid = pid_key[order]
-    spk = pk_key[order]
-    svalid = valid[order]
-
-    is_start = jnp.concatenate([
-        jnp.ones((1,), dtype=bool),
-        (spid[1:] != spid[:-1]) | (spk[1:] != spk[:-1])
-    ])
-    rank = _segment_rank(is_start)
-    keep_row = svalid & (rank < linf_cap)
-
-    group_id = (jnp.cumsum(is_start) - 1).astype(jnp.int32)
-    start_w = (is_start & svalid).astype(jnp.int32)
-    g_pid = jax.ops.segment_sum(spid * start_w, group_id, num_segments=n)
-    g_valid = jax.ops.segment_sum(start_w, group_id, num_segments=n) > 0
-
-    g_rand = jax.random.uniform(k2, (n,))
-    g_pid_key = jnp.where(g_valid, g_pid, _INT32_MAX)
-    order2 = jnp.lexsort((g_rand, g_pid_key))
-    is_start2 = jnp.concatenate(
-        [jnp.ones((1,), dtype=bool),
-         g_pid_key[order2][1:] != g_pid_key[order2][:-1]])
-    keep_sorted = _segment_rank(is_start2) < l0_cap
-    keep_group = jnp.zeros((n,), dtype=bool).at[order2].set(keep_sorted)
-    keep_group = keep_group & g_valid
-
-    keep_sorted_rows = keep_row & keep_group[group_id]
-    return jnp.zeros((n,), dtype=bool).at[order].set(keep_sorted_rows)
+    s = _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap)
+    keep_sorted_rows = s.keep_row & s.keep_group[s.group_id]
+    return jnp.zeros((n,), dtype=bool).at[s.order].set(keep_sorted_rows)
 
 
 @functools.partial(jax.jit, static_argnames=("num_partitions",))

@@ -191,17 +191,42 @@ def _lookup_ids(column: np.ndarray, vocab: Vocabulary) -> np.ndarray:
     return ids.astype(np.int32)
 
 
+_INT32_SENTINEL_SAFE = np.iinfo(np.int32).max - 1
+
+
+def _pid_passthrough(pid_col: np.ndarray) -> Optional[np.ndarray]:
+    """Raw integer privacy ids shifted to [0, span], or None if unusable.
+
+    The kernels only compare privacy ids for equality, so dense
+    factorization is pure overhead when the input ids are already integers
+    — a shift-to-zero keeps them inside int32 (the kernel reserves
+    INT32_MAX as its padding sentinel, hence the safety margin).
+    """
+    if not np.issubdtype(pid_col.dtype, np.integer) or len(pid_col) == 0:
+        return None
+    lo = int(pid_col.min())
+    span = int(pid_col.max()) - lo
+    if span >= _INT32_SENTINEL_SAFE:
+        return None
+    shifted = pid_col - lo if lo else pid_col
+    return shifted.astype(np.int32, copy=False)
+
+
 def encode_columns(
     pid_col,
     pk_col,
     value_col,
     public_partitions: Optional[Sequence[Any]] = None,
     vector_size: Optional[int] = None,
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Vocabulary, Vocabulary]:
+    factorize_pid: bool = True,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Optional[Vocabulary],
+           Vocabulary]:
     """Vectorized encoding of raw columns; same contract as encode_rows.
 
     ``pid_col`` may be None (contribution_bounds_already_enforced: each row
-    becomes its own privacy unit).
+    becomes its own privacy unit). With ``factorize_pid=False`` integer
+    privacy ids skip factorization entirely (returned shifted-to-zero with
+    pid_vocab=None) — the kernels never need dense pid ids, only equality.
     """
     pk_col = np.asarray(pk_col)
     if pid_col is not None:
@@ -222,11 +247,16 @@ def encode_columns(
         pid_ids = np.arange(len(pk_ids), dtype=np.int32)
         pid_vocab = Vocabulary.from_unique(np.arange(len(pk_ids)))
     else:
-        pid_ids, pid_uniques = _factorize(pid_col)
-        pid_vocab = Vocabulary.from_unique(pid_uniques)
+        pid_ids = None if factorize_pid else _pid_passthrough(pid_col)
+        if pid_ids is not None:
+            pid_vocab = None
+        else:
+            pid_ids, pid_uniques = _factorize(pid_col)
+            pid_vocab = Vocabulary.from_unique(pid_uniques)
     value_arr = _value_array(value_col, len(pk_ids), vector_size)
-    return (pid_ids.astype(np.int32), pk_ids.astype(np.int32), value_arr,
-            pid_vocab, pk_vocab)
+    return (pid_ids.astype(np.int32, copy=False),
+            pk_ids.astype(np.int32, copy=False), value_arr, pid_vocab,
+            pk_vocab)
 
 
 def _value_array(value_col, n: int,
@@ -246,7 +276,9 @@ def encode_rows(
     value_extractor,
     public_partitions: Optional[Sequence[Any]] = None,
     vector_size: Optional[int] = None,
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Vocabulary, Vocabulary]:
+    factorize_pid: bool = True,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Optional[Vocabulary],
+           Vocabulary]:
     """Encodes Python rows into (pid_ids, pk_ids, values) numpy columns.
 
     Columnar inputs (ColumnarData / EncodedColumns) skip the per-row
@@ -261,7 +293,8 @@ def encode_rows(
     if isinstance(rows, ColumnarData):
         pid_col = rows.pid if privacy_id_extractor is not None else None
         return encode_columns(pid_col, rows.pk, rows.value,
-                              public_partitions, vector_size)
+                              public_partitions, vector_size,
+                              factorize_pid=factorize_pid)
     rows = list(rows)
     pk_col = _column_from_list([partition_extractor(row) for row in rows])
     if privacy_id_extractor is not None and privacy_id_extractor is not True:
@@ -274,7 +307,7 @@ def encode_rows(
     else:
         value_col = None
     return encode_columns(pid_col, pk_col, value_col, public_partitions,
-                          vector_size)
+                          vector_size, factorize_pid=factorize_pid)
 
 
 def _encode_pre_encoded(cols: EncodedColumns,

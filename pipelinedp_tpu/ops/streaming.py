@@ -1,0 +1,221 @@
+"""Streaming (chunked) execution of the fused DP-aggregation kernel.
+
+The columnar engine's end-to-end cost on real hardware is dominated by the
+host->device transfer of the row columns, not by the kernel (BASELINE.md
+headline workload: ~1.2 GB of columns vs a ~15 s fused kernel). This module
+turns the single-shot `columnar.bound_and_aggregate` call into a pipeline of
+pid-disjoint chunks so that
+
+  * the transfer of chunk k+1 overlaps the kernel of chunk k (the dispatch
+    queue is async end to end),
+  * each chunk ships byte-packed to the minimal width its id ranges need
+    (privacy ids and partition ids rarely need 4 bytes each), and
+  * the `valid` mask is never transferred at all (it is `iota < n` on
+    device).
+
+Chunks are made pid-disjoint by hash-sharding rows on the privacy id, which
+is what makes the result exact rather than approximate: contribution
+bounding (the Linf/L0 sampling of `ops/columnar.py`) only looks at rows of
+one privacy id at a time, so bounding each shard independently with the full
+caps and summing the per-partition accumulators is *identical in
+distribution* to bounding the whole dataset at once (same role as the
+per-key sampling of the reference, contribution_bounders.py:62-111 — the
+key-space split is just a different iteration order). Privacy-id counts add
+across shards because a pid lives in exactly one shard.
+
+The same trick is used across devices by `parallel/sharded.py`; here it is
+used across *time* on one device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pipelinedp_tpu.ops import columnar
+
+# Knuth multiplicative hash so that structured pid spaces (all-even ids,
+# contiguous ranges handed out per site, ...) still shard evenly.
+_HASH_MULT = np.uint32(2654435761)
+
+# Row count below which the single-shot path wins (chunking only adds
+# dispatch latency when the transfer is small).
+MIN_STREAM_ROWS = 2_000_000
+
+DEFAULT_NUM_CHUNKS = 16
+
+
+def _num_chunks(n_rows: int) -> int:
+    # ~8 MB of packed bytes per chunk minimum, capped at the default.
+    return int(min(DEFAULT_NUM_CHUNKS, max(2, n_rows // 1_000_000)))
+
+
+def _int_bytes(max_value: int) -> int:
+    """Bytes needed to carry values in [0, max_value]."""
+    for nbytes in (1, 2, 3, 4):
+        if max_value < (1 << (8 * nbytes)):
+            return nbytes
+    raise ValueError(f"{max_value} does not fit in 4 bytes")
+
+
+def _pack_ints(out: np.ndarray, col: np.ndarray, offset: int,
+               nbytes: int) -> None:
+    """Little-endian byte-split of an int column into out[:, offset:...]."""
+    col = col.astype(np.uint32, copy=False)
+    for b in range(nbytes):
+        out[:, offset + b] = (col >> (8 * b)).astype(np.uint8)
+
+
+def _unpack_ints(buf: jnp.ndarray, offset: int, nbytes: int) -> jnp.ndarray:
+    """Device-side inverse of _pack_ints -> int32."""
+    acc = buf[:, offset].astype(jnp.int32)
+    for b in range(1, nbytes):
+        acc = acc | (buf[:, offset + b].astype(jnp.int32) << (8 * b))
+    return acc
+
+
+def _unpack_value(buf: jnp.ndarray, offset: int,
+                  is_f16: bool) -> jnp.ndarray:
+    if is_f16:
+        u16 = (buf[:, offset].astype(jnp.uint16) |
+               (buf[:, offset + 1].astype(jnp.uint16) << 8))
+        return jax.lax.bitcast_convert_type(u16, jnp.float16).astype(
+            jnp.float32)
+    u32 = (buf[:, offset].astype(jnp.uint32) |
+           (buf[:, offset + 1].astype(jnp.uint32) << 8) |
+           (buf[:, offset + 2].astype(jnp.uint32) << 16) |
+           (buf[:, offset + 3].astype(jnp.uint32) << 24))
+    return jax.lax.bitcast_convert_type(u32, jnp.float32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_partitions", "bytes_pid", "bytes_pk", "value_f16"),
+    donate_argnums=(3,))
+def _chunk_step(key, buf, n_valid, accs, linf_cap, l0_cap, row_clip_lo,
+                row_clip_hi, middle, group_clip_lo, group_clip_hi, *,
+                num_partitions: int, bytes_pid: int, bytes_pk: int,
+                value_f16: bool):
+    """Unpack one byte-packed chunk, bound+aggregate it, add into accs."""
+    pid = _unpack_ints(buf, 0, bytes_pid)
+    pk = _unpack_ints(buf, bytes_pid, bytes_pk)
+    value = _unpack_value(buf, bytes_pid + bytes_pk, value_f16)
+    valid = jnp.arange(buf.shape[0], dtype=jnp.int32) < n_valid
+    chunk_accs = columnar.bound_and_aggregate(
+        key, pid, pk, value, valid,
+        num_partitions=num_partitions,
+        linf_cap=linf_cap,
+        l0_cap=l0_cap,
+        row_clip_lo=row_clip_lo,
+        row_clip_hi=row_clip_hi,
+        middle=middle,
+        group_clip_lo=group_clip_lo,
+        group_clip_hi=group_clip_hi)
+    return columnar.PartitionAccumulators(
+        *(a + c for a, c in zip(accs, chunk_accs)))
+
+
+def stream_bound_and_aggregate(
+    key: jax.Array,
+    pid: np.ndarray,
+    pk: np.ndarray,
+    value: Optional[np.ndarray],
+    *,
+    num_partitions: int,
+    linf_cap,
+    l0_cap,
+    row_clip_lo,
+    row_clip_hi,
+    middle,
+    group_clip_lo,
+    group_clip_hi,
+    n_chunks: Optional[int] = None,
+    value_transfer_dtype: Optional[np.dtype] = None,
+) -> columnar.PartitionAccumulators:
+    """Chunked, transfer-overlapped twin of columnar.bound_and_aggregate.
+
+    pid: integer numpy array, any range (NOT required to be dense ids — the
+      kernel only compares privacy ids for equality, so raw integer ids are
+      shipped as-is after a shift-to-zero; this is what lets the engine skip
+      privacy-id factorization entirely on the hot path).
+    pk: dense int32 ids in [0, num_partitions).
+    value: float array or None (COUNT-style).
+    value_transfer_dtype: np.float16 to halve the value transfer bytes
+      (opt-in: the f16 rounding of individual contributions is far below
+      any DP noise scale, but it is a lossy ingest step so the caller must
+      ask for it).
+
+    Returns per-partition accumulators on device, identical in distribution
+    to the single-shot kernel.
+    """
+    n = len(pid)
+    if n == 0:
+        zeros = jnp.zeros((num_partitions,), dtype=jnp.float32)
+        return columnar.PartitionAccumulators(zeros, zeros, zeros, zeros,
+                                              zeros)
+    k = n_chunks or _num_chunks(n)
+
+    pid = np.asarray(pid)
+    pid_lo = int(pid.min())
+    pid_span = int(pid.max()) - pid_lo
+    if pid_span >= np.iinfo(np.int32).max - 1:
+        # The kernel reserves INT32_MAX as its padding sentinel; a shifted
+        # pid colliding with it would be silently dropped. Callers with a
+        # wider id space must factorize to dense ids first.
+        raise ValueError(
+            f"privacy-id span {pid_span} does not fit int32; factorize the "
+            f"ids to dense int32 before streaming")
+    bytes_pid = _int_bytes(pid_span)
+    bytes_pk = _int_bytes(max(num_partitions - 1, 0))
+    value_f16 = value_transfer_dtype == np.float16
+    bytes_value = 2 if value_f16 else 4
+    width = bytes_pid + bytes_pk + bytes_value
+
+    # Hash-shard rows by privacy id. uint32 wraparound in the shift is fine:
+    # the hash only needs to be a pure function of pid. Modulo (not a bit
+    # mask) so any chunk count splits evenly.
+    shifted = (pid - pid_lo).astype(np.uint32, copy=False)
+    bucket = ((shifted * _HASH_MULT) >> np.uint32(16)) % np.uint32(k)
+
+    # One padded chunk size for all buckets => one compiled kernel.
+    counts = np.bincount(bucket, minlength=k)
+    chunk_rows = int(counts.max())
+
+    # Five distinct buffers: the accumulators are donated into each chunk
+    # step, and a donated buffer must not be aliased.
+    accs = columnar.PartitionAccumulators(
+        *(jnp.zeros((num_partitions,), dtype=jnp.float32) for _ in range(5)))
+    if value is not None:
+        value = np.asarray(value)
+        if value_f16:
+            value = value.astype(np.float16)
+        else:
+            value = value.astype(np.float32, copy=False)
+
+    for c in range(k):
+        idx = np.flatnonzero(bucket == c)
+        buf = np.zeros((chunk_rows, width), dtype=np.uint8)
+        m = len(idx)
+        _pack_ints(buf[:m], shifted[idx], 0, bytes_pid)
+        _pack_ints(buf[:m], pk[idx].astype(np.uint32, copy=False), bytes_pid,
+                   bytes_pk)
+        if value is not None:
+            vbytes = value[idx]
+            buf[:m, bytes_pid + bytes_pk:] = (
+                vbytes.view(np.uint8).reshape(m, bytes_value))
+        # device_put enqueues the DMA and returns; the chunk kernel is
+        # dispatched right behind it, so packing bucket c+1 on host overlaps
+        # both the transfer and the compute of bucket c.
+        dbuf = jax.device_put(buf)
+        accs = _chunk_step(jax.random.fold_in(key, c), dbuf, m, accs,
+                           linf_cap, l0_cap, row_clip_lo, row_clip_hi,
+                           middle, group_clip_lo, group_clip_hi,
+                           num_partitions=num_partitions,
+                           bytes_pid=bytes_pid,
+                           bytes_pk=bytes_pk,
+                           value_f16=value_f16)
+    return accs

@@ -86,7 +86,12 @@ def shard_rows_by_pid(pid: np.ndarray,
     plus the validity mask for padding rows. Keeping each pid on one shard
     makes L0/Linf bounding exact with zero cross-device row exchange.
     """
-    shard_of_row = pid % n_shards
+    # Multiplicative hash, not bare modulo: raw (unfactorized) id spaces
+    # are often structured (all-even ids, per-site ranges) and would skew
+    # a low-bits split, doubling shard padding.
+    hashed = ((pid.astype(np.uint32) * np.uint32(2654435761)) >>
+              np.uint32(16))
+    shard_of_row = hashed % np.uint32(n_shards)
     order = np.argsort(shard_of_row, kind="stable")
     pid, pk, value = pid[order], pk[order], value[order]
     valid = (np.ones(len(pid), dtype=bool)

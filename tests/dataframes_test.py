@@ -1,0 +1,186 @@
+"""QueryBuilder / Query tests.
+
+Mirrors the intent of the reference's dataframes tests (query validation +
+end-to-end runs with effectively-no-noise budgets), on pandas frames and
+dict-of-column frames instead of Spark DataFrames.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import dataframes
+
+
+def _visits_df():
+    # 30 users; each visits day 1 and day 2 once, spending 10 + user%3.
+    rows = []
+    for user in range(30):
+        for day in (1, 2):
+            rows.append((user, day, 10.0 + user % 3))
+    return pd.DataFrame(rows, columns=["user_id", "day", "spent"])
+
+
+HUGE = dataframes.Budget(epsilon=1e8, delta=1 - 1e-12)
+
+
+class TestQueryBuilderValidation:
+
+    def test_unknown_privacy_column(self):
+        with pytest.raises(ValueError, match="not present"):
+            pdp.QueryBuilder(_visits_df(), "nope")
+
+    def test_unknown_groupby_column(self):
+        with pytest.raises(ValueError, match="not present"):
+            pdp.QueryBuilder(_visits_df(), "user_id").groupby(
+                "nope", max_groups_contributed=1,
+                max_contributions_per_group=1)
+
+    def test_groupby_twice(self):
+        builder = pdp.QueryBuilder(_visits_df(), "user_id").groupby(
+            "day", max_groups_contributed=1, max_contributions_per_group=1)
+        with pytest.raises(ValueError, match="only once"):
+            builder.groupby("day", max_groups_contributed=1,
+                            max_contributions_per_group=1)
+
+    def test_aggregation_before_groupby(self):
+        with pytest.raises(NotImplementedError, match="groupby"):
+            pdp.QueryBuilder(_visits_df(), "user_id").count()
+
+    def test_no_aggregations(self):
+        with pytest.raises(ValueError, match="No aggregations"):
+            pdp.QueryBuilder(_visits_df(), "user_id").groupby(
+                "day", max_groups_contributed=1,
+                max_contributions_per_group=1).build_query()
+
+    def test_duplicate_aggregation(self):
+        with pytest.raises(ValueError, match="only once"):
+            (pdp.QueryBuilder(_visits_df(), "user_id").groupby(
+                "day", max_groups_contributed=1,
+                max_contributions_per_group=1).count().count().build_query())
+
+    def test_missing_caps(self):
+        with pytest.raises(ValueError, match="min_value and max_value"):
+            (pdp.QueryBuilder(_visits_df(), "user_id").groupby(
+                "day", max_groups_contributed=1,
+                max_contributions_per_group=1).sum("spent").build_query())
+
+    def test_conflicting_caps(self):
+        with pytest.raises(ValueError, match="must be the same"):
+            (pdp.QueryBuilder(_visits_df(), "user_id").groupby(
+                "day", max_groups_contributed=1,
+                max_contributions_per_group=1).sum(
+                    "spent", min_value=0,
+                    max_value=20).mean("spent", min_value=0,
+                                       max_value=30).build_query())
+
+    def test_two_value_columns(self):
+        df = _visits_df()
+        df["other"] = 1.0
+        with pytest.raises(NotImplementedError, match="one column"):
+            (pdp.QueryBuilder(df, "user_id").groupby(
+                "day", max_groups_contributed=1,
+                max_contributions_per_group=1).sum(
+                    "spent", min_value=0,
+                    max_value=20).mean("other").build_query())
+
+
+class TestRunQuery:
+
+    @pytest.mark.parametrize("engine", ["jax", "local"])
+    def test_count_sum_mean_public_keys(self, engine):
+        df = _visits_df()
+        query = (pdp.QueryBuilder(df, "user_id").groupby(
+            "day",
+            max_groups_contributed=2,
+            max_contributions_per_group=1,
+            public_keys=[1, 2, 3]).count().sum(
+                "spent", min_value=0.0,
+                max_value=20.0).mean("spent").build_query())
+        out = query.run_query(HUGE, engine=engine)
+        assert sorted(out["day"].tolist()) == [1, 2, 3]
+        by_day = {d: i for i, d in enumerate(out["day"].tolist())}
+        # 30 visits each real day, none on day 3 (noise-only).
+        assert out["count"][by_day[1]] == pytest.approx(30, abs=0.5)
+        assert out["count"][by_day[2]] == pytest.approx(30, abs=0.5)
+        assert out["count"][by_day[3]] == pytest.approx(0, abs=0.5)
+        expected_sum = sum(10.0 + u % 3 for u in range(30))
+        assert out["sum"][by_day[1]] == pytest.approx(expected_sum, rel=0.01)
+        assert out["mean"][by_day[1]] == pytest.approx(expected_sum / 30,
+                                                       rel=0.01)
+
+    def test_private_selection_keeps_dense_days(self):
+        df = _visits_df()
+        query = (pdp.QueryBuilder(df, "user_id").groupby(
+            "day", max_groups_contributed=2,
+            max_contributions_per_group=1).count().build_query())
+        out = query.run_query(dataframes.Budget(epsilon=50, delta=1e-4))
+        assert set(out["day"].tolist()) == {1, 2}
+
+    def test_output_column_names(self):
+        df = _visits_df()
+        query = (pdp.QueryBuilder(df, "user_id").groupby(
+            "day",
+            max_groups_contributed=2,
+            max_contributions_per_group=1,
+            public_keys=[1, 2]).count(name="n_visits").privacy_id_count(
+                name="n_users").build_query())
+        out = query.run_query(HUGE)
+        assert set(out.columns) == {"day", "n_visits", "n_users"}
+        assert out["n_users"].max() == pytest.approx(30, abs=0.5)
+
+    def test_multi_column_groupby(self):
+        rows = []
+        for user in range(25):
+            rows.append((user, "a", 1, 5.0))
+            rows.append((user, "b", 1, 7.0))
+        df = pd.DataFrame(rows, columns=["user_id", "site", "day", "spent"])
+        query = (pdp.QueryBuilder(df, "user_id").groupby(
+            ["site", "day"],
+            max_groups_contributed=2,
+            max_contributions_per_group=1,
+            public_keys=[("a", 1), ("b", 1), ("c", 2)]).count().build_query())
+        out = query.run_query(HUGE)
+        assert set(out.columns) == {"site", "day", "count"}
+        assert sorted(zip(out["site"], out["day"])) == [("a", 1), ("b", 1),
+                                                        ("c", 2)]
+        lookup = {(s, d): c
+                  for s, d, c in zip(out["site"], out["day"], out["count"])}
+        assert lookup[("a", 1)] == pytest.approx(25, abs=0.5)
+        assert lookup[("c", 2)] == pytest.approx(0, abs=0.5)
+
+    def test_dict_frame(self):
+        data = {
+            "user": np.arange(40) % 20,
+            "shop": np.arange(40) % 2,
+            "spent": np.full(40, 3.0),
+        }
+        # User u owns rows u and u+20, both in shop u%2: 10 users per shop,
+        # 2 contributions each.
+        query = (pdp.QueryBuilder(data, "user").groupby(
+            "shop",
+            max_groups_contributed=2,
+            max_contributions_per_group=2,
+            public_keys=[0, 1]).sum("spent", min_value=0,
+                                    max_value=5).build_query())
+        out = query.run_query(HUGE)
+        assert isinstance(out, dict)
+        assert out["sum"].shape == (2,)
+        np.testing.assert_allclose(out["sum"], [60.0, 60.0], atol=1.0)
+
+    def test_percentile_and_variance(self):
+        rng = np.random.default_rng(0)
+        df = pd.DataFrame({
+            "user": np.arange(400),
+            "g": np.zeros(400, dtype=int),
+            "v": rng.uniform(0, 10, 400),
+        })
+        query = (pdp.QueryBuilder(df, "user").groupby(
+            "g",
+            max_groups_contributed=1,
+            max_contributions_per_group=1,
+            public_keys=[0]).variance("v", min_value=0.0,
+                                      max_value=10.0).build_query())
+        out = query.run_query(HUGE)
+        assert out["variance"][0] == pytest.approx(np.var(df["v"]), abs=1.5)
