@@ -31,6 +31,8 @@ from pipelinedp_tpu.analysis.dp_strategy_selector import (
     DPStrategySelectorFactory,
 )
 from pipelinedp_tpu.analysis.pre_aggregation import preaggregate
+from pipelinedp_tpu.analysis.probability_computations import (
+    compute_sum_laplace_gaussian_quantiles,)
 from pipelinedp_tpu.analysis.dataset_summary import (
     PublicPartitionsSummary,
     compute_public_partitions_summary,
@@ -49,6 +51,7 @@ __all__ = [
     "UtilityAnalysisEngine",
     "UtilityAnalysisOptions",
     "compute_public_partitions_summary",
+    "compute_sum_laplace_gaussian_quantiles",
     "get_aggregate_params",
     "get_partition_selection_strategy",
     "metrics",

@@ -179,18 +179,8 @@ class MultiProcLocalBackend(LocalBackend):
         # entire chunk iterator eagerly.
 
         def gen():
-            iter_col = iter(col)
-            chunks = iter(
-                lambda: list(itertools.islice(iter_col, self._chunksize)), [])
-            max_in_flight = 2 * self._n_jobs
-            with self._executor() as pool:
-                in_flight = collections.deque()
-                for chunk in chunks:
-                    in_flight.append(pool.submit(chunk_fn, chunk))
-                    if len(in_flight) >= max_in_flight:
-                        yield from in_flight.popleft().result()
-                while in_flight:
-                    yield from in_flight.popleft().result()
+            for result in self._chunk_results(col, chunk_fn):
+                yield from result
 
         return gen()
 
@@ -202,6 +192,52 @@ class MultiProcLocalBackend(LocalBackend):
 
     def filter(self, col, fn: Callable, stage_name: str = None):
         return self._parallel_chunks(col, _FilterChunk(fn))
+
+    def map_tuple(self, col, fn: Callable, stage_name: str = None):
+        return self._parallel_chunks(col, _MapTupleChunk(fn))
+
+    def map_values(self, col, fn: Callable, stage_name: str = None):
+        return self._parallel_chunks(col, _MapValuesChunk(fn))
+
+    def reduce_per_key(self, col, fn: Callable, stage_name: str = None):
+        """Parallel per-key reduce: workers reduce chunks to partial dicts,
+        the main thread merges the partials with the same fn.
+
+        This is the shuffle/reduce hot-spot of the aggregation graph
+        (combine_accumulators_per_key / sum_per_key route here) — the one
+        op the reference's experimental multiproc backend left serial.
+        Associativity of fn is already required by the Combiner contract.
+        """
+
+        def gen():
+            merged = {}
+            for partial in self._chunk_results(col, _ReduceChunk(fn)):
+                if not merged:
+                    merged = partial
+                    continue
+                for key, value in partial.items():
+                    if key in merged:
+                        merged[key] = fn(merged[key], value)
+                    else:
+                        merged[key] = value
+            yield from merged.items()
+
+        return gen()
+
+    def _chunk_results(self, col, chunk_fn: Callable):
+        """Yields one result object per processed chunk (no flattening)."""
+        iter_col = iter(col)
+        chunks = iter(
+            lambda: list(itertools.islice(iter_col, self._chunksize)), [])
+        max_in_flight = 2 * self._n_jobs
+        with self._executor() as pool:
+            in_flight = collections.deque()
+            for chunk in chunks:
+                in_flight.append(pool.submit(chunk_fn, chunk))
+                if len(in_flight) >= max_in_flight:
+                    yield in_flight.popleft().result()
+            while in_flight:
+                yield in_flight.popleft().result()
 
 
 class _MapChunk:
@@ -229,3 +265,37 @@ class _FilterChunk:
 
     def __call__(self, chunk):
         return [x for x in chunk if self._fn(x)]
+
+
+class _MapTupleChunk:
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, chunk):
+        return [self._fn(*x) for x in chunk]
+
+
+class _MapValuesChunk:
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, chunk):
+        return [(k, self._fn(v)) for k, v in chunk]
+
+
+class _ReduceChunk:
+    """Per-chunk partial reduce to a {key: reduced_value} dict."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, chunk):
+        reduced = {}
+        for key, value in chunk:
+            if key in reduced:
+                reduced[key] = self._fn(reduced[key], value)
+            else:
+                reduced[key] = value
+        return reduced

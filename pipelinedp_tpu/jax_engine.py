@@ -56,17 +56,28 @@ def _mechanism_noise_params(spec: budget_accounting.MechanismSpec,
         mech.noise_parameter)
 
 
-class LazyJaxResult:
-    """Deferred result of a columnar aggregation.
+class _LazyColumns:
+    """Deferred column-dict result: computes on first access — after
+    BudgetAccountant.compute_budgets(), per the lazy-budget contract
+    (accessing unresolved specs raises)."""
 
-    Executes on first access — after BudgetAccountant.compute_budgets(), per
-    the lazy-budget contract (accessing unresolved specs raises).
-    """
+    def __init__(self, compute_fn):
+        self._compute_fn = compute_fn
+        self._columns = None
+
+    def to_columns(self) -> dict:
+        """Returns {'partition_id', 'keep_mask', value arrays...}."""
+        if self._columns is None:
+            self._columns = self._compute_fn()
+        return self._columns
+
+
+class LazyJaxResult(_LazyColumns):
+    """Deferred result of a columnar aggregation."""
 
     def __init__(self, compute_fn, pk_vocab: encoding.Vocabulary):
-        self._compute_fn = compute_fn
+        super().__init__(compute_fn)
         self._pk_vocab = pk_vocab
-        self._columns = None
 
     def to_columns(self) -> dict:
         """Returns {'partition_id', 'keep_mask', metric arrays...}
@@ -76,9 +87,7 @@ class LazyJaxResult:
         masked to NaN, so consuming the columns directly cannot leak
         non-kept partitions (keep_mask says which rows are real output).
         """
-        if self._columns is None:
-            self._columns = self._compute_fn()
-        return self._columns
+        return super().to_columns()
 
     def partition_keys(self) -> List[Any]:
         """Keys of the partitions present in the DP output (selection
@@ -108,6 +117,32 @@ class LazyJaxResult:
                 yield (self._pk_vocab.decode(int(ids[i])),
                        tuple_type(*(element(arr, i)
                                     for arr in metric_arrays)))
+
+
+class _LazySelectedPartitions(_LazyColumns):
+    """Deferred result of select_partitions: iterates kept partition keys."""
+
+    def __init__(self, compute_fn, pk_vocab: encoding.Vocabulary):
+        super().__init__(compute_fn)
+        self._pk_vocab = pk_vocab
+
+    def __iter__(self):
+        cols = self.to_columns()
+        ids = cols["partition_id"][cols["keep_mask"]]
+        yield from self._pk_vocab.decode_all(ids)
+
+
+class _LazyNoisedValues(_LazyColumns):
+    """Deferred result of add_dp_noise: iterates (pk, noised value)."""
+
+    def __init__(self, compute_fn, pk_col):
+        super().__init__(compute_fn)
+        self._pk_col = pk_col
+
+    def __iter__(self):
+        values = self.to_columns()["value"]
+        for pk, value in zip(self._pk_col, values):
+            yield (pk.item() if hasattr(pk, "item") else pk, float(value))
 
 
 class JaxDPEngine:
@@ -211,6 +246,136 @@ class JaxDPEngine:
             self._budget_accountant._compute_budget_for_aggregation(
                 params.budget_weight)
             return result
+
+    # -- select_partitions / add_dp_noise (columnar fast paths) -------------
+
+    def select_partitions(self,
+                          col,
+                          params: SelectPartitionsParams,
+                          data_extractors: Optional[DataExtractors] = None):
+        """DP-selected partition keys, computed on device.
+
+        Columnar twin of DPEngine.select_partitions (dp_engine.py:170): one
+        fused kernel L0-bounds each privacy unit's distinct partitions and
+        counts distinct units per partition; one vectorized selection call
+        decides the keys. Returns a lazy iterable of kept partition keys.
+        """
+        is_columnar = isinstance(
+            col, (encoding.ColumnarData, encoding.EncodedColumns))
+        if not is_columnar:
+            dp_engine_lib.DPEngine._check_select_private_partitions(
+                self, col, params, data_extractors)
+        dp_engine_lib.DPEngine._check_budget_accountant_compatibility(
+            self, False, [], False)
+        with self._budget_accountant.scope(weight=params.budget_weight):
+            self._report_generators.append(
+                report_generator_lib.ReportGenerator(params,
+                                                     "select_partitions",
+                                                     False))
+            spec = self._budget_accountant.request_budget(
+                mechanism_type=MechanismType.GENERIC)
+            pid_extractor = (None
+                             if params.contribution_bounds_already_enforced
+                             else (data_extractors.privacy_id_extractor
+                                   if data_extractors is not None else True))
+            pid, pk, _, _, pk_vocab = encoding.encode_rows(
+                col,
+                pid_extractor,
+                data_extractors.partition_extractor
+                if data_extractors else None,
+                None,
+                factorize_pid=False)
+            num_partitions = max(len(pk_vocab), 1)
+            l0 = params.max_partitions_contributed
+            self._add_report_stage(
+                f"Cross-partition contribution bounding: for each privacy_id "
+                f"randomly select max(actual_partition_contributed, {l0}) "
+                f"partitions")
+            self._add_report_stage(
+                lambda: f"Private partition selection: using "
+                        f"{params.partition_selection_strategy.value} "
+                        f"method with (eps={spec.eps}, delta={spec.delta})")
+            key = self._next_key()
+            engine = self
+
+            def compute():
+                k_kernel, k_select = jax.random.split(key)
+                counts = columnar.count_distinct_pids_per_partition(
+                    jnp.asarray(pid), jnp.asarray(pk),
+                    jnp.ones(len(pid), dtype=bool), k_kernel, l0,
+                    num_partitions=num_partitions)
+                exists = counts > 0
+                strategy = ps_lib.create_partition_selection_strategy(
+                    params.partition_selection_strategy, spec.eps,
+                    spec.delta, l0, params.pre_threshold)
+                keep, _ = engine._apply_selection(k_select, counts, exists,
+                                                  strategy)
+                return {
+                    "partition_id":
+                        np.arange(num_partitions, dtype=np.int32),
+                    "keep_mask": np.asarray(keep),
+                }
+
+            result = _LazySelectedPartitions(compute, pk_vocab)
+            self._budget_accountant._compute_budget_for_aggregation(
+                params.budget_weight)
+            return result
+
+    def add_dp_noise(self,
+                     col,
+                     params,
+                     out_explain_computation_report: Optional[
+                         ExplainComputationReport] = None):
+        """Adds calibrated DP noise to pre-aggregated (pk, value) pairs.
+
+        Columnar twin of DPEngine.add_dp_noise (dp_engine.py:449): one
+        batched noise call over the whole value column. Does NOT enforce
+        sensitivity — the caller guarantees the declared l0/linf bounds
+        hold and that the partition keys are public or DP-selected. Input
+        is an iterable of (pk, value) pairs or a ColumnarData with
+        pk/value set.
+        """
+        mechanism_type = params.noise_kind.convert_to_mechanism_type()
+        spec = self._budget_accountant.request_budget(mechanism_type)
+        sensitivities = dp_computations.Sensitivities(
+            l0=params.l0_sensitivity, linf=params.linf_sensitivity)
+        self._report_generators.append(
+            report_generator_lib.ReportGenerator(params, "add_dp_noise",
+                                                 True))
+        if out_explain_computation_report is not None:
+            out_explain_computation_report._set_report_generator(
+                self._current_report_generator)
+
+        if isinstance(col, encoding.ColumnarData):
+            pk_col = np.asarray(col.pk)
+            values = np.asarray(col.value, dtype=np.float64)
+        else:
+            pairs = list(col)
+            pk_col = encoding._column_from_list([p for p, _ in pairs])
+            values = np.array([v for _, v in pairs], dtype=np.float64)
+
+        self._add_report_stage(
+            lambda: (f"Adding {dp_computations.create_additive_mechanism(spec, sensitivities).noise_kind} "
+                     f"noise with parameter "
+                     f"{dp_computations.create_additive_mechanism(spec, sensitivities).noise_parameter}"))
+        key = self._next_key()
+        engine = self
+
+        def compute():
+            is_g, scale, gran = _mechanism_noise_params(spec, sensitivities)
+            # numpy in: the secure host path keeps float64 end to end; the
+            # device path converts on entry.
+            noised = engine._add_noise(key, values, is_g, scale, gran)
+            return {
+                "partition_id": np.arange(len(pk_col), dtype=np.int32),
+                "keep_mask": np.ones(len(pk_col), dtype=bool),
+                "value": np.asarray(noised),
+            }
+
+        result = _LazyNoisedValues(compute, pk_col)
+        self._budget_accountant._compute_budget_for_aggregation(
+            params.budget_weight)
+        return result
 
     def _check_supported(self, params: AggregateParams):
         if params.custom_combiners:
@@ -468,18 +633,11 @@ class JaxDPEngine:
                 max_rows_per_pid = (params.max_contributions or
                                     params.max_contributions_per_partition)
             pid_counts_est = jnp.ceil(accs.pid_count / max_rows_per_pid)
-            if self._secure_host_noise:
-                strategy = ps_lib.create_partition_selection_strategy(
-                    params.partition_selection_strategy, selection_spec.eps,
-                    selection_spec.delta, declared_l0, params.pre_threshold)
-                keep_np, _ = strategy.select_vec(np.asarray(pid_counts_est))
-                keep_mask = keep_np & np.asarray(partition_exists)
-            else:
-                sel_params = selection_ops.create_selection_params(
-                    params.partition_selection_strategy, selection_spec.eps,
-                    selection_spec.delta, declared_l0, params.pre_threshold)
-                keep_mask, _ = selection_ops.select_partitions(
-                    k_select, pid_counts_est, sel_params, partition_exists)
+            strategy = ps_lib.create_partition_selection_strategy(
+                params.partition_selection_strategy, selection_spec.eps,
+                selection_spec.delta, declared_l0, params.pre_threshold)
+            keep_mask, _ = self._apply_selection(k_select, pid_counts_est,
+                                                 partition_exists, strategy)
         else:
             keep_mask = partition_exists  # post-agg thresholding prunes below
 
@@ -495,15 +653,9 @@ class JaxDPEngine:
                 thresh = dp_computations.create_thresholding_mechanism(
                     combiner.mechanism_spec(), combiner.sensitivities(),
                     params.pre_threshold)
-                if self._secure_host_noise:
-                    keep_np, noised = thresh.strategy.select_vec(
-                        np.asarray(accs.pid_count))
-                    thresh_keep = keep_np & np.asarray(partition_exists)
-                else:
-                    sel_params = selection_ops.selection_params_from_strategy(
-                        thresh.strategy)
-                    thresh_keep, noised = selection_ops.select_partitions(
-                        sub_key, accs.pid_count, sel_params, partition_exists)
+                thresh_keep, noised = self._apply_selection(
+                    sub_key, accs.pid_count, partition_exists,
+                    thresh.strategy)
                 keep_mask = keep_mask & thresh_keep
                 columns["privacy_id_count"] = noised
 
@@ -518,6 +670,24 @@ class JaxDPEngine:
         columns["partition_id"] = np.arange(num_partitions, dtype=np.int32)
         columns["keep_mask"] = keep_np
         return columns
+
+    # -- selection dispatch: secure host path or device kernel --------------
+
+    def _apply_selection(self, key, counts, exists, strategy):
+        """(keep_mask, noised_counts) from a host strategy object.
+
+        The single dispatch point between the float64 secure host path
+        (strategy.select_vec) and the device kernel
+        (ops/selection.select_partitions) — every selection decision
+        (private partition selection, post-aggregation thresholding,
+        select_partitions) routes through here.
+        """
+        if self._secure_host_noise:
+            keep, noised = strategy.select_vec(np.asarray(counts))
+            return keep & np.asarray(exists), noised
+        sel_params = selection_ops.selection_params_from_strategy(strategy)
+        return selection_ops.select_partitions(key, counts, sel_params,
+                                               exists)
 
     # -- noise dispatch: device kernels or float64 host finalization --------
 

@@ -1,0 +1,5 @@
+"""Native secure-noise library: build, load, install into noise_core."""
+
+from pipelinedp_tpu.native.loader import (install, is_loaded, load)
+
+__all__ = ["install", "is_loaded", "load"]

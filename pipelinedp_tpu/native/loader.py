@@ -1,0 +1,123 @@
+"""Loader for the native secure-noise library.
+
+Builds `secure_noise.cc` into a shared object on first use (plain
+`g++ -O2 -shared`; no external build deps), loads it with ctypes, and
+installs the discrete samplers as noise_core's `sample_laplace` /
+`sample_gaussian` implementations. When no compiler or writable cache is
+available, the numpy granularity-snapping fallback in noise_core stays in
+place (distributionally equivalent; without the bit-exact discrete
+construction).
+
+Python <-> C++ boundary: ctypes over a 3-function C ABI (the environment
+has no pybind11 — see the repo build notes). The samplers return *integer*
+noise in granularity units; scaling by the power-of-two granularity happens
+here, which is exact in float64.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import sysconfig
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "secure_noise.cc")
+_SO = os.path.join(_DIR, f"_secure_noise{sysconfig.get_config_var('EXT_SUFFIX') or '.so'}")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread", _SRC,
+        "-o", _SO
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        logging.info("pipelinedp_tpu.native: build failed (%s); using the "
+                     "numpy fallback sampler", e)
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Returns the loaded library, building it if needed; None on failure."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_SO) or (os.path.exists(_SRC) and
+                                       os.path.getmtime(_SO) <
+                                       os.path.getmtime(_SRC)):
+            if not _build():
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            lib.pdp_noise_abi_version.restype = ctypes.c_int
+            if lib.pdp_noise_abi_version() != 1:
+                raise OSError("ABI version mismatch")
+            for name in ("pdp_sample_discrete_laplace",
+                         "pdp_sample_discrete_gaussian"):
+                fn = getattr(lib, name)
+                fn.restype = ctypes.c_int
+                fn.argtypes = [
+                    ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+                    ctypes.c_double
+                ]
+            _lib = lib
+        except OSError as e:
+            logging.info("pipelinedp_tpu.native: load failed (%s)", e)
+            _load_failed = True
+        return _lib
+
+
+def is_loaded() -> bool:
+    return _lib is not None
+
+
+def _sample(fn, units: float, size) -> np.ndarray:
+    n = 1 if size is None else int(np.prod(size))
+    out = np.empty(max(n, 1), dtype=np.int64)
+    rc = fn(out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+            float(units))
+    if rc != 0:
+        raise ValueError(f"native sampler rejected parameters (units="
+                         f"{units})")
+    return out[:n]
+
+
+def install() -> bool:
+    """Loads the library and installs the native samplers into noise_core.
+
+    Returns True when the native path is active.
+    """
+    lib = load()
+    if lib is None:
+        return False
+    from pipelinedp_tpu import noise_core
+
+    def native_laplace(scale: float, size=None):
+        g = noise_core.laplace_granularity(scale)
+        ints = _sample(lib.pdp_sample_discrete_laplace, scale / g, size)
+        noise = ints.astype(np.float64) * g
+        return float(noise[0]) if size is None else noise.reshape(size)
+
+    def native_gaussian(stddev: float, size=None):
+        g = noise_core.gaussian_granularity(stddev)
+        ints = _sample(lib.pdp_sample_discrete_gaussian, stddev / g, size)
+        noise = ints.astype(np.float64) * g
+        return float(noise[0]) if size is None else noise.reshape(size)
+
+    noise_core.sample_laplace = native_laplace
+    noise_core.sample_gaussian = native_gaussian
+    return True

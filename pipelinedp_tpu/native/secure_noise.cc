@@ -1,0 +1,207 @@
+// Secure discrete-noise sampling for differential privacy.
+//
+// Native (C++) equivalent of the security-critical sampling the reference
+// delegates to Google's C++ differential-privacy library through PyDP
+// (SURVEY.md section 2.4; call sites pipeline_dp/dp_computations.py:130-151).
+// Naive float Laplace sampling leaks through the float representation
+// (Mironov 2012); the defense here is to sample *integers* from the exact
+// discrete Laplace / discrete Gaussian distributions and scale by a
+// power-of-two granularity on the Python side, so the released value is a
+// granularity multiple and the sampler itself never touches floating-point
+// transcendentals of secret data.
+//
+// Sampling algorithms: Canonne, Kamath, Steinke, "The Discrete Gaussian for
+// Differential Privacy" (NeurIPS 2020), Algorithms 1-3 — exact rejection
+// samplers built from Bernoulli(exp(-x)) coin flips. Entropy: getrandom(2)
+// (the kernel CSPRNG), buffered per thread. The only deviation from
+// exactness is Bernoulli(p) on a 64-bit uniform, a bias of at most 2^-64
+// per coin (the same concession Google's library makes).
+//
+// Deliberately NOT seedable: secure noise must not be replayable.
+
+#include <cerrno>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <sys/random.h>
+
+namespace {
+
+// --- buffered kernel CSPRNG ------------------------------------------------
+
+class EntropyBuffer {
+ public:
+  uint64_t NextU64() {
+    if (pos_ + 8 > kBufSize) Refill();
+    uint64_t out;
+    std::memcpy(&out, buf_ + pos_, 8);
+    pos_ += 8;
+    return out;
+  }
+
+ private:
+  static constexpr size_t kBufSize = 1 << 16;
+
+  void Refill() {
+    size_t got = 0;
+    while (got < kBufSize) {
+      ssize_t r = getrandom(buf_ + got, kBufSize - got, 0);
+      if (r > 0) {
+        got += static_cast<size_t>(r);
+        continue;
+      }
+      if (r < 0 && (errno == EINTR || errno == EAGAIN)) continue;
+      // Non-retryable (ENOSYS on ancient kernels, EPERM under seccomp):
+      // try /dev/urandom once, else die loudly — silently degraded entropy
+      // is the one failure a secure sampler must never absorb, and this
+      // runs under a ctypes call where an exception can't propagate.
+      if (!RefillFromDevUrandom(got)) {
+        std::fprintf(stderr,
+                     "pipelinedp_tpu secure_noise: no entropy source "
+                     "(getrandom errno=%d, /dev/urandom unreadable)\n",
+                     errno);
+        std::abort();
+      }
+      got = kBufSize;
+    }
+    pos_ = 0;
+  }
+
+  bool RefillFromDevUrandom(size_t from) {
+    std::FILE* f = std::fopen("/dev/urandom", "rb");
+    if (!f) return false;
+    size_t need = kBufSize - from;
+    size_t got = std::fread(buf_ + from, 1, need, f);
+    std::fclose(f);
+    return got == need;
+  }
+
+  unsigned char buf_[kBufSize];
+  size_t pos_ = kBufSize;  // force refill on first use
+};
+
+thread_local EntropyBuffer tl_entropy;
+
+// Bernoulli(p): bias <= 2^-64.
+inline bool Bernoulli(double p) {
+  if (p <= 0.0) return false;
+  if (p >= 1.0) return true;
+  // p * 2^64, computed in long double to keep the comparison monotone.
+  long double threshold = static_cast<long double>(p) * 1.8446744073709551616e19L;
+  return static_cast<long double>(tl_entropy.NextU64()) < threshold;
+}
+
+// Unbiased Uniform{0, ..., n-1} by rejection.
+inline uint64_t UniformBelow(uint64_t n) {
+  uint64_t limit = UINT64_MAX - (UINT64_MAX % n);
+  for (;;) {
+    uint64_t u = tl_entropy.NextU64();
+    if (u < limit) return u % n;
+  }
+}
+
+// Bernoulli(exp(-gamma)) for gamma in [0, 1] (CKS Algorithm 1 core): count
+// successes of Bernoulli(gamma/k); exp(-gamma) is the probability of an
+// even count.
+inline bool BernoulliExpAtMostOne(double gamma) {
+  uint64_t k = 1;
+  for (;;) {
+    if (!Bernoulli(gamma / static_cast<double>(k))) break;
+    ++k;
+  }
+  return (k & 1) == 1;  // k-1 successes, even
+}
+
+// Bernoulli(exp(-gamma)) for any gamma >= 0.
+inline bool BernoulliExp(double gamma) {
+  while (gamma > 1.0) {
+    if (!BernoulliExpAtMostOne(1.0)) return false;
+    gamma -= 1.0;
+  }
+  return BernoulliExpAtMostOne(gamma);
+}
+
+// Discrete Laplace with scale t (integer t >= 1): P(X = x) proportional to
+// exp(-|x|/t). CKS Algorithm 2.
+inline int64_t DiscreteLaplace(uint64_t t) {
+  for (;;) {
+    uint64_t u = UniformBelow(t);
+    if (!BernoulliExp(static_cast<double>(u) / static_cast<double>(t)))
+      continue;
+    uint64_t v = 0;
+    while (BernoulliExpAtMostOne(1.0)) ++v;
+    uint64_t x = u + t * v;
+    bool negative = Bernoulli(0.5);
+    if (negative && x == 0) continue;
+    int64_t xi = static_cast<int64_t>(x);
+    return negative ? -xi : xi;
+  }
+}
+
+// Discrete Gaussian with parameter sigma (in integer units): P(X = x)
+// proportional to exp(-x^2 / (2 sigma^2)). CKS Algorithm 3: rejection from
+// discrete Laplace(t), t = floor(sigma) + 1.
+inline int64_t DiscreteGaussian(double sigma) {
+  uint64_t t = static_cast<uint64_t>(std::floor(sigma)) + 1;
+  double sigma_sq = sigma * sigma;
+  for (;;) {
+    int64_t y = DiscreteLaplace(t);
+    double ay = static_cast<double>(y < 0 ? -y : y);
+    double d = ay - sigma_sq / static_cast<double>(t);
+    if (BernoulliExp(d * d / (2.0 * sigma_sq))) return y;
+  }
+}
+
+template <typename Fn>
+void ParallelFill(int64_t* out, int64_t n, const Fn& sample_one) {
+  const int64_t kMinPerThread = 1 << 15;
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t max_threads = n / kMinPerThread;
+  int64_t n_threads = hw < 1 ? 1 : static_cast<int64_t>(hw);
+  if (n_threads > max_threads) n_threads = max_threads;
+  if (n_threads <= 1) {
+    for (int64_t i = 0; i < n; ++i) out[i] = sample_one();
+    return;
+  }
+  std::vector<std::thread> threads;
+  int64_t per = (n + n_threads - 1) / n_threads;
+  for (int64_t s = 0; s < n; s += per) {
+    int64_t e = s + per < n ? s + per : n;
+    threads.emplace_back([out, s, e, &sample_one] {
+      for (int64_t i = s; i < e; ++i) out[i] = sample_one();
+    });
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// ABI version for the Python loader's sanity check.
+int pdp_noise_abi_version() { return 1; }
+
+// n samples of discrete Laplace with scale t_units (rounded to >= 1
+// integer units). Returns 0 on success.
+int pdp_sample_discrete_laplace(int64_t* out, int64_t n, double t_units) {
+  if (!out || n < 0 || !(t_units > 0) || !std::isfinite(t_units)) return 1;
+  uint64_t t = t_units < 1.0 ? 1 : static_cast<uint64_t>(std::llround(t_units));
+  ParallelFill(out, n, [t] { return DiscreteLaplace(t); });
+  return 0;
+}
+
+// n samples of discrete Gaussian with parameter sigma_units (> 0).
+int pdp_sample_discrete_gaussian(int64_t* out, int64_t n,
+                                 double sigma_units) {
+  if (!out || n < 0 || !(sigma_units > 0) || !std::isfinite(sigma_units))
+    return 1;
+  ParallelFill(out, n, [sigma_units] { return DiscreteGaussian(sigma_units); });
+  return 0;
+}
+
+}  // extern "C"

@@ -4,21 +4,22 @@ Role in the stack: this module is the Python-visible surface of the L0
 "native DP primitives" layer (reference reaches it through PyDP's pybind11
 wrapper over Google's C++ differential-privacy library —
 dp_computations.py:25, see SURVEY.md §2.4). Noise calibration (sigma for the
-analytic Gaussian mechanism, Laplace diversity) lives here in pure
-float math; *sampling* will be delegated to the native C++ library
-(pipelinedp_tpu/native, see its loader once built) when available, with the
-numpy fallback below as the default.
+analytic Gaussian mechanism, Laplace diversity) lives here in pure float
+math; *sampling* is delegated to the native C++ library
+(pipelinedp_tpu/native/secure_noise.cc — exact discrete Laplace/Gaussian
+samplers over the kernel CSPRNG), auto-installed on the first draw when a
+compiler is available; the numpy fallback covers environments without one.
 
 Security note (why a native library exists at all): naive float Laplace
 sampling leaks information through the floating-point representation
 (Mironov 2012, "On significance of the least significant bits for
-differential privacy"). The mitigations implemented natively are the
-snapping/granularity construction: noise is sampled as an *integer* multiple
-of a power-of-two granularity (a discrete Laplace / discrete Gaussian), and
-the value is rounded to the same granularity before adding. The numpy
-fallback implements the same granularity snapping on top of numpy's float
-samplers — distributions match, bit-level security guarantees require the
-native path.
+differential privacy"). The native mitigation is the snapping/granularity
+construction: noise is sampled as an *integer* multiple of a power-of-two
+granularity (an exact discrete Laplace / discrete Gaussian, Canonne-Kamath-
+Steinke 2020), and the value is rounded to the same granularity before
+adding. The numpy fallback implements the same granularity snapping on top
+of numpy's float samplers — distributions match, bit-level security
+guarantees require the native path (check with using_native_sampling()).
 
 The TPU bulk path (pipelinedp_tpu/ops/noise.py, built alongside the JAX
 backend) applies the same snapping scheme with JAX's counter-based threefry
@@ -124,37 +125,86 @@ def laplace_diversity(eps: float, l1_sensitivity: float) -> float:
 
 
 # ---------------------------------------------------------------------------
-# Sampling (numpy fallback; native override installed by native/loader.py)
+# Sampling. The hooks below start in "autoload" state: the first draw
+# builds/loads the native C++ samplers (pipelinedp_tpu/native) and rebinds
+# the hooks — deferred so importing the package never shells out to g++.
+# The numpy fallback covers environments without a compiler, with a lock
+# because backends may draw noise from worker threads.
 # ---------------------------------------------------------------------------
 
+import threading as _threading
+
 _rng = np.random.default_rng()
+_rng_lock = _threading.Lock()
 
 
 def seed_fallback_rng(seed: Optional[int]) -> None:
-    """Reseeds the numpy fallback RNG (tests only)."""
-    global _rng
+    """Test hook: reseeds the numpy RNG AND routes sampling through the
+    (seedable) fallback — secure native noise is deliberately not
+    replayable, so deterministic tests must opt out of it. Call
+    pipelinedp_tpu.native.install() to restore the native path."""
+    global _rng, sample_laplace, sample_gaussian
     _rng = np.random.default_rng(seed)
+    sample_laplace = _fallback_laplace
+    sample_gaussian = _fallback_gaussian
 
 
 def _fallback_laplace(scale: float, size=None):
     g = laplace_granularity(scale)
-    raw = _rng.laplace(0.0, scale, size)
+    with _rng_lock:
+        raw = _rng.laplace(0.0, scale, size)
     return round_to_granularity(raw, g)
 
 
 def _fallback_gaussian(stddev: float, size=None):
     g = gaussian_granularity(stddev)
-    raw = _rng.normal(0.0, stddev, size)
+    with _rng_lock:
+        raw = _rng.normal(0.0, stddev, size)
     return round_to_granularity(raw, g)
 
 
-# Hook points: the native loader replaces these with C++ implementations.
-sample_laplace = _fallback_laplace
-sample_gaussian = _fallback_gaussian
+_native_attempted = False
+
+
+def _try_native_install() -> None:
+    """One attempt to build/load the native samplers (rebinds the hooks)."""
+    global _native_attempted
+    if _native_attempted:
+        return
+    _native_attempted = True
+    try:
+        from pipelinedp_tpu.native import loader as native_loader
+        native_loader.install()
+    except Exception:  # noqa: BLE001 — native failure must not break noise
+        pass
+
+
+def _autoload_laplace(scale: float, size=None):
+    global sample_laplace, sample_gaussian
+    _try_native_install()
+    if sample_laplace is _autoload_laplace:  # native unavailable
+        sample_laplace = _fallback_laplace
+        sample_gaussian = _fallback_gaussian
+    return sample_laplace(scale, size)
+
+
+def _autoload_gaussian(stddev: float, size=None):
+    global sample_laplace, sample_gaussian
+    _try_native_install()
+    if sample_gaussian is _autoload_gaussian:
+        sample_laplace = _fallback_laplace
+        sample_gaussian = _fallback_gaussian
+    return sample_gaussian(stddev, size)
+
+
+# Hook points: rebound to the native C++ samplers on first draw (or to the
+# numpy fallback when no native build is possible / after seed_fallback_rng).
+sample_laplace = _autoload_laplace
+sample_gaussian = _autoload_gaussian
 
 
 def using_native_sampling() -> bool:
-    return sample_laplace is not _fallback_laplace
+    return sample_laplace not in (_fallback_laplace, _autoload_laplace)
 
 
 def add_laplace_noise(value: float, scale: float) -> float:

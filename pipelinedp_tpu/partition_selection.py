@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import abc
 import math
+import threading
 from typing import Optional
 
 import numpy as np
@@ -44,12 +45,21 @@ PARTITION_STRATEGY_ENUM_TO_STR = {
 }
 
 _rng = np.random.default_rng()
+# Selection decisions may be drawn from backend worker threads
+# (MultiProcLocalBackend parallelizes filter/map_values); numpy Generators
+# are not thread-safe, so draws go through this lock.
+_rng_lock = threading.Lock()
 
 
 def seed_rng(seed: Optional[int]) -> None:
     """Reseeds the selection RNG (tests only)."""
     global _rng
     _rng = np.random.default_rng(seed)
+
+
+def _draw_uniform(shape=None):
+    with _rng_lock:
+        return _rng.random() if shape is None else _rng.random(shape)
 
 
 def _per_partition_delta(delta: float, max_partitions: int) -> float:
@@ -119,7 +129,7 @@ class PartitionSelection(abc.ABC):
         return np.where(n <= 0, 0.0, probs)
 
     def should_keep(self, num_privacy_units: int) -> bool:
-        return bool(_rng.random() < self.probability_of_keep(num_privacy_units))
+        return bool(_draw_uniform() < self.probability_of_keep(num_privacy_units))
 
     @abc.abstractmethod
     def _probability_of_keep_shifted(self, n: np.ndarray) -> np.ndarray:
@@ -146,7 +156,7 @@ class PartitionSelection(abc.ABC):
         """
         counts = np.asarray(num_privacy_units)
         probs = self.probability_of_keep_vec(counts)
-        keep = _rng.random(counts.shape) < probs
+        keep = _draw_uniform(counts.shape) < probs
         return keep, counts.astype(np.float64)
 
 

@@ -1,0 +1,103 @@
+"""CountErrorEstimator tests.
+
+Mirrors the reference's estimator semantics
+(histogram_error_estimator.py:44-158): ratio-dropped interpolation, noise
+std scaling per noise kind, RMSE averaging over the partition histogram.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu.backends import LocalBackend
+from pipelinedp_tpu.dataset_histograms import computing_histograms
+from pipelinedp_tpu.dataset_histograms import histogram_error_estimator
+
+
+def _histograms():
+    # 4 users: user u contributes to partitions 0..u (l0 = 1..4), one
+    # contribution each except user 3 contributes twice per partition.
+    rows = []
+    for user in range(4):
+        for p in range(user + 1):
+            rows.append((user, p, 1.0))
+            if user == 3:
+                rows.append((user, p, 1.0))
+    extractors = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                    partition_extractor=lambda r: r[1],
+                                    value_extractor=lambda r: r[2])
+    return list(
+        computing_histograms.compute_dataset_histograms(
+            rows, extractors, LocalBackend()))[0]
+
+
+class TestCountErrorEstimator:
+
+    def test_only_count_metrics_supported(self):
+        with pytest.raises(ValueError, match="COUNT"):
+            histogram_error_estimator.create_error_estimator(
+                _histograms(), 1.0, pdp.Metrics.SUM, pdp.NoiseKind.LAPLACE)
+
+    def test_ratio_dropped_bounds(self):
+        est = histogram_error_estimator.create_error_estimator(
+            _histograms(), 1.0, pdp.Metrics.COUNT, pdp.NoiseKind.LAPLACE)
+        # Bound above the max contribution drops nothing; 0 drops all.
+        assert est.get_ratio_dropped_l0(10) == 0
+        assert est.get_ratio_dropped_l0(0) == 1
+        assert est.get_ratio_dropped_linf(10) == 0
+        # Monotone decreasing in the bound.
+        r = [est.get_ratio_dropped_l0(b) for b in range(1, 5)]
+        assert all(a >= b for a, b in zip(r, r[1:]))
+
+    def test_rmse_noise_only_when_nothing_dropped(self):
+        # With bounds covering the data fully, RMSE == noise std.
+        est = histogram_error_estimator.create_error_estimator(
+            _histograms(), 2.0, pdp.Metrics.COUNT, pdp.NoiseKind.LAPLACE)
+        rmse = est.estimate_rmse(l0_bound=4, linf_bound=2)
+        assert rmse == pytest.approx(2.0 * 4 * 2)
+
+    def test_gaussian_std_scaling(self):
+        est = histogram_error_estimator.create_error_estimator(
+            _histograms(), 2.0, pdp.Metrics.COUNT, pdp.NoiseKind.GAUSSIAN)
+        rmse = est.estimate_rmse(l0_bound=4, linf_bound=2)
+        assert rmse == pytest.approx(2.0 * math.sqrt(4) * 2)
+
+    def test_privacy_id_count_ignores_linf(self):
+        est = histogram_error_estimator.create_error_estimator(
+            _histograms(), 1.0, pdp.Metrics.PRIVACY_ID_COUNT,
+            pdp.NoiseKind.LAPLACE)
+        assert est.estimate_rmse(4) == pytest.approx(est.estimate_rmse(4, 7))
+
+    def test_count_requires_linf(self):
+        est = histogram_error_estimator.create_error_estimator(
+            _histograms(), 1.0, pdp.Metrics.COUNT, pdp.NoiseKind.LAPLACE)
+        with pytest.raises(ValueError, match="linf"):
+            est.estimate_rmse(2)
+
+    def test_dropped_data_increases_rmse(self):
+        est = histogram_error_estimator.create_error_estimator(
+            _histograms(), 0.1, pdp.Metrics.COUNT, pdp.NoiseKind.LAPLACE)
+        # Tight l0 bound drops data -> bigger error than noise alone for
+        # the same std... compare normalized by the noise std.
+        rmse_tight = est.estimate_rmse(1, 2)
+        noise_tight = 0.1 * 1 * 2
+        assert rmse_tight > noise_tight
+
+    def test_vectorized_matches_scalar(self):
+        est = histogram_error_estimator.create_error_estimator(
+            _histograms(), 0.5, pdp.Metrics.COUNT, pdp.NoiseKind.GAUSSIAN)
+        l0s = np.array([1, 2, 3, 4])
+        linfs = np.array([1, 2, 1, 2])
+        vec = est.estimate_rmse_vec(l0s, linfs)
+        for i in range(4):
+            assert vec[i] == pytest.approx(
+                est.estimate_rmse(int(l0s[i]), int(linfs[i])))
+
+    def test_interpolation_between_thresholds(self):
+        ratios = [(0, 1.0), (2, 0.5), (4, 0.0)]
+        out = histogram_error_estimator._interp_ratio_dropped(
+            ratios, np.array([1.0, 3.0]))
+        assert out[0] == pytest.approx(0.75)
+        assert out[1] == pytest.approx(0.25)

@@ -1,0 +1,61 @@
+"""Tests for Laplace+Gaussian sum quantiles.
+
+Parity intent: /root/reference/analysis/tests/probability_computations_test.py
+— quantiles of the noise-sum distribution; here the exact inverse-CDF path
+is additionally cross-checked against Monte Carlo and against the pure
+single-distribution limits.
+"""
+
+import numpy as np
+import pytest
+from scipy import stats
+
+from pipelinedp_tpu.analysis import compute_sum_laplace_gaussian_quantiles
+from pipelinedp_tpu.analysis.probability_computations import _sum_cdf
+
+
+class TestSumLaplaceGaussianQuantiles:
+
+    def test_pure_gaussian_limit(self):
+        qs = [0.05, 0.5, 0.95]
+        out = compute_sum_laplace_gaussian_quantiles(0.0, 2.0, qs)
+        np.testing.assert_allclose(out, stats.norm.ppf(qs, scale=2.0),
+                                   atol=1e-9)
+
+    def test_pure_laplace_limit(self):
+        qs = [0.1, 0.5, 0.9]
+        out = compute_sum_laplace_gaussian_quantiles(3.0, 0.0, qs)
+        np.testing.assert_allclose(out, stats.laplace.ppf(qs, scale=3.0),
+                                   rtol=1e-9, atol=1e-9)
+
+    def test_symmetry_and_monotonicity(self):
+        qs = [0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99]
+        out = compute_sum_laplace_gaussian_quantiles(1.5, 2.5, qs)
+        assert out[3] == pytest.approx(0.0, abs=1e-9)
+        np.testing.assert_allclose(out, -np.asarray(out)[::-1], atol=1e-8)
+        assert all(a < b for a, b in zip(out, out[1:]))
+
+    def test_cdf_roundtrip(self):
+        qs = np.linspace(0.01, 0.99, 25)
+        out = compute_sum_laplace_gaussian_quantiles(1.0, 1.0, qs)
+        np.testing.assert_allclose(_sum_cdf(np.asarray(out), 1.0, 1.0), qs,
+                                   atol=1e-10)
+
+    def test_exact_matches_monte_carlo(self):
+        qs = [0.1, 0.5, 0.9]
+        exact = compute_sum_laplace_gaussian_quantiles(2.0, 1.0, qs)
+        mc = compute_sum_laplace_gaussian_quantiles(
+            2.0, 1.0, qs, num_samples=200_000, method="monte_carlo",
+            rng=np.random.default_rng(0))
+        np.testing.assert_allclose(exact, mc, atol=0.05)
+
+    def test_extreme_quantiles_stable(self):
+        out = compute_sum_laplace_gaussian_quantiles(1.0, 1.0,
+                                                     [1e-9, 1 - 1e-9])
+        assert np.isfinite(out).all()
+        assert out[0] < -15 and out[1] > 15
+
+    def test_unknown_method(self):
+        with pytest.raises(ValueError, match="method"):
+            compute_sum_laplace_gaussian_quantiles(1, 1, [0.5],
+                                                   method="nope")
