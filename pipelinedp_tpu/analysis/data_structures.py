@@ -33,6 +33,7 @@ class MultiParameterConfiguration:
     noise_kind: Optional[Sequence[NoiseKind]] = None
     partition_selection_strategy: Optional[
         Sequence[PartitionSelectionStrategy]] = None
+    post_aggregation_thresholding: Optional[Sequence[bool]] = None
 
     def __post_init__(self):
         lengths = {
@@ -63,7 +64,8 @@ class MultiParameterConfiguration:
         for field in ("max_partitions_contributed",
                       "max_contributions_per_partition",
                       "min_sum_per_partition", "max_sum_per_partition",
-                      "noise_kind", "partition_selection_strategy"):
+                      "noise_kind", "partition_selection_strategy",
+                      "post_aggregation_thresholding"):
             values = getattr(self, field)
             if values:
                 setattr(params, field, values[index])

@@ -182,6 +182,7 @@ def _attach_dp_strategies(
     config.noise_kind = []
     if not selector.is_public_partitions:
         config.partition_selection_strategy = []
+        config.post_aggregation_thresholding = []
     for params in all_params:
         if selector.metric is None:
             sensitivities = dp_computations.Sensitivities(
@@ -194,6 +195,12 @@ def _attach_dp_strategies(
         if not selector.is_public_partitions:
             config.partition_selection_strategy.append(
                 strategy.partition_selection_strategy)
+            # Honor the selector's full recommendation: when it chooses
+            # post-aggregation thresholding (PRIVACY_ID_COUNT), the swept
+            # config analyzes that exact strategy instead of silently
+            # falling back to separate-budget selection.
+            config.post_aggregation_thresholding.append(
+                strategy.post_aggregation_thresholding)
 
 
 def tune(col,

@@ -31,7 +31,8 @@ from pipelinedp_tpu import budget_accounting
 from pipelinedp_tpu import dp_computations
 from pipelinedp_tpu import partition_selection as ps_lib
 from pipelinedp_tpu.aggregate_params import (AggregateParams, MechanismType,
-                                             Metric, Metrics, NoiseKind)
+                                             Metric, Metrics, NoiseKind,
+                                             noise_to_thresholding)
 from pipelinedp_tpu.analysis import data_structures
 from pipelinedp_tpu.analysis import poisson_binomial
 from pipelinedp_tpu.analysis.pre_aggregation import PreAggregates
@@ -54,6 +55,9 @@ class ConfigSpec:
     params: AggregateParams
     selection_spec: Optional[budget_accounting.MechanismSpec]
     metric_specs: Dict[Metric, budget_accounting.MechanismSpec]
+    # Private selection happens through PRIVACY_ID_COUNT thresholding
+    # (no separate selection budget).
+    post_agg_thresholding: bool = False
 
 
 def resolve_config_budgets(options: data_structures.UtilityAnalysisOptions,
@@ -70,19 +74,43 @@ def resolve_config_budgets(options: data_structures.UtilityAnalysisOptions,
     for i, params in enumerate(data_structures.get_aggregate_params(options)):
         accountant = budget_accounting.NaiveBudgetAccountant(
             options.epsilon, options.delta)
+        post_agg = (params.post_aggregation_thresholding and
+                    not public_partitions)
         selection_spec = None
-        if not public_partitions:
+        if not public_partitions and not post_agg:
+            # With post-aggregation thresholding, selection rides on the
+            # PRIVACY_ID_COUNT thresholding mechanism — no separate budget
+            # (parity: the engine requests no GENERIC spec in that mode).
             selection_spec = accountant.request_budget(MechanismType.GENERIC)
         mechanism_type = (params.noise_kind.convert_to_mechanism_type()
                           if params.noise_kind else None)
         metric_specs = {}
         for metric in METRIC_ORDER:
             if metric in metrics:
-                metric_specs[metric] = accountant.request_budget(
-                    mechanism_type)
+                if metric == Metrics.PRIVACY_ID_COUNT and post_agg:
+                    metric_specs[metric] = accountant.request_budget(
+                        noise_to_thresholding(params.noise_kind))
+                else:
+                    metric_specs[metric] = accountant.request_budget(
+                        mechanism_type)
         accountant.compute_budgets()
-        configs.append(ConfigSpec(i, params, selection_spec, metric_specs))
+        configs.append(
+            ConfigSpec(i, params, selection_spec, metric_specs,
+                       post_agg_thresholding=bool(post_agg)))
     return configs
+
+
+def _thresholding_strategy(
+        config: ConfigSpec) -> ps_lib.PartitionSelection:
+    """The post-aggregation thresholding strategy of a config (its keep
+    probabilities AND its PRIVACY_ID_COUNT noise, per the engine's
+    PostAggregationThresholdingCombiner)."""
+    params = config.params
+    spec = config.metric_specs[Metrics.PRIVACY_ID_COUNT]
+    sensitivities = (
+        dp_computations.compute_sensitivities_for_privacy_id_count(params))
+    return dp_computations.create_thresholding_mechanism(
+        spec, sensitivities, params.pre_threshold).strategy
 
 
 @dataclasses.dataclass
@@ -118,8 +146,16 @@ def _metric_values_and_bounds(metric: Metric, pre: PreAggregates,
         if params.bounds_per_partition_are_set:
             lo, hi = params.min_sum_per_partition, params.max_sum_per_partition
         else:
-            # Per-contribution bounds: per-group sum bound is count-scaled;
-            # model at group level with the partition-sum interpretation.
+            # Per-contribution bounds: the engine clips each contribution to
+            # [min_value, max_value] and keeps at most linf of them, so a
+            # group's released sum lies in linf-scaled bounds — model
+            # clipping there. DELIBERATE DEVIATION from the reference, whose
+            # analysis SumCombiner reads only min/max_sum_per_partition and
+            # applies NO clipping in this mode
+            # (per_partition_combiners.py:250-259: np.clip with None
+            # bounds); that under-reports clipping error for groups whose
+            # raw sum exceeds the count-scaled bounds. Pinned by
+            # tests/analysis_test.py TestSumPerContributionBounds.
             lo = params.min_value * params.max_contributions_per_partition
             hi = params.max_value * params.max_contributions_per_partition
         return pre.sums, lo, hi
@@ -162,10 +198,17 @@ def compute_metric_errors(pre: PreAggregates, configs: List[ConfigSpec],
                                n_partitions)
         exp_l0[c] = _segment(-x * (1.0 - q), pre.pk_ids, n_partitions)
         var_l0[c] = _segment(x * x * q * (1.0 - q), pre.pk_ids, n_partitions)
-        sensitivities = dp_computations.compute_sensitivities(metric, params)
-        mechanism = dp_computations.create_additive_mechanism(
-            config.metric_specs[metric], sensitivities)
-        std_noise[c] = mechanism.std
+        if (metric == Metrics.PRIVACY_ID_COUNT and
+                config.post_agg_thresholding):
+            # Post-aggregation thresholding: the released count is the
+            # thresholding strategy's noised value.
+            std_noise[c] = _thresholding_strategy(config).noise_stddev
+        else:
+            sensitivities = dp_computations.compute_sensitivities(
+                metric, params)
+            mechanism = dp_computations.create_additive_mechanism(
+                config.metric_specs[metric], sensitivities)
+            std_noise[c] = mechanism.std
         noise_kinds.append(params.noise_kind)
     return MetricErrorArrays(metric=metric,
                              raw=raw,
@@ -183,6 +226,38 @@ def _keep_prob_exact(qs: np.ndarray,
     counts = np.arange(pmf.start, pmf.start + len(pmf.probabilities))
     return float(
         np.dot(pmf.probabilities, strategy.probability_of_keep_vec(counts)))
+
+
+# Exact-path batch buckets: partitions are grouped by privacy-unit count
+# and padded to the bucket upper bound (padding with q=0 units is exact —
+# a Bernoulli(0) contributes nothing to the PGF), so each bucket is one
+# vectorized convolution instead of a per-partition Python loop.
+_EXACT_BUCKETS = (4, 8, 16, 32, 64, MAX_EXACT_PROBABILITIES)
+
+
+def _keep_prob_exact_batch(q_padded: np.ndarray, shift: np.ndarray,
+                           strategy: ps_lib.PartitionSelection) -> np.ndarray:
+    """Exact Poisson-binomial keep probabilities for a [P, M] batch.
+
+    Row p holds partition p's *random* (q < 1) per-unit survival
+    probabilities, zero-padded; shift[p] is the partition's count of
+    deterministic q == 1 units, which translate the PMF instead of being
+    convolved. The PMF recurrence runs over the unit axis with all
+    partitions in lockstep: pmf_{j+1} = pmf_j (1 - q_j) + shift(pmf_j) q_j
+    — identical arithmetic to poisson_binomial.compute_pmf, batched.
+    """
+    n_rows, m = q_padded.shape
+    pmf = np.zeros((n_rows, m + 1))
+    pmf[:, 0] = 1.0
+    shifted = np.zeros_like(pmf)
+    for j in range(m):
+        qj = q_padded[:, j:j + 1]
+        shifted[:, 1:] = pmf[:, :-1]
+        pmf = pmf * (1.0 - qj) + shifted * qj
+    counts = shift[:, None] + np.arange(m + 1)[None, :]
+    pok = strategy.probability_of_keep_vec(counts.ravel()).reshape(
+        counts.shape)
+    return np.clip((pmf * pok).sum(axis=1), 0.0, 1.0)
 
 
 def _keep_prob_approx_vec(mean: np.ndarray, var: np.ndarray, m3: np.ndarray,
@@ -241,25 +316,50 @@ def compute_keep_probabilities(pre: PreAggregates, configs: List[ConfigSpec],
     out = np.zeros((n_configs, n_partitions))
     n_units = np.bincount(pre.pk_ids,
                           minlength=n_partitions).astype(np.int64)
-    small = n_units <= MAX_EXACT_PROBABILITIES
-    # Group ids of each partition, for the exact path.
+    # Sorted-by-partition group view, for the exact path's padded batches.
+    # All of this indexing is config-independent, computed once.
     order = np.argsort(pre.pk_ids, kind="stable")
-    boundaries = np.searchsorted(pre.pk_ids[order],
-                                 np.arange(n_partitions + 1))
+    spk = pre.pk_ids[order]
+    small = np.flatnonzero(
+        (n_units > 0) & (n_units <= MAX_EXACT_PROBABILITIES))
+    small_set = np.zeros(n_partitions, dtype=bool)
+    small_set[small] = True
+    sel_small = small_set[spk]
+    spk_small = spk[sel_small]
+    sq_order = order[sel_small]
+    # Keep probabilities depend on the config only through the selection
+    # strategy and the L0 bound — NOT through linf or the sum bounds — so
+    # sweep configurations differing only in those share one computation.
+    cache = {}
     for c, config in enumerate(configs):
         params = config.params
-        spec = config.selection_spec
-        strategy = ps_lib.create_partition_selection_strategy(
-            params.partition_selection_strategy, spec.eps, spec.delta,
-            params.max_partitions_contributed, params.pre_threshold)
+        if config.post_agg_thresholding:
+            # Selection = the PRIVACY_ID_COUNT thresholding strategy: the
+            # analyzed strategy is exactly what the engine would run.
+            strategy = _thresholding_strategy(config)
+            spec = config.metric_specs[Metrics.PRIVACY_ID_COUNT]
+            key = (True, spec.eps, spec.delta, params.noise_kind,
+                   params.max_partitions_contributed, params.pre_threshold)
+        else:
+            spec = config.selection_spec
+            strategy = ps_lib.create_partition_selection_strategy(
+                params.partition_selection_strategy, spec.eps, spec.delta,
+                params.max_partitions_contributed, params.pre_threshold)
+            key = (False, spec.eps, spec.delta,
+                   params.partition_selection_strategy,
+                   params.max_partitions_contributed, params.pre_threshold)
+        if key in cache:
+            out[c] = out[cache[key]]
+            continue
+        cache[key] = c
         q = np.minimum(1.0, params.max_partitions_contributed /
                        np.maximum(pre.n_partitions, 1))
-        # Exact Poisson-binomial for small partitions.
-        for p in np.flatnonzero(small & (n_units > 0)):
-            qs = q[order[boundaries[p]:boundaries[p + 1]]]
-            out[c, p] = _keep_prob_exact(qs, strategy)
+        if len(small):
+            out[c, small] = _exact_keep_probs(q[sq_order], spk_small,
+                                              n_units, small, n_partitions,
+                                              strategy)
         # Vectorized refined-normal for the rest.
-        big = np.flatnonzero(~small)
+        big = np.flatnonzero(n_units > MAX_EXACT_PROBABILITIES)
         if len(big):
             mean = _segment(q, pre.pk_ids, n_partitions)[big]
             var = _segment(q * (1 - q), pre.pk_ids, n_partitions)[big]
@@ -268,6 +368,53 @@ def compute_keep_probabilities(pre: PreAggregates, configs: List[ConfigSpec],
             out[c, big] = _keep_prob_approx_vec(mean, var, m3, n_units[big],
                                                 strategy)
     return out
+
+
+def _exact_keep_probs(sq: np.ndarray, spk_small: np.ndarray,
+                      n_units: np.ndarray, small: np.ndarray,
+                      n_partitions: int,
+                      strategy: ps_lib.PartitionSelection) -> np.ndarray:
+    """Exact keep probabilities for the small partitions (one config).
+
+    sq: per-unit survival probabilities of the small partitions' units, in
+    partition-sorted order; spk_small: their partition ids. Deterministic
+    q == 1 units only translate the Poisson-binomial PMF, so partitions are
+    bucketed by their count of *random* (q < 1) units — under a generous L0
+    bound most units are deterministic and whole buckets collapse to a
+    direct probability_of_keep lookup.
+    """
+    keep = np.zeros(len(small))
+    is_random = sq < 1.0
+    n_random = np.bincount(spk_small[is_random],
+                           minlength=n_partitions)[small]
+    n_all = n_units[small]
+    # Fully deterministic partitions: N == n_units.
+    det = n_random == 0
+    if det.any():
+        keep[det] = strategy.probability_of_keep_vec(n_all[det])
+    # Random positions within each partition's q<1 subset.
+    csel = np.flatnonzero(is_random)
+    if len(csel):
+        spk_r = spk_small[csel]
+        starts = np.searchsorted(spk_r, spk_r, side="left")
+        pos = np.arange(len(spk_r)) - starts
+        # Map partition id -> row in the small/bucket arrays.
+        rowmap = np.full(n_partitions, -1)
+        lo = 0
+        for m in _EXACT_BUCKETS:
+            rows = np.flatnonzero((n_random > lo) & (n_random <= m))
+            lo = m
+            if not len(rows):
+                continue
+            rowmap[:] = -1
+            rowmap[small[rows]] = np.arange(len(rows))
+            in_bucket = rowmap[spk_r] >= 0
+            q_padded = np.zeros((len(rows), m))
+            q_padded[rowmap[spk_r[in_bucket]], pos[in_bucket]] = (
+                sq[csel[in_bucket]])
+            shift = n_all[rows] - n_random[rows]
+            keep[rows] = _keep_prob_exact_batch(q_padded, shift, strategy)
+    return keep
 
 
 def compute_per_partition_arrays(pre: PreAggregates,

@@ -143,7 +143,10 @@ def _check_analyze_params(
         raise NotImplementedError(
             "Utility analysis with contribution_bounds_already_enforced is "
             "not supported.")
-    if params.post_aggregation_thresholding:
-        raise NotImplementedError(
-            "Utility analysis with post_aggregation_thresholding is not "
-            "supported.")
+    if (params.post_aggregation_thresholding and
+            Metrics.PRIVACY_ID_COUNT not in (params.metrics or [])):
+        # Same validation as DPEngine._check_aggregate_params
+        # (dp_engine.py:338-341): the thresholding rides on the
+        # PRIVACY_ID_COUNT mechanism.
+        raise ValueError("When post_aggregation_thresholding = True, "
+                         "PRIVACY_ID_COUNT must be in metrics")

@@ -439,13 +439,19 @@ class JaxDPEngine:
             linf_cap = max(len(pid), 1)
         l0_cap = (params.max_partitions_contributed
                   if params.max_partitions_contributed else num_partitions)
+        l1_cap = None
         if params.max_contributions is not None:
-            # L1 bounding: cap total contributions. On the columnar path we
-            # enforce it as (linf=max_contributions within a partition,
-            # l0=max_contributions partitions) which is a strictly tighter
-            # bound than the reference's total-sample.
-            linf_cap = params.max_contributions
-            l0_cap = params.max_contributions
+            # L1 bounding: a uniform sample of max_contributions rows per
+            # privacy unit, total across all partitions — the same
+            # semantics as the reference's
+            # SamplingPerPrivacyIdContributionBounder
+            # (contribution_bounders.py:114-156), and the bound the L1
+            # noise sensitivity is calibrated to. Linf/L0 caps are
+            # disabled; the kernels apply the L1 sample first. Pinned by
+            # tests/jax_engine_test.py TestL1ModeParity.
+            l1_cap = params.max_contributions
+            linf_cap = max(len(pid), 1)
+            l0_cap = num_partitions
         if params.contribution_bounds_already_enforced:
             # The input already satisfies the bounds; apply none.
             linf_cap = max(len(pid), 1)
@@ -453,6 +459,11 @@ class JaxDPEngine:
             self._add_report_stage(
                 "Contribution bounding: skipped (already enforced by the "
                 "caller)")
+        elif l1_cap is not None:
+            self._add_report_stage(
+                f"Total contribution bounding: for each privacy_id randomly "
+                f"select max(actual_contributions, {l1_cap}) contributions "
+                f"across all partitions")
         else:
             self._add_report_stage(
                 f"Per-partition contribution bounding: for each privacy_id "
@@ -472,7 +483,8 @@ class JaxDPEngine:
             return engine._execute(compound, params, selection_spec,
                                    kernel_key, pid, pk, value,
                                    num_partitions, linf_cap, l0_cap,
-                                   public_partitions is not None, is_vector)
+                                   public_partitions is not None, is_vector,
+                                   l1_cap=l1_cap)
 
         return LazyJaxResult(compute, pk_vocab)
 
@@ -480,7 +492,7 @@ class JaxDPEngine:
 
     def _execute(self, compound, params: AggregateParams, selection_spec,
                  key, pid, pk, value, num_partitions, linf_cap, l0_cap,
-                 is_public: bool, is_vector: bool) -> dict:
+                 is_public: bool, is_vector: bool, l1_cap=None) -> dict:
         k_kernel, k_select, k_noise = jax.random.split(key, 3)
         n_rows = len(pid)
         has_quantile = any(
@@ -518,7 +530,8 @@ class JaxDPEngine:
                     linf_cap=linf_cap,
                     l0_cap=l0_cap,
                     max_norm=params.vector_max_norm,
-                    norm_ord=norm_ord)
+                    norm_ord=norm_ord,
+                    l1_cap=l1_cap)
             else:
                 accs = sharded.bound_and_aggregate(
                     self._mesh, k_kernel, pid, pk, value, valid_rows,
@@ -529,7 +542,8 @@ class JaxDPEngine:
                     row_clip_hi=row_hi,
                     middle=middle,
                     group_clip_lo=glo,
-                    group_clip_hi=ghi)
+                    group_clip_hi=ghi,
+                    l1_cap=l1_cap)
         elif is_vector:
             vector_sums, accs = columnar.bound_and_aggregate_vector(
                 k_kernel, jnp.asarray(pid), jnp.asarray(pk),
@@ -538,7 +552,8 @@ class JaxDPEngine:
                 linf_cap=linf_cap,
                 l0_cap=l0_cap,
                 max_norm=params.vector_max_norm,
-                norm_ord=norm_ord)
+                norm_ord=norm_ord,
+                l1_cap=l1_cap)
         elif (not has_quantile and self._stream_chunks != 1 and
               (self._stream_chunks is not None or
                n_rows >= streaming.MIN_STREAM_ROWS)):
@@ -555,6 +570,7 @@ class JaxDPEngine:
                 middle=middle,
                 group_clip_lo=glo,
                 group_clip_hi=ghi,
+                l1_cap=l1_cap,
                 n_chunks=self._stream_chunks,
                 value_transfer_dtype=self._value_transfer_dtype)
         else:
@@ -568,7 +584,8 @@ class JaxDPEngine:
                 row_clip_hi=row_hi,
                 middle=middle,
                 group_clip_lo=glo,
-                group_clip_hi=ghi)
+                group_clip_hi=ghi,
+                l1_cap=l1_cap)
 
         # On a mesh the accumulators are padded so the partition dimension
         # shards evenly; all downstream math runs on the padded arrays and
@@ -602,14 +619,16 @@ class JaxDPEngine:
                     lower=params.min_value,
                     upper=params.max_value,
                     linf_cap=linf_cap,
-                    l0_cap=l0_cap)
+                    l0_cap=l0_cap,
+                    l1_cap=l1_cap)
             else:
                 row_keep = columnar.bound_row_mask(k_kernel,
                                                    jnp.asarray(pid),
                                                    jnp.asarray(pk),
                                                    jnp.ones(n_rows,
                                                             dtype=bool),
-                                                   linf_cap, l0_cap)
+                                                   linf_cap, l0_cap,
+                                                   l1_cap=l1_cap)
                 quantile_hist = quantile_ops.leaf_histograms(
                     jnp.asarray(pk),
                     jnp.asarray(value),
@@ -621,8 +640,8 @@ class JaxDPEngine:
 
         # Partition selection. The selection strategy's L0 sensitivity is
         # the *declared* cross-partition bound: max_partitions_contributed,
-        # or max_contributions in L1 mode (which caps partitions at the same
-        # value — the kernel's l0_cap matches).
+        # or max_contributions in L1 mode (the per-privacy-id total sample
+        # of at most k rows reaches at most k partitions).
         if is_public:
             keep_mask = jnp.arange(num_out) < num_partitions
         elif selection_spec is not None:

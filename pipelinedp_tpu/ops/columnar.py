@@ -69,17 +69,46 @@ class SampledRows(NamedTuple):
     g_valid: jnp.ndarray  # group slot holds a real group
 
 
+def _l1_sample_mask(key: jax.Array, pid: jnp.ndarray, valid: jnp.ndarray,
+                    l1_cap) -> jnp.ndarray:
+    """Keeps a uniform sample of at most l1_cap rows per privacy id.
+
+    Exact replication of the reference's per-privacy-id L1 bounding
+    (SamplingPerPrivacyIdContributionBounder,
+    contribution_bounders.py:114-156): sort rows by (pid, uniform) — each
+    privacy id's rows land in random order — and keep rank < l1_cap.
+    """
+    n = pid.shape[0]
+    pid_key = jnp.where(valid, pid, _INT32_MAX)
+    tiebreak = jax.random.uniform(key, (n,))
+    order = jnp.lexsort((tiebreak, pid_key))
+    spid = pid_key[order]
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), spid[1:] != spid[:-1]])
+    keep_sorted = valid[order] & (_segment_rank(is_start) < l1_cap)
+    return jnp.zeros((n,), dtype=bool).at[order].set(keep_sorted)
+
+
 def _sample_rows_and_groups(key: jax.Array, pid: jnp.ndarray,
                             pk: jnp.ndarray, valid: jnp.ndarray, linf_cap,
-                            l0_cap) -> SampledRows:
+                            l0_cap, l1_cap=None) -> SampledRows:
     """Sorts rows by (pid, pk, uniform) and samples Linf rows / L0 groups.
 
     The uniform tiebreak makes each (pid, pk) group a random permutation,
     so "rank < cap" is exact sampling without replacement (the
     sample_fixed_per_key of the reference, done once for all keys).
+
+    l1_cap (max_contributions mode): when given, a uniform sample of at
+    most l1_cap rows per privacy id is taken FIRST — the total-contribution
+    bound whose L1 sensitivity the noise is calibrated to. Passing
+    linf/l0 caps >= the data bounds alongside reproduces the reference's
+    L1-only bounding exactly.
     """
     n = pid.shape[0]
     k1, k2 = jax.random.split(key)
+    if l1_cap is not None:
+        valid = _l1_sample_mask(jax.random.fold_in(key, 3), pid, valid,
+                                l1_cap)
 
     # Padding rows sort to the very end.
     pid_key = jnp.where(valid, pid, _INT32_MAX)
@@ -129,7 +158,8 @@ def bound_and_aggregate(key: jax.Array,
                         row_clip_hi,
                         middle,
                         group_clip_lo,
-                        group_clip_hi) -> PartitionAccumulators:
+                        group_clip_hi,
+                        l1_cap=None) -> PartitionAccumulators:
     """Contribution bounding + per-partition aggregation, fully fused.
 
     Args:
@@ -144,12 +174,15 @@ def bound_and_aggregate(key: jax.Array,
       middle: normalization midpoint for mean/variance sums.
       group_clip_lo/hi: per-partition-sum clip bounds (+-inf to disable) —
         the min/max_sum_per_partition mode of SumCombiner.
+      l1_cap: max_contributions mode — uniform per-privacy-id total sample
+        applied before everything else (pass linf/l0 caps >= data bounds).
     """
     n = pid.shape[0]
     if n == 0:
         zeros = jnp.zeros((num_partitions,), dtype=value.dtype)
         return PartitionAccumulators(zeros, zeros, zeros, zeros, zeros)
-    s = _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap)
+    s = _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap,
+                                l1_cap)
     sval = value[s.order]
 
     # -- rows -> (pid, pk) group accumulators ------------------------------
@@ -192,7 +225,8 @@ def bound_and_aggregate_vector(key: jax.Array,
                                linf_cap,
                                l0_cap,
                                max_norm,
-                               norm_ord: int
+                               norm_ord: int,
+                               l1_cap=None
                                ) -> tuple[jnp.ndarray, PartitionAccumulators]:
     """VECTOR_SUM path: per-row norm clipping + the same two-stage sampling.
 
@@ -207,7 +241,8 @@ def bound_and_aggregate_vector(key: jax.Array,
         zeros = jnp.zeros((num_partitions,), dtype=value.dtype)
         return (jnp.zeros((num_partitions, d), dtype=value.dtype),
                 PartitionAccumulators(zeros, zeros, zeros, zeros, zeros))
-    s = _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap)
+    s = _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap,
+                                l1_cap)
     sval = value[s.order]
 
     if norm_ord == 0:
@@ -243,7 +278,8 @@ def bound_and_aggregate_vector(key: jax.Array,
 
 @functools.partial(jax.jit)
 def bound_row_mask(key: jax.Array, pid: jnp.ndarray, pk: jnp.ndarray,
-                   valid: jnp.ndarray, linf_cap, l0_cap) -> jnp.ndarray:
+                   valid: jnp.ndarray, linf_cap, l0_cap,
+                   l1_cap=None) -> jnp.ndarray:
     """Per-row keep mask (original row order) after Linf + L0 bounding.
 
     Identical sampling decisions to bound_and_aggregate for the same key —
@@ -256,7 +292,8 @@ def bound_row_mask(key: jax.Array, pid: jnp.ndarray, pk: jnp.ndarray,
     n = pid.shape[0]
     if n == 0:
         return jnp.zeros((0,), dtype=bool)
-    s = _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap)
+    s = _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap,
+                                l1_cap)
     keep_sorted_rows = s.keep_row & s.keep_group[s.group_id]
     return jnp.zeros((n,), dtype=bool).at[s.order].set(keep_sorted_rows)
 

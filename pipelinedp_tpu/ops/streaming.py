@@ -97,10 +97,15 @@ def _unpack_value(buf: jnp.ndarray, offset: int,
     static_argnames=("num_partitions", "bytes_pid", "bytes_pk", "value_f16"),
     donate_argnums=(3,))
 def _chunk_step(key, buf, n_valid, accs, linf_cap, l0_cap, row_clip_lo,
-                row_clip_hi, middle, group_clip_lo, group_clip_hi, *,
+                row_clip_hi, middle, group_clip_lo, group_clip_hi,
+                l1_cap=None, *,
                 num_partitions: int, bytes_pid: int, bytes_pk: int,
                 value_f16: bool):
-    """Unpack one byte-packed chunk, bound+aggregate it, add into accs."""
+    """Unpack one byte-packed chunk, bound+aggregate it, add into accs.
+
+    Chunks are pid-disjoint, so the optional L1 (max_contributions) sample
+    inside the kernel is exact per chunk.
+    """
     pid = _unpack_ints(buf, 0, bytes_pid)
     pk = _unpack_ints(buf, bytes_pid, bytes_pk)
     value = _unpack_value(buf, bytes_pid + bytes_pk, value_f16)
@@ -114,7 +119,8 @@ def _chunk_step(key, buf, n_valid, accs, linf_cap, l0_cap, row_clip_lo,
         row_clip_hi=row_clip_hi,
         middle=middle,
         group_clip_lo=group_clip_lo,
-        group_clip_hi=group_clip_hi)
+        group_clip_hi=group_clip_hi,
+        l1_cap=l1_cap)
     return columnar.PartitionAccumulators(
         *(a + c for a, c in zip(accs, chunk_accs)))
 
@@ -133,6 +139,7 @@ def stream_bound_and_aggregate(
     middle,
     group_clip_lo,
     group_clip_hi,
+    l1_cap=None,
     n_chunks: Optional[int] = None,
     value_transfer_dtype: Optional[np.dtype] = None,
 ) -> columnar.PartitionAccumulators:
@@ -213,7 +220,7 @@ def stream_bound_and_aggregate(
         dbuf = jax.device_put(buf)
         accs = _chunk_step(jax.random.fold_in(key, c), dbuf, m, accs,
                            linf_cap, l0_cap, row_clip_lo, row_clip_hi,
-                           middle, group_clip_lo, group_clip_hi,
+                           middle, group_clip_lo, group_clip_hi, l1_cap,
                            num_partitions=num_partitions,
                            bytes_pid=bytes_pid,
                            bytes_pk=bytes_pk,

@@ -130,11 +130,17 @@ def _reduce_scatter(x):
 
 
 @functools.lru_cache(maxsize=None)
-def _scalar_kernel(mesh: Mesh, padded_p: int):
-    """Sharded twin of columnar.bound_and_aggregate for a given mesh."""
+def _scalar_kernel(mesh: Mesh, padded_p: int, has_l1: bool = False):
+    """Sharded twin of columnar.bound_and_aggregate for a given mesh.
+
+    has_l1 compiles the max_contributions variant (an extra runtime l1_cap
+    scalar and the per-pid total sample in the local kernel) — shards are
+    pid-disjoint, so per-shard L1 sampling is exact.
+    """
 
     def local_step(key, pid, pk, value, valid, linf_cap, l0_cap, row_clip_lo,
-                   row_clip_hi, middle, group_clip_lo, group_clip_hi):
+                   row_clip_hi, middle, group_clip_lo, group_clip_hi,
+                   *l1_args):
         accs = columnar.bound_and_aggregate(
             _device_key(key), pid, pk, value, valid,
             num_partitions=padded_p,
@@ -144,37 +150,41 @@ def _scalar_kernel(mesh: Mesh, padded_p: int):
             row_clip_hi=row_clip_hi,
             middle=middle,
             group_clip_lo=group_clip_lo,
-            group_clip_hi=group_clip_hi)
+            group_clip_hi=group_clip_hi,
+            l1_cap=l1_args[0] if has_l1 else None)
         return jax.tree.map(_reduce_scatter, accs)
 
     fn = jax.shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(P(),) + (ROW_SPEC,) * 4 + (P(),) * 7,
+        in_specs=(P(),) + (ROW_SPEC,) * 4 + (P(),) * (8 if has_l1 else 7),
         out_specs=columnar.PartitionAccumulators(*([PART_SPEC] * 5)),
         check_vma=False)
     return jax.jit(fn)
 
 
 @functools.lru_cache(maxsize=None)
-def _vector_kernel(mesh: Mesh, padded_p: int, norm_ord: int):
+def _vector_kernel(mesh: Mesh, padded_p: int, norm_ord: int,
+                   has_l1: bool = False):
     """Sharded twin of columnar.bound_and_aggregate_vector."""
 
-    def local_step(key, pid, pk, value, valid, linf_cap, l0_cap, max_norm):
+    def local_step(key, pid, pk, value, valid, linf_cap, l0_cap, max_norm,
+                   *l1_args):
         vector_sums, accs = columnar.bound_and_aggregate_vector(
             _device_key(key), pid, pk, value, valid,
             num_partitions=padded_p,
             linf_cap=linf_cap,
             l0_cap=l0_cap,
             max_norm=max_norm,
-            norm_ord=norm_ord)
+            norm_ord=norm_ord,
+            l1_cap=l1_args[0] if has_l1 else None)
         return (_reduce_scatter(vector_sums),
                 jax.tree.map(_reduce_scatter, accs))
 
     fn = jax.shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(P(),) + (ROW_SPEC,) * 4 + (P(),) * 3,
+        in_specs=(P(),) + (ROW_SPEC,) * 4 + (P(),) * (4 if has_l1 else 3),
         out_specs=(PART_SPEC,
                    columnar.PartitionAccumulators(*([PART_SPEC] * 5))),
         check_vma=False)
@@ -182,13 +192,15 @@ def _vector_kernel(mesh: Mesh, padded_p: int, norm_ord: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _quantile_kernel(mesh: Mesh, padded_p: int, num_leaves: int):
+def _quantile_kernel(mesh: Mesh, padded_p: int, num_leaves: int,
+                     has_l1: bool = False):
     """Sharded leaf-histogram kernel for the batched quantile trees."""
 
     def local_step(key, pid, pk, value, valid, linf_cap, l0_cap, lower,
-                   upper):
+                   upper, *l1_args):
         mask = columnar.bound_row_mask(_device_key(key), pid, pk, valid,
-                                       linf_cap, l0_cap)
+                                       linf_cap, l0_cap,
+                                       l1_cap=l1_args[0] if has_l1 else None)
         hist = quantile_ops.leaf_histograms(pk, value, mask,
                                             num_partitions=padded_p,
                                             num_leaves=num_leaves,
@@ -199,7 +211,7 @@ def _quantile_kernel(mesh: Mesh, padded_p: int, num_leaves: int):
     fn = jax.shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(P(),) + (ROW_SPEC,) * 4 + (P(),) * 4,
+        in_specs=(P(),) + (ROW_SPEC,) * 4 + (P(),) * (5 if has_l1 else 4),
         out_specs=PART_SPEC,
         check_vma=False)
     return jax.jit(fn)
@@ -207,13 +219,17 @@ def _quantile_kernel(mesh: Mesh, padded_p: int, num_leaves: int):
 
 def quantile_leaf_histograms(mesh: Mesh, key, pid, pk, value, valid, *,
                              num_partitions: int, num_leaves: int, lower,
-                             upper, linf_cap, l0_cap):
+                             upper, linf_cap, l0_cap, l1_cap=None):
     """Multi-chip [padded_p, num_leaves] quantile-tree leaf counts."""
     padded_p = padded_num_partitions(mesh, num_partitions)
     dpid, dpk, dval, dvalid = _shard_and_put(mesh, pid, pk, value, valid)
-    kernel = _quantile_kernel(mesh, padded_p, num_leaves)
-    return kernel(key, dpid, dpk, dval, dvalid, linf_cap, l0_cap,
-                  float(lower), float(upper))
+    kernel = _quantile_kernel(mesh, padded_p, num_leaves,
+                              has_l1=l1_cap is not None)
+    args = (key, dpid, dpk, dval, dvalid, linf_cap, l0_cap, float(lower),
+            float(upper))
+    if l1_cap is not None:
+        args += (l1_cap,)
+    return kernel(*args)
 
 
 def _shard_and_put(mesh: Mesh, pid, pk, value, valid):
@@ -253,16 +269,20 @@ def bound_and_aggregate(mesh: Mesh,
                         row_clip_hi,
                         middle,
                         group_clip_lo,
-                        group_clip_hi) -> columnar.PartitionAccumulators:
+                        group_clip_hi,
+                        l1_cap=None) -> columnar.PartitionAccumulators:
     """Multi-chip bound-and-aggregate: host rows in, global sharded
     [padded_p] accumulators out (padding partitions are all-zero; callers
     trim to num_partitions when materializing)."""
     padded_p = padded_num_partitions(mesh, num_partitions)
     dpid, dpk, dval, dvalid = _shard_and_put(mesh, pid, pk, value, valid)
-    kernel = _scalar_kernel(mesh, padded_p)
-    return kernel(key, dpid, dpk, dval, dvalid, linf_cap, l0_cap,
-                  float(row_clip_lo), float(row_clip_hi), float(middle),
-                  float(group_clip_lo), float(group_clip_hi))
+    kernel = _scalar_kernel(mesh, padded_p, has_l1=l1_cap is not None)
+    args = (key, dpid, dpk, dval, dvalid, linf_cap, l0_cap,
+            float(row_clip_lo), float(row_clip_hi), float(middle),
+            float(group_clip_lo), float(group_clip_hi))
+    if l1_cap is not None:
+        args += (l1_cap,)
+    return kernel(*args)
 
 
 def bound_and_aggregate_vector(mesh: Mesh,
@@ -276,10 +296,14 @@ def bound_and_aggregate_vector(mesh: Mesh,
                                linf_cap,
                                l0_cap,
                                max_norm,
-                               norm_ord: int):
+                               norm_ord: int,
+                               l1_cap=None):
     """Multi-chip VECTOR_SUM path; see bound_and_aggregate."""
     padded_p = padded_num_partitions(mesh, num_partitions)
     dpid, dpk, dval, dvalid = _shard_and_put(mesh, pid, pk, value, valid)
-    kernel = _vector_kernel(mesh, padded_p, norm_ord)
-    return kernel(key, dpid, dpk, dval, dvalid, linf_cap, l0_cap,
-                  float(max_norm))
+    kernel = _vector_kernel(mesh, padded_p, norm_ord,
+                            has_l1=l1_cap is not None)
+    args = (key, dpid, dpk, dval, dvalid, linf_cap, l0_cap, float(max_norm))
+    if l1_cap is not None:
+        args += (l1_cap,)
+    return kernel(*args)

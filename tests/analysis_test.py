@@ -391,3 +391,211 @@ class TestMultiParameterConfiguration:
         params = config.get_aggregate_params(count_params(), 1)
         assert params.max_partitions_contributed == 5
         assert params.noise_kind == pdp.NoiseKind.GAUSSIAN
+
+
+class TestPostAggregationThresholdingAnalysis:
+    """Verdict-r2 task 8: the analysis models post-aggregation thresholding
+    so the tuner can honor the strategy selector's PRIVACY_ID_COUNT
+    recommendation."""
+
+    def _pid_params(self, post_agg):
+        return pdp.AggregateParams(
+            metrics=[pdp.Metrics.PRIVACY_ID_COUNT],
+            noise_kind=pdp.NoiseKind.GAUSSIAN,
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            post_aggregation_thresholding=post_agg)
+
+    def test_keep_prob_matches_thresholding_strategy(self):
+        # 40 users, all in one partition, each contributing once: N is
+        # deterministic, so the modeled keep probability must equal the
+        # thresholding strategy's probability_of_keep(40) exactly.
+        rows = [(u, "p", 1.0) for u in range(40)]
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=1.0, delta=1e-6,
+            aggregate_params=self._pid_params(True))
+        engine = analysis.UtilityAnalysisEngine()
+        result = engine.analyze(rows, options, extractors())
+        keep_prob = result.arrays.keep_prob[0, 0]
+        configs = per_partition.resolve_config_budgets(options, False)
+        assert configs[0].post_agg_thresholding
+        strategy = per_partition._thresholding_strategy(configs[0])
+        assert keep_prob == pytest.approx(strategy.probability_of_keep(40),
+                                          abs=1e-9)
+        # The modeled noise std is the thresholding strategy's noise.
+        pid_errors = [
+            e for e in result.arrays.metric_errors
+            if e.metric == pdp.Metrics.PRIVACY_ID_COUNT
+        ][0]
+        assert pid_errors.std_noise[0] == pytest.approx(
+            strategy.noise_stddev)
+
+    def test_thresholding_gets_full_budget(self):
+        # Without post-agg thresholding the budget is split between
+        # selection and noise; with it, the thresholding mechanism gets
+        # everything — its noise must be strictly smaller.
+        rows = [(u, u % 3, 1.0) for u in range(60)]
+        def std_of(post_agg):
+            options = analysis.UtilityAnalysisOptions(
+                epsilon=1.0, delta=1e-6,
+                aggregate_params=self._pid_params(post_agg))
+            engine = analysis.UtilityAnalysisEngine()
+            result = engine.analyze(rows, options, extractors())
+            return [
+                e for e in result.arrays.metric_errors
+                if e.metric == pdp.Metrics.PRIVACY_ID_COUNT
+            ][0].std_noise[0]
+        assert std_of(True) < std_of(False)
+
+    def test_tune_privacy_id_count_analyzes_selector_strategy(self):
+        # The selector recommends post-aggregation thresholding for
+        # PRIVACY_ID_COUNT; tune() must attach and analyze that bit
+        # instead of dropping it.
+        rng = np.random.default_rng(0)
+        rows = [(int(u), int(rng.integers(0, 20)), 1.0)
+                for u in range(500)]
+        hists = list(
+            computing_histograms.compute_dataset_histograms(
+                rows, extractors(), pdp.LocalBackend()))[0]
+        options = analysis.TuneOptions(
+            epsilon=1.0,
+            delta=1e-6,
+            aggregate_params=self._pid_params(False),
+            function_to_minimize=analysis.MinimizingFunction.ABSOLUTE_ERROR,
+            parameters_to_tune=analysis.ParametersToTune(
+                max_partitions_contributed=True),
+            number_of_parameter_candidates=5)
+        tune_result, _ = analysis.tune(rows,
+                                       contribution_histograms=hists,
+                                       options=options,
+                                       data_extractors=extractors())
+        candidates = tune_result.utility_analysis_parameters
+        assert candidates.post_aggregation_thresholding is not None
+        assert all(candidates.post_aggregation_thresholding)
+        assert 0 <= tune_result.index_best < candidates.size
+
+
+class TestVectorizedExactKeepProbabilities:
+    """Verdict-r2 task 4: the exact Poisson-binomial path is batched, with
+    exactness pinned against the scalar PGF and approx agreement pinned at
+    the exact/approx boundary."""
+
+    def _pre_and_config(self, rows, l0=2):
+        from pipelinedp_tpu.analysis import pre_aggregation
+        ext = extractors()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     noise_kind=pdp.NoiseKind.LAPLACE,
+                                     max_partitions_contributed=l0,
+                                     max_contributions_per_partition=2)
+        options = analysis.UtilityAnalysisOptions(epsilon=1.0, delta=1e-6,
+                                                  aggregate_params=params)
+        pre = pre_aggregation.preaggregate_from_rows(rows, ext)
+        configs = per_partition.resolve_config_budgets(options, False)
+        return pre, configs, params
+
+    def test_batch_matches_scalar_exact(self):
+        rng = np.random.default_rng(3)
+        rows = []
+        for p in range(60):
+            for u in range(int(rng.integers(1, 40))):
+                uid = p * 1000 + u
+                rows.append((uid, p, 1.0))
+                # Vary each user's partition load so q < 1 varies.
+                for extra in range(int(rng.integers(0, 4))):
+                    rows.append((uid, 500 + extra, 1.0))
+        pre, configs, params = self._pre_and_config(rows)
+        n_partitions = max(len(pre.pk_vocab), 1)
+        out = per_partition.compute_keep_probabilities(
+            pre, configs, n_partitions)
+        spec = configs[0].selection_spec
+        strategy = ps_lib.create_partition_selection_strategy(
+            params.partition_selection_strategy, spec.eps, spec.delta,
+            params.max_partitions_contributed, None)
+        q = np.minimum(
+            1.0, params.max_partitions_contributed /
+            np.maximum(pre.n_partitions, 1))
+        order = np.argsort(pre.pk_ids, kind="stable")
+        spk = pre.pk_ids[order]
+        bounds = np.searchsorted(spk, np.arange(n_partitions + 1))
+        for p in range(n_partitions):
+            qs = q[order[bounds[p]:bounds[p + 1]]]
+            if not len(qs) or len(qs) > per_partition.MAX_EXACT_PROBABILITIES:
+                continue
+            ref = per_partition._keep_prob_exact(qs, strategy)
+            assert out[0, p] == pytest.approx(ref, abs=1e-12), p
+
+    def test_exact_and_approx_agree_at_boundary(self):
+        # Two partitions straddling MAX_EXACT_PROBABILITIES with identical
+        # per-unit survival probabilities: the exact PGF (n=100) and the
+        # refined-normal lattice (n=101) must agree closely.
+        m = per_partition.MAX_EXACT_PROBABILITIES
+        rows = []
+        for u in range(m):
+            rows.append((u, "exact", 1.0))
+            rows.append((u, "other_a", 1.0))  # load 3 -> q = 2/3
+            rows.append((u, "other_b", 1.0))
+        for u in range(m + 1):
+            uid = 10_000 + u
+            rows.append((uid, "approx", 1.0))
+            rows.append((uid, "other_a", 1.0))
+            rows.append((uid, "other_b", 1.0))
+        pre, configs, params = self._pre_and_config(rows, l0=2)
+        n_partitions = max(len(pre.pk_vocab), 1)
+        out = per_partition.compute_keep_probabilities(
+            pre, configs, n_partitions)
+        keys = pre.pk_vocab.keys
+        p_exact = out[0, keys.index("exact")]
+        p_approx = out[0, keys.index("approx")]
+        # n differs by one unit; both ~ kept with the same probability.
+        assert p_approx == pytest.approx(p_exact, abs=0.01)
+        assert 0 < p_exact < 1
+
+
+class TestSumPerContributionBounds:
+    """Verdict-r2 task 10b: SUM analysis under per-contribution bounds.
+
+    Pinned semantics: the error model clips each (pid, partition) group's
+    sum at count-scaled bounds [min_value*linf, max_value*linf] — what the
+    engine's per-contribution clipping + Linf sampling actually bounds.
+    (Deliberate deviation from the reference, whose analysis SumCombiner
+    applies no clipping in this mode; see per_partition.py.)"""
+
+    def _params(self, linf=2):
+        return pdp.AggregateParams(metrics=[pdp.Metrics.SUM],
+                                   noise_kind=pdp.NoiseKind.LAPLACE,
+                                   max_partitions_contributed=1,
+                                   max_contributions_per_partition=linf,
+                                   min_value=0.0,
+                                   max_value=3.0)
+
+    def _analyze(self, rows):
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=1.0, delta=1e-6, aggregate_params=self._params())
+        engine = analysis.UtilityAnalysisEngine()
+        return engine.analyze(rows, options, extractors(),
+                              public_partitions=["a"])
+
+    def test_clipping_at_count_scaled_bounds(self):
+        # One user, 4 contributions of 3.0 to "a": raw group sum 12;
+        # count-scaled cap = max_value * linf = 6 -> clip error -6.
+        rows = [(1, "a", 3.0)] * 4
+        result = self._analyze(rows)
+        err = dict(result)["a"][0].metric_errors[0]
+        assert err.sum == pytest.approx(12.0)
+        assert err.clipping_to_max_error == pytest.approx(-6.0)
+        assert err.clipping_to_min_error == pytest.approx(0.0)
+
+    def test_no_clipping_within_bounds(self):
+        rows = [(1, "a", 2.0), (1, "a", 1.0)]  # sum 3 <= 6
+        result = self._analyze(rows)
+        err = dict(result)["a"][0].metric_errors[0]
+        assert err.clipping_to_max_error == pytest.approx(0.0)
+        assert err.clipping_to_min_error == pytest.approx(0.0)
+
+    def test_noise_std_uses_per_contribution_sensitivity(self):
+        rows = [(1, "a", 1.0)]
+        result = self._analyze(rows)
+        err = dict(result)["a"][0].metric_errors[0]
+        # Public partitions, one metric: full eps to SUM. Laplace scale =
+        # l0 * linf * max_abs / eps = 1*2*3/1.
+        assert err.std_noise == pytest.approx(np.sqrt(2.0) * 6.0)
