@@ -49,6 +49,8 @@ from pipelinedp_tpu.dp_engine import DPEngine
 from pipelinedp_tpu.jax_engine import JaxDPEngine, LazyJaxResult
 from pipelinedp_tpu import dataframes
 from pipelinedp_tpu.dataframes import QueryBuilder
+from pipelinedp_tpu.private_collection import (PrivateCollection,
+                                               make_private)
 
 __version__ = "0.1.0"
 
@@ -83,10 +85,12 @@ __all__ = [
     "PipelineBackend",
     "PreAggregateExtractors",
     "PrivacyIdCountParams",
+    "PrivateCollection",
     "PrivateContributionBounds",
     "QueryBuilder",
     "SelectPartitionsParams",
     "SumParams",
     "VarianceParams",
     "__version__",
+    "make_private",
 ]

@@ -400,6 +400,7 @@ class SumParams:
     noise_kind: NoiseKind = NoiseKind.LAPLACE
     contribution_bounds_already_enforced: bool = False
     pre_threshold: Optional[int] = None
+    public_partitions: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -418,6 +419,7 @@ class VarianceParams:
     noise_kind: NoiseKind = NoiseKind.LAPLACE
     contribution_bounds_already_enforced: bool = False
     pre_threshold: Optional[int] = None
+    public_partitions: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -436,6 +438,7 @@ class MeanParams:
     noise_kind: NoiseKind = NoiseKind.LAPLACE
     contribution_bounds_already_enforced: bool = False
     pre_threshold: Optional[int] = None
+    public_partitions: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -451,6 +454,7 @@ class CountParams:
     budget_weight: float = 1
     contribution_bounds_already_enforced: bool = False
     pre_threshold: Optional[int] = None
+    public_partitions: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -465,6 +469,7 @@ class PrivacyIdCountParams:
     budget_weight: float = 1
     contribution_bounds_already_enforced: bool = False
     pre_threshold: Optional[int] = None
+    public_partitions: Optional[Any] = None
 
 
 @dataclasses.dataclass

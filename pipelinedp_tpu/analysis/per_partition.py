@@ -76,6 +76,13 @@ def resolve_config_budgets(options: data_structures.UtilityAnalysisOptions,
             options.epsilon, options.delta)
         post_agg = (params.post_aggregation_thresholding and
                     not public_partitions)
+        if post_agg and Metrics.PRIVACY_ID_COUNT not in metrics:
+            # Per-config validation: the sweep can enable the flag per
+            # configuration, bypassing the engine-level check on the
+            # blueprint params.
+            raise ValueError(
+                f"Configuration {i}: post_aggregation_thresholding requires "
+                f"PRIVACY_ID_COUNT in metrics")
         selection_spec = None
         if not public_partitions and not post_agg:
             # With post-aggregation thresholding, selection rides on the

@@ -44,6 +44,7 @@ from pipelinedp_tpu import quantile_tree as quantile_tree_lib
 from pipelinedp_tpu import partition_selection as ps_lib
 from pipelinedp_tpu.report_generator import ExplainComputationReport
 from pipelinedp_tpu import noise_core
+from pipelinedp_tpu import profiler
 
 
 def _mechanism_noise_params(spec: budget_accounting.MechanismSpec,
@@ -418,14 +419,16 @@ class JaxDPEngine:
                          if data_extractors is not None else True)
         if params.contribution_bounds_already_enforced:
             pid_extractor = None  # encode_rows assigns a unique id per row
-        pid, pk, value, pid_vocab, pk_vocab = encoding.encode_rows(
-            col,
-            pid_extractor,
-            data_extractors.partition_extractor if data_extractors else None,
-            data_extractors.value_extractor if data_extractors else None,
-            public_partitions=public_partitions,
-            vector_size=params.vector_size if is_vector else None,
-            factorize_pid=False)
+        with profiler.stage("dp/encode"):
+            pid, pk, value, pid_vocab, pk_vocab = encoding.encode_rows(
+                col,
+                pid_extractor,
+                data_extractors.partition_extractor
+                if data_extractors else None,
+                data_extractors.value_extractor if data_extractors else None,
+                public_partitions=public_partitions,
+                vector_size=params.vector_size if is_vector else None,
+                factorize_pid=False)
         num_partitions = max(len(pk_vocab), 1)
 
         # When no child combiner expects per-partition sampling (e.g. the
@@ -480,11 +483,12 @@ class JaxDPEngine:
         engine = self
 
         def compute():
-            return engine._execute(compound, params, selection_spec,
-                                   kernel_key, pid, pk, value,
-                                   num_partitions, linf_cap, l0_cap,
-                                   public_partitions is not None, is_vector,
-                                   l1_cap=l1_cap)
+            with profiler.stage("dp/execute"):
+                return engine._execute(compound, params, selection_spec,
+                                       kernel_key, pid, pk, value,
+                                       num_partitions, linf_cap, l0_cap,
+                                       public_partitions is not None,
+                                       is_vector, l1_cap=l1_cap)
 
         return LazyJaxResult(compute, pk_vocab)
 
@@ -701,22 +705,25 @@ class JaxDPEngine:
         (private partition selection, post-aggregation thresholding,
         select_partitions) routes through here.
         """
-        if self._secure_host_noise:
-            keep, noised = strategy.select_vec(np.asarray(counts))
-            return keep & np.asarray(exists), noised
-        sel_params = selection_ops.selection_params_from_strategy(strategy)
-        return selection_ops.select_partitions(key, counts, sel_params,
-                                               exists)
+        with profiler.stage("dp/partition_selection"):
+            if self._secure_host_noise:
+                keep, noised = strategy.select_vec(np.asarray(counts))
+                return keep & np.asarray(exists), noised
+            sel_params = selection_ops.selection_params_from_strategy(
+                strategy)
+            return selection_ops.select_partitions(key, counts, sel_params,
+                                                   exists)
 
     # -- noise dispatch: device kernels or float64 host finalization --------
 
     def _add_noise(self, key, values, is_gaussian, scale_or_std, granularity):
-        if self._secure_host_noise:
-            return noise_core.add_noise_array(np.asarray(values),
-                                              bool(is_gaussian),
-                                              float(scale_or_std))
-        return noise_ops.add_noise(key, values, is_gaussian, scale_or_std,
-                                   granularity)
+        with profiler.stage("dp/noise"):
+            if self._secure_host_noise:
+                return noise_core.add_noise_array(np.asarray(values),
+                                                  bool(is_gaussian),
+                                                  float(scale_or_std))
+            return noise_ops.add_noise(key, values, is_gaussian,
+                                       scale_or_std, granularity)
 
     def _add_laplace(self, key, values, scale, granularity):
         if self._secure_host_noise:

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pipelinedp_tpu.ops import columnar
+from pipelinedp_tpu import profiler
 
 # Knuth multiplicative hash so that structured pid spaces (all-even ids,
 # contiguous ranges handed out per site, ...) still shard evenly.
@@ -217,12 +218,13 @@ def stream_bound_and_aggregate(
         # device_put enqueues the DMA and returns; the chunk kernel is
         # dispatched right behind it, so packing bucket c+1 on host overlaps
         # both the transfer and the compute of bucket c.
-        dbuf = jax.device_put(buf)
-        accs = _chunk_step(jax.random.fold_in(key, c), dbuf, m, accs,
-                           linf_cap, l0_cap, row_clip_lo, row_clip_hi,
-                           middle, group_clip_lo, group_clip_hi, l1_cap,
-                           num_partitions=num_partitions,
-                           bytes_pid=bytes_pid,
-                           bytes_pk=bytes_pk,
-                           value_f16=value_f16)
+        with profiler.stage(f"dp/stream_chunk_{c}"):
+            dbuf = jax.device_put(buf)
+            accs = _chunk_step(jax.random.fold_in(key, c), dbuf, m, accs,
+                               linf_cap, l0_cap, row_clip_lo, row_clip_hi,
+                               middle, group_clip_lo, group_clip_hi, l1_cap,
+                               num_partitions=num_partitions,
+                               bytes_pid=bytes_pid,
+                               bytes_pk=bytes_pk,
+                               value_f16=value_f16)
     return accs

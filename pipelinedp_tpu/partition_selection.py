@@ -181,25 +181,49 @@ class TruncatedGeometricPartitionSelection(PartitionSelection):
         e = self._eps_p
         d = self._delta_p
         a = math.exp(-e)
-        # Crossover probability between the two branches.
-        pi_star = (1.0 - d) * (1.0 - a) / (math.exp(e) - a)
-        # The recurrence steps with branch A while pi_n <= pi*, so segment A's
-        # closed form holds through n1 = (last n with pi_A(n) <= pi*) + 1.
-        ratio = 1.0 + pi_star * math.expm1(e) / d
-        self._n1 = max(1, math.floor(math.log(ratio) / e) + 1)
+        if a == 0.0:
+            # eps' beyond float range (exp(-eps') underflows): one unit is
+            # kept with probability d, two or more always.
+            self._n1 = 1
+            self._pi_n1 = d
+            self._pi_inf = 1.0
+            self._n_always_keep = 2
+            return
+        # Crossover probability between the two branches:
+        # (1-d)(1-a)/(e^e - a) == (1-d) a/(1+a) — the right-hand form never
+        # overflows, however large eps' gets.
+        pi_star = (1.0 - d) * a / (1.0 + a)
+        # The recurrence steps with branch A while pi_n <= pi*, so segment
+        # A's closed form holds through n1 = (last n with pi_A(n) <= pi*)+1.
+        # log(ratio) computed in log space: pi_star (e^e - 1)/d =
+        # exp(log(pi_star) + e + log1p(-a) - log(d)).
+        log_term = math.log(pi_star) + e + math.log1p(-a) - math.log(d)
+        log_ratio = (log_term
+                     if log_term > 30 else math.log1p(math.exp(log_term)))
+        self._n1 = max(1, math.floor(log_ratio / e) + 1)
         self._pi_n1 = self._segment_a(np.asarray([self._n1], dtype=np.float64))[0]
         self._pi_inf = 1.0 + d * a / (1.0 - a)
         # First n with pi_n == 1 (numerically), for the threshold property.
+        # pi_inf - 1 = d a/(1-a) underflows in float for large eps'; compare
+        # in log space instead.
         gap = self._pi_inf - self._pi_n1
-        if gap <= self._pi_inf - 1.0:
+        log_pi_inf_m1 = math.log(d) - e - math.log1p(-a)
+        if gap <= 0 or math.log(gap) <= log_pi_inf_m1:
             self._n_always_keep = self._n1
         else:
             self._n_always_keep = self._n1 + math.ceil(
-                math.log(gap / (self._pi_inf - 1.0)) / e)
+                (math.log(gap) - log_pi_inf_m1) / e)
 
     def _segment_a(self, n: np.ndarray) -> np.ndarray:
+        # d expm1(n e)/expm1(e) = d e^{(n-1)e} (1-a^n)/(1-a), evaluated in
+        # log space so large eps' cannot overflow; values above the clip
+        # range are capped (the caller clips probabilities at 1).
         e, d = self._eps_p, self._delta_p
-        return d * np.expm1(n * e) / math.expm1(e)
+        a = math.exp(-e)
+        n = np.asarray(n, dtype=np.float64)
+        exponent = ((n - 1.0) * e + np.log1p(-np.power(a, n)) -
+                    math.log1p(-a) + math.log(d))
+        return np.exp(np.minimum(exponent, math.log(2.0)))
 
     def _segment_b(self, n: np.ndarray) -> np.ndarray:
         e = self._eps_p

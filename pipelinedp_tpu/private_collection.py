@@ -1,0 +1,179 @@
+"""Budget-enforced "private collection" wrapper.
+
+Role parity with the reference's idiomatic L5 wrappers — private_spark.py's
+PrivateRDD (:21-374) and the PrivatePCollection of private_beam.py: wrap a
+keyed collection once with its privacy-id extractor and a budget
+accountant, then express DP aggregations fluently; every aggregation draws
+from the shared budget, and non-DP transforms (map / flat_map) preserve the
+privacy-id association.
+
+    private = make_private(rows, budget_accountant, lambda r: r.user_id)
+    visits = private.count(pdp.CountParams(...))
+    spend = private.sum(pdp.SumParams(...))
+    budget_accountant.compute_budgets()
+
+Executes on any host backend (LocalBackend default — Beam/Spark are not
+targets of this framework; the columnar TPU engine's high-level API is the
+QueryBuilder, pipelinedp_tpu/dataframes.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from pipelinedp_tpu import aggregate_params as agg
+from pipelinedp_tpu import budget_accounting
+from pipelinedp_tpu import dp_engine as dp_engine_lib
+from pipelinedp_tpu.backends import base as backend_base
+from pipelinedp_tpu.backends.local import LocalBackend
+from pipelinedp_tpu.data_extractors import DataExtractors
+
+
+class PrivateCollection:
+    """A collection bound to a privacy-id per element and a budget.
+
+    Internal representation: (privacy_id, element) pairs — the same shape
+    the reference's PrivateRDD keeps (private_spark.py:33-38). Create via
+    make_private.
+    """
+
+    def __init__(self, pairs, budget_accountant, backend):
+        self._pairs = pairs
+        self._budget_accountant = budget_accountant
+        self._backend = backend
+
+    # -- non-DP transforms (privacy-id preserving) --------------------------
+
+    def map(self, fn: Callable[[Any], Any]) -> "PrivateCollection":
+        pairs = self._backend.map_tuple(self._pairs,
+                                        lambda pid, x: (pid, fn(x)),
+                                        "PrivateCollection map")
+        return PrivateCollection(list(pairs), self._budget_accountant,
+                                 self._backend)
+
+    def flat_map(self, fn: Callable[[Any], Any]) -> "PrivateCollection":
+        pairs = self._backend.flat_map(
+            self._pairs, lambda pair: ((pair[0], y) for y in fn(pair[1])),
+            "PrivateCollection flat_map")
+        return PrivateCollection(list(pairs), self._budget_accountant,
+                                 self._backend)
+
+    # -- DP aggregations ----------------------------------------------------
+
+    def count(self, params: agg.CountParams):
+        """DP count per partition; lazy (pk, count) pairs."""
+        return self._aggregate(
+            agg.AggregateParams(
+                noise_kind=params.noise_kind,
+                metrics=[agg.Metrics.COUNT],
+                max_partitions_contributed=params.max_partitions_contributed,
+                max_contributions_per_partition=params.
+                max_contributions_per_partition,
+                budget_weight=params.budget_weight,
+                contribution_bounds_already_enforced=params.
+                contribution_bounds_already_enforced,
+                pre_threshold=params.pre_threshold), params, "count")
+
+    def sum(self, params: agg.SumParams):
+        return self._aggregate(
+            agg.AggregateParams(
+                noise_kind=params.noise_kind,
+                metrics=[agg.Metrics.SUM],
+                max_partitions_contributed=params.max_partitions_contributed,
+                max_contributions_per_partition=params.
+                max_contributions_per_partition,
+                min_value=params.min_value,
+                max_value=params.max_value,
+                budget_weight=params.budget_weight,
+                contribution_bounds_already_enforced=params.
+                contribution_bounds_already_enforced,
+                pre_threshold=params.pre_threshold), params, "sum")
+
+    def mean(self, params: agg.MeanParams):
+        return self._aggregate(
+            agg.AggregateParams(
+                noise_kind=params.noise_kind,
+                metrics=[agg.Metrics.MEAN],
+                max_partitions_contributed=params.max_partitions_contributed,
+                max_contributions_per_partition=params.
+                max_contributions_per_partition,
+                min_value=params.min_value,
+                max_value=params.max_value,
+                budget_weight=params.budget_weight,
+                contribution_bounds_already_enforced=params.
+                contribution_bounds_already_enforced,
+                pre_threshold=params.pre_threshold), params, "mean")
+
+    def variance(self, params: agg.VarianceParams):
+        return self._aggregate(
+            agg.AggregateParams(
+                noise_kind=params.noise_kind,
+                metrics=[agg.Metrics.VARIANCE],
+                max_partitions_contributed=params.max_partitions_contributed,
+                max_contributions_per_partition=params.
+                max_contributions_per_partition,
+                min_value=params.min_value,
+                max_value=params.max_value,
+                budget_weight=params.budget_weight,
+                contribution_bounds_already_enforced=params.
+                contribution_bounds_already_enforced,
+                pre_threshold=params.pre_threshold), params, "variance")
+
+    def privacy_id_count(self, params: agg.PrivacyIdCountParams):
+        return self._aggregate(
+            agg.AggregateParams(
+                noise_kind=params.noise_kind,
+                metrics=[agg.Metrics.PRIVACY_ID_COUNT],
+                max_partitions_contributed=params.max_partitions_contributed,
+                max_contributions_per_partition=1,
+                budget_weight=params.budget_weight,
+                contribution_bounds_already_enforced=params.
+                contribution_bounds_already_enforced,
+                pre_threshold=params.pre_threshold), params,
+            "privacy_id_count")
+
+    def select_partitions(self, params: agg.SelectPartitionsParams,
+                          partition_extractor: Callable[[Any], Any]):
+        """DP-selected partition keys (lazy)."""
+        engine = dp_engine_lib.DPEngine(self._budget_accountant,
+                                        self._backend)
+        extractors = DataExtractors(
+            privacy_id_extractor=lambda pair: pair[0],
+            partition_extractor=lambda pair: partition_extractor(pair[1]))
+        return engine.select_partitions(self._pairs, params, extractors)
+
+    def _aggregate(self, aggregate_params: agg.AggregateParams, params,
+                   metric_name: str):
+        engine = dp_engine_lib.DPEngine(self._budget_accountant,
+                                        self._backend)
+        value_extractor = getattr(params, "value_extractor", None)
+        extractors = DataExtractors(
+            privacy_id_extractor=lambda pair: pair[0],
+            partition_extractor=lambda pair: params.partition_extractor(
+                pair[1]),
+            value_extractor=(
+                (lambda pair: value_extractor(pair[1]))
+                if value_extractor is not None else (lambda pair: 0)))
+        public = getattr(params, "public_partitions", None)
+        result = engine.aggregate(self._pairs, aggregate_params, extractors,
+                                  public_partitions=public)
+        # (pk, MetricsTuple) -> (pk, scalar), like the reference wrappers
+        # (private_spark.py:178-232 maps the namedtuple down to the value).
+        return self._backend.map_values(
+            result, lambda metrics: getattr(metrics, metric_name),
+            f"Extract {metric_name}")
+
+
+def make_private(
+    col,
+    budget_accountant: budget_accounting.BudgetAccountant,
+    privacy_id_extractor: Callable[[Any], Any],
+    backend: Optional[backend_base.PipelineBackend] = None,
+) -> PrivateCollection:
+    """Binds a collection to privacy ids and a budget (parity:
+    private_spark.make_private, :377)."""
+    backend = backend or LocalBackend()
+    pairs = list(
+        backend.map(col, lambda x: (privacy_id_extractor(x), x),
+                    "Extract privacy id"))
+    return PrivateCollection(pairs, budget_accountant, backend)

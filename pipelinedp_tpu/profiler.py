@@ -1,0 +1,57 @@
+"""Profiling hooks: JAX profiler traces with DP-stage annotations.
+
+A capability the reference lacks (SURVEY.md §5 — its only observability is
+the explain-computation report): wrap any engine call in
+``with profiler.profile("/tmp/trace"):`` and open the result in
+TensorBoard/Perfetto; the engine's stages show up as named trace spans via
+``stage(...)`` annotations.
+
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import profiler
+
+    with profiler.profile("/tmp/dp_trace"):
+        result = engine.aggregate(data, params)
+        accountant.compute_budgets()
+        result.to_columns()
+
+Annotations are no-ops when no trace is active, so they stay in the engine
+permanently.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def profile(logdir: str,
+            create_perfetto_link: bool = False) -> Iterator[None]:
+    """Captures a JAX profiler trace of the enclosed block into logdir."""
+    jax.profiler.start_trace(logdir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def stage(name: str) -> Iterator[None]:
+    """Names the enclosed host block (and its dispatched device work) in
+    the trace; free when no trace is active."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def annotate_function(fn, name: Optional[str] = None):
+    """Decorator form of stage()."""
+    return jax.profiler.annotate_function(fn, name=name)
+
+
+def device_memory_profile(path: str) -> None:
+    """Writes a device memory profile (pprof format) to path."""
+    with open(path, "wb") as f:
+        f.write(jax.profiler.device_memory_profile())

@@ -167,3 +167,33 @@ class TestFactory:
             ps.TruncatedGeometricPartitionSelection(1, 0, 1)
         with pytest.raises(ValueError):
             ps.TruncatedGeometricPartitionSelection(1, 1e-6, 0)
+
+
+class TestLargeEpsilonRobustness:
+    """The closed forms must stay finite for arbitrarily large epsilon
+    (log-space evaluation; exp(-eps') underflow handled)."""
+
+    @pytest.mark.parametrize("eps", [100.0, 600.0, 2000.0, 1e8])
+    def test_truncated_geometric_large_eps(self, eps):
+        s = ps.TruncatedGeometricPartitionSelection(eps, 1e-6, 2)
+        probs = [s.probability_of_keep(n) for n in (1, 2, 5, 100)]
+        assert all(0.0 <= p <= 1.0 for p in probs)
+        # delta' for one unit; everything else is certain at huge eps.
+        assert probs[0] == pytest.approx(
+            1 - (1 - 1e-6)**0.5, rel=1e-6)
+        assert probs[2] == pytest.approx(1.0)
+        assert s.threshold <= 3
+
+    def test_matches_recurrence_moderate_eps(self):
+        # The log-space forms equal the direct recurrence where the
+        # recurrence is computable.
+        eps, delta, m = 20.0, 1e-8, 3
+        s = ps.TruncatedGeometricPartitionSelection(eps, delta, m)
+        e = eps / m
+        d = s._delta_p
+        pi = 0.0
+        import math
+        for n in range(1, 30):
+            pi = min(math.exp(e) * pi + d,
+                     1 - math.exp(-e) * (1 - pi - d), 1.0)
+            assert s.probability_of_keep(n) == pytest.approx(pi, abs=1e-12)

@@ -1,0 +1,155 @@
+"""PrivateCollection wrapper tests.
+
+Mirrors the reference's private_spark tests' intent (private_spark_test.py):
+budget-enforced fluent aggregations over a wrapped collection, privacy-id
+preserving transforms, select_partitions.
+"""
+
+import collections
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+
+Visit = collections.namedtuple("Visit", ["user", "day", "spent"])
+
+
+def _visits():
+    rows = []
+    for user in range(30):
+        for day in (1, 2):
+            rows.append(Visit(user, day, 10.0))
+    return rows
+
+
+HUGE_EPS, HUGE_DELTA = 600.0, 1e-4
+
+
+class TestPrivateCollection:
+
+    def test_count(self):
+        accountant = pdp.NaiveBudgetAccountant(HUGE_EPS, HUGE_DELTA)
+        private = pdp.make_private(_visits(), accountant, lambda v: v.user)
+        result = private.count(
+            pdp.CountParams(noise_kind=pdp.NoiseKind.LAPLACE,
+                            max_partitions_contributed=2,
+                            max_contributions_per_partition=1,
+                            partition_extractor=lambda v: v.day))
+        accountant.compute_budgets()
+        out = dict(result)
+        assert out[1] == pytest.approx(30, abs=0.5)
+        assert out[2] == pytest.approx(30, abs=0.5)
+
+    def test_sum_and_mean_share_budget(self):
+        accountant = pdp.NaiveBudgetAccountant(HUGE_EPS, HUGE_DELTA)
+        private = pdp.make_private(_visits(), accountant, lambda v: v.user)
+        s = private.sum(
+            pdp.SumParams(max_partitions_contributed=2,
+                          max_contributions_per_partition=1,
+                          min_value=0.0,
+                          max_value=20.0,
+                          partition_extractor=lambda v: v.day,
+                          value_extractor=lambda v: v.spent))
+        m = private.mean(
+            pdp.MeanParams(max_partitions_contributed=2,
+                           max_contributions_per_partition=1,
+                           min_value=0.0,
+                           max_value=20.0,
+                           partition_extractor=lambda v: v.day,
+                           value_extractor=lambda v: v.spent))
+        accountant.compute_budgets()
+        assert dict(s)[1] == pytest.approx(300.0, rel=0.01)
+        assert dict(m)[2] == pytest.approx(10.0, rel=0.01)
+
+    def test_privacy_id_count(self):
+        accountant = pdp.NaiveBudgetAccountant(HUGE_EPS, HUGE_DELTA)
+        private = pdp.make_private(_visits(), accountant, lambda v: v.user)
+        result = private.privacy_id_count(
+            pdp.PrivacyIdCountParams(noise_kind=pdp.NoiseKind.GAUSSIAN,
+                                     max_partitions_contributed=2,
+                                     partition_extractor=lambda v: v.day))
+        accountant.compute_budgets()
+        assert dict(result)[1] == pytest.approx(30, abs=0.5)
+
+    def test_variance(self):
+        rng = np.random.default_rng(0)
+        rows = [Visit(u, 1, float(rng.uniform(0, 10))) for u in range(400)]
+        accountant = pdp.NaiveBudgetAccountant(HUGE_EPS, HUGE_DELTA)
+        private = pdp.make_private(rows, accountant, lambda v: v.user)
+        result = private.variance(
+            pdp.VarianceParams(max_partitions_contributed=1,
+                               max_contributions_per_partition=1,
+                               min_value=0.0,
+                               max_value=10.0,
+                               partition_extractor=lambda v: v.day,
+                               value_extractor=lambda v: v.spent))
+        accountant.compute_budgets()
+        expected = float(np.var([v.spent for v in rows]))
+        assert dict(result)[1] == pytest.approx(expected, abs=1.0)
+
+    def test_map_preserves_privacy_ids(self):
+        accountant = pdp.NaiveBudgetAccountant(HUGE_EPS, HUGE_DELTA)
+        private = pdp.make_private(_visits(), accountant, lambda v: v.user)
+        doubled = private.map(lambda v: Visit(v.user, v.day, v.spent * 2))
+        s = doubled.sum(
+            pdp.SumParams(max_partitions_contributed=2,
+                          max_contributions_per_partition=1,
+                          min_value=0.0,
+                          max_value=40.0,
+                          partition_extractor=lambda v: v.day,
+                          value_extractor=lambda v: v.spent))
+        accountant.compute_budgets()
+        assert dict(s)[1] == pytest.approx(600.0, rel=0.01)
+
+    def test_flat_map(self):
+        accountant = pdp.NaiveBudgetAccountant(HUGE_EPS, HUGE_DELTA)
+        private = pdp.make_private(_visits(), accountant, lambda v: v.user)
+        exploded = private.flat_map(lambda v: [v, v])
+        result = exploded.count(
+            pdp.CountParams(noise_kind=pdp.NoiseKind.LAPLACE,
+                            max_partitions_contributed=2,
+                            max_contributions_per_partition=2,
+                            partition_extractor=lambda v: v.day))
+        accountant.compute_budgets()
+        assert dict(result)[1] == pytest.approx(60, abs=0.5)
+
+    def test_select_partitions(self):
+        accountant = pdp.NaiveBudgetAccountant(5.0, 1e-5)
+        private = pdp.make_private(_visits(), accountant, lambda v: v.user)
+        keys = private.select_partitions(
+            pdp.SelectPartitionsParams(max_partitions_contributed=2),
+            partition_extractor=lambda v: v.day)
+        accountant.compute_budgets()
+        assert sorted(keys) == [1, 2]
+
+    def test_budget_is_shared_across_aggregations(self):
+        # Two aggregations on one accountant: each gets half the budget,
+        # visible through the explain-report epsilons.
+        accountant = pdp.NaiveBudgetAccountant(2.0, 1e-6)
+        private = pdp.make_private(_visits(), accountant, lambda v: v.user)
+        params = pdp.CountParams(noise_kind=pdp.NoiseKind.LAPLACE,
+                                 max_partitions_contributed=2,
+                                 max_contributions_per_partition=1,
+                                 partition_extractor=lambda v: v.day)
+        r1 = private.count(params)
+        r2 = private.count(params)
+        accountant.compute_budgets()
+        list(r1), list(r2)
+        specs = [s for s in accountant._mechanisms]
+        total_eps = sum(s.mechanism_spec.eps for s in specs)
+        assert total_eps == pytest.approx(2.0)
+
+    def test_public_partitions_on_params(self):
+        accountant = pdp.NaiveBudgetAccountant(HUGE_EPS, HUGE_DELTA)
+        private = pdp.make_private(_visits(), accountant, lambda v: v.user)
+        result = private.count(
+            pdp.CountParams(noise_kind=pdp.NoiseKind.LAPLACE,
+                            max_partitions_contributed=2,
+                            max_contributions_per_partition=1,
+                            partition_extractor=lambda v: v.day,
+                            public_partitions=[1, 2, 3]))
+        accountant.compute_budgets()
+        out = dict(result)
+        assert sorted(out) == [1, 2, 3]
+        assert out[3] == pytest.approx(0, abs=0.5)

@@ -1,0 +1,38 @@
+"""Profiler hooks: traces capture the engine's named stages."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import profiler
+
+
+class TestProfiler:
+
+    def test_stage_is_noop_without_trace(self):
+        with profiler.stage("anything"):
+            x = 1 + 1
+        assert x == 2
+
+    def test_profile_captures_engine_trace(self, tmp_path):
+        logdir = str(tmp_path / "trace")
+        rng = np.random.default_rng(0)
+        data = pdp.ColumnarData(pid=rng.integers(0, 100, 2000),
+                                pk=rng.integers(0, 5, 2000),
+                                value=rng.uniform(0, 1, 2000))
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=5,
+                                     max_contributions_per_partition=50)
+        with profiler.profile(logdir):
+            accountant = pdp.NaiveBudgetAccountant(1.0, 1e-6)
+            engine = pdp.JaxDPEngine(accountant)
+            result = engine.aggregate(data, params,
+                                      public_partitions=list(range(5)))
+            accountant.compute_budgets()
+            result.to_columns()
+        traces = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                           recursive=True)
+        assert traces, f"no trace files under {logdir}"
