@@ -76,7 +76,9 @@ def bench_e2e(pid, pk, value) -> float:
         return time.perf_counter() - t0
 
     run(100)  # warmup/compile
-    times = [run(i) for i in range(2)]
+    # min-of-3: the host->device link bandwidth varies ~2x between runs;
+    # the minimum is the honest sustained capability of the path.
+    times = [run(i) for i in range(3)]
     return N_PARTITIONS / min(times)
 
 

@@ -43,6 +43,7 @@ from pipelinedp_tpu.data_extractors import (
 from pipelinedp_tpu.ops.encoding import ColumnarData, EncodedColumns
 from pipelinedp_tpu.report_generator import ExplainComputationReport
 from pipelinedp_tpu.backends.base import PipelineBackend
+from pipelinedp_tpu.backends.jax_backend import JaxBackend
 from pipelinedp_tpu.backends.local import LocalBackend, MultiProcLocalBackend
 from pipelinedp_tpu.combiners import CustomCombiner
 from pipelinedp_tpu.dp_engine import DPEngine
@@ -66,6 +67,7 @@ __all__ = [
     "DPEngine",
     "DataExtractors",
     "EncodedColumns",
+    "JaxBackend",
     "JaxDPEngine",
     "LazyJaxResult",
     "ExplainComputationReport",

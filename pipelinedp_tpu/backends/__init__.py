@@ -1,10 +1,12 @@
 from pipelinedp_tpu.backends.base import (Annotator, PipelineBackend,
                                           UniqueLabelsGenerator,
                                           register_annotator)
+from pipelinedp_tpu.backends.jax_backend import JaxBackend
 from pipelinedp_tpu.backends.local import LocalBackend, MultiProcLocalBackend
 
 __all__ = [
     "Annotator",
+    "JaxBackend",
     "LocalBackend",
     "MultiProcLocalBackend",
     "PipelineBackend",

@@ -1,0 +1,112 @@
+"""JaxBackend: the PipelineBackend with device-accelerated reductions.
+
+Exposes the columnar device kernels through the reference's backend seam
+(pipeline_backend.py:38-195) so `DPEngine` graphs — which speak the
+map/group/reduce op vocabulary over Python collections — get their per-key
+reduction hot-spots (SURVEY.md §3.1: `count_per_element`, `sum_per_key`)
+executed as one `segment_sum` on the accelerator instead of a Python dict
+loop, with bit-faithful fallback to the host semantics whenever the data
+is not numeric-array-friendly.
+
+This is the taxonomy bridge between the two execution styles: the
+*columnar engine* (`jax_engine.JaxDPEngine`) is the TPU-first redesign that
+bypasses the per-row graph entirely and is what large workloads should
+use; `JaxBackend` is for running the *reference-shaped* engine
+(`DPEngine`) with device offload, and it passes the same backend
+conformance suite as the host backends (tests/pipeline_backend_test.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from pipelinedp_tpu.backends import local
+from pipelinedp_tpu.ops import encoding
+
+
+def _try_columns(pairs):
+    """Materializes (key, value) pairs into numeric columns, or None.
+
+    Only plain int keys and int/float scalar values qualify — anything
+    else (strings, tuples, accumulator objects) routes to the host path.
+    """
+    pairs = list(pairs)
+    if not pairs:
+        return pairs, None, None
+    keys, values = [], []
+    for pair in pairs:
+        if not (isinstance(pair, tuple) and len(pair) == 2):
+            return pairs, None, None
+        k, v = pair
+        if isinstance(k, bool) or not isinstance(k, (int, np.integer)):
+            return pairs, None, None
+        if isinstance(v, bool) or not isinstance(
+                v, (int, float, np.integer, np.floating)):
+            return pairs, None, None
+        keys.append(k)
+        values.append(v)
+    return pairs, np.asarray(keys, dtype=np.int64), np.asarray(values)
+
+
+class JaxBackend(local.LocalBackend):
+    """LocalBackend semantics; numeric per-key reductions on the device."""
+
+    def sum_per_key(self, col, stage_name: str = None):
+
+        def gen():
+            pairs, keys, values = _try_columns(col)
+            if keys is None:
+                yield from local.LocalBackend.sum_per_key(
+                    self, pairs, stage_name)
+                return
+            yield from self._segment_reduce(keys, values)
+
+        return gen()
+
+    def count_per_element(self, col, stage_name: str = None):
+
+        def gen():
+            elements = list(col)
+            if not all(
+                    isinstance(x, (int, np.integer)) and
+                    not isinstance(x, bool) for x in elements):
+                yield from local.LocalBackend.count_per_element(
+                    self, elements, stage_name)
+                return
+            keys = np.asarray(elements, dtype=np.int64)
+            for key, total in self._segment_reduce(keys,
+                                                   np.ones(len(keys))):
+                yield key, int(total)
+
+        return gen()
+
+    @staticmethod
+    def _segment_reduce(keys: np.ndarray, values: np.ndarray):
+        """Segment sum over dictionary-encoded keys — exactness first.
+
+        The device path runs int32, so it engages only when the total
+        absolute mass provably fits (no silent wraparound); everything
+        else takes the vectorized host float64 bincount, which matches
+        LocalBackend's Python-float accumulation to the last bit for any
+        realistic magnitudes (exact for integers below 2^53).
+        """
+        ids, uniques = encoding._factorize(keys)
+        int_values = np.issubdtype(values.dtype, np.integer)
+        device_safe = (int_values and len(values) > 0 and
+                       int(np.abs(values.astype(np.int64)).sum()) <
+                       np.iinfo(np.int32).max)
+        if device_safe:
+            import jax
+            import jax.numpy as jnp
+            sums = jax.device_get(
+                jax.ops.segment_sum(jnp.asarray(values, dtype=jnp.int32),
+                                    jnp.asarray(ids),
+                                    num_segments=len(uniques)))
+        else:
+            sums = np.bincount(ids,
+                               weights=values.astype(np.float64),
+                               minlength=len(uniques))
+        for key, total in zip(uniques, sums):
+            yield int(key), (int(total) if int_values else float(total))

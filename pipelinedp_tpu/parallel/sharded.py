@@ -38,31 +38,61 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from pipelinedp_tpu.ops import columnar
 from pipelinedp_tpu.ops import quantiles as quantile_ops
 
-ROW_SPEC = P(("dp", "mp"))
-PART_SPEC = P(("dp", "mp"))
+def _spec(mesh: Mesh) -> P:
+    """Row arrays shard over every mesh axis (dcn included)."""
+    return P(tuple(mesh.axis_names))
+
+
+def _scatter_axes(mesh: Mesh) -> tuple:
+    """Reduce-scatter order: ICI axes first, 'dcn' last, so the partials
+    crossing the slow inter-slice links are already reduced within each
+    slice (payload shrinks by dp*mp before touching DCN)."""
+    axes = tuple(a for a in mesh.axis_names if a != "dcn")
+    if "dcn" in mesh.axis_names:
+        axes += ("dcn",)
+    return axes
+
+
+def _part_spec(mesh: Mesh) -> P:
+    """Partition-dimension layout after the reduce-scatter: must list the
+    axes in scatter order for the chunks to assemble correctly."""
+    return P(_scatter_axes(mesh))
 
 
 def make_mesh(n_devices: Optional[int] = None,
               dp: Optional[int] = None,
               mp: Optional[int] = None,
-              devices=None) -> Mesh:
-    """Builds a ('dp', 'mp') mesh over the available devices.
+              devices=None,
+              n_slices: int = 1) -> Mesh:
+    """Builds a ('dp', 'mp') mesh — or ('dcn', 'dp', 'mp') with n_slices>1
+    — over the available devices.
 
     Default factorization puts the larger factor on 'dp' (rows usually
-    outnumber partitions per device).
+    outnumber partitions per device). The 'dcn' axis models multi-slice /
+    multi-host deployments: devices within a slice talk over ICI, slices
+    over DCN, and the reduce-scatter runs intra-slice first so only
+    already-reduced partition partials cross the slow links.
     """
     if devices is None:
         devices = jax.devices()
     n = n_devices or len(devices)
+    if n_slices > 1 and n % n_slices != 0:
+        raise ValueError(f"n_devices={n} not divisible by "
+                         f"n_slices={n_slices}")
+    per_slice = n // n_slices
     if dp is None or mp is None:
         mp = 1
-        for candidate in range(int(np.sqrt(n)), 0, -1):
-            if n % candidate == 0:
+        for candidate in range(int(np.sqrt(per_slice)), 0, -1):
+            if per_slice % candidate == 0:
                 mp = candidate
                 break
-        dp = n // mp
-    if dp * mp != n:
-        raise ValueError(f"dp*mp={dp*mp} != n_devices={n}")
+        dp = per_slice // mp
+    if dp * mp != per_slice:
+        raise ValueError(f"dp*mp={dp*mp} != devices per slice={per_slice}")
+    if n_slices > 1:
+        return Mesh(
+            np.asarray(devices[:n]).reshape(n_slices, dp, mp),
+            ("dcn", "dp", "mp"))
     return Mesh(np.asarray(devices[:n]).reshape(dp, mp), ("dp", "mp"))
 
 
@@ -115,18 +145,20 @@ def shard_rows_by_pid(pid: np.ndarray,
     return out_pid, out_pk, out_val, out_valid
 
 
-def _device_key(key):
+def _device_key(key, axes):
     """Independent PRNG stream per mesh position."""
-    dp_idx = jax.lax.axis_index("dp")
-    mp_idx = jax.lax.axis_index("mp")
-    return jax.random.fold_in(jax.random.fold_in(key, dp_idx), mp_idx)
+    for axis in axes:
+        key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+    return key
 
 
-def _reduce_scatter(x):
-    # 'dp' first, then 'mp', so the slice held by device (d, m) is chunk
-    # d*mp + m — matching the P(('dp','mp')) output layout.
-    x = jax.lax.psum_scatter(x, "dp", scatter_dimension=0, tiled=True)
-    return jax.lax.psum_scatter(x, "mp", scatter_dimension=0, tiled=True)
+def _reduce_scatter(x, scatter_axes):
+    # Scatter in _scatter_axes order (ICI first, DCN last): each hop moves
+    # already-partially-reduced data, and the chunk each device ends up
+    # holding matches the _part_spec output layout.
+    for axis in scatter_axes:
+        x = jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+    return x
 
 
 @functools.lru_cache(maxsize=None)
@@ -138,11 +170,14 @@ def _scalar_kernel(mesh: Mesh, padded_p: int, has_l1: bool = False):
     pid-disjoint, so per-shard L1 sampling is exact.
     """
 
+    axes = tuple(mesh.axis_names)
+    scatter = _scatter_axes(mesh)
+
     def local_step(key, pid, pk, value, valid, linf_cap, l0_cap, row_clip_lo,
                    row_clip_hi, middle, group_clip_lo, group_clip_hi,
                    *l1_args):
         accs = columnar.bound_and_aggregate(
-            _device_key(key), pid, pk, value, valid,
+            _device_key(key, axes), pid, pk, value, valid,
             num_partitions=padded_p,
             linf_cap=linf_cap,
             l0_cap=l0_cap,
@@ -152,13 +187,15 @@ def _scalar_kernel(mesh: Mesh, padded_p: int, has_l1: bool = False):
             group_clip_lo=group_clip_lo,
             group_clip_hi=group_clip_hi,
             l1_cap=l1_args[0] if has_l1 else None)
-        return jax.tree.map(_reduce_scatter, accs)
+        return jax.tree.map(lambda x: _reduce_scatter(x, scatter), accs)
 
+    spec = _spec(mesh)
+    part = _part_spec(mesh)
     fn = jax.shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(P(),) + (ROW_SPEC,) * 4 + (P(),) * (8 if has_l1 else 7),
-        out_specs=columnar.PartitionAccumulators(*([PART_SPEC] * 5)),
+        in_specs=(P(),) + (spec,) * 4 + (P(),) * (8 if has_l1 else 7),
+        out_specs=columnar.PartitionAccumulators(*([part] * 5)),
         check_vma=False)
     return jax.jit(fn)
 
@@ -168,25 +205,30 @@ def _vector_kernel(mesh: Mesh, padded_p: int, norm_ord: int,
                    has_l1: bool = False):
     """Sharded twin of columnar.bound_and_aggregate_vector."""
 
+    axes = tuple(mesh.axis_names)
+    scatter = _scatter_axes(mesh)
+
     def local_step(key, pid, pk, value, valid, linf_cap, l0_cap, max_norm,
                    *l1_args):
         vector_sums, accs = columnar.bound_and_aggregate_vector(
-            _device_key(key), pid, pk, value, valid,
+            _device_key(key, axes), pid, pk, value, valid,
             num_partitions=padded_p,
             linf_cap=linf_cap,
             l0_cap=l0_cap,
             max_norm=max_norm,
             norm_ord=norm_ord,
             l1_cap=l1_args[0] if has_l1 else None)
-        return (_reduce_scatter(vector_sums),
-                jax.tree.map(_reduce_scatter, accs))
+        return (_reduce_scatter(vector_sums, scatter),
+                jax.tree.map(lambda x: _reduce_scatter(x, scatter), accs))
 
+    spec = _spec(mesh)
+    part = _part_spec(mesh)
     fn = jax.shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(P(),) + (ROW_SPEC,) * 4 + (P(),) * (4 if has_l1 else 3),
-        out_specs=(PART_SPEC,
-                   columnar.PartitionAccumulators(*([PART_SPEC] * 5))),
+        in_specs=(P(),) + (spec,) * 4 + (P(),) * (4 if has_l1 else 3),
+        out_specs=(part,
+                   columnar.PartitionAccumulators(*([part] * 5))),
         check_vma=False)
     return jax.jit(fn)
 
@@ -196,23 +238,27 @@ def _quantile_kernel(mesh: Mesh, padded_p: int, num_leaves: int,
                      has_l1: bool = False):
     """Sharded leaf-histogram kernel for the batched quantile trees."""
 
+    axes = tuple(mesh.axis_names)
+    scatter = _scatter_axes(mesh)
+
     def local_step(key, pid, pk, value, valid, linf_cap, l0_cap, lower,
                    upper, *l1_args):
-        mask = columnar.bound_row_mask(_device_key(key), pid, pk, valid,
-                                       linf_cap, l0_cap,
+        mask = columnar.bound_row_mask(_device_key(key, axes), pid, pk,
+                                       valid, linf_cap, l0_cap,
                                        l1_cap=l1_args[0] if has_l1 else None)
         hist = quantile_ops.leaf_histograms(pk, value, mask,
                                             num_partitions=padded_p,
                                             num_leaves=num_leaves,
                                             lower=lower,
                                             upper=upper)
-        return _reduce_scatter(hist)
+        return _reduce_scatter(hist, scatter)
 
+    spec = _spec(mesh)
     fn = jax.shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(P(),) + (ROW_SPEC,) * 4 + (P(),) * (5 if has_l1 else 4),
-        out_specs=PART_SPEC,
+        in_specs=(P(),) + (spec,) * 4 + (P(),) * (5 if has_l1 else 4),
+        out_specs=_part_spec(mesh),
         check_vma=False)
     return jax.jit(fn)
 
@@ -244,7 +290,7 @@ def _shard_and_put(mesh: Mesh, pid, pk, value, valid):
                                                 np.asarray(pk),
                                                 np.asarray(value), n_dev,
                                                 np.asarray(valid))
-    sharding = NamedSharding(mesh, ROW_SPEC)
+    sharding = NamedSharding(mesh, _spec(mesh))
     return tuple(
         jax.device_put(a, sharding) for a in (spid, spk, sval, svalid))
 

@@ -62,75 +62,20 @@ class PrivateCollection:
 
     def count(self, params: agg.CountParams):
         """DP count per partition; lazy (pk, count) pairs."""
-        return self._aggregate(
-            agg.AggregateParams(
-                noise_kind=params.noise_kind,
-                metrics=[agg.Metrics.COUNT],
-                max_partitions_contributed=params.max_partitions_contributed,
-                max_contributions_per_partition=params.
-                max_contributions_per_partition,
-                budget_weight=params.budget_weight,
-                contribution_bounds_already_enforced=params.
-                contribution_bounds_already_enforced,
-                pre_threshold=params.pre_threshold), params, "count")
+        return self._aggregate(params, agg.Metrics.COUNT, "count")
 
     def sum(self, params: agg.SumParams):
-        return self._aggregate(
-            agg.AggregateParams(
-                noise_kind=params.noise_kind,
-                metrics=[agg.Metrics.SUM],
-                max_partitions_contributed=params.max_partitions_contributed,
-                max_contributions_per_partition=params.
-                max_contributions_per_partition,
-                min_value=params.min_value,
-                max_value=params.max_value,
-                budget_weight=params.budget_weight,
-                contribution_bounds_already_enforced=params.
-                contribution_bounds_already_enforced,
-                pre_threshold=params.pre_threshold), params, "sum")
+        return self._aggregate(params, agg.Metrics.SUM, "sum")
 
     def mean(self, params: agg.MeanParams):
-        return self._aggregate(
-            agg.AggregateParams(
-                noise_kind=params.noise_kind,
-                metrics=[agg.Metrics.MEAN],
-                max_partitions_contributed=params.max_partitions_contributed,
-                max_contributions_per_partition=params.
-                max_contributions_per_partition,
-                min_value=params.min_value,
-                max_value=params.max_value,
-                budget_weight=params.budget_weight,
-                contribution_bounds_already_enforced=params.
-                contribution_bounds_already_enforced,
-                pre_threshold=params.pre_threshold), params, "mean")
+        return self._aggregate(params, agg.Metrics.MEAN, "mean")
 
     def variance(self, params: agg.VarianceParams):
-        return self._aggregate(
-            agg.AggregateParams(
-                noise_kind=params.noise_kind,
-                metrics=[agg.Metrics.VARIANCE],
-                max_partitions_contributed=params.max_partitions_contributed,
-                max_contributions_per_partition=params.
-                max_contributions_per_partition,
-                min_value=params.min_value,
-                max_value=params.max_value,
-                budget_weight=params.budget_weight,
-                contribution_bounds_already_enforced=params.
-                contribution_bounds_already_enforced,
-                pre_threshold=params.pre_threshold), params, "variance")
+        return self._aggregate(params, agg.Metrics.VARIANCE, "variance")
 
     def privacy_id_count(self, params: agg.PrivacyIdCountParams):
-        return self._aggregate(
-            agg.AggregateParams(
-                noise_kind=params.noise_kind,
-                metrics=[agg.Metrics.PRIVACY_ID_COUNT],
-                max_partitions_contributed=params.max_partitions_contributed,
-                max_contributions_per_partition=1,
-                budget_weight=params.budget_weight,
-                contribution_bounds_already_enforced=params.
-                contribution_bounds_already_enforced,
-                pre_threshold=params.pre_threshold), params,
-            "privacy_id_count")
+        return self._aggregate(params, agg.Metrics.PRIVACY_ID_COUNT,
+                               "privacy_id_count")
 
     def select_partitions(self, params: agg.SelectPartitionsParams,
                           partition_extractor: Callable[[Any], Any]):
@@ -142,8 +87,22 @@ class PrivateCollection:
             partition_extractor=lambda pair: partition_extractor(pair[1]))
         return engine.select_partitions(self._pairs, params, extractors)
 
-    def _aggregate(self, aggregate_params: agg.AggregateParams, params,
-                   metric_name: str):
+    def _aggregate(self, params, metric: agg.Metric, metric_name: str):
+        """Translates a high-level params dataclass into one AggregateParams
+        run; optional fields (value caps, linf) are read off the dataclass
+        where present."""
+        aggregate_params = agg.AggregateParams(
+            noise_kind=params.noise_kind,
+            metrics=[metric],
+            max_partitions_contributed=params.max_partitions_contributed,
+            max_contributions_per_partition=getattr(
+                params, "max_contributions_per_partition", 1),
+            min_value=getattr(params, "min_value", None),
+            max_value=getattr(params, "max_value", None),
+            budget_weight=params.budget_weight,
+            contribution_bounds_already_enforced=params.
+            contribution_bounds_already_enforced,
+            pre_threshold=params.pre_threshold)
         engine = dp_engine_lib.DPEngine(self._budget_accountant,
                                         self._backend)
         value_extractor = getattr(params, "value_extractor", None)
@@ -154,9 +113,8 @@ class PrivateCollection:
             value_extractor=(
                 (lambda pair: value_extractor(pair[1]))
                 if value_extractor is not None else (lambda pair: 0)))
-        public = getattr(params, "public_partitions", None)
         result = engine.aggregate(self._pairs, aggregate_params, extractors,
-                                  public_partitions=public)
+                                  public_partitions=params.public_partitions)
         # (pk, MetricsTuple) -> (pk, scalar), like the reference wrappers
         # (private_spark.py:178-232 maps the namedtuple down to the value).
         return self._backend.map_values(

@@ -22,6 +22,7 @@ def _backends():
         pytest.param(lambda: pdp.MultiProcLocalBackend(n_jobs=2,
                                                        chunksize=3),
                      id="mp-small-chunks"),
+        pytest.param(lambda: pdp.JaxBackend(), id="jax"),
     ]
 
 
