@@ -47,7 +47,15 @@ def _try_columns(pairs):
             return pairs, None, None
         keys.append(k)
         values.append(v)
-    return pairs, np.asarray(keys, dtype=np.int64), np.asarray(values)
+    try:
+        keys_arr = np.asarray(keys, dtype=np.int64)
+        values_arr = np.asarray(values)
+    except OverflowError:
+        # Arbitrary-precision Python ints beyond int64: host path only.
+        return pairs, None, None
+    if values_arr.dtype == object or values_arr.dtype == np.uint64:
+        return pairs, None, None
+    return pairs, keys_arr, values_arr
 
 
 class JaxBackend(local.LocalBackend):
@@ -75,9 +83,15 @@ class JaxBackend(local.LocalBackend):
                 yield from local.LocalBackend.count_per_element(
                     self, elements, stage_name)
                 return
-            keys = np.asarray(elements, dtype=np.int64)
-            for key, total in self._segment_reduce(keys,
-                                                   np.ones(len(keys))):
+            try:
+                keys = np.asarray(elements, dtype=np.int64)
+            except OverflowError:
+                yield from local.LocalBackend.count_per_element(
+                    self, elements, stage_name)
+                return
+            # int64 ones so counting takes the device int32 path.
+            for key, total in self._segment_reduce(
+                    keys, np.ones(len(keys), dtype=np.int64)):
                 yield key, int(total)
 
         return gen()
@@ -94,9 +108,11 @@ class JaxBackend(local.LocalBackend):
         """
         ids, uniques = encoding._factorize(keys)
         int_values = np.issubdtype(values.dtype, np.integer)
+        # Magnitude check in float64 (abs of int64-min would wrap); the
+        # 2^16 margin covers float64 rounding of the sum.
         device_safe = (int_values and len(values) > 0 and
-                       int(np.abs(values.astype(np.int64)).sum()) <
-                       np.iinfo(np.int32).max)
+                       float(np.abs(values.astype(np.float64)).sum()) <
+                       float(np.iinfo(np.int32).max - (1 << 16)))
         if device_safe:
             import jax
             import jax.numpy as jnp

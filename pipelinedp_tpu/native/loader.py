@@ -27,62 +27,97 @@ from typing import Optional
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "secure_noise.cc")
-_SO = os.path.join(_DIR, f"_secure_noise{sysconfig.get_config_var('EXT_SUFFIX') or '.so'}")
+_EXT = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
 
 _lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_load_failed = False
+_libs: dict = {}  # stem -> CDLL | None (None = load failed)
 
 
-def _build() -> bool:
+def _build(stem: str) -> bool:
+    src = os.path.join(_DIR, f"{stem}.cc")
+    so = os.path.join(_DIR, f"_{stem}{_EXT}")
     cmd = [
-        "g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread", _SRC,
-        "-o", _SO
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread", src,
+        "-o", so
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return True
     except (OSError, subprocess.SubprocessError) as e:
-        logging.info("pipelinedp_tpu.native: build failed (%s); using the "
-                     "numpy fallback sampler", e)
+        logging.info("pipelinedp_tpu.native: build of %s failed (%s); "
+                     "using the numpy fallback", stem, e)
         return False
 
 
-def load() -> Optional[ctypes.CDLL]:
-    """Returns the loaded library, building it if needed; None on failure."""
-    global _lib, _load_failed
+def _load_lib(stem: str, abi_symbol: str) -> Optional[ctypes.CDLL]:
+    """Builds (if stale/missing) and loads native/<stem>.cc; caches."""
     with _lock:
-        if _lib is not None or _load_failed:
-            return _lib
-        if not os.path.exists(_SO) or (os.path.exists(_SRC) and
-                                       os.path.getmtime(_SO) <
-                                       os.path.getmtime(_SRC)):
-            if not _build():
-                _load_failed = True
+        if stem in _libs:
+            return _libs[stem]
+        src = os.path.join(_DIR, f"{stem}.cc")
+        so = os.path.join(_DIR, f"_{stem}{_EXT}")
+        if not os.path.exists(so) or (os.path.exists(src) and
+                                      os.path.getmtime(so) <
+                                      os.path.getmtime(src)):
+            if not _build(stem):
+                _libs[stem] = None
                 return None
         try:
-            lib = ctypes.CDLL(_SO)
-            lib.pdp_noise_abi_version.restype = ctypes.c_int
-            if lib.pdp_noise_abi_version() != 1:
+            lib = ctypes.CDLL(so)
+            abi = getattr(lib, abi_symbol)
+            abi.restype = ctypes.c_int
+            if abi() != 1:
                 raise OSError("ABI version mismatch")
-            for name in ("pdp_sample_discrete_laplace",
-                         "pdp_sample_discrete_gaussian"):
-                fn = getattr(lib, name)
-                fn.restype = ctypes.c_int
-                fn.argtypes = [
-                    ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
-                    ctypes.c_double
-                ]
-            _lib = lib
         except OSError as e:
-            logging.info("pipelinedp_tpu.native: load failed (%s)", e)
-            _load_failed = True
-        return _lib
+            logging.info("pipelinedp_tpu.native: load of %s failed (%s)",
+                         stem, e)
+            lib = None
+        _libs[stem] = lib
+        return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The secure-noise library, building it if needed; None on failure."""
+    lib = _load_lib("secure_noise", "pdp_noise_abi_version")
+    if lib is not None and not getattr(lib, "_pdp_typed", False):
+        for name in ("pdp_sample_discrete_laplace",
+                     "pdp_sample_discrete_gaussian"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int
+            fn.argtypes = [
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+                ctypes.c_double
+            ]
+        lib._pdp_typed = True
+    return lib
+
+
+def load_row_packer() -> Optional[ctypes.CDLL]:
+    """The row bucketing/packing library; None on failure."""
+    lib = _load_lib("row_packer", "pdp_row_packer_abi_version")
+    if lib is not None and not getattr(lib, "_pdp_typed", False):
+        fn = lib.pdp_pack_buckets
+        fn.restype = ctypes.c_int
+        fn.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),  # pid
+            ctypes.POINTER(ctypes.c_int32),  # pk
+            ctypes.c_void_p,  # value (float* or NULL)
+            ctypes.c_int64,  # n
+            ctypes.c_int32,  # pid_lo
+            ctypes.c_int64,  # n_buckets
+            ctypes.c_int,  # bytes_pid
+            ctypes.c_int,  # bytes_pk
+            ctypes.c_int,  # value_f16
+            ctypes.POINTER(ctypes.c_uint8),  # out
+            ctypes.c_int64,  # cap
+            ctypes.POINTER(ctypes.c_int64),  # counts
+        ]
+        lib._pdp_typed = True
+    return lib
 
 
 def is_loaded() -> bool:
-    return _lib is not None
+    return _libs.get("secure_noise") is not None
 
 
 def _sample(fn, units: float, size) -> np.ndarray:

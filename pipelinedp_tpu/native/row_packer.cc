@@ -1,0 +1,181 @@
+// Native row bucketing + byte-packing for the streaming execution path.
+//
+// The streaming engine (pipelinedp_tpu/ops/streaming.py) hash-shards rows
+// by privacy id into pid-disjoint buckets and ships each bucket byte-packed
+// to the device. Doing that with numpy costs one full-array pass per bucket
+// (flatnonzero + three gathers + byte splits, ~1 s per bucket at the
+// benchmark scale); this helper does the whole job in one two-pass radix
+// partition over the input, multithreaded, writing the packed per-bucket
+// buffers directly. Role: the native data-loader stage (SURVEY.md §2.5 —
+// the reference delegates its loader hot path to Beam/Spark native runners).
+//
+// Layout written: out[bucket][slot] = bytes_pid little-endian bytes of
+// (pid - pid_lo) | bytes_pk bytes of pk | 4 bytes f32 value (or 2 bytes
+// f16 when value_f16). Buckets are pid-disjoint by construction
+// (bucket = knuth_hash(pid - pid_lo) % n_buckets, identical to the Python
+// fallback in streaming.py).
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kHashMult = 2654435761u;
+
+inline uint32_t BucketOf(int32_t shifted, uint32_t n_buckets) {
+  return ((static_cast<uint32_t>(shifted) * kHashMult) >> 16) % n_buckets;
+}
+
+// f32 -> f16 (round-to-nearest-even), bit-level.
+inline uint16_t F32ToF16(float f) {
+  uint32_t x;
+  std::memcpy(&x, &f, 4);
+  uint32_t sign = (x >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((x >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = x & 0x7fffffu;
+  if (exp >= 31) {
+    // Overflow -> inf; NaN keeps a nonzero mantissa (matching numpy's
+    // f32->f16 cast so the packer and the fallback stay bit-identical).
+    if (((x >> 23) & 0xff) == 255 && mant) {
+      uint32_t m = mant >> 13;
+      if (!m) m = 1;
+      return static_cast<uint16_t>(sign | 0x7c00u | m);
+    }
+    return static_cast<uint16_t>(sign | 0x7c00u);
+  }
+  if (exp <= 0) {
+    if (exp < -10) return static_cast<uint16_t>(sign);
+    mant |= 0x800000u;
+    uint32_t shift = static_cast<uint32_t>(14 - exp);
+    uint32_t half = mant >> shift;
+    uint32_t rem = mant & ((1u << shift) - 1);
+    uint32_t halfway = 1u << (shift - 1);
+    if (rem > halfway || (rem == halfway && (half & 1u))) half += 1;
+    return static_cast<uint16_t>(sign | half);
+  }
+  uint32_t half = (static_cast<uint32_t>(exp) << 10) | (mant >> 13);
+  uint32_t rem = mant & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (half & 1u))) half += 1;
+  return static_cast<uint16_t>(sign | half);
+}
+
+struct PackArgs {
+  const int32_t* pid;
+  const int32_t* pk;
+  const float* value;
+  int64_t n;
+  int32_t pid_lo;
+  uint32_t n_buckets;
+  int bytes_pid;
+  int bytes_pk;
+  bool value_f16;
+  uint8_t* out;
+  int64_t cap;
+  int width;
+};
+
+inline void WriteRow(const PackArgs& a, int64_t row, uint8_t* dst) {
+  uint32_t spid = static_cast<uint32_t>(a.pid[row] - a.pid_lo);
+  for (int b = 0; b < a.bytes_pid; ++b) dst[b] = (spid >> (8 * b)) & 0xff;
+  uint32_t pk = static_cast<uint32_t>(a.pk[row]);
+  uint8_t* d = dst + a.bytes_pid;
+  for (int b = 0; b < a.bytes_pk; ++b) d[b] = (pk >> (8 * b)) & 0xff;
+  d += a.bytes_pk;
+  if (a.value_f16) {
+    uint16_t h = F32ToF16(a.value ? a.value[row] : 0.0f);
+    d[0] = h & 0xff;
+    d[1] = (h >> 8) & 0xff;
+  } else {
+    float v = a.value ? a.value[row] : 0.0f;
+    std::memcpy(d, &v, 4);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Two-pass multithreaded radix partition + byte pack.
+//   out: n_buckets * cap * width bytes (bucket-major).
+//   counts: n_buckets entries, filled with rows per bucket.
+// Returns 0 on success, 1 on bad args, 2 if any bucket exceeds cap
+// (counts still valid — caller re-allocates with counts.max() and retries).
+int pdp_pack_buckets(const int32_t* pid, const int32_t* pk,
+                     const float* value, int64_t n, int32_t pid_lo,
+                     int64_t n_buckets, int bytes_pid, int bytes_pk,
+                     int value_f16, uint8_t* out, int64_t cap,
+                     int64_t* counts) {
+  if (!pid || !pk || !out || !counts || n < 0 || n_buckets <= 0 ||
+      bytes_pid < 1 || bytes_pid > 4 || bytes_pk < 1 || bytes_pk > 4) {
+    return 1;
+  }
+  PackArgs args{pid,      pk,       value,
+                n,        pid_lo,   static_cast<uint32_t>(n_buckets),
+                bytes_pid, bytes_pk, value_f16 != 0,
+                out,      cap,      bytes_pid + bytes_pk + (value_f16 ? 2 : 4)};
+
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t n_threads = hw < 1 ? 1 : static_cast<int64_t>(hw);
+  if (n_threads > 16) n_threads = 16;
+  if (n < (1 << 16)) n_threads = 1;
+  int64_t per = (n + n_threads - 1) / n_threads;
+
+  // Pass 1: per-thread per-bucket counts.
+  std::vector<std::vector<int64_t>> thread_counts(
+      n_threads, std::vector<int64_t>(n_buckets, 0));
+  {
+    std::vector<std::thread> threads;
+    for (int64_t t = 0; t < n_threads; ++t) {
+      threads.emplace_back([&, t] {
+        int64_t lo = t * per;
+        int64_t hi = lo + per < n ? lo + per : n;
+        auto& local = thread_counts[t];
+        for (int64_t i = lo; i < hi; ++i) {
+          local[BucketOf(pid[i] - pid_lo, args.n_buckets)] += 1;
+        }
+      });
+    }
+    for (auto& th : threads) th.join();
+  }
+  // Per-(thread, bucket) write offsets; totals into counts.
+  std::vector<std::vector<int64_t>> offsets(
+      n_threads, std::vector<int64_t>(n_buckets, 0));
+  bool overflow = false;
+  for (int64_t b = 0; b < n_buckets; ++b) {
+    int64_t acc = 0;
+    for (int64_t t = 0; t < n_threads; ++t) {
+      offsets[t][b] = acc;
+      acc += thread_counts[t][b];
+    }
+    counts[b] = acc;
+    if (acc > cap) overflow = true;
+  }
+  if (overflow) return 2;
+
+  // Pass 2: write rows, bucket-major output, per-thread disjoint slots.
+  {
+    std::vector<std::thread> threads;
+    for (int64_t t = 0; t < n_threads; ++t) {
+      threads.emplace_back([&, t] {
+        int64_t lo = t * per;
+        int64_t hi = lo + per < n ? lo + per : n;
+        auto local = offsets[t];  // copy: mutated as we write
+        for (int64_t i = lo; i < hi; ++i) {
+          uint32_t b = BucketOf(pid[i] - pid_lo, args.n_buckets);
+          int64_t slot = local[b]++;
+          uint8_t* dst =
+              out + (static_cast<int64_t>(b) * cap + slot) * args.width;
+          WriteRow(args, i, dst);
+        }
+      });
+    }
+    for (auto& th : threads) th.join();
+  }
+  return 0;
+}
+
+int pdp_row_packer_abi_version() { return 1; }
+
+}  // extern "C"

@@ -183,44 +183,26 @@ def stream_bound_and_aggregate(
     bytes_value = 2 if value_f16 else 4
     width = bytes_pid + bytes_pk + bytes_value
 
-    # Hash-shard rows by privacy id. uint32 wraparound in the shift is fine:
-    # the hash only needs to be a pure function of pid. Modulo (not a bit
-    # mask) so any chunk count splits evenly.
-    shifted = (pid - pid_lo).astype(np.uint32, copy=False)
-    bucket = ((shifted * _HASH_MULT) >> np.uint32(16)) % np.uint32(k)
-
-    # One padded chunk size for all buckets => one compiled kernel.
-    counts = np.bincount(bucket, minlength=k)
-    chunk_rows = int(counts.max())
+    packed = _pack_native(pid, pk, value, pid_lo, k, bytes_pid, bytes_pk,
+                          value_f16, width)
+    if packed is None:
+        # Lazy generator: bucket c+1 packs on host while bucket c's DMA
+        # and kernel run.
+        packed = _pack_numpy(pid, pk, value, pid_lo, k, bytes_pid, bytes_pk,
+                             value_f16, width, bytes_value)
 
     # Five distinct buffers: the accumulators are donated into each chunk
     # step, and a donated buffer must not be aliased.
     accs = columnar.PartitionAccumulators(
         *(jnp.zeros((num_partitions,), dtype=jnp.float32) for _ in range(5)))
-    if value is not None:
-        value = np.asarray(value)
-        if value_f16:
-            value = value.astype(np.float16)
-        else:
-            value = value.astype(np.float32, copy=False)
-
-    for c in range(k):
-        idx = np.flatnonzero(bucket == c)
-        buf = np.zeros((chunk_rows, width), dtype=np.uint8)
-        m = len(idx)
-        _pack_ints(buf[:m], shifted[idx], 0, bytes_pid)
-        _pack_ints(buf[:m], pk[idx].astype(np.uint32, copy=False), bytes_pid,
-                   bytes_pk)
-        if value is not None:
-            vbytes = value[idx]
-            buf[:m, bytes_pid + bytes_pk:] = (
-                vbytes.view(np.uint8).reshape(m, bytes_value))
+    for c, (buf, m) in enumerate(packed):
         # device_put enqueues the DMA and returns; the chunk kernel is
-        # dispatched right behind it, so packing bucket c+1 on host overlaps
+        # dispatched right behind it, so host work on bucket c+1 overlaps
         # both the transfer and the compute of bucket c.
         with profiler.stage(f"dp/stream_chunk_{c}"):
             dbuf = jax.device_put(buf)
-            accs = _chunk_step(jax.random.fold_in(key, c), dbuf, m, accs,
+            accs = _chunk_step(jax.random.fold_in(key, c), dbuf,
+                               int(m), accs,
                                linf_cap, l0_cap, row_clip_lo, row_clip_hi,
                                middle, group_clip_lo, group_clip_hi, l1_cap,
                                num_partitions=num_partitions,
@@ -228,3 +210,73 @@ def stream_bound_and_aggregate(
                                bytes_pk=bytes_pk,
                                value_f16=value_f16)
     return accs
+
+
+def _pack_native(pid, pk, value, pid_lo, k, bytes_pid, bytes_pk, value_f16,
+                 width):
+    """One multithreaded C++ pass: bucket + byte-pack all rows.
+
+    Returns ([bucket buffers], counts) or None when the native library is
+    unavailable or the dtypes don't qualify (the numpy fallback handles
+    everything).
+    """
+    try:
+        from pipelinedp_tpu.native import loader
+        lib = loader.load_row_packer()
+    except Exception:  # noqa: BLE001 — packer is an optimization only
+        return None
+    if lib is None:
+        return None
+    import ctypes
+
+    n = len(pid)
+    pid32 = np.ascontiguousarray(pid, dtype=np.int32)
+    pk32 = np.ascontiguousarray(pk, dtype=np.int32)
+    val32 = (np.ascontiguousarray(value, dtype=np.float32)
+             if value is not None else None)
+    # Knuth-hashed buckets are near-uniform: pad 2% + slack, retry once
+    # with the exact max if an adversarial id distribution overflows.
+    cap = n // k + max(n // (k * 50), 4096)
+    for _ in range(2):
+        out = np.zeros((k, cap, width), dtype=np.uint8)
+        counts = np.zeros(k, dtype=np.int64)
+        rc = lib.pdp_pack_buckets(
+            pid32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            pk32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            val32.ctypes.data_as(ctypes.c_void_p) if val32 is not None
+            else None, n, int(pid_lo), k, bytes_pid, bytes_pk,
+            int(value_f16),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap,
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if rc == 0:
+            return list(zip(out, counts))
+        if rc == 2:
+            cap = int(counts.max())
+            continue
+        return None
+    return None
+
+
+def _pack_numpy(pid, pk, value, pid_lo, k, bytes_pid, bytes_pk, value_f16,
+                width, bytes_value):
+    """Numpy fallback: same buckets and byte layout as the native packer,
+    yielded lazily so per-bucket host work overlaps the pipeline."""
+    shifted = (pid - pid_lo).astype(np.uint32, copy=False)
+    bucket = ((shifted * _HASH_MULT) >> np.uint32(16)) % np.uint32(k)
+    counts = np.bincount(bucket, minlength=k)
+    chunk_rows = int(counts.max())
+    if value is not None:
+        value = np.asarray(value)
+        value = value.astype(np.float16 if value_f16 else np.float32,
+                             copy=False)
+    for c in range(k):
+        idx = np.flatnonzero(bucket == c)
+        buf = np.zeros((chunk_rows, width), dtype=np.uint8)
+        m = len(idx)
+        _pack_ints(buf[:m], shifted[idx], 0, bytes_pid)
+        _pack_ints(buf[:m], pk[idx].astype(np.uint32, copy=False),
+                   bytes_pid, bytes_pk)
+        if value is not None:
+            buf[:m, bytes_pid + bytes_pk:] = (
+                value[idx].view(np.uint8).reshape(m, bytes_value))
+        yield buf, m
