@@ -46,10 +46,9 @@ def main():
         movie_id = np.fromiter((v.movie_id for v in views), dtype=np.int64)
         rating = np.fromiter((v.rating for v in views), dtype=np.int64)
     else:
-        # 2k movies: the percentile metrics build a dense
-        # [movies, tree-leaves] histogram on device, so the demo stays
-        # inside the quantile-histogram budget (drop the percentiles from
-        # `metrics` below to scale the other metrics to millions of keys).
+        # 2k movies keeps the demo fast; the percentile metrics scale to
+        # millions of movies too (the engine blocks the [movies,
+        # tree-leaves] histograms over the device budget automatically).
         user_id, movie_id, rating = synthesize_columns(n_movies=2_000)
     data = pdp.ColumnarData(pid=user_id, pk=movie_id, value=rating)
 

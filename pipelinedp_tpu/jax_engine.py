@@ -597,50 +597,21 @@ class JaxDPEngine:
         num_out = int(accs.pid_count.shape[0])
         partition_exists = accs.pid_count > 0
 
-        # PERCENTILE: one dense [num_partitions, leaves] histogram of the
-        # bounded rows feeds every partition's quantile tree at once
-        # (ops/quantiles.py). The row keep mask replays the exact sampling
-        # decisions of the fused kernel (same PRNG key).
-        quantile_hist = None
-        if any(
-                isinstance(c, combiners_lib.QuantileCombiner)
-                for c in compound.combiners):
-            num_leaves = (quantile_tree_lib.DEFAULT_BRANCHING_FACTOR**
-                          quantile_tree_lib.DEFAULT_TREE_HEIGHT)
-            if num_out * num_leaves > quantile_ops.MAX_HISTOGRAM_ELEMENTS:
-                raise ValueError(
-                    f"PERCENTILE over {num_out} partitions needs a "
-                    f"{num_out}x{num_leaves} histogram, above the "
-                    f"{quantile_ops.MAX_HISTOGRAM_ELEMENTS}-element device "
-                    f"budget; use DPEngine with LocalBackend for this "
-                    f"workload.")
-            if self._mesh is not None:
-                from pipelinedp_tpu.parallel import sharded
-                quantile_hist = sharded.quantile_leaf_histograms(
-                    self._mesh, k_kernel, pid, pk, value, valid_rows,
-                    num_partitions=num_partitions,
-                    num_leaves=num_leaves,
-                    lower=params.min_value,
-                    upper=params.max_value,
-                    linf_cap=linf_cap,
-                    l0_cap=l0_cap,
-                    l1_cap=l1_cap)
-            else:
-                row_keep = columnar.bound_row_mask(k_kernel,
-                                                   jnp.asarray(pid),
-                                                   jnp.asarray(pk),
-                                                   jnp.ones(n_rows,
-                                                            dtype=bool),
-                                                   linf_cap, l0_cap,
-                                                   l1_cap=l1_cap)
-                quantile_hist = quantile_ops.leaf_histograms(
-                    jnp.asarray(pk),
-                    jnp.asarray(value),
-                    row_keep,
-                    num_partitions=num_out,
-                    num_leaves=num_leaves,
-                    lower=params.min_value,
-                    upper=params.max_value)
+        # PERCENTILE: dense [num_partitions, leaves] histograms feed every
+        # partition's quantile tree at once; partition counts beyond the
+        # device budget process in partition blocks over pk-sorted rows
+        # (ops/quantiles.py). Computed up front so the combiner loop only
+        # reads finished columns.
+        quantile_cols = None
+        if has_quantile:
+            qcombiner = next(
+                c for c in compound.combiners
+                if isinstance(c, combiners_lib.QuantileCombiner))
+            quantile_cols = self._quantile_columns(
+                qcombiner, pid, pk, value, n_rows, num_out,
+                num_partitions, linf_cap, l0_cap, l1_cap, k_kernel,
+                jax.random.fold_in(k_noise, 10_000),
+                valid_rows if self._mesh is not None else None)
 
         # Partition selection. The selection strategy's L0 sensitivity is
         # the *declared* cross-partition bound: max_partitions_contributed,
@@ -670,7 +641,7 @@ class JaxDPEngine:
             sub_key = jax.random.fold_in(k_noise, i)
             self._compute_combiner_metrics(combiner, params, accs,
                                            vector_sums, sub_key, columns,
-                                           quantile_hist=quantile_hist)
+                                           quantile_cols=quantile_cols)
             if isinstance(combiner,
                           combiners_lib.PostAggregationThresholdingCombiner):
                 thresh = dp_computations.create_thresholding_mechanism(
@@ -739,7 +710,7 @@ class JaxDPEngine:
 
     def _compute_combiner_metrics(self, combiner, params, accs, vector_sums,
                                   key, columns: dict,
-                                  quantile_hist=None) -> None:
+                                  quantile_cols=None) -> None:
         k1, k2, k3 = jax.random.split(key, 3)
         if isinstance(combiner, combiners_lib.CountCombiner):
             is_g, scale, gran = _mechanism_noise_params(
@@ -781,27 +752,9 @@ class JaxDPEngine:
             self._variance_metrics(combiner, params, accs, (k1, k2, k3),
                                    columns)
         elif isinstance(combiner, combiners_lib.QuantileCombiner):
-            p = combiner._params.aggregate_params
-            eps, delta = combiner._params.eps, combiner._params.delta
-            is_gaussian = p.noise_kind == NoiseKind.GAUSSIAN
-            branching = quantile_tree_lib.DEFAULT_BRANCHING_FACTOR
-            height = quantile_tree_lib.DEFAULT_TREE_HEIGHT
-            levels = quantile_ops.level_counts(quantile_hist, branching,
-                                               height)
-            if self._secure_host_noise:
-                noised = quantile_ops.noised_levels_host(
-                    [np.asarray(lvl) for lvl in levels], eps, delta,
-                    p.max_partitions_contributed,
-                    p.max_contributions_per_partition, is_gaussian)
-            else:
-                noised = quantile_ops.noised_levels_device(
-                    k1, levels, eps, delta, p.max_partitions_contributed,
-                    p.max_contributions_per_partition, is_gaussian)
-            qcols = quantile_ops.walk_quantiles(
-                noised, combiner._quantiles_to_compute, p.min_value,
-                p.max_value, branching)
+            # Columns precomputed by _quantile_columns (dense or blocked).
             for i, name in enumerate(combiner.metrics_names()):
-                columns[name] = qcols[:, i]
+                columns[name] = quantile_cols[:, i]
         elif isinstance(combiner, combiners_lib.VectorSumCombiner):
             p = combiner._params
             noise_params = p.additive_vector_noise_params
@@ -825,6 +778,103 @@ class JaxDPEngine:
             raise NotImplementedError(
                 f"Combiner {type(combiner).__name__} is not supported on the "
                 f"columnar engine.")
+
+    def _quantile_columns(self, combiner, pid, pk, value, n_rows,
+                          num_out, num_partitions, linf_cap, l0_cap, l1_cap,
+                          k_kernel, k_noise, mesh_valid_rows):
+        """[num_out, n_quantiles] DP quantile estimates for every
+        partition. Dense single-histogram path when the [partitions,
+        leaves] layout fits the device budget; otherwise partition-blocked
+        over pk-sorted rows (ops/quantiles.blocked_quantile_columns). The
+        row keep mask replays the fused kernel's sampling decisions (same
+        PRNG key)."""
+        p = combiner._params.aggregate_params
+        eps, delta = combiner._params.eps, combiner._params.delta
+        is_gaussian = p.noise_kind == NoiseKind.GAUSSIAN
+        branching = quantile_tree_lib.DEFAULT_BRANCHING_FACTOR
+        height = quantile_tree_lib.DEFAULT_TREE_HEIGHT
+        num_leaves = branching**height
+        quantiles = combiner._quantiles_to_compute
+        noise_counter = [0]
+
+        def noise_fn(levels):
+            if self._secure_host_noise:
+                return quantile_ops.noised_levels_host(
+                    [np.asarray(lvl) for lvl in levels], eps, delta,
+                    p.max_partitions_contributed,
+                    p.max_contributions_per_partition, is_gaussian)
+            noise_counter[0] += 1
+            return quantile_ops.noised_levels_device(
+                jax.random.fold_in(k_noise, noise_counter[0]), levels, eps,
+                delta, p.max_partitions_contributed,
+                p.max_contributions_per_partition, is_gaussian)
+
+        def finish(hist):
+            # Device-noise mode keeps hist -> levels -> noise -> walk all
+            # on device ([partitions, quantiles] is the only download);
+            # the secure host path pulls the levels once and finishes in
+            # float64 numpy. Used for the dense histogram and per block.
+            levels = quantile_ops.level_counts(hist, branching, height)
+            noised = noise_fn(levels)
+            if self._secure_host_noise:
+                return quantile_ops.walk_quantiles(noised, quantiles,
+                                                   p.min_value, p.max_value,
+                                                   branching)
+            return np.asarray(
+                quantile_ops.walk_quantiles_device(
+                    noised, jnp.asarray(quantiles, dtype=jnp.float32),
+                    p.min_value, p.max_value, branching=branching))
+
+        dense_fits = num_out * num_leaves <= quantile_ops.MAX_HISTOGRAM_ELEMENTS
+        if self._mesh is not None:
+            from pipelinedp_tpu.parallel import sharded
+            if not dense_fits:
+                raise ValueError(
+                    f"PERCENTILE over {num_out} partitions exceeds the "
+                    f"{quantile_ops.MAX_HISTOGRAM_ELEMENTS}-element device "
+                    f"budget on the mesh path; run without a mesh (the "
+                    f"single-device engine blocks the computation) or use "
+                    f"DPEngine with LocalBackend.")
+            hist = sharded.quantile_leaf_histograms(
+                self._mesh, k_kernel, pid, pk, value, mesh_valid_rows,
+                num_partitions=num_partitions,
+                num_leaves=num_leaves,
+                lower=p.min_value,
+                upper=p.max_value,
+                linf_cap=linf_cap,
+                l0_cap=l0_cap,
+                l1_cap=l1_cap)
+            return finish(hist)
+        row_keep = columnar.bound_row_mask(k_kernel, jnp.asarray(pid),
+                                           jnp.asarray(pk),
+                                           jnp.ones(n_rows, dtype=bool),
+                                           linf_cap, l0_cap, l1_cap=l1_cap)
+        if dense_fits:
+            hist = quantile_ops.leaf_histograms(jnp.asarray(pk),
+                                                jnp.asarray(value),
+                                                row_keep,
+                                                num_partitions=num_out,
+                                                num_leaves=num_leaves,
+                                                lower=p.min_value,
+                                                upper=p.max_value)
+            return finish(hist)
+        # Blocked path: sort rows by partition on device once; each block
+        # histograms a contiguous row range.
+        dpk = jnp.asarray(pk)
+        order = jnp.argsort(dpk)
+        spk = dpk[order]
+        sval = jnp.asarray(value)[order]
+        skeep = row_keep[order]
+        row_bounds = np.concatenate(
+            [[0], np.cumsum(np.bincount(pk, minlength=num_out))])
+        return quantile_ops.blocked_quantile_columns(
+            spk, sval, skeep, row_bounds,
+            num_partitions=num_out,
+            num_leaves=num_leaves,
+            lower=p.min_value,
+            upper=p.max_value,
+            num_quantiles=len(quantiles),
+            finish_fn=finish)
 
     def _variance_metrics(self, combiner, params, accs, keys, columns):
         """Vectorized twin of dp_computations.compute_dp_var."""

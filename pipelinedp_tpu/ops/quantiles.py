@@ -30,9 +30,66 @@ import numpy as np
 from pipelinedp_tpu import noise_core
 from pipelinedp_tpu.ops import noise as noise_ops
 
-# Guard for the dense [num_partitions, leaves] layout: above this many
-# histogram elements (2^28 floats = 1 GiB), fall back to the host engine.
+# Dense [num_partitions, leaves] budget (2^28 floats = 1 GiB). Larger
+# partition counts are processed in partition blocks of this many elements:
+# rows are sorted by partition once, each block slices its row range and
+# histograms into a [block, leaves] array — same released values, bounded
+# memory.
 MAX_HISTOGRAM_ELEMENTS = 2**28
+
+
+def blocked_quantile_columns(spk: jnp.ndarray, sval: jnp.ndarray,
+                             skeep: jnp.ndarray, row_bounds: np.ndarray, *,
+                             num_partitions: int, num_leaves: int, lower,
+                             upper, num_quantiles: int, finish_fn
+                             ) -> np.ndarray:
+    """[num_partitions, n_quantiles] DP quantiles, block by block.
+
+    spk/sval/skeep: device arrays sorted by partition id (skeep is the
+    contribution-bounding row mask, already permuted); row_bounds[p] is the
+    host-side row offset of partition p in the sorted order. finish_fn
+    turns one [block, num_leaves] histogram (device array) into the
+    block's [block, n_quantiles] DP quantiles — noise + tree walk in
+    whichever mode the engine runs (the eps/delta split is per tree, so
+    per-block noising is identical to one global call: blocks partition
+    the node space).
+    """
+    block_p = max(1, MAX_HISTOGRAM_ELEMENTS // num_leaves)
+    starts = list(range(0, num_partitions, block_p))
+    n_rows = int(spk.shape[0])
+    out = np.zeros((num_partitions, num_quantiles), dtype=np.float64)
+    for p0 in starts:
+        p1 = min(p0 + block_p, num_partitions)
+        rows_b = int(row_bounds[p1] - row_bounds[p0])
+        if rows_b == 0 or n_rows == 0:
+            # No contributions: zero trees (noise in finish_fn may still
+            # release nonzero counts — same as dense on empty partitions).
+            hist = jnp.zeros((block_p, num_leaves), dtype=jnp.float32)
+        else:
+            # Slice size = rows rounded up to a power of two, so skewed
+            # blocks cost work proportional to their own rows while the
+            # kernel compiles at most log2(n) shapes. The start clamp near
+            # the array end is harmless: the in-block partition mask drops
+            # neighbouring rows the padded window picks up.
+            # rows_b <= n_rows, so the clamp never shrinks below rows_b.
+            size = 1 << (rows_b - 1).bit_length()
+            size = min(max(size, 1024), n_rows)
+            start = min(int(row_bounds[p0]), n_rows - size)
+            bpk = jax.lax.dynamic_slice_in_dim(spk, start, size)
+            bval = jax.lax.dynamic_slice_in_dim(sval, start, size)
+            bkeep = jax.lax.dynamic_slice_in_dim(skeep, start, size)
+            weights = bkeep & (bpk >= p0) & (bpk < p1)
+            hist = leaf_histograms(bpk - p0, bval, weights,
+                                   num_partitions=block_p,
+                                   num_leaves=num_leaves,
+                                   lower=lower,
+                                   upper=upper)
+        # Full [block_p, leaves] shape even for the tail block, so the
+        # noise/walk kernels compile once; only the output is trimmed.
+        # (The extra padding partitions burn a little noise, not budget —
+        # noise is per released node, and padding nodes are discarded.)
+        out[p0:p1] = finish_fn(hist)[:p1 - p0]
+    return out
 
 
 @functools.partial(jax.jit, static_argnames=("num_partitions", "num_leaves"))
@@ -116,6 +173,50 @@ def walk_quantiles(noised_levels: Sequence[np.ndarray],
     return np.where(dead, dead_result, out)
 
 
+@functools.partial(jax.jit, static_argnames=("branching",))
+def walk_quantiles_device(noised_levels, quantiles_arr: jnp.ndarray,
+                          lower, upper, *, branching: int) -> jnp.ndarray:
+    """Device twin of walk_quantiles: same descent, jnp ops, so the
+    O(partitions x nodes) noised levels never leave the device — only the
+    [partitions, quantiles] result does."""
+    b = branching
+    num_partitions = noised_levels[0].shape[0]
+    num_q = quantiles_arr.shape[0]
+    node = jnp.zeros((num_partitions, num_q), dtype=jnp.int32)
+    lo = jnp.full((num_partitions, num_q), lower, dtype=jnp.float32)
+    hi = jnp.full((num_partitions, num_q), upper, dtype=jnp.float32)
+    target = jnp.tile(quantiles_arr.astype(jnp.float32),
+                      (num_partitions, 1))
+    dead = jnp.zeros((num_partitions, num_q), dtype=bool)
+    dead_result = jnp.zeros((num_partitions, num_q), dtype=jnp.float32)
+
+    for level_nodes in noised_levels:
+        lvl = jnp.maximum(level_nodes.astype(jnp.float32), 0.0)
+        idx = node[:, :, None] * b + jnp.arange(b, dtype=jnp.int32)
+        children = jnp.take_along_axis(lvl[:, None, :], idx, axis=2)
+        total = children.sum(axis=2)
+        newly_dead = ~dead & (total <= 0)
+        dead_result = jnp.where(newly_dead, lo + (hi - lo) / 2, dead_result)
+        dead = dead | newly_dead
+        cum = jnp.cumsum(children, axis=2)
+        rank = target * total
+        child = jnp.minimum((cum <= rank[:, :, None]).sum(axis=2), b - 1)
+        child_count = jnp.take_along_axis(children, child[:, :, None],
+                                          axis=2)[:, :, 0]
+        below = jnp.take_along_axis(cum, child[:, :, None],
+                                    axis=2)[:, :, 0] - child_count
+        target = jnp.where(child_count > 0,
+                           (rank - below) / jnp.maximum(child_count, 1e-30),
+                           0.5)
+        target = jnp.clip(target, 0.0, 1.0)
+        width = (hi - lo) / b
+        lo = lo + child * width
+        hi = lo + width
+        node = node * b + child
+    out = lo + target * (hi - lo)
+    return jnp.where(dead, dead_result, out)
+
+
 def noised_levels_host(levels: Sequence[np.ndarray], eps: float, delta: float,
                        l0: int, linf: float,
                        is_gaussian: bool) -> List[np.ndarray]:
@@ -140,8 +241,10 @@ def noised_levels_host(levels: Sequence[np.ndarray], eps: float, delta: float,
 
 def noised_levels_device(key: jax.Array, levels: Sequence[jnp.ndarray],
                          eps: float, delta: float, l0: int, linf: float,
-                         is_gaussian: bool) -> List[np.ndarray]:
-    """Device-side batched noise per level (fast mode)."""
+                         is_gaussian: bool) -> List[jnp.ndarray]:
+    """Device-side batched noise per level (fast mode). Returns device
+    arrays — feed them to walk_quantiles_device so the O(partitions x
+    nodes) level counts never cross the host link."""
     height = len(levels)
     eps_l, delta_l = eps / height, delta / height
     if is_gaussian:
@@ -155,9 +258,11 @@ def noised_levels_device(key: jax.Array, levels: Sequence[jnp.ndarray],
     for i, counts in enumerate(levels):
         k = jax.random.fold_in(key, i)
         if is_gaussian:
-            out.append(np.asarray(
-                noise_ops.add_gaussian_noise(k, counts, sigma, gran)))
+            out.append(
+                noise_ops.add_gaussian_noise(k, jnp.asarray(counts), sigma,
+                                             gran))
         else:
-            out.append(np.asarray(
-                noise_ops.add_laplace_noise(k, counts, scale, gran)))
+            out.append(
+                noise_ops.add_laplace_noise(k, jnp.asarray(counts), scale,
+                                            gran))
     return out

@@ -619,3 +619,63 @@ class TestL1ModeParity:
         res.to_columns()
         report = eng.explain_computations_report()[0]
         assert "Laplace" in report or "laplace" in report
+
+
+class TestBlockedQuantiles:
+    """PERCENTILE beyond the dense device budget: the blocked path must
+    release the same values as the dense path (no-noise comparison with
+    the histogram budget shrunk so blocking engages)."""
+
+    def _run(self, seed=5):
+        rng = np.random.default_rng(0)
+        n = 30_000
+        data = [(int(u), int(p), float(v)) for u, p, v in zip(
+            rng.integers(0, 3000, n), rng.integers(0, 40, n),
+            rng.uniform(0.0, 10.0, n))]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PERCENTILE(50), pdp.Metrics.PERCENTILE(90)],
+            max_partitions_contributed=40,
+            max_contributions_per_partition=100,
+            min_value=0.0,
+            max_value=10.0)
+        # eps so large the per-node tree noise (~4e3/eps) cannot flip a
+        # descent: the dense and blocked paths draw different noise, so
+        # only the noise-free trees are comparable.
+        accountant = pdp.NaiveBudgetAccountant(1e12, 1e-9)
+        engine = pdp.JaxDPEngine(accountant, seed=seed,
+                                 secure_host_noise=False)
+        result = engine.aggregate(data, params, extractors(),
+                                  public_partitions=list(range(40)))
+        accountant.compute_budgets()
+        return result.to_columns()
+
+    def test_blocked_matches_dense(self, monkeypatch):
+        dense = self._run()
+        from pipelinedp_tpu.ops import quantiles as quantile_ops
+        # 40 partitions x 65536 leaves = 2.6M elements; budget 600k forces
+        # ~10-partition blocks.
+        monkeypatch.setattr(quantile_ops, "MAX_HISTOGRAM_ELEMENTS",
+                            600_000)
+        blocked = self._run()
+        # The paths draw different (astronomically small) node noise.
+        # Integer counts make exact rank==boundary ties common (~15% of
+        # partitions at p50), and a tie resolves by the noise sign — those
+        # flips move the estimate by less than a cell width. Most
+        # partitions match exactly; all must be within a tight absolute
+        # band (a real blocking bug — wrong offsets, wrong rows — would
+        # be off by O(1)).
+        for name in ("percentile_50", "percentile_90"):
+            close = np.isclose(blocked[name], dense[name], rtol=1e-6)
+            assert close.mean() >= 0.7, name
+            np.testing.assert_allclose(blocked[name], dense[name],
+                                       atol=0.05)
+
+    def test_blocked_close_to_true_quantiles(self, monkeypatch):
+        from pipelinedp_tpu.ops import quantiles as quantile_ops
+        monkeypatch.setattr(quantile_ops, "MAX_HISTOGRAM_ELEMENTS",
+                            600_000)
+        cols = self._run()
+        # Uniform[0, 10], ~750 samples per partition: sample-median std is
+        # ~0.18, so the max over 40 partitions stays within 0.6.
+        assert np.abs(cols["percentile_50"] - 5.0).max() < 0.6
+        assert np.abs(cols["percentile_90"] - 9.0).max() < 0.6
