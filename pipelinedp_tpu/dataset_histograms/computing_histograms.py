@@ -222,7 +222,14 @@ def _to_dataset_histograms(histogram_cols, backend: base.PipelineBackend):
 def compute_dataset_histograms(col, data_extractors: DataExtractors,
                                backend: base.PipelineBackend):
     """Computes all seven histograms; returns a 1-element collection with a
-    DatasetHistograms."""
+    DatasetHistograms.
+
+    ColumnarData input takes the vectorized columnar fast path
+    (compute_dataset_histograms_columnar); extractors/backend are unused
+    there."""
+    from pipelinedp_tpu.ops import encoding as _encoding
+    if isinstance(col, _encoding.ColumnarData):
+        return [compute_dataset_histograms_columnar(col)]
     col_with_values = backend.map(
         col, lambda row: ((data_extractors.privacy_id_extractor(row),
                            data_extractors.partition_extractor(row)),
@@ -329,3 +336,128 @@ def compute_dataset_histograms_on_preaggregated_data(
         _preagg_partition_privacy_id_count_histogram(col, backend),
         _preagg_partition_sum_histogram(col, backend),
     ], backend)
+
+
+# -- columnar fast path ------------------------------------------------------
+# The per-row builders above cost Python-level work per row; the tuning
+# story needs histograms of 100M-row datasets, so ColumnarData gets a fully
+# vectorized numpy implementation producing bit-identical Histogram objects
+# (same log bins, same float bins) in seconds.
+
+
+def _int_histogram_from_values(values: np.ndarray,
+                               name: hist.HistogramType) -> hist.Histogram:
+    """Log-binned integer histogram, vectorized twin of
+    _to_bin_lower_upper_logarithmic + _bins_to_histogram."""
+    v = np.asarray(values, dtype=np.int64)
+    v = v[v > 0]
+    if len(v) == 0:
+        return hist.Histogram(name, [])
+    # Minimal power of 10 >= max(v, 1000), with fix-ups for float log
+    # error at exact powers.
+    exp = np.maximum(3, np.ceil(np.log10(np.maximum(v, 1)))).astype(np.int64)
+    too_big = (exp > 3) & (v.astype(np.float64) <= 10.0**(exp - 1))
+    exp = np.where(too_big, exp - 1, exp)
+    too_small = v.astype(np.float64) > 10.0**exp
+    exp = np.where(too_small, exp + 1, exp)
+    bound = (10.0**exp).astype(np.int64)
+    round_base = bound // 1000
+    lower = v // round_base * round_base
+    bin_size = np.where(v != bound, round_base, round_base * 10)
+    upper = lower + bin_size
+
+    uniq, inverse = np.unique(lower, return_inverse=True)
+    counts = np.bincount(inverse)
+    sums = np.bincount(inverse, weights=v.astype(np.float64))
+    maxes = np.zeros(len(uniq), dtype=np.int64)
+    np.maximum.at(maxes, inverse, v)
+    uppers = np.zeros(len(uniq), dtype=np.int64)
+    np.maximum.at(uppers, inverse, upper)
+    bins = [
+        hist.FrequencyBin(lower=int(lo),
+                          upper=int(up),
+                          count=int(c),
+                          sum=int(s),
+                          max=int(m))
+        for lo, up, c, s, m in zip(uniq, uppers, counts, sums, maxes)
+    ]
+    return hist.Histogram(name, bins)
+
+
+def _float_histogram_from_values(values: np.ndarray,
+                                 name: hist.HistogramType) -> hist.Histogram:
+    """Equal-width float histogram, vectorized twin of
+    _min_max_lowers + _float_values_to_histogram."""
+    v = np.asarray(values, dtype=np.float64)
+    if len(v) == 0:
+        return hist.Histogram(name, [])
+    lo, hi = float(v.min()), float(v.max())
+    if lo == hi:
+        return hist.Histogram(name, [
+            hist.FrequencyBin(lower=lo,
+                              upper=lo,
+                              count=len(v),
+                              sum=float(v.sum()),
+                              max=hi)
+        ])
+    lowers = np.linspace(lo, hi, NUMBER_OF_BUCKETS_SUM_HISTOGRAM + 1)
+    idx = np.minimum(
+        np.searchsorted(lowers, v, side="right") - 1,
+        NUMBER_OF_BUCKETS_SUM_HISTOGRAM - 1)
+    uniq, inverse = np.unique(idx, return_inverse=True)
+    counts = np.bincount(inverse)
+    sums = np.bincount(inverse, weights=v)
+    maxes = np.full(len(uniq), -np.inf)
+    np.maximum.at(maxes, inverse, v)
+    bins = [
+        hist.FrequencyBin(lower=float(lowers[i]),
+                          upper=float(lowers[i + 1]),
+                          count=int(c),
+                          sum=float(s),
+                          max=float(m))
+        for i, c, s, m in zip(uniq, counts, sums, maxes)
+    ]
+    return hist.Histogram(name, bins)
+
+
+def compute_dataset_histograms_columnar(data) -> hist.DatasetHistograms:
+    """All seven histograms from ColumnarData in vectorized numpy.
+
+    Produces the same Histogram objects as the per-row pipeline (pinned by
+    tests/dataset_histograms_test.py), at columnar speed: one int64
+    group-by via np.unique plus bincounts.
+    """
+    from pipelinedp_tpu.ops import encoding
+
+    pid_ids, _ = encoding._factorize(np.asarray(data.pid))
+    pk_ids, pk_uniques = encoding._factorize(np.asarray(data.pk))
+    n_pk = max(len(pk_uniques), 1)
+    value = (np.asarray(data.value, dtype=np.float64)
+             if data.value is not None else np.zeros(len(pk_ids)))
+
+    group_key = pid_ids.astype(np.int64) * n_pk + pk_ids
+    uniq_g, g_inverse, g_counts = np.unique(group_key,
+                                            return_inverse=True,
+                                            return_counts=True)
+    g_sums = np.bincount(g_inverse, weights=value)
+    g_pid = uniq_g // n_pk
+    g_pk = (uniq_g % n_pk).astype(np.int64)
+
+    return hist.DatasetHistograms(
+        _int_histogram_from_values(np.bincount(g_pid),
+                                   hist.HistogramType.L0_CONTRIBUTIONS),
+        _int_histogram_from_values(np.bincount(pid_ids),
+                                   hist.HistogramType.L1_CONTRIBUTIONS),
+        _int_histogram_from_values(g_counts,
+                                   hist.HistogramType.LINF_CONTRIBUTIONS),
+        _float_histogram_from_values(
+            g_sums, hist.HistogramType.LINF_SUM_CONTRIBUTIONS),
+        _int_histogram_from_values(np.bincount(pk_ids),
+                                   hist.HistogramType.COUNT_PER_PARTITION),
+        _int_histogram_from_values(
+            np.bincount(g_pk),
+            hist.HistogramType.COUNT_PRIVACY_ID_PER_PARTITION),
+        _float_histogram_from_values(
+            np.bincount(pk_ids, weights=value),
+            hist.HistogramType.SUM_PER_PARTITION),
+    )

@@ -125,96 +125,68 @@ def level_counts(leaf_hist: np.ndarray, branching: int,
     return levels
 
 
-def walk_quantiles(noised_levels: Sequence[np.ndarray],
-                   quantiles: Sequence[float], lower: float, upper: float,
-                   branching: int) -> np.ndarray:
-    """[num_partitions, num_quantiles] quantile estimates from noised levels.
-
-    Vectorized twin of QuantileTree._locate_quantile: descend level by
-    level following the target rank; partitions whose subtree total drops
-    to <= 0 resolve to the middle of their current range.
-    """
+def _walk_impl(xp, noised_levels, quantiles_arr, lower, upper,
+               branching: int, dtype, tiny):
+    """The tree descent, shared by the host and device walks (xp = numpy
+    or jax.numpy): descend level by level following the target rank;
+    partitions whose subtree total drops to <= 0 resolve to the middle of
+    their current range. Vectorized twin of
+    QuantileTree._locate_quantile."""
     b = branching
     num_partitions = noised_levels[0].shape[0]
-    num_q = len(quantiles)
-    node = np.zeros((num_partitions, num_q), dtype=np.int64)
-    lo = np.full((num_partitions, num_q), lower, dtype=np.float64)
-    hi = np.full((num_partitions, num_q), upper, dtype=np.float64)
-    target = np.tile(np.asarray(quantiles, dtype=np.float64),
-                     (num_partitions, 1))
-    dead = np.zeros((num_partitions, num_q), dtype=bool)
-    dead_result = np.zeros((num_partitions, num_q), dtype=np.float64)
+    num_q = quantiles_arr.shape[0]
+    node = xp.zeros((num_partitions, num_q), dtype=xp.int32)
+    lo = xp.full((num_partitions, num_q), lower, dtype=dtype)
+    hi = xp.full((num_partitions, num_q), upper, dtype=dtype)
+    target = xp.tile(quantiles_arr.astype(dtype), (num_partitions, 1))
+    dead = xp.zeros((num_partitions, num_q), dtype=bool)
+    dead_result = xp.zeros((num_partitions, num_q), dtype=dtype)
 
     for level_nodes in noised_levels:
-        lvl = np.maximum(np.asarray(level_nodes, dtype=np.float64), 0.0)
-        idx = node[:, :, None] * b + np.arange(b)  # [P, Q, b]
-        children = np.take_along_axis(lvl[:, None, :], idx, axis=2)
+        lvl = xp.maximum(level_nodes.astype(dtype), 0.0)
+        idx = node[:, :, None] * b + xp.arange(b, dtype=xp.int32)  # [P,Q,b]
+        children = xp.take_along_axis(lvl[:, None, :], idx, axis=2)
         total = children.sum(axis=2)
         newly_dead = ~dead & (total <= 0)
-        dead_result = np.where(newly_dead, lo + (hi - lo) / 2, dead_result)
-        dead |= newly_dead
-        cum = np.cumsum(children, axis=2)
+        dead_result = xp.where(newly_dead, lo + (hi - lo) / 2, dead_result)
+        dead = dead | newly_dead
+        cum = xp.cumsum(children, axis=2)
         rank = target * total
         # searchsorted(cum, rank, side="right"), clipped to the last child.
-        child = np.minimum((cum <= rank[:, :, None]).sum(axis=2), b - 1)
-        child_count = np.take_along_axis(children, child[:, :, None],
+        child = xp.minimum((cum <= rank[:, :, None]).sum(axis=2), b - 1)
+        child_count = xp.take_along_axis(children, child[:, :, None],
                                          axis=2)[:, :, 0]
-        below = np.take_along_axis(cum, child[:, :, None],
+        below = xp.take_along_axis(cum, child[:, :, None],
                                    axis=2)[:, :, 0] - child_count
-        target = np.where(child_count > 0,
-                          (rank - below) / np.maximum(child_count, 1e-300),
+        target = xp.where(child_count > 0,
+                          (rank - below) / xp.maximum(child_count, tiny),
                           0.5)
-        target = np.clip(target, 0.0, 1.0)
+        target = xp.clip(target, 0.0, 1.0)
         width = (hi - lo) / b
         lo = lo + child * width
         hi = lo + width
         node = node * b + child
     out = lo + target * (hi - lo)
-    return np.where(dead, dead_result, out)
+    return xp.where(dead, dead_result, out)
+
+
+def walk_quantiles(noised_levels: Sequence[np.ndarray],
+                   quantiles: Sequence[float], lower: float, upper: float,
+                   branching: int) -> np.ndarray:
+    """[num_partitions, num_quantiles] quantile estimates (host, float64)."""
+    levels = [np.asarray(lvl, dtype=np.float64) for lvl in noised_levels]
+    return _walk_impl(np, levels, np.asarray(quantiles, dtype=np.float64),
+                      lower, upper, branching, np.float64, 1e-300)
 
 
 @functools.partial(jax.jit, static_argnames=("branching",))
 def walk_quantiles_device(noised_levels, quantiles_arr: jnp.ndarray,
                           lower, upper, *, branching: int) -> jnp.ndarray:
-    """Device twin of walk_quantiles: same descent, jnp ops, so the
-    O(partitions x nodes) noised levels never leave the device — only the
-    [partitions, quantiles] result does."""
-    b = branching
-    num_partitions = noised_levels[0].shape[0]
-    num_q = quantiles_arr.shape[0]
-    node = jnp.zeros((num_partitions, num_q), dtype=jnp.int32)
-    lo = jnp.full((num_partitions, num_q), lower, dtype=jnp.float32)
-    hi = jnp.full((num_partitions, num_q), upper, dtype=jnp.float32)
-    target = jnp.tile(quantiles_arr.astype(jnp.float32),
-                      (num_partitions, 1))
-    dead = jnp.zeros((num_partitions, num_q), dtype=bool)
-    dead_result = jnp.zeros((num_partitions, num_q), dtype=jnp.float32)
-
-    for level_nodes in noised_levels:
-        lvl = jnp.maximum(level_nodes.astype(jnp.float32), 0.0)
-        idx = node[:, :, None] * b + jnp.arange(b, dtype=jnp.int32)
-        children = jnp.take_along_axis(lvl[:, None, :], idx, axis=2)
-        total = children.sum(axis=2)
-        newly_dead = ~dead & (total <= 0)
-        dead_result = jnp.where(newly_dead, lo + (hi - lo) / 2, dead_result)
-        dead = dead | newly_dead
-        cum = jnp.cumsum(children, axis=2)
-        rank = target * total
-        child = jnp.minimum((cum <= rank[:, :, None]).sum(axis=2), b - 1)
-        child_count = jnp.take_along_axis(children, child[:, :, None],
-                                          axis=2)[:, :, 0]
-        below = jnp.take_along_axis(cum, child[:, :, None],
-                                    axis=2)[:, :, 0] - child_count
-        target = jnp.where(child_count > 0,
-                           (rank - below) / jnp.maximum(child_count, 1e-30),
-                           0.5)
-        target = jnp.clip(target, 0.0, 1.0)
-        width = (hi - lo) / b
-        lo = lo + child * width
-        hi = lo + width
-        node = node * b + child
-    out = lo + target * (hi - lo)
-    return jnp.where(dead, dead_result, out)
+    """Device twin of walk_quantiles (same _walk_impl descent, jnp ops,
+    float32) so the O(partitions x nodes) noised levels never leave the
+    device — only the [partitions, quantiles] result does."""
+    return _walk_impl(jnp, noised_levels, quantiles_arr, lower, upper,
+                      branching, jnp.float32, 1e-30)
 
 
 def noised_levels_host(levels: Sequence[np.ndarray], eps: float, delta: float,

@@ -1,6 +1,7 @@
 """Tests for dataset histograms (mirrors tests/dataset_histograms/ in the
 reference)."""
 
+import numpy as np
 import pytest
 
 import pipelinedp_tpu as pdp
@@ -146,3 +147,77 @@ class TestHistogramMethods:
         h = hist.Histogram(hist.HistogramType.L0_CONTRIBUTIONS, [])
         with pytest.raises(ValueError):
             h.quantiles([0.5])
+
+
+class TestColumnarHistograms:
+    """The columnar fast path must produce bit-identical Histogram objects
+    to the per-row pipeline."""
+
+    def _row_histograms(self, rows):
+        result = list(
+            ch.compute_dataset_histograms(rows, extractors(),
+                                          pdp.LocalBackend()))
+        return result[0]
+
+    def _columnar_histograms(self, rows):
+        pid = np.array([r[0] for r in rows])
+        pk = np.array([r[1] for r in rows])
+        value = np.array([r[2] for r in rows])
+        result = list(
+            ch.compute_dataset_histograms(
+                pdp.ColumnarData(pid=pid, pk=pk, value=value), None, None))
+        return result[0]
+
+    def _assert_histograms_equal(self, a, b):
+        import dataclasses
+        for field in dataclasses.fields(a):
+            ha = getattr(a, field.name)
+            hb = getattr(b, field.name)
+            assert (ha is None) == (hb is None), field.name
+            if ha is None:
+                continue
+            assert len(ha.bins) == len(hb.bins), field.name
+            for ba, bb in zip(ha.bins, hb.bins):
+                assert ba.lower == pytest.approx(bb.lower), field.name
+                assert ba.upper == pytest.approx(bb.upper), field.name
+                assert ba.count == bb.count, field.name
+                assert ba.sum == pytest.approx(bb.sum), field.name
+                assert ba.max == pytest.approx(bb.max), field.name
+
+    def test_matches_row_pipeline_random(self):
+        rng = np.random.default_rng(0)
+        rows = [(int(rng.integers(0, 40)), int(rng.integers(0, 15)),
+                 float(rng.uniform(-3, 20))) for _ in range(3000)]
+        self._assert_histograms_equal(self._row_histograms(rows),
+                                      self._columnar_histograms(rows))
+
+    def test_matches_row_pipeline_heavy_hitters(self):
+        # Exercise the log-binning boundaries: counts beyond 1000 and
+        # exact powers of ten.
+        rows = []
+        for i in range(1500):
+            rows.append((1, 1, 1.0))
+        for i in range(1000):
+            rows.append((2, 2, 2.0))
+        for i in range(10000):
+            rows.append((3, 3, 0.5))
+        rows.append((4, 4, 7.0))
+        self._assert_histograms_equal(self._row_histograms(rows),
+                                      self._columnar_histograms(rows))
+
+    def test_constant_values_single_float_bin(self):
+        rows = [(u, 0, 2.5) for u in range(10)]
+        cols = self._columnar_histograms(rows)
+        assert len(cols.linf_sum_contributions_histogram.bins) == 1
+        self._assert_histograms_equal(self._row_histograms(rows),
+                                      cols)
+
+    def test_scales_to_millions(self):
+        rng = np.random.default_rng(1)
+        n = 3_000_000
+        data = pdp.ColumnarData(pid=rng.integers(0, 300_000, n),
+                                pk=rng.integers(0, 50_000, n),
+                                value=rng.uniform(0, 10, n))
+        result = list(ch.compute_dataset_histograms(data, None, None))[0]
+        n_users = len(np.unique(data.pid))
+        assert result.l0_contributions_histogram.total_count() == n_users
