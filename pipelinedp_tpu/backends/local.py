@@ -22,6 +22,12 @@ from pipelinedp_tpu.backends import base
 from pipelinedp_tpu.sampling_utils import choose_from_list_without_replacement
 
 
+def _add(a, b):
+    # Module-level (not a lambda) so sum_per_key stays picklable for the
+    # multiprocess backend's "processes" mode.
+    return a + b
+
+
 class LocalBackend(base.PipelineBackend):
     """Lazy single-process backend over Python iterables."""
 
@@ -109,7 +115,7 @@ class LocalBackend(base.PipelineBackend):
         return gen()
 
     def sum_per_key(self, col, stage_name: str = None):
-        return self.reduce_per_key(col, lambda a, b: a + b, stage_name)
+        return self.reduce_per_key(col, _add, stage_name)
 
     def combine_accumulators_per_key(self, col, combiner,
                                      stage_name: str = None):

@@ -228,6 +228,10 @@ def compute_dataset_histograms(col, data_extractors: DataExtractors,
     (compute_dataset_histograms_columnar); extractors/backend are unused
     there."""
     from pipelinedp_tpu.ops import encoding as _encoding
+    if isinstance(col, _encoding.EncodedColumns):
+        # Dense ids are just a special case of raw columns here (histogram
+        # semantics never decode keys).
+        col = _encoding.ColumnarData(pid=col.pid, pk=col.pk, value=col.value)
     if isinstance(col, _encoding.ColumnarData):
         return [compute_dataset_histograms_columnar(col)]
     col_with_values = backend.map(
@@ -353,14 +357,13 @@ def _int_histogram_from_values(values: np.ndarray,
     v = v[v > 0]
     if len(v) == 0:
         return hist.Histogram(name, [])
-    # Minimal power of 10 >= max(v, 1000), with fix-ups for float log
-    # error at exact powers.
-    exp = np.maximum(3, np.ceil(np.log10(np.maximum(v, 1)))).astype(np.int64)
-    too_big = (exp > 3) & (v.astype(np.float64) <= 10.0**(exp - 1))
-    exp = np.where(too_big, exp - 1, exp)
-    too_small = v.astype(np.float64) > 10.0**exp
-    exp = np.where(too_small, exp + 1, exp)
-    bound = (10.0**exp).astype(np.int64)
+    # Minimal power of 10 >= max(v, 1000), exact integer arithmetic via a
+    # power table (float log would wobble at exact powers of ten).
+    powers = 10**np.arange(3, 19, dtype=np.int64)
+    if v.max() > powers[-1]:
+        raise ValueError(
+            f"{name}: contribution counts above 1e18 are not supported")
+    bound = powers[np.searchsorted(powers, v, side="left")]
     round_base = bound // 1000
     lower = v // round_base * round_base
     bin_size = np.where(v != bound, round_base, round_base * 10)
@@ -434,6 +437,10 @@ def compute_dataset_histograms_columnar(data) -> hist.DatasetHistograms:
     n_pk = max(len(pk_uniques), 1)
     value = (np.asarray(data.value, dtype=np.float64)
              if data.value is not None else np.zeros(len(pk_ids)))
+    if value.ndim != 1:
+        raise ValueError(
+            "dataset histograms need scalar values; vector-valued "
+            f"ColumnarData (shape {value.shape}) is not supported")
 
     group_key = pid_ids.astype(np.int64) * n_pk + pk_ids
     uniq_g, g_inverse, g_counts = np.unique(group_key,

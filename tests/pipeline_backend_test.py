@@ -198,3 +198,37 @@ class TestUniqueLabels:
         b = gen.unique("stage")
         assert a != b
         assert "stage" in a and "stage" in b
+
+
+def _double(x):
+    return x * 2
+
+
+def _concat(a, b):
+    return a + b
+
+
+class TestMultiProcProcessesMode:
+    """'processes' mode needs picklable functions; the chunk-fn classes in
+    backends/local.py are module-level so fork-based pools work."""
+
+    def test_map_and_reduce(self):
+        backend = pdp.MultiProcLocalBackend(n_jobs=2, mode="processes",
+                                            chunksize=5)
+        out = list(backend.map(range(100), _double, "map"))
+        assert out == [2 * x for x in range(100)]
+        pairs = [(i % 3, "x") for i in range(30)]
+        reduced = dict(backend.reduce_per_key(pairs, _concat, "reduce"))
+        assert reduced == {0: "x" * 10, 1: "x" * 10, 2: "x" * 10}
+
+    def test_invalid_mode_rejected(self):
+        with pytest.raises(ValueError, match="mode"):
+            pdp.MultiProcLocalBackend(mode="fibers")
+
+    def test_sum_per_key_processes_mode(self):
+        # sum_per_key's reducer must be picklable (was a lambda).
+        backend = pdp.MultiProcLocalBackend(n_jobs=2, mode="processes",
+                                            chunksize=7)
+        out = dict(backend.sum_per_key([(i % 5, 2) for i in range(1000)],
+                                       "s"))
+        assert out == {k: 400 for k in range(5)}
