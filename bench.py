@@ -35,7 +35,10 @@ L0_CAP = 8
 LINF_CAP = 4
 EPS, DELTA = 1.0, 1e-6
 
-CPU_ROWS = int(os.environ.get("BENCH_CPU_ROWS", 200_000))
+# 2M rows / 20k partitions: big enough that the partitions/sec extrapolation
+# to the 100M-row workload rests on a 50x smaller gap (LocalBackend cost is
+# linear in rows; density held equal), small enough to finish in ~30 s.
+CPU_ROWS = int(os.environ.get("BENCH_CPU_ROWS", 2_000_000))
 CPU_PARTITIONS = max(CPU_ROWS * N_PARTITIONS // N_ROWS, 1)
 
 

@@ -16,16 +16,11 @@ import collections
 import concurrent.futures
 import itertools
 import multiprocessing
+import operator
 from typing import Callable, Iterable
 
 from pipelinedp_tpu.backends import base
 from pipelinedp_tpu.sampling_utils import choose_from_list_without_replacement
-
-
-def _add(a, b):
-    # Module-level (not a lambda) so sum_per_key stays picklable for the
-    # multiprocess backend's "processes" mode.
-    return a + b
 
 
 class LocalBackend(base.PipelineBackend):
@@ -115,7 +110,9 @@ class LocalBackend(base.PipelineBackend):
         return gen()
 
     def sum_per_key(self, col, stage_name: str = None):
-        return self.reduce_per_key(col, _add, stage_name)
+        # operator.add: picklable by reference, unlike a lambda (the
+        # multiprocess backend's 'processes' mode ships it to workers).
+        return self.reduce_per_key(col, operator.add, stage_name)
 
     def combine_accumulators_per_key(self, col, combiner,
                                      stage_name: str = None):
@@ -160,7 +157,7 @@ class MultiProcLocalBackend(LocalBackend):
     Parallelizes the element-wise ops (map / flat_map / filter) across a
     worker pool while inheriting the shuffle ops from LocalBackend. Because
     arbitrary Python closures are not picklable, workers are threads by
-    default ("threads" mode); "processes" mode uses a fork-based pool and
+    default ("threads" mode); "processes" mode uses a process pool and
     requires picklable functions. The reference's equivalent
     (pipeline_backend.py:600-823) is likewise marked experimental with
     several ops unimplemented.
@@ -177,6 +174,11 @@ class MultiProcLocalBackend(LocalBackend):
     def _executor(self):
         if self._mode == "threads":
             return concurrent.futures.ThreadPoolExecutor(self._n_jobs)
+        # Platform-default start method (fork on Linux), like the
+        # reference's multiprocessing.Pool: spawn would re-import
+        # __main__, breaking stdin scripts and notebooks. The standard
+        # fork-from-threaded-process caveat applies; prefer "threads"
+        # mode unless the workload is CPU-bound Python.
         return concurrent.futures.ProcessPoolExecutor(self._n_jobs)
 
     def _parallel_chunks(self, col, chunk_fn: Callable):
