@@ -32,7 +32,9 @@ def main():
                         help="Netflix-prize format input; synthetic if unset")
     parser.add_argument("--output_file", default=None)
     parser.add_argument("--pld_accounting", action="store_true",
-                        help="PLD accounting instead of naive composition")
+                        help="PLD accounting instead of naive composition "
+                             "(implies public partitions 0..99: PLD does "
+                             "not support private partition selection)")
     parser.add_argument("--pre_threshold", type=int, default=None)
     parser.add_argument("--public_partitions", action="store_true",
                         help="Treat movies 0..99 as publicly known keys")
@@ -85,7 +87,14 @@ def main():
     if args.pre_threshold:
         params.pre_threshold = args.pre_threshold
 
-    public_partitions = list(range(100)) if args.public_partitions else None
+    # PLD accounting does not support private partition selection (parity
+    # with the reference engine, dp_engine.py:529-531) — the reference
+    # example likewise always passes public partitions.
+    use_public = args.public_partitions or args.pld_accounting
+    if args.pld_accounting and not args.public_partitions:
+        print("note: PLD accounting requires public partitions; using "
+              "movies 0..99 as publicly known keys")
+    public_partitions = list(range(100)) if use_public else None
 
     explain_computation_report = pdp.ExplainComputationReport()
     # Lazy: the result materializes only after compute_budgets().
