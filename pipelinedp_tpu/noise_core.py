@@ -32,6 +32,7 @@ import math
 from typing import Optional
 
 import numpy as np
+from scipy import special
 from scipy import stats
 
 # 2^-40: relative granularity for Laplace snapping (matches the construction
@@ -84,8 +85,12 @@ def gaussian_delta(sigma: float, eps: float, l2_sensitivity: float) -> float:
     s = l2_sensitivity
     a = s / (2.0 * sigma)
     b = eps * sigma / s
-    return float(
-        stats.norm.cdf(a - b) - math.exp(eps) * stats.norm.cdf(-a - b))
+    # e^eps Phi(-a-b) in log space: for large eps the exponential overflows
+    # while the product stays finite. In fact log_term = eps + log Phi(-a-b)
+    # <= eps - (a+b)^2/2 <= eps - 2ab = 0 by AM-GM (2ab = eps), so the
+    # product is always <= 1; exp never overflows.
+    log_term = eps + special.log_ndtr(-a - b)
+    return float(stats.norm.cdf(a - b) - math.exp(log_term))
 
 
 def analytic_gaussian_sigma(eps: float, delta: float,

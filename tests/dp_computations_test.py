@@ -273,3 +273,47 @@ class TestNoiseStdHelpers:
             noise_kind=pdp.NoiseKind.GAUSSIAN)
         expected = dp.compute_sigma(1.0, 1e-6, 4.0 * 2)  # l2 = sqrt(4)*4
         assert dp.compute_dp_sum_noise_std(params) == pytest.approx(expected)
+
+
+class TestGaussianCalibrationLargeEps:
+    """gaussian_delta must stay finite for arbitrarily large epsilon
+    (e^eps Phi(-a-b) evaluated in log space) — huge-eps Gaussian configs
+    are the standard no-noise testing pattern."""
+
+    def test_delta_finite_at_large_eps(self):
+        # Finite for ALL inputs: log_term <= 0 by AM-GM, so the exp term
+        # is <= 1 (slightly negative deltas are legitimate — the
+        # expression under-shoots zero when sigma over-satisfies eps).
+        for eps in (10.0, 700.0, 1e4, 1e8):
+            for sigma in (1e-6, 1.0, 1e6):
+                d = noise_core.gaussian_delta(sigma, eps, 1.0)
+                assert math.isfinite(d) and d <= 1.0
+
+    def test_sigma_search_at_large_eps(self):
+        sigma = noise_core.analytic_gaussian_sigma(1e8, 1e-9, 1.0)
+        assert 0 < sigma < 1e-3
+        # Small-eps calibration unchanged by the log-space rewrite
+        # (Balle-Wang reference value).
+        ref = noise_core.analytic_gaussian_sigma(1.0, 1e-6, 1.0)
+        assert ref == pytest.approx(4.2247, abs=1e-3)
+
+    def test_mean_gaussian_huge_eps_end_to_end(self):
+        import pipelinedp_tpu as pdp
+        rows = [(u, 0, float(u % 4)) for u in range(40)]
+        ext = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                 partition_extractor=lambda r: r[1],
+                                 value_extractor=lambda r: r[2])
+        acc = pdp.NaiveBudgetAccountant(1e8, 1 - 1e-9)
+        engine = pdp.JaxDPEngine(acc, secure_host_noise=False)
+        res = engine.aggregate(
+            rows,
+            pdp.AggregateParams(metrics=[pdp.Metrics.MEAN],
+                                noise_kind=pdp.NoiseKind.GAUSSIAN,
+                                max_partitions_contributed=1,
+                                max_contributions_per_partition=1,
+                                min_value=0.0,
+                                max_value=3.0),
+            ext, public_partitions=[0])
+        acc.compute_budgets()
+        out = dict(res)
+        assert out[0].mean == pytest.approx(1.5, abs=0.05)
