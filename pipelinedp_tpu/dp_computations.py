@@ -152,6 +152,20 @@ def _clip_vector(vec: np.ndarray, max_norm: float,
         f"Vector norm of kind '{kind}' is not supported.")
 
 
+def vector_noise_stddev(noise_params: AdditiveVectorNoiseParams) -> float:
+    """Per-coordinate noise stddev of add_noise_vector's mechanism."""
+    if noise_params.noise_kind == NoiseKind.LAPLACE:
+        scale = noise_core.laplace_diversity(
+            noise_params.eps_per_coordinate,
+            compute_l1_sensitivity(noise_params.l0_sensitivity,
+                                   noise_params.linf_sensitivity))
+        return scale * math.sqrt(2.0)
+    return compute_sigma(
+        noise_params.eps_per_coordinate, noise_params.delta_per_coordinate,
+        compute_l2_sensitivity(noise_params.l0_sensitivity,
+                               noise_params.linf_sensitivity))
+
+
 def add_noise_vector(vec: np.ndarray,
                      noise_params: AdditiveVectorNoiseParams) -> np.ndarray:
     """Clips the vector to max_norm and noises each coordinate."""
@@ -542,20 +556,31 @@ class ExponentialMechanism:
         def is_monotonic(self) -> bool:
             """Whether neighboring datasets move all scores one direction."""
 
-    _rng = np.random.default_rng()
+    # Candidate draws are DP releases (calculate_private_contribution_bounds
+    # publishes the result), so the uniform comes from noise_core's secure
+    # sampler; seed_rng swaps in a seeded numpy Generator for tests.
+    _seeded_rng: Optional[np.random.Generator] = None
 
     @classmethod
     def seed_rng(cls, seed: Optional[int]) -> None:
-        """Reseeds the selection RNG (tests only)."""
-        cls._rng = np.random.default_rng(seed)
+        """Routes selection draws through a seeded numpy RNG (tests only).
+
+        Pass seed_rng(None) to restore the secure non-replayable source.
+        """
+        cls._seeded_rng = None if seed is None else np.random.default_rng(seed)
 
     def __init__(self, scoring_function: "ExponentialMechanism.ScoringFunction"):
         self._scoring_function = scoring_function
 
     def apply(self, eps: float, inputs_to_score_col: List[Any]) -> Any:
         probs = self._calculate_probabilities(eps, inputs_to_score_col)
-        index = ExponentialMechanism._rng.choice(len(inputs_to_score_col),
-                                                 p=probs)
+        if ExponentialMechanism._seeded_rng is not None:
+            u = ExponentialMechanism._seeded_rng.random()
+        else:
+            u = noise_core.sample_uniform()
+        # Inverse-CDF draw: first index whose cumulative probability exceeds u.
+        index = min(int(np.searchsorted(np.cumsum(probs), u, side="right")),
+                    len(probs) - 1)
         return inputs_to_score_col[index]
 
     def _calculate_probabilities(self, eps: float,

@@ -49,7 +49,8 @@ def _build(stem: str) -> bool:
         return False
 
 
-def _load_lib(stem: str, abi_symbol: str) -> Optional[ctypes.CDLL]:
+def _load_lib(stem: str, abi_symbol: str,
+              abi_version: int = 1) -> Optional[ctypes.CDLL]:
     """Builds (if stale/missing) and loads native/<stem>.cc; caches."""
     with _lock:
         if stem in _libs:
@@ -62,23 +63,38 @@ def _load_lib(stem: str, abi_symbol: str) -> Optional[ctypes.CDLL]:
             if not _build(stem):
                 _libs[stem] = None
                 return None
-        try:
-            lib = ctypes.CDLL(so)
-            abi = getattr(lib, abi_symbol)
-            abi.restype = ctypes.c_int
-            if abi() != 1:
-                raise OSError("ABI version mismatch")
-        except OSError as e:
-            logging.info("pipelinedp_tpu.native: load of %s failed (%s)",
-                         stem, e)
-            lib = None
+        lib = _try_load(so, abi_symbol, abi_version)
+        if lib is None and os.path.exists(src):
+            # A stale prebuilt .so can pass the mtime check (archive
+            # extraction and docker COPY normalize mtimes) yet miss the
+            # current ABI; rebuild from source once before giving up.
+            logging.info(
+                "pipelinedp_tpu.native: %s failed to load; rebuilding "
+                "from source", stem)
+            if _build(stem):
+                lib = _try_load(so, abi_symbol, abi_version)
         _libs[stem] = lib
         return lib
 
 
+def _try_load(so: str, abi_symbol: str,
+              abi_version: int) -> Optional[ctypes.CDLL]:
+    try:
+        lib = ctypes.CDLL(so)
+        abi = getattr(lib, abi_symbol)
+        abi.restype = ctypes.c_int
+        if abi() != abi_version:
+            raise OSError(f"ABI version mismatch (want {abi_version}, "
+                          f"got {abi()})")
+        return lib
+    except OSError as e:
+        logging.info("pipelinedp_tpu.native: load of %s failed (%s)", so, e)
+        return None
+
+
 def load() -> Optional[ctypes.CDLL]:
     """The secure-noise library, building it if needed; None on failure."""
-    lib = _load_lib("secure_noise", "pdp_noise_abi_version")
+    lib = _load_lib("secure_noise", "pdp_noise_abi_version", abi_version=2)
     if lib is not None and not getattr(lib, "_pdp_typed", False):
         for name in ("pdp_sample_discrete_laplace",
                      "pdp_sample_discrete_gaussian"):
@@ -88,6 +104,9 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
                 ctypes.c_double
             ]
+        fn = lib.pdp_sample_uniform_double
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.POINTER(ctypes.c_double), ctypes.c_int64]
         lib._pdp_typed = True
     return lib
 
@@ -153,6 +172,16 @@ def install() -> bool:
         noise = ints.astype(np.float64) * g
         return float(noise[0]) if size is None else noise.reshape(size)
 
+    def native_uniform(size=None):
+        n = 1 if size is None else int(np.prod(size))
+        out = np.empty(max(n, 1), dtype=np.float64)
+        rc = lib.pdp_sample_uniform_double(
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n)
+        if rc != 0:
+            raise ValueError("native uniform sampler failed")
+        return float(out[0]) if size is None else out[:n].reshape(size)
+
     noise_core.sample_laplace = native_laplace
     noise_core.sample_gaussian = native_gaussian
+    noise_core.sample_uniform = native_uniform
     return True

@@ -87,13 +87,28 @@ class EntropyBuffer {
 
 thread_local EntropyBuffer tl_entropy;
 
-// Bernoulli(p): bias <= 2^-64.
+// Bernoulli(p): bias <= 2^-64. The threshold p * 2^64 is computed exactly
+// in 128-bit integer arithmetic from p's (mantissa, exponent) decomposition
+// — no extended-precision float type involved, so the bound holds on every
+// ABI (long double == double included); only the sub-2^-64 fractional part
+// of the threshold is truncated, the same concession as a 64-bit uniform.
 inline bool Bernoulli(double p) {
   if (p <= 0.0) return false;
   if (p >= 1.0) return true;
-  // p * 2^64, computed in long double to keep the comparison monotone.
-  long double threshold = static_cast<long double>(p) * 1.8446744073709551616e19L;
-  return static_cast<long double>(tl_entropy.NextU64()) < threshold;
+  int e;
+  double m = std::frexp(p, &e);  // p = m * 2^e, m in [0.5, 1), e <= 0
+  // 53-bit integer mantissa, exact: p = mant * 2^(e-53).
+  uint64_t mant = static_cast<uint64_t>(std::ldexp(m, 53));
+  int shift = e + 11;  // p * 2^64 = mant * 2^shift
+  unsigned __int128 threshold;
+  if (shift >= 0) {
+    threshold = static_cast<unsigned __int128>(mant) << shift;
+  } else if (shift > -64) {
+    threshold = mant >> -shift;  // truncation bias < 2^-64
+  } else {
+    threshold = 0;  // p < 2^-75: below the uniform's resolution
+  }
+  return static_cast<unsigned __int128>(tl_entropy.NextU64()) < threshold;
 }
 
 // Unbiased Uniform{0, ..., n-1} by rejection.
@@ -157,8 +172,8 @@ inline int64_t DiscreteGaussian(double sigma) {
   }
 }
 
-template <typename Fn>
-void ParallelFill(int64_t* out, int64_t n, const Fn& sample_one) {
+template <typename T, typename Fn>
+void ParallelFill(T* out, int64_t n, const Fn& sample_one) {
   const int64_t kMinPerThread = 1 << 15;
   unsigned hw = std::thread::hardware_concurrency();
   int64_t max_threads = n / kMinPerThread;
@@ -184,7 +199,7 @@ void ParallelFill(int64_t* out, int64_t n, const Fn& sample_one) {
 extern "C" {
 
 // ABI version for the Python loader's sanity check.
-int pdp_noise_abi_version() { return 1; }
+int pdp_noise_abi_version() { return 2; }
 
 // n samples of discrete Laplace with scale t_units (rounded to >= 1
 // integer units). Returns 0 on success.
@@ -201,6 +216,19 @@ int pdp_sample_discrete_gaussian(int64_t* out, int64_t n,
   if (!out || n < 0 || !(sigma_units > 0) || !std::isfinite(sigma_units))
     return 1;
   ParallelFill(out, n, [sigma_units] { return DiscreteGaussian(sigma_units); });
+  return 0;
+}
+
+// n uniform doubles in [0, 1) with full 53-bit precision, drawn from the
+// kernel CSPRNG. Backs partition-selection keep decisions and exponential-
+// mechanism draws: those comparisons ("u < keep_probability") are exactly as
+// release-critical as additive noise, so they must not ride a seedable
+// userspace PRNG.
+int pdp_sample_uniform_double(double* out, int64_t n) {
+  if (!out || n < 0) return 1;
+  ParallelFill(out, n, [] {
+    return static_cast<double>(tl_entropy.NextU64() >> 11) * 0x1.0p-53;
+  });
   return 0;
 }
 

@@ -137,6 +137,7 @@ def laplace_diversity(eps: float, l1_sensitivity: float) -> float:
 # because backends may draw noise from worker threads.
 # ---------------------------------------------------------------------------
 
+import logging as _logging
 import threading as _threading
 
 _rng = np.random.default_rng()
@@ -148,10 +149,11 @@ def seed_fallback_rng(seed: Optional[int]) -> None:
     (seedable) fallback — secure native noise is deliberately not
     replayable, so deterministic tests must opt out of it. Call
     pipelinedp_tpu.native.install() to restore the native path."""
-    global _rng, sample_laplace, sample_gaussian
+    global _rng, sample_laplace, sample_gaussian, sample_uniform
     _rng = np.random.default_rng(seed)
     sample_laplace = _fallback_laplace
     sample_gaussian = _fallback_gaussian
+    sample_uniform = _fallback_uniform
 
 
 def _fallback_laplace(scale: float, size=None):
@@ -168,44 +170,81 @@ def _fallback_gaussian(stddev: float, size=None):
     return round_to_granularity(raw, g)
 
 
+def _fallback_uniform(size=None):
+    with _rng_lock:
+        return _rng.random() if size is None else _rng.random(size)
+
+
 _native_attempted = False
 
 
 def _try_native_install() -> None:
-    """One attempt to build/load the native samplers (rebinds the hooks)."""
+    """One attempt to build/load the native samplers (rebinds the hooks).
+
+    The first draw may shell out to g++ (see native/loader.py); deployments
+    that cannot afford that latency on the first DP release should warm up
+    explicitly with pipelinedp_tpu.native.install().
+    """
     global _native_attempted
     if _native_attempted:
         return
     _native_attempted = True
     try:
         from pipelinedp_tpu.native import loader as native_loader
-        native_loader.install()
-    except Exception:  # noqa: BLE001 — native failure must not break noise
-        pass
+        ok = native_loader.install()
+    except Exception as e:  # noqa: BLE001 — native failure must not break noise
+        _logging.warning(
+            "pipelinedp_tpu: native secure-noise install raised %r; "
+            "falling back to the seedable numpy samplers "
+            "(distributionally equivalent, weaker bit-level guarantees)", e)
+    else:
+        if not ok:
+            _logging.warning(
+                "pipelinedp_tpu: native secure-noise library unavailable "
+                "(no compiler, or the build failed — details at INFO "
+                "level); noise and selection draws use the seedable numpy "
+                "fallback. Warm up at startup with "
+                "pipelinedp_tpu.native.install() to control when the "
+                "build cost is paid, or ship a prebuilt _secure_noise "
+                "shared object matching the current ABI.")
 
 
 def _autoload_laplace(scale: float, size=None):
-    global sample_laplace, sample_gaussian
+    global sample_laplace, sample_gaussian, sample_uniform
     _try_native_install()
     if sample_laplace is _autoload_laplace:  # native unavailable
-        sample_laplace = _fallback_laplace
-        sample_gaussian = _fallback_gaussian
+        _bind_fallbacks()
     return sample_laplace(scale, size)
 
 
 def _autoload_gaussian(stddev: float, size=None):
-    global sample_laplace, sample_gaussian
+    global sample_gaussian
     _try_native_install()
     if sample_gaussian is _autoload_gaussian:
-        sample_laplace = _fallback_laplace
-        sample_gaussian = _fallback_gaussian
+        _bind_fallbacks()
     return sample_gaussian(stddev, size)
+
+
+def _autoload_uniform(size=None):
+    global sample_uniform
+    _try_native_install()
+    if sample_uniform is _autoload_uniform:
+        _bind_fallbacks()
+    return sample_uniform(size)
+
+
+def _bind_fallbacks() -> None:
+    global sample_laplace, sample_gaussian, sample_uniform
+    sample_laplace = _fallback_laplace
+    sample_gaussian = _fallback_gaussian
+    sample_uniform = _fallback_uniform
 
 
 # Hook points: rebound to the native C++ samplers on first draw (or to the
 # numpy fallback when no native build is possible / after seed_fallback_rng).
 sample_laplace = _autoload_laplace
 sample_gaussian = _autoload_gaussian
+sample_uniform = _autoload_uniform
 
 
 def using_native_sampling() -> bool:

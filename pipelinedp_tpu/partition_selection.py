@@ -44,22 +44,32 @@ PARTITION_STRATEGY_ENUM_TO_STR = {
     PartitionSelectionStrategy.GAUSSIAN_THRESHOLDING: "gaussian",
 }
 
-_rng = np.random.default_rng()
-# Selection decisions may be drawn from backend worker threads
-# (MultiProcLocalBackend parallelizes filter/map_values); numpy Generators
-# are not thread-safe, so draws go through this lock.
+# Keep decisions ("uniform < keep_probability") are as release-critical as
+# additive noise, so uniforms come from noise_core.sample_uniform — the
+# native kernel-CSPRNG sampler when available (secure_noise.cc
+# pdp_sample_uniform_double), never replayable. seed_rng routes draws
+# through a private seeded numpy Generator instead (tests only); the lock
+# covers backend worker threads (MultiProcLocalBackend parallelizes
+# filter/map_values) since numpy Generators are not thread-safe.
+_seeded_rng: Optional[np.random.Generator] = None
 _rng_lock = threading.Lock()
 
 
 def seed_rng(seed: Optional[int]) -> None:
-    """Reseeds the selection RNG (tests only)."""
-    global _rng
-    _rng = np.random.default_rng(seed)
+    """Routes selection draws through a seeded numpy RNG (tests only).
+
+    Pass seed_rng(None) to restore the secure non-replayable source.
+    """
+    global _seeded_rng
+    _seeded_rng = None if seed is None else np.random.default_rng(seed)
 
 
 def _draw_uniform(shape=None):
-    with _rng_lock:
-        return _rng.random() if shape is None else _rng.random(shape)
+    if _seeded_rng is not None:
+        with _rng_lock:
+            return (_seeded_rng.random()
+                    if shape is None else _seeded_rng.random(shape))
+    return noise_core.sample_uniform(shape)
 
 
 def _per_partition_delta(delta: float, max_partitions: int) -> float:
