@@ -235,6 +235,12 @@ class AggregateParams:
     pre_threshold: Optional[int] = None
     post_aggregation_thresholding: bool = False
     perform_cross_partition_contribution_bounding: bool = True
+    # When True, the output carries a "<metric>_noise_stddev" column/field
+    # next to each released additive metric (COUNT, PRIVACY_ID_COUNT, SUM,
+    # VECTOR_SUM) stating the standard deviation of the noise that was
+    # added — useful for downstream error bars. Ratio metrics (MEAN,
+    # VARIANCE, PERCENTILE_*) have no single additive noise stddev and are
+    # rejected at validation time.
     output_noise_stddev: bool = False
 
     @property
@@ -282,6 +288,22 @@ class AggregateParams:
 
         if self.pre_threshold is not None:
             _require_positive_int(self.pre_threshold, "pre_threshold")
+
+        if self.output_noise_stddev:
+            if self.custom_combiners:
+                raise ValueError(
+                    "output_noise_stddev is not supported with custom "
+                    "combiners.")
+            supported = {
+                Metrics.COUNT, Metrics.PRIVACY_ID_COUNT, Metrics.SUM,
+                Metrics.VECTOR_SUM
+            }
+            unsupported = set(self.metrics or []) - supported
+            if unsupported:
+                raise ValueError(
+                    f"output_noise_stddev supports only additive metrics "
+                    f"(COUNT, PRIVACY_ID_COUNT, SUM, VECTOR_SUM); got "
+                    f"{sorted(str(m) for m in unsupported)}.")
 
     def _validate_metric_compatibility(self, value_bound: bool,
                                        partition_bound: bool) -> None:

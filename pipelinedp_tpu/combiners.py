@@ -174,6 +174,7 @@ class CountCombiner(Combiner, AdditiveMechanismMixin):
         self._mechanism_spec = mechanism_spec
         self._sensitivities = dp_computations.compute_sensitivities_for_count(
             aggregate_params)
+        self._output_noise_stddev = aggregate_params.output_noise_stddev
 
     def create_accumulator(self, values: Sized) -> int:
         return len(values)
@@ -182,9 +183,14 @@ class CountCombiner(Combiner, AdditiveMechanismMixin):
         return count1 + count2
 
     def compute_metrics(self, count: int) -> dict:
-        return {"count": self.get_mechanism().add_noise(count)}
+        out = {"count": self.get_mechanism().add_noise(count)}
+        if self._output_noise_stddev:
+            out["count_noise_stddev"] = self.get_mechanism().std
+        return out
 
     def metrics_names(self) -> List[str]:
+        if self._output_noise_stddev:
+            return ["count", "count_noise_stddev"]
         return ["count"]
 
     def explain_computation(self):
@@ -208,6 +214,7 @@ class PrivacyIdCountCombiner(Combiner, AdditiveMechanismMixin):
         self._sensitivities = (
             dp_computations.compute_sensitivities_for_privacy_id_count(
                 aggregate_params))
+        self._output_noise_stddev = aggregate_params.output_noise_stddev
 
     def create_accumulator(self, values: Sized) -> int:
         return 1 if values else 0
@@ -216,9 +223,14 @@ class PrivacyIdCountCombiner(Combiner, AdditiveMechanismMixin):
         return count1 + count2
 
     def compute_metrics(self, count: int) -> dict:
-        return {"privacy_id_count": self.get_mechanism().add_noise(count)}
+        out = {"privacy_id_count": self.get_mechanism().add_noise(count)}
+        if self._output_noise_stddev:
+            out["privacy_id_count_noise_stddev"] = self.get_mechanism().std
+        return out
 
     def metrics_names(self) -> List[str]:
+        if self._output_noise_stddev:
+            return ["privacy_id_count", "privacy_id_count_noise_stddev"]
         return ["privacy_id_count"]
 
     def explain_computation(self):
@@ -251,6 +263,7 @@ class PostAggregationThresholdingCombiner(Combiner, MechanismContainerMixin):
             dp_computations.compute_sensitivities_for_privacy_id_count(
                 aggregate_params))
         self._pre_threshold = aggregate_params.pre_threshold
+        self._output_noise_stddev = aggregate_params.output_noise_stddev
 
     def create_accumulator(self, values: Sized) -> int:
         return 1 if values else 0
@@ -259,12 +272,18 @@ class PostAggregationThresholdingCombiner(Combiner, MechanismContainerMixin):
         return count1 + count2
 
     def compute_metrics(self, count: int) -> dict:
-        return {
+        out = {
             "privacy_id_count":
                 self.get_mechanism().noised_value_if_should_keep(count)
         }
+        if self._output_noise_stddev:
+            out["privacy_id_count_noise_stddev"] = (
+                self.get_mechanism().strategy.noise_stddev)
+        return out
 
     def metrics_names(self) -> List[str]:
+        if self._output_noise_stddev:
+            return ["privacy_id_count", "privacy_id_count_noise_stddev"]
         return ["privacy_id_count"]
 
     def explain_computation(self):
@@ -294,6 +313,7 @@ class SumCombiner(Combiner, AdditiveMechanismMixin):
         self._mechanism_spec = mechanism_spec
         self._sensitivities = dp_computations.compute_sensitivities_for_sum(
             aggregate_params)
+        self._output_noise_stddev = aggregate_params.output_noise_stddev
         self._bounding_per_partition = (
             aggregate_params.bounds_per_partition_are_set)
         if self._bounding_per_partition:
@@ -317,9 +337,14 @@ class SumCombiner(Combiner, AdditiveMechanismMixin):
         return sum1 + sum2
 
     def compute_metrics(self, sum_: float) -> dict:
-        return {"sum": self.get_mechanism().add_noise(sum_)}
+        out = {"sum": self.get_mechanism().add_noise(sum_)}
+        if self._output_noise_stddev:
+            out["sum_noise_stddev"] = self.get_mechanism().std
+        return out
 
     def metrics_names(self) -> List[str]:
+        if self._output_noise_stddev:
+            return ["sum", "sum_noise_stddev"]
         return ["sum"]
 
     def expects_per_partition_sampling(self) -> bool:
@@ -653,13 +678,20 @@ class VectorSumCombiner(Combiner):
         return sum1 + sum2
 
     def compute_metrics(self, array_sum: np.ndarray) -> dict:
-        return {
+        out = {
             "vector_sum":
                 dp_computations.add_noise_vector(
                     array_sum, self._params.additive_vector_noise_params)
         }
+        if self._params.aggregate_params.output_noise_stddev:
+            out["vector_sum_noise_stddev"] = (
+                dp_computations.vector_noise_stddev(
+                    self._params.additive_vector_noise_params))
+        return out
 
     def metrics_names(self) -> List[str]:
+        if self._params.aggregate_params.output_noise_stddev:
+            return ["vector_sum", "vector_sum_noise_stddev"]
         return ["vector_sum"]
 
     def explain_computation(self):

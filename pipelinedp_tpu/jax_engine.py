@@ -442,6 +442,12 @@ class JaxDPEngine:
             linf_cap = max(len(pid), 1)
         l0_cap = (params.max_partitions_contributed
                   if params.max_partitions_contributed else num_partitions)
+        if not params.perform_cross_partition_contribution_bounding:
+            # Linf-only bounding (utility-analysis mode): noise stays
+            # calibrated to the declared L0 bound, but no partitions are
+            # dropped (parity: DPEngine._create_contribution_bounder,
+            # dp_engine.py:285-293).
+            l0_cap = num_partitions
         l1_cap = None
         if params.max_contributions is not None:
             # L1 bounding: a uniform sample of max_contributions rows per
@@ -472,10 +478,15 @@ class JaxDPEngine:
                 f"Per-partition contribution bounding: for each privacy_id "
                 f"and each partition, randomly select max(actual_"
                 f"contributions_per_partition, {linf_cap}) contributions.")
-            self._add_report_stage(
-                f"Cross-partition contribution bounding: for each privacy_id "
-                f"randomly select max(actual_partition_contributed, {l0_cap}) "
-                f"partitions")
+            if params.perform_cross_partition_contribution_bounding:
+                self._add_report_stage(
+                    f"Cross-partition contribution bounding: for each "
+                    f"privacy_id randomly select max(actual_partition_"
+                    f"contributed, {l0_cap}) partitions")
+            else:
+                self._add_report_stage(
+                    "Cross-partition contribution bounding: skipped "
+                    "(perform_cross_partition_contribution_bounding=False)")
         for stage in compound.explain_computation():
             self._add_report_stage(stage)
 
@@ -652,6 +663,10 @@ class JaxDPEngine:
                     thresh.strategy)
                 keep_mask = keep_mask & thresh_keep
                 columns["privacy_id_count"] = noised
+                if params.output_noise_stddev:
+                    columns["privacy_id_count_noise_stddev"] = np.full(
+                        num_out, float(thresh.strategy.noise_stddev),
+                        dtype=np.float64)
 
         # Mask metrics of non-kept partitions: direct consumers of the
         # columns must not see values partition selection dropped. Mesh
@@ -708,24 +723,42 @@ class JaxDPEngine:
                                                        float(stddev))
         return noise_ops.add_gaussian_noise(key, values, stddev, granularity)
 
+    @staticmethod
+    def _noise_stddev_column(columns: dict, name: str, is_gaussian,
+                             scale_or_std, n: int) -> None:
+        """[n] constant column stating the added noise's stddev (wired when
+        params.output_noise_stddev — see aggregate_params.py)."""
+        std = (float(scale_or_std)
+               if is_gaussian else float(scale_or_std) * math.sqrt(2.0))
+        columns[f"{name}_noise_stddev"] = np.full(n, std, dtype=np.float64)
+
     def _compute_combiner_metrics(self, combiner, params, accs, vector_sums,
                                   key, columns: dict,
                                   quantile_cols=None) -> None:
         k1, k2, k3 = jax.random.split(key, 3)
+        n_out = int(np.asarray(accs.pid_count).shape[0])
         if isinstance(combiner, combiners_lib.CountCombiner):
             is_g, scale, gran = _mechanism_noise_params(
                 combiner.mechanism_spec(), combiner.sensitivities())
             columns["count"] = self._add_noise(k1, accs.count, is_g, scale,
                                                gran)
+            if params.output_noise_stddev:
+                self._noise_stddev_column(columns, "count", is_g, scale,
+                                          n_out)
         elif isinstance(combiner, combiners_lib.SumCombiner):
             is_g, scale, gran = _mechanism_noise_params(
                 combiner.mechanism_spec(), combiner.sensitivities())
             columns["sum"] = self._add_noise(k1, accs.sum, is_g, scale, gran)
+            if params.output_noise_stddev:
+                self._noise_stddev_column(columns, "sum", is_g, scale, n_out)
         elif isinstance(combiner, combiners_lib.PrivacyIdCountCombiner):
             is_g, scale, gran = _mechanism_noise_params(
                 combiner.mechanism_spec(), combiner.sensitivities())
             columns["privacy_id_count"] = self._add_noise(
                 k1, accs.pid_count, is_g, scale, gran)
+            if params.output_noise_stddev:
+                self._noise_stddev_column(columns, "privacy_id_count", is_g,
+                                          scale, n_out)
         elif isinstance(combiner,
                         combiners_lib.PostAggregationThresholdingCombiner):
             pass  # handled by the caller (needs the keep mask)
@@ -765,6 +798,9 @@ class JaxDPEngine:
                 gran = noise_core.laplace_granularity(scale)
                 columns["vector_sum"] = self._add_laplace(
                     k1, vector_sums, scale, gran)
+                if params.output_noise_stddev:
+                    self._noise_stddev_column(columns, "vector_sum", False,
+                                              scale, n_out)
             else:
                 l2 = (math.sqrt(noise_params.l0_sensitivity) *
                       noise_params.linf_sensitivity)
@@ -774,6 +810,9 @@ class JaxDPEngine:
                 gran = noise_core.gaussian_granularity(sigma)
                 columns["vector_sum"] = self._add_gaussian(
                     k1, vector_sums, sigma, gran)
+                if params.output_noise_stddev:
+                    self._noise_stddev_column(columns, "vector_sum", True,
+                                              sigma, n_out)
         else:
             raise NotImplementedError(
                 f"Combiner {type(combiner).__name__} is not supported on the "

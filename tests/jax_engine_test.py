@@ -679,3 +679,92 @@ class TestBlockedQuantiles:
         # ~0.18, so the max over 40 partitions stays within 0.6.
         assert np.abs(cols["percentile_50"] - 5.0).max() < 0.6
         assert np.abs(cols["percentile_90"] - 9.0).max() < 0.6
+
+
+class TestOutputNoiseStddev:
+    """params.output_noise_stddev emits "<metric>_noise_stddev" columns."""
+
+    def test_count_sum_stddev_columns(self):
+        data = simple_data()
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=3,
+            max_contributions_per_partition=2,
+            min_value=0,
+            max_value=5,
+            output_noise_stddev=True)
+        jax_res, accountant, _ = run_jax(data, params, public=["a", "b", "c"],
+                                         eps=2.0, delta=1e-15)
+        # Two Laplace mechanisms split eps equally: scale = l0*linf/(eps/2).
+        expected_count_std = (3 * 2 / 1.0) * np.sqrt(2.0)
+        expected_sum_std = (3 * 2 * 5 / 1.0) * np.sqrt(2.0)
+        for pk in "abc":
+            assert jax_res[pk].count_noise_stddev == pytest.approx(
+                expected_count_std, rel=1e-6)
+            assert jax_res[pk].sum_noise_stddev == pytest.approx(
+                expected_sum_std, rel=1e-6)
+
+    def test_local_engine_matches_jax_columns(self):
+        data = simple_data()
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT],
+            max_partitions_contributed=2,
+            max_contributions_per_partition=1,
+            output_noise_stddev=True)
+        jax_res, _, _ = run_jax(data, params, public=["a"], eps=1.0)
+        local_res, _ = run_local(data, params, public=["a"], eps=1.0)
+        assert jax_res["a"].count_noise_stddev == pytest.approx(
+            local_res["a"].count_noise_stddev, rel=1e-9)
+
+    def test_gaussian_stddev(self):
+        data = simple_data()
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT],
+            noise_kind=pdp.NoiseKind.GAUSSIAN,
+            max_partitions_contributed=2,
+            max_contributions_per_partition=1,
+            output_noise_stddev=True)
+        jax_res, _, _ = run_jax(data, params, public=["a"], eps=1.0,
+                                delta=1e-6)
+        from pipelinedp_tpu import noise_core
+        expected = noise_core.analytic_gaussian_sigma(1.0, 1e-6, np.sqrt(2))
+        assert jax_res["a"].count_noise_stddev == pytest.approx(expected,
+                                                               rel=1e-6)
+
+    def test_rejected_for_ratio_metrics(self):
+        with pytest.raises(ValueError, match="output_noise_stddev"):
+            pdp.AggregateParams(metrics=[pdp.Metrics.MEAN],
+                                max_partitions_contributed=1,
+                                max_contributions_per_partition=1,
+                                min_value=0,
+                                max_value=1,
+                                output_noise_stddev=True)
+
+
+class TestPerformCrossPartitionBounding:
+
+    def test_disabled_keeps_all_partitions(self):
+        # One user contributing to 10 partitions with l0 bound 2: with
+        # bounding the user survives in only 2 partitions; without, all 10
+        # keep their contribution (noise still calibrated to the bound).
+        data = [(0, f"pk{i}", 1.0) for i in range(10)]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT],
+            max_partitions_contributed=2,
+            max_contributions_per_partition=1,
+            perform_cross_partition_contribution_bounding=False)
+        public = [f"pk{i}" for i in range(10)]
+        jax_res, _, _ = run_jax(data, params, public=public)
+        counts = np.array([jax_res[pk].count for pk in public])
+        np.testing.assert_allclose(counts, 1.0, atol=1e-2)
+
+    def test_enabled_bounds_partitions(self):
+        data = [(0, f"pk{i}", 1.0) for i in range(10)]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT],
+            max_partitions_contributed=2,
+            max_contributions_per_partition=1)
+        public = [f"pk{i}" for i in range(10)]
+        jax_res, _, _ = run_jax(data, params, public=public)
+        counts = np.array([jax_res[pk].count for pk in public])
+        assert counts.sum() == pytest.approx(2.0, abs=0.05)
