@@ -101,10 +101,11 @@ class JaxBackend(local.LocalBackend):
         """Segment sum over dictionary-encoded keys — exactness first.
 
         The device path runs int32, so it engages only when the total
-        absolute mass provably fits (no silent wraparound); everything
-        else takes the vectorized host float64 bincount, which matches
-        LocalBackend's Python-float accumulation to the last bit for any
-        realistic magnitudes (exact for integers below 2^53).
+        absolute mass provably fits (no silent wraparound). Larger integer
+        inputs reduce exactly on host (int64 np.add.at, escalating to
+        Python ints when int64 could overflow) — bit-faithful to
+        LocalBackend's Python-int reduction at any magnitude. Floats take
+        the vectorized float64 bincount.
         """
         ids, uniques = encoding._factorize(keys)
         int_values = np.issubdtype(values.dtype, np.integer)
@@ -120,6 +121,22 @@ class JaxBackend(local.LocalBackend):
                 jax.ops.segment_sum(jnp.asarray(values, dtype=jnp.int32),
                                     jnp.asarray(ids),
                                     num_segments=len(uniques)))
+        elif int_values:
+            # Hot integers too big for int32 on device: exact int64
+            # accumulation with the overflow check numpy won't do itself;
+            # arbitrary-precision Python ints on detected risk. float64
+            # bincount would silently lose exactness past 2^53.
+            sums = np.zeros(len(uniques), dtype=np.int64)
+            # Python-int abs: np.abs(int64 min) would wrap.
+            max_abs = (max(abs(int(values.max())), abs(int(values.min())))
+                       if len(values) else 0)
+            if max_abs and len(values) > (2**62) // max_abs:
+                totals = [0] * len(uniques)
+                for i, v in zip(ids, values):
+                    totals[i] += int(v)
+                sums = totals
+            else:
+                np.add.at(sums, ids, values.astype(np.int64))
         else:
             sums = np.bincount(ids,
                                weights=values.astype(np.float64),

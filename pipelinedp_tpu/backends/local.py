@@ -15,8 +15,10 @@ from __future__ import annotations
 import collections
 import concurrent.futures
 import itertools
+import logging
 import multiprocessing
 import operator
+import sys
 from typing import Callable, Iterable
 
 from pipelinedp_tpu.backends import base
@@ -170,15 +172,24 @@ class MultiProcLocalBackend(LocalBackend):
             raise ValueError(f"mode must be 'threads' or 'processes': {mode}")
         self._mode = mode
         self._chunksize = chunksize
+        self._warned_fork_after_jax = False
 
     def _executor(self):
         if self._mode == "threads":
             return concurrent.futures.ThreadPoolExecutor(self._n_jobs)
         # Platform-default start method (fork on Linux), like the
         # reference's multiprocessing.Pool: spawn would re-import
-        # __main__, breaking stdin scripts and notebooks. The standard
-        # fork-from-threaded-process caveat applies; prefer "threads"
-        # mode unless the workload is CPU-bound Python.
+        # __main__, breaking stdin scripts and notebooks. Forking a
+        # JAX-initialized (multithreaded) parent can deadlock the child,
+        # so warn loudly when that combination is detected; prefer
+        # "threads" mode unless the workload is CPU-bound Python.
+        if "jax" in sys.modules and not self._warned_fork_after_jax:
+            self._warned_fork_after_jax = True
+            logging.warning(
+                "MultiProcLocalBackend 'processes' mode forks after JAX "
+                "initialization; forked children of a multithreaded parent "
+                "can deadlock. Use mode='threads', or build the pipeline "
+                "before importing jax.")
         return concurrent.futures.ProcessPoolExecutor(self._n_jobs)
 
     def _parallel_chunks(self, col, chunk_fn: Callable):

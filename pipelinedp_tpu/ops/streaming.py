@@ -30,6 +30,7 @@ used across *time* on one device.
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Optional, Tuple
 
 import jax
@@ -237,7 +238,11 @@ def _pack_native(pid, pk, value, pid_lo, k, bytes_pid, bytes_pk, value_f16,
     # Knuth-hashed buckets are near-uniform: pad 2% + slack, retry once
     # with the exact max if an adversarial id distribution overflows.
     cap = n // k + max(n // (k * 50), 4096)
-    for _ in range(2):
+    out = None
+    for attempt in range(2):
+        # Drop the undersized buffer before allocating the retry size, so
+        # peak host RAM stays ~1x the packed input even on skewed ids.
+        del out
         out = np.zeros((k, cap, width), dtype=np.uint8)
         counts = np.zeros(k, dtype=np.int64)
         rc = lib.pdp_pack_buckets(
@@ -251,7 +256,12 @@ def _pack_native(pid, pk, value, pid_lo, k, bytes_pid, bytes_pk, value_f16,
         if rc == 0:
             return list(zip(out, counts))
         if rc == 2:
-            cap = int(counts.max())
+            new_cap = int(counts.max())
+            logging.warning(
+                "pipelinedp_tpu streaming: bucket capacity %d overflowed "
+                "(skewed privacy-id distribution; max bucket %d rows); "
+                "retrying with the exact size.", cap, new_cap)
+            cap = new_cap
             continue
         return None
     return None
