@@ -145,6 +145,64 @@ def bench_kernel(pid, pk, value) -> float:
     return N_PARTITIONS / min(times)
 
 
+def bench_utility_sweep():
+    """BASELINE.md #5: 64-configuration multi-parameter utility-analysis
+    sweep (COUNT+SUM+PRIVACY_ID_COUNT error grids) on the device vs the
+    host numpy oracle. Returns (device_sec, host_sec)."""
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu.analysis import (cross_partition, data_structures,
+                                         per_partition)
+    from pipelinedp_tpu.analysis.pre_aggregation import PreAggregates
+
+    n_groups = int(os.environ.get("BENCH_SWEEP_GROUPS", 2_000_000))
+    n_parts = int(os.environ.get("BENCH_SWEEP_PARTITIONS", 100_000))
+    n_cfg = 64
+    rng = np.random.default_rng(1)
+    counts = rng.integers(1, 10, n_groups).astype(np.float64)
+    pre = PreAggregates(
+        pk_ids=rng.integers(0, n_parts, n_groups).astype(np.int32),
+        counts=counts,
+        sums=counts * rng.uniform(0, 5, n_groups),
+        n_partitions=rng.integers(1, 50, n_groups).astype(np.int32),
+        pk_vocab=None)
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM,
+                 pdp.Metrics.PRIVACY_ID_COUNT],
+        noise_kind=pdp.NoiseKind.GAUSSIAN,
+        max_partitions_contributed=8,
+        max_contributions_per_partition=4,
+        min_sum_per_partition=0.0,
+        max_sum_per_partition=5.0)
+    multi = data_structures.MultiParameterConfiguration(
+        max_partitions_contributed=[1, 2, 3, 4, 6, 8, 12, 16] * 8,
+        max_contributions_per_partition=[1, 2, 4, 8] * 16,
+        min_sum_per_partition=[0.0] * n_cfg,
+        max_sum_per_partition=[float(1 + i % 10) for i in range(n_cfg)])
+    options = data_structures.UtilityAnalysisOptions(
+        epsilon=4.0, delta=1e-5, aggregate_params=params,
+        multi_param_configuration=multi)
+    configs = per_partition.resolve_config_budgets(options,
+                                                   public_partitions=True)
+    metrics = list(params.metrics)
+
+    def run(use_device):
+        # Full sweep pipeline: error grids + fused cross-partition report
+        # reduction (what parameter_tuning.tune consumes).
+        t0 = time.perf_counter()
+        arrays = per_partition.compute_per_partition_arrays(
+            pre, configs, metrics, public_partitions=True,
+            n_partitions=n_parts, use_device=use_device)
+        reports = cross_partition.build_reports_with_histogram(
+            arrays, metrics, public_partitions=True)
+        assert len(reports) == n_cfg
+        return time.perf_counter() - t0
+
+    run(True)  # warmup/compile
+    device_sec = min(run(True) for _ in range(2))
+    host_sec = run(False)
+    return device_sec, host_sec
+
+
 def bench_cpu_baseline() -> float:
     import pipelinedp_tpu as pdp
 
@@ -187,6 +245,17 @@ def main():
             "error": f"{type(e).__name__}: {e}"[:300],
         }))
         sys.exit(0)
+    extra = {}
+    try:
+        sweep_dev_sec, sweep_host_sec = bench_utility_sweep()
+        extra = {
+            # BASELINE.md #5: 64-config multi-parameter sweep, 2M groups.
+            "utility_sweep_64cfg_sec": round(sweep_dev_sec, 3),
+            "utility_sweep_host_sec": round(sweep_host_sec, 3),
+            "utility_sweep_vs_host": round(sweep_host_sec / sweep_dev_sec, 2),
+        }
+    except Exception as e:  # noqa: BLE001
+        extra = {"utility_sweep_error": f"{type(e).__name__}: {e}"[:200]}
     print(json.dumps({
         "metric": "DP-aggregated partitions/sec (COUNT+SUM, 1M keys), "
                   "end-to-end through JaxDPEngine.aggregate",
@@ -196,6 +265,7 @@ def main():
         "kernel_partitions_per_sec": round(kernel_pps, 1),
         "kernel_vs_baseline": round(kernel_pps / cpu_pps, 2),
         "cpu_baseline_partitions_per_sec": round(cpu_pps, 1),
+        **extra,
     }))
 
 

@@ -181,6 +181,123 @@ def bucket_upper_bound(lower: int) -> int:
     return BUCKET_BOUNDS[idx] if idx < len(BUCKET_BOUNDS) else -1
 
 
+def _metric_utility_from_sums(metric, noise_kind, std_noise: float,
+                              s: np.ndarray,
+                              weight: float) -> metrics_lib.MetricUtility:
+    """MetricUtility from the device's per-bucket report sums.
+
+    s is one [device_sweep.N_REPORT_FIELDS] vector: weighted absolute sums
+    (0-7), weighted relative sums (8-15), then raw / l0-dropped /
+    linf-dropped / selection-dropped mass (16-19). Same arithmetic as
+    _metric_utility, with the per-partition reductions already done
+    on-device.
+    """
+
+    def d(x):
+        return float(x) / weight if weight else 0.0
+
+    def value_errors(base):
+        return metrics_lib.ValueErrors(
+            bounding_errors=metrics_lib.ContributionBoundingErrors(
+                l0=metrics_lib.MeanVariance(d(s[base]), d(s[base + 1])),
+                linf_min=d(s[base + 2]),
+                linf_max=d(s[base + 3])),
+            mean=d(s[base + 4]),
+            variance=d(s[base + 5]),
+            rmse=d(s[base + 6]),
+            l1=0.0,
+            rmse_with_dropped_partitions=d(s[base + 7]),
+            l1_with_dropped_partitions=0.0)
+
+    total_raw = float(s[16])
+    denom = total_raw if total_raw != 0 else 1.0
+    data_dropped = metrics_lib.DataDropInfo(
+        l0=float(s[17]) / denom,
+        linf=float(s[18]) / denom,
+        partition_selection=float(s[19]) / denom)
+    return metrics_lib.MetricUtility(metric=metric,
+                                     noise_std=std_noise,
+                                     noise_kind=noise_kind,
+                                     ratio_data_dropped=data_dropped,
+                                     absolute_error=value_errors(0),
+                                     relative_error=value_errors(8))
+
+
+def _build_reports_device(
+        arrays: PerPartitionArrays, dp_metrics: Sequence[Metric],
+        public_partitions: bool) -> List[metrics_lib.UtilityReport]:
+    """Fused device report path: one segment-sum over partition-size
+    buckets per metric; only [n_buckets, n_fields, n_configs] sums leave
+    the device (the [n_configs, n_partitions] grids are never pulled)."""
+    dev = arrays.device
+    sizes = (dev.exact_sizes
+             if dev.exact_sizes is not None else dev.pull_raw(0))
+    buckets = partition_size_buckets(sizes)
+    uniq = sorted(set(buckets.tolist()))
+    bucket_ids = np.searchsorted(np.asarray(uniq), buckets)
+    n_buckets = len(uniq)
+    keep = None if public_partitions else arrays.keep_prob
+    metric_sums, keep_sums = dev.report_sums(bucket_ids, n_buckets, keep)
+    bucket_count = np.bincount(bucket_ids,
+                               minlength=n_buckets).astype(np.float64)
+    if public_partitions:
+        weights = np.broadcast_to(bucket_count[:, None],
+                                  (n_buckets, arrays.n_configs))
+        raw_count = np.asarray(arrays.raw_count, dtype=np.float64)
+        nonempty = np.bincount(bucket_ids,
+                               weights=(raw_count > 0).astype(np.float64),
+                               minlength=n_buckets)
+        empty_count = bucket_count - nonempty
+    else:
+        weights = keep_sums[:, 0, :]
+
+    def partitions_info(b, c):
+        if public_partitions:
+            ne = nonempty.sum() if b is None else nonempty[b]
+            em = empty_count.sum() if b is None else empty_count[b]
+            return metrics_lib.PartitionsInfo(public_partitions=True,
+                                              num_dataset_partitions=int(ne),
+                                              num_non_public_partitions=0,
+                                              num_empty_partitions=int(em))
+        ks = keep_sums.sum(axis=0) if b is None else keep_sums[b]
+        n = bucket_count.sum() if b is None else bucket_count[b]
+        return metrics_lib.PartitionsInfo(
+            public_partitions=False,
+            num_dataset_partitions=int(n),
+            kept_partitions=metrics_lib.MeanVariance(float(ks[0, c]),
+                                                     float(ks[1, c])))
+
+    def metric_utilities(b, c):
+        out = []
+        for err, sums in zip(arrays.metric_errors, metric_sums):
+            s = sums.sum(axis=0)[:, c] if b is None else sums[b][:, c]
+            w = (float(weights.sum(axis=0)[c])
+                 if b is None else float(weights[b, c]))
+            out.append(
+                _metric_utility_from_sums(err.metric, err.noise_kind[c],
+                                          float(err.std_noise[c]), s, w))
+        return out
+
+    reports = []
+    for c in range(arrays.n_configs):
+        report = metrics_lib.UtilityReport(
+            configuration_index=c,
+            partitions_info=partitions_info(None, c),
+            metric_errors=metric_utilities(None, c))
+        report.utility_report_histogram = [
+            metrics_lib.UtilityReportBin(
+                partition_size_from=int(lower),
+                partition_size_to=int(bucket_upper_bound(int(lower))),
+                report=metrics_lib.UtilityReport(
+                    configuration_index=c,
+                    partitions_info=partitions_info(b, c),
+                    metric_errors=metric_utilities(b, c)))
+            for b, lower in enumerate(uniq)
+        ]
+        reports.append(report)
+    return reports
+
+
 def build_reports_with_histogram(
         arrays: PerPartitionArrays, dp_metrics: Sequence[Metric],
         public_partitions: bool) -> List[metrics_lib.UtilityReport]:
@@ -188,8 +305,12 @@ def build_reports_with_histogram(
 
     Partition size is the raw value of the first analyzed metric in the
     first configuration (raw privacy-id count when only partition selection
-    is analyzed).
+    is analyzed). When the sweep ran on the device, the reduction is fused
+    there (_build_reports_device).
     """
+    if (getattr(arrays, "device", None) is not None and arrays.metric_errors
+            and dp_metrics):
+        return _build_reports_device(arrays, dp_metrics, public_partitions)
     if arrays.metric_errors:
         sizes = arrays.metric_errors[0].raw[0]
     else:

@@ -74,13 +74,21 @@ class MultiParameterConfiguration:
 
 @dataclasses.dataclass
 class UtilityAnalysisOptions:
-    """Options for the utility analysis."""
+    """Options for the utility analysis.
+
+    use_device_sweep: True runs the multi-parameter error-model sweep as a
+      jitted device kernel (analysis/device_sweep.py), False keeps it on
+      host numpy, None (default) auto-selects: device when an accelerator
+      is present and the [configurations x groups] grid is large enough to
+      amortize the launch.
+    """
     epsilon: float
     delta: float
     aggregate_params: AggregateParams
     multi_param_configuration: Optional[MultiParameterConfiguration] = None
     partitions_sampling_prob: float = 1
     pre_aggregated_data: bool = False
+    use_device_sweep: Optional[bool] = None
 
     def __post_init__(self):
         input_validators.validate_epsilon_delta(self.epsilon, self.delta,

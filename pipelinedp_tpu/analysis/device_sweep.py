@@ -1,0 +1,379 @@
+"""Device-side (jitted JAX) multi-parameter utility-analysis sweep.
+
+The reference evaluates a parameter sweep by building n_configurations
+deep-copied combiner graphs and running every one of them against every row
+(analysis/utility_analysis_engine.py:99-143). The host rewrite already
+collapsed that to numpy grids (per_partition.py); this module puts the same
+error model on the accelerator and keeps it there:
+
+  * per-group metric values broadcast against a leading configuration axis,
+    every [n_configs, n_partitions] error grid produced by batched
+    segment-sums inside jit;
+  * the cross-partition report reduction (cross_partition._metric_utility's
+    weighted sums, including the per-partition nonlinearities rmse and
+    relative errors) runs as a second device kernel over partition-size
+    buckets, so a full UtilityReport sweep pulls only
+    [n_buckets, n_fields, n_configs] scalars off the device — the
+    [n_configs, n_partitions] grids are materialized to host numpy lazily
+    and only if a consumer actually reads them.
+
+The numpy implementation in per_partition.py / cross_partition.py remains
+the conformance oracle; tests/analysis_test.py pins the two paths against
+each other.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# [config-chunk, n_groups] float intermediates are bounded to roughly this
+# many elements (the stacked segment-sum operand peaks at ~4x this) so a
+# wide sweep over tens of millions of groups never overflows device memory;
+# configurations beyond the chunk run in further launches of the same
+# compiled kernel.
+_CHUNK_ELEMENT_BUDGET = 1 << 25
+
+# Order of the per-(config, bucket) report sums produced by _report_kernel.
+# ABS/REL blocks mirror cross_partition._metric_utility's ValueErrors
+# fields; the DROP block mirrors its DataDropInfo attribution.
+ABS_FIELDS = ("exp_l0", "var_l0", "clip_min", "clip_max", "bias", "variance",
+              "rmse", "rmse_dropped")
+N_ABS = len(ABS_FIELDS)
+N_REPORT_FIELDS = 2 * N_ABS + 4  # abs + rel + (raw, l0, linf, selection)
+
+
+def _jnp():
+    import jax
+    import jax.numpy as jnp
+    return jax, jnp
+
+
+def should_use_device(num_groups: int, n_configs: int) -> bool:
+    """Auto-dispatch policy: accelerate when an accelerator exists and the
+    grid is big enough to amortize the launch."""
+    try:
+        import jax
+        backend = jax.default_backend()
+    except Exception:  # pragma: no cover - jax always importable in-repo
+        return False
+    if backend == "cpu":
+        return False
+    return num_groups * max(n_configs, 1) >= (1 << 16)
+
+
+@functools.lru_cache(maxsize=None)
+def _kernels():
+    """Builds the jitted kernels lazily so that importing the analysis
+    package never initializes a JAX backend."""
+    jax, jnp = _jnp()
+
+    @functools.partial(jax.jit,
+                       static_argnames=("n_partitions", "metric_kind"))
+    def metric_grids(counts, sums, pk_ids, npart, lo, hi, l0, n_partitions,
+                     metric_kind):
+        """[4, C, P] error grids + [P] raw values for one metric.
+
+        counts/sums: [G] per-group pre-aggregates; npart: [G] L0 load of
+        each group's privacy id; lo/hi/l0: [C] per-configuration clip
+        bounds and L0 bound. Grid order: clip_min_err, clip_max_err,
+        exp_l0_err, var_l0_err — the same accumulators as the host error
+        model (per_partition.compute_metric_errors).
+        """
+        if metric_kind == "sum":
+            v = sums
+        elif metric_kind == "count":
+            v = counts
+        else:  # privacy_id_count
+            v = (counts > 0).astype(counts.dtype)
+        vb = v[None, :]
+        q = jnp.minimum(1.0, l0[:, None] / jnp.maximum(npart, 1.0)[None, :])
+        x = jnp.clip(vb, lo[:, None], hi[:, None])
+        err = x - vb
+        below = jnp.where(vb < lo[:, None], err, 0.0)
+        above = jnp.where(vb > hi[:, None], err, 0.0)
+        data = jnp.stack(
+            [below, above, -x * (1.0 - q), x * x * q * (1.0 - q)])
+        grids = jax.ops.segment_sum(jnp.moveaxis(data, -1, 0),
+                                    pk_ids,
+                                    num_segments=n_partitions)
+        raw = jax.ops.segment_sum(v, pk_ids, num_segments=n_partitions)
+        return raw, jnp.moveaxis(grids, 0, -1)
+
+    @functools.partial(jax.jit, static_argnames=("n_partitions",))
+    def moment_grids(pk_ids, npart, l0, n_partitions):
+        """[3, C, P] Poisson-binomial moment grids (mean, var, third
+        central moment of the partition's surviving-unit count) feeding the
+        refined-normal keep-probability approximation."""
+        q = jnp.minimum(1.0, l0[:, None] / jnp.maximum(npart, 1.0)[None, :])
+        data = jnp.stack([q, q * (1.0 - q), q * (1.0 - q) * (1.0 - 2.0 * q)])
+        sums = jax.ops.segment_sum(jnp.moveaxis(data, -1, 0),
+                                   pk_ids,
+                                   num_segments=n_partitions)
+        return jnp.moveaxis(sums, 0, -1)
+
+    @functools.partial(jax.jit, static_argnames=("n_buckets",))
+    def report_sums(raw, grids, std_noise, keep, bucket_ids, n_buckets):
+        """[B, N_REPORT_FIELDS, C] cross-partition sums for one metric.
+
+        Device twin of cross_partition._metric_utility's reductions: the
+        per-partition nonlinearities (rmse, relative division by raw,
+        dropped-mass attribution) are evaluated on-device and summed per
+        partition-size bucket; the host divides by the weights and fills
+        dataclasses. keep is [C, P] (ones for public partitions).
+        """
+        clip_min, clip_max, exp_l0, var_l0 = (grids[0], grids[1], grids[2],
+                                              grids[3])
+        rawb = jnp.broadcast_to(raw[None, :], exp_l0.shape)
+        bias = exp_l0 + clip_min + clip_max
+        variance = var_l0 + (std_noise * std_noise)[:, None]
+        rmse = jnp.sqrt(bias * bias + variance)
+        rmse_dropped = keep * rmse + (1.0 - keep) * jnp.abs(rawb)
+        safe_raw = jnp.where(rawb == 0.0, 1.0, rawb)
+        nz = (rawb != 0.0).astype(rmse.dtype)
+        inv = nz / safe_raw
+        inv2 = nz / (safe_raw * safe_raw)
+        abs_fields = (exp_l0, var_l0, clip_min, clip_max, bias, variance,
+                      rmse, rmse_dropped)
+        rel_fields = (exp_l0 * inv, var_l0 * inv2, clip_min * inv,
+                      clip_max * inv, bias * inv, variance * inv2,
+                      rmse * inv, rmse_dropped * inv)
+        l0_dropped = -exp_l0
+        linf_dropped = clip_min - clip_max
+        selection_dropped = (rawb - l0_dropped - linf_dropped) * (1.0 - keep)
+        data = jnp.stack(
+            [f * keep for f in abs_fields + rel_fields] +
+            [rawb, l0_dropped, linf_dropped, selection_dropped])
+        return jax.ops.segment_sum(jnp.moveaxis(data, -1, 0),
+                                   bucket_ids,
+                                   num_segments=n_buckets)
+
+    @functools.partial(jax.jit, static_argnames=("n_buckets",))
+    def keep_sums(keep, bucket_ids, n_buckets):
+        """[B, 2, C]: (sum keep, sum keep*(1-keep)) per bucket — the
+        kept-partitions Poisson-binomial mean/variance."""
+        data = jnp.stack([keep, keep * (1.0 - keep)])
+        return jax.ops.segment_sum(jnp.moveaxis(data, -1, 0),
+                                   bucket_ids,
+                                   num_segments=n_buckets)
+
+    return metric_grids, moment_grids, report_sums, keep_sums
+
+
+@dataclasses.dataclass
+class _MetricGrids:
+    """Device-resident error grids of one metric."""
+    raw: object  # [P] device array
+    grids: object  # [4, C, P] device array
+    std_noise: np.ndarray  # [C] host
+    metric_kind: str
+
+
+class DeviceSweep:
+    """Device-resident state of one utility-analysis sweep.
+
+    Uploads the pre-aggregate columns once, computes per-metric error grids
+    (kept on device), and serves both consumers: lazy host materialization
+    of the [C, P] grids and the fused cross-partition report reduction.
+    """
+
+    def __init__(self, pk_ids: np.ndarray, counts: np.ndarray,
+                 sums: np.ndarray, npart: np.ndarray, n_partitions: int,
+                 n_configs: int):
+        _, jnp = _jnp()
+        self.n_partitions = n_partitions
+        self.n_configs = n_configs
+        self.n_groups = len(pk_ids)
+        self._counts = jnp.asarray(np.asarray(counts, dtype=np.float32))
+        self._sums = jnp.asarray(np.asarray(sums, dtype=np.float32))
+        self._pk_ids = jnp.asarray(np.asarray(pk_ids, dtype=np.int32))
+        self._npart = jnp.asarray(np.asarray(npart, dtype=np.float32))
+        self.metrics: List[_MetricGrids] = []
+        self._moments = None  # [3, C, P] device array when computed
+        # Exact (float64, host) per-partition raw values of the first
+        # metric, for report-size bucketing; set by the builder
+        # (per_partition._build_device_sweep). The device raw is float32
+        # and could straddle a 1-2-5 bucket boundary.
+        self.exact_sizes: Optional[np.ndarray] = None
+        self._lazy_views: List["LazyMetricErrorArrays"] = []
+
+    def _config_chunk(self, per_config_elements: int) -> int:
+        return max(
+            1,
+            min(self.n_configs,
+                _CHUNK_ELEMENT_BUDGET // max(per_config_elements, 1)))
+
+    def add_metric(self, metric_kind: str, lo: np.ndarray, hi: np.ndarray,
+                   l0: np.ndarray, std_noise: np.ndarray) -> int:
+        """Computes one metric's error grids on device; returns its index.
+
+        metric_kind: "sum" | "count" | "privacy_id_count".
+        """
+        kernel, _, _, _ = _kernels()
+        _, jnp = _jnp()
+        step = self._config_chunk(self.n_groups)
+        raw = None
+        parts = []
+        for s in range(0, self.n_configs, step):
+            e = min(s + step, self.n_configs)
+            r, grids = kernel(
+                self._counts, self._sums, self._pk_ids, self._npart,
+                jnp.asarray(np.asarray(lo[s:e], dtype=np.float32)),
+                jnp.asarray(np.asarray(hi[s:e], dtype=np.float32)),
+                jnp.asarray(np.asarray(l0[s:e], dtype=np.float32)),
+                n_partitions=self.n_partitions,
+                metric_kind=metric_kind)
+            if raw is None:
+                raw = r
+            parts.append(grids)
+        grids = parts[0] if len(parts) == 1 else jnp.concatenate(parts,
+                                                                 axis=1)
+        self.metrics.append(
+            _MetricGrids(raw=raw,
+                         grids=grids,
+                         std_noise=np.asarray(std_noise, dtype=np.float64),
+                         metric_kind=metric_kind))
+        return len(self.metrics) - 1
+
+    def materialize_metric(self, index: int) -> Dict[str, np.ndarray]:
+        """Pulls one metric's grids to host numpy (float64), in the
+        MetricErrorArrays field layout."""
+        m = self.metrics[index]
+        if m.grids is None:
+            raise RuntimeError(
+                "DeviceSweep.release(materialize=False) already dropped the "
+                "device grids; materialize before releasing to keep "
+                "per-partition access working.")
+        grids = np.asarray(m.grids, dtype=np.float64)
+        raw = np.asarray(m.raw, dtype=np.float64)
+        return {
+            "raw": np.broadcast_to(raw,
+                                   (self.n_configs,
+                                    self.n_partitions)).copy(),
+            "clip_min_err": grids[0],
+            "clip_max_err": grids[1],
+            "exp_l0_err": grids[2],
+            "var_l0_err": grids[3],
+        }
+
+    def pull_raw(self, index: int) -> np.ndarray:
+        """[P] raw per-partition values of one metric (host float64)."""
+        return np.asarray(self.metrics[index].raw, dtype=np.float64)
+
+    def compute_moments(self, l0: np.ndarray) -> None:
+        """Computes the [3, C, P] keep-probability moment grids on device
+        (configurations sharing an L0 bound share the kernel work)."""
+        _, kernel, _, _ = _kernels()
+        _, jnp = _jnp()
+        l0 = np.asarray(l0, dtype=np.float32)
+        uniq, inverse = np.unique(l0, return_inverse=True)
+        step = self._config_chunk(self.n_groups)
+        parts = []
+        for s in range(0, len(uniq), step):
+            e = min(s + step, len(uniq))
+            parts.append(
+                kernel(self._pk_ids, self._npart, jnp.asarray(uniq[s:e]),
+                       n_partitions=self.n_partitions))
+        grids = parts[0] if len(parts) == 1 else jnp.concatenate(parts,
+                                                                 axis=1)
+        self._moments = jnp.take(grids, jnp.asarray(inverse), axis=1)
+
+    def pull_moments(self) -> Optional[np.ndarray]:
+        if self._moments is None:
+            return None
+        return np.asarray(self._moments, dtype=np.float64)
+
+    def drop_inputs(self) -> None:
+        """Frees the uploaded input columns and the moments grid — called
+        by the builder once all kernels have run; only the per-metric
+        grids (lazy host materialization, report reduction) stay
+        resident."""
+        self._counts = self._sums = self._pk_ids = self._npart = None
+        self._moments = None
+
+    def release(self, materialize: bool = True) -> None:
+        """Frees the device-resident grids (HBM held otherwise lives as
+        long as the analysis result).
+
+        materialize=True first pulls every metric's grids into its lazy
+        host views so per-partition consumers keep working; False drops
+        the device data outright (subsequent lazy access raises).
+        """
+        if materialize:
+            for view in self._lazy_views:
+                view.raw  # touch: materializes all grid fields
+        for m in self.metrics:
+            m.raw = None
+            m.grids = None
+        self.drop_inputs()
+
+    def report_sums(
+            self, bucket_ids: np.ndarray, n_buckets: int,
+            keep_prob: Optional[np.ndarray]
+    ) -> Tuple[List[np.ndarray], Optional[np.ndarray]]:
+        """Fused cross-partition reduction.
+
+        Returns (per-metric [B, N_REPORT_FIELDS, C] sums,
+        [B, 2, C] keep sums or None for public partitions). Only these
+        small arrays leave the device.
+        """
+        _, _, report_kernel, keep_kernel = _kernels()
+        _, jnp = _jnp()
+        dbuckets = jnp.asarray(np.asarray(bucket_ids, dtype=np.int32))
+        if keep_prob is None:
+            dkeep = jnp.ones((self.n_configs, self.n_partitions),
+                             dtype=jnp.float32)
+        else:
+            dkeep = jnp.asarray(np.asarray(keep_prob, dtype=np.float32))
+        step = self._config_chunk(self.n_partitions * N_REPORT_FIELDS)
+        metric_sums = []
+        for m in self.metrics:
+            parts = []
+            for s in range(0, self.n_configs, step):
+                e = min(s + step, self.n_configs)
+                parts.append(
+                    report_kernel(m.raw, m.grids[:, s:e],
+                                  jnp.asarray(
+                                      m.std_noise[s:e].astype(np.float32)),
+                                  dkeep[s:e], dbuckets,
+                                  n_buckets=n_buckets))
+            sums = (parts[0] if len(parts) == 1 else jnp.concatenate(
+                parts, axis=2))
+            metric_sums.append(np.asarray(sums, dtype=np.float64))
+        ksums = None
+        if keep_prob is not None:
+            ksums = np.asarray(keep_kernel(dkeep, dbuckets,
+                                           n_buckets=n_buckets),
+                               dtype=np.float64)
+        return metric_sums, ksums
+
+
+class LazyMetricErrorArrays:
+    """MetricErrorArrays twin whose [C, P] grids materialize from the
+    device on first attribute access (per_partition.MetricErrorArrays is
+    the eager host equivalent)."""
+
+    _GRID_FIELDS = ("raw", "clip_min_err", "clip_max_err", "exp_l0_err",
+                    "var_l0_err")
+
+    def __init__(self, metric, std_noise, noise_kind, sweep: DeviceSweep,
+                 index: int):
+        self.metric = metric
+        self.std_noise = std_noise
+        self.noise_kind = noise_kind
+        self._sweep = sweep
+        self._index = index
+        sweep._lazy_views.append(self)
+
+    def __getattr__(self, name):
+        if name in LazyMetricErrorArrays._GRID_FIELDS:
+            self.__dict__.update(
+                self._sweep.materialize_metric(self._index))
+            return self.__dict__[name]
+        raise AttributeError(name)

@@ -59,6 +59,8 @@ class TuneOptions:
     partitions_sampling_prob: float = 1
     pre_aggregated_data: bool = False
     number_of_parameter_candidates: int = 100
+    # None auto-selects the device sweep (see UtilityAnalysisOptions).
+    use_device_sweep: Optional[bool] = None
 
     def __post_init__(self):
         input_validators.validate_epsilon_delta(self.epsilon, self.delta,
@@ -246,7 +248,8 @@ def tune(col,
         aggregate_params=options.aggregate_params,
         multi_param_configuration=candidates,
         partitions_sampling_prob=options.partitions_sampling_prob,
-        pre_aggregated_data=options.pre_aggregated_data)
+        pre_aggregated_data=options.pre_aggregated_data,
+        use_device_sweep=options.use_device_sweep)
     reports, per_partition = utility_analysis.perform_utility_analysis(
         col, backend, analysis_options, data_extractors, public_partitions)
 

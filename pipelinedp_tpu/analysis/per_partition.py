@@ -135,47 +135,99 @@ class MetricErrorArrays:
 
 @dataclasses.dataclass
 class PerPartitionArrays:
-    """The complete vectorized analysis state."""
+    """The complete vectorized analysis state.
+
+    device, when set, is the analysis/device_sweep.DeviceSweep holding the
+    device-resident grids; metric_errors are then lazy views that pull to
+    host numpy on first array access, and the report builder
+    (cross_partition.build_reports_with_histogram) reduces on-device
+    without ever materializing them.
+    """
     n_configs: int
     n_partitions: int
     metric_errors: List[MetricErrorArrays]
     keep_prob: Optional[np.ndarray]  # [n_configs, n_partitions]; None=public
     raw_pid_count: np.ndarray  # [n_partitions]
     raw_count: np.ndarray  # [n_partitions]
+    device: Optional[object] = None
+
+    def release_device(self, materialize: bool = True) -> None:
+        """Frees the device-resident grids (see DeviceSweep.release);
+        no-op for host-computed arrays."""
+        if self.device is not None:
+            self.device.release(materialize)
+            self.device = None
+
+
+def _metric_values(metric: Metric, pre: PreAggregates) -> np.ndarray:
+    """Per-group raw values v of the metric (configuration-independent)."""
+    if metric == Metrics.SUM:
+        return pre.sums
+    if metric == Metrics.COUNT:
+        return pre.counts
+    if metric == Metrics.PRIVACY_ID_COUNT:
+        return (pre.counts > 0).astype(np.float64)
+    raise ValueError(f"Unsupported analysis metric: {metric}")
+
+
+def _metric_bounds(metric: Metric, params: AggregateParams):
+    """(clip lo, clip hi) for the metric under one configuration
+    (reference combiners: SumCombiner :244, CountCombiner :304,
+    PrivacyIdCountCombiner :328)."""
+    if metric == Metrics.SUM:
+        if params.bounds_per_partition_are_set:
+            return params.min_sum_per_partition, params.max_sum_per_partition
+        # Per-contribution bounds: the engine clips each contribution to
+        # [min_value, max_value] and keeps at most linf of them, so a
+        # group's released sum lies in linf-scaled bounds — model
+        # clipping there. DELIBERATE DEVIATION from the reference, whose
+        # analysis SumCombiner reads only min/max_sum_per_partition and
+        # applies NO clipping in this mode
+        # (per_partition_combiners.py:250-259: np.clip with None
+        # bounds); that under-reports clipping error for groups whose
+        # raw sum exceeds the count-scaled bounds. Pinned by
+        # tests/analysis_test.py TestSumPerContributionBounds.
+        return (params.min_value * params.max_contributions_per_partition,
+                params.max_value * params.max_contributions_per_partition)
+    if metric == Metrics.COUNT:
+        return 0.0, float(params.max_contributions_per_partition)
+    if metric == Metrics.PRIVACY_ID_COUNT:
+        return 0.0, 1.0
+    raise ValueError(f"Unsupported analysis metric: {metric}")
 
 
 def _metric_values_and_bounds(metric: Metric, pre: PreAggregates,
                               params: AggregateParams):
     """(per-group raw values v, clip lo, clip hi) for the metric under the
-    given config (reference combiners: SumCombiner :244, CountCombiner
-    :304, PrivacyIdCountCombiner :328)."""
-    if metric == Metrics.SUM:
-        if params.bounds_per_partition_are_set:
-            lo, hi = params.min_sum_per_partition, params.max_sum_per_partition
-        else:
-            # Per-contribution bounds: the engine clips each contribution to
-            # [min_value, max_value] and keeps at most linf of them, so a
-            # group's released sum lies in linf-scaled bounds — model
-            # clipping there. DELIBERATE DEVIATION from the reference, whose
-            # analysis SumCombiner reads only min/max_sum_per_partition and
-            # applies NO clipping in this mode
-            # (per_partition_combiners.py:250-259: np.clip with None
-            # bounds); that under-reports clipping error for groups whose
-            # raw sum exceeds the count-scaled bounds. Pinned by
-            # tests/analysis_test.py TestSumPerContributionBounds.
-            lo = params.min_value * params.max_contributions_per_partition
-            hi = params.max_value * params.max_contributions_per_partition
-        return pre.sums, lo, hi
-    if metric == Metrics.COUNT:
-        return pre.counts, 0.0, float(params.max_contributions_per_partition)
-    if metric == Metrics.PRIVACY_ID_COUNT:
-        return (pre.counts > 0).astype(np.float64), 0.0, 1.0
-    raise ValueError(f"Unsupported analysis metric: {metric}")
+    given config."""
+    lo, hi = _metric_bounds(metric, params)
+    return _metric_values(metric, pre), lo, hi
 
 
 def _segment(values: np.ndarray, pk_ids: np.ndarray,
              n_partitions: int) -> np.ndarray:
     return np.bincount(pk_ids, weights=values, minlength=n_partitions)
+
+
+def _metric_noise(configs: List[ConfigSpec], metric: Metric):
+    """([n_configs] noise stddevs, per-config noise kinds) — host scalar
+    mechanism math, shared by the host and device grid paths."""
+    std_noise = np.zeros(len(configs))
+    noise_kinds = []
+    for c, config in enumerate(configs):
+        if (metric == Metrics.PRIVACY_ID_COUNT and
+                config.post_agg_thresholding):
+            # Post-aggregation thresholding: the released count is the
+            # thresholding strategy's noised value.
+            std_noise[c] = _thresholding_strategy(config).noise_stddev
+        else:
+            sensitivities = dp_computations.compute_sensitivities(
+                metric, config.params)
+            mechanism = dp_computations.create_additive_mechanism(
+                config.metric_specs[metric], sensitivities)
+            std_noise[c] = mechanism.std
+        noise_kinds.append(config.params.noise_kind)
+    return std_noise, noise_kinds
 
 
 def compute_metric_errors(pre: PreAggregates, configs: List[ConfigSpec],
@@ -189,8 +241,6 @@ def compute_metric_errors(pre: PreAggregates, configs: List[ConfigSpec],
     clip_max = np.zeros(shape)
     exp_l0 = np.zeros(shape)
     var_l0 = np.zeros(shape)
-    std_noise = np.zeros(n_configs)
-    noise_kinds = []
     for c, config in enumerate(configs):
         params = config.params
         v, lo, hi = _metric_values_and_bounds(metric, pre, params)
@@ -205,18 +255,7 @@ def compute_metric_errors(pre: PreAggregates, configs: List[ConfigSpec],
                                n_partitions)
         exp_l0[c] = _segment(-x * (1.0 - q), pre.pk_ids, n_partitions)
         var_l0[c] = _segment(x * x * q * (1.0 - q), pre.pk_ids, n_partitions)
-        if (metric == Metrics.PRIVACY_ID_COUNT and
-                config.post_agg_thresholding):
-            # Post-aggregation thresholding: the released count is the
-            # thresholding strategy's noised value.
-            std_noise[c] = _thresholding_strategy(config).noise_stddev
-        else:
-            sensitivities = dp_computations.compute_sensitivities(
-                metric, params)
-            mechanism = dp_computations.create_additive_mechanism(
-                config.metric_specs[metric], sensitivities)
-            std_noise[c] = mechanism.std
-        noise_kinds.append(params.noise_kind)
+    std_noise, noise_kinds = _metric_noise(configs, metric)
     return MetricErrorArrays(metric=metric,
                              raw=raw,
                              clip_min_err=clip_min,
@@ -225,6 +264,61 @@ def compute_metric_errors(pre: PreAggregates, configs: List[ConfigSpec],
                              var_l0_err=var_l0,
                              std_noise=std_noise,
                              noise_kind=noise_kinds)
+
+
+# metric -> DeviceSweep metric_kind (analysis/device_sweep.py).
+_METRIC_KIND = {
+    Metrics.SUM: "sum",
+    Metrics.COUNT: "count",
+    Metrics.PRIVACY_ID_COUNT: "privacy_id_count",
+}
+
+
+def _build_device_sweep(pre: PreAggregates, configs: List[ConfigSpec],
+                        ordered_metrics: List[Metric], n_partitions: int,
+                        public_partitions: bool, n_units: np.ndarray):
+    """Computes the whole configuration sweep on the device.
+
+    Returns (DeviceSweep, lazy metric_errors, approx_moments or None). The
+    grids stay device-resident; LazyMetricErrorArrays materializes them to
+    host numpy only when a consumer reads the arrays (the fused report
+    reduction in cross_partition never does).
+    """
+    from pipelinedp_tpu.analysis import device_sweep
+
+    sweep = device_sweep.DeviceSweep(pre.pk_ids, pre.counts, pre.sums,
+                                     pre.n_partitions, n_partitions,
+                                     len(configs))
+    l0 = np.asarray(
+        [config.params.max_partitions_contributed for config in configs],
+        dtype=np.float64)
+    metric_errors = []
+    for metric in ordered_metrics:
+        bounds = [_metric_bounds(metric, config.params) for config in configs]
+        lo = np.asarray([b[0] for b in bounds], dtype=np.float64)
+        hi = np.asarray([b[1] for b in bounds], dtype=np.float64)
+        std_noise, noise_kinds = _metric_noise(configs, metric)
+        index = sweep.add_metric(_METRIC_KIND[metric], lo, hi, l0, std_noise)
+        metric_errors.append(
+            device_sweep.LazyMetricErrorArrays(metric, std_noise,
+                                               noise_kinds, sweep, index))
+    if ordered_metrics:
+        # Exact (float64) per-partition sizes for report bucketing: the
+        # device raw values are float32 and could land on the other side
+        # of a 1-2-5 bucket boundary.
+        sweep.exact_sizes = _segment(_metric_values(ordered_metrics[0], pre),
+                                     pre.pk_ids, n_partitions)
+    approx_moments = None
+    if (not public_partitions and pre.num_groups and
+            (n_units > MAX_EXACT_PROBABILITIES).any()):
+        # The refined-normal keep-probability path needs the moment
+        # grids on host (the strategy's pi evaluation is host math).
+        sweep.compute_moments(l0)
+        approx_moments = sweep.pull_moments()
+    # All kernels have run: free the uploaded input columns and the
+    # moments grid so only the per-metric grids stay in device memory.
+    sweep.drop_inputs()
+    return sweep, metric_errors, approx_moments
 
 
 def _keep_prob_exact(qs: np.ndarray,
@@ -317,12 +411,24 @@ def _keep_prob_approx_vec(mean: np.ndarray, var: np.ndarray, m3: np.ndarray,
 
 
 def compute_keep_probabilities(pre: PreAggregates, configs: List[ConfigSpec],
-                               n_partitions: int) -> np.ndarray:
-    """[n_configs, n_partitions] private-partition keep probabilities."""
+                               n_partitions: int,
+                               approx_moments: Optional[np.ndarray] = None,
+                               n_units: Optional[np.ndarray] = None
+                               ) -> np.ndarray:
+    """[n_configs, n_partitions] private-partition keep probabilities.
+
+    approx_moments: optional [3, n_configs, n_partitions] Poisson-binomial
+    moment grids (mean, var, m3) precomputed on the device
+    (device_sweep.DeviceSweep.compute_moments); when absent the moments
+    are segment sums on the host. n_units: optional precomputed
+    privacy-unit count per partition (one bincount pass saved on the hot
+    path).
+    """
     n_configs = len(configs)
     out = np.zeros((n_configs, n_partitions))
-    n_units = np.bincount(pre.pk_ids,
-                          minlength=n_partitions).astype(np.int64)
+    if n_units is None:
+        n_units = np.bincount(pre.pk_ids, minlength=n_partitions)
+    n_units = n_units.astype(np.int64)
     # Sorted-by-partition group view, for the exact path's padded batches.
     # All of this indexing is config-independent, computed once.
     order = np.argsort(pre.pk_ids, kind="stable")
@@ -368,10 +474,15 @@ def compute_keep_probabilities(pre: PreAggregates, configs: List[ConfigSpec],
         # Vectorized refined-normal for the rest.
         big = np.flatnonzero(n_units > MAX_EXACT_PROBABILITIES)
         if len(big):
-            mean = _segment(q, pre.pk_ids, n_partitions)[big]
-            var = _segment(q * (1 - q), pre.pk_ids, n_partitions)[big]
-            m3 = _segment(q * (1 - q) * (1 - 2 * q), pre.pk_ids,
-                          n_partitions)[big]
+            if approx_moments is not None:
+                mean = approx_moments[0, c][big]
+                var = approx_moments[1, c][big]
+                m3 = approx_moments[2, c][big]
+            else:
+                mean = _segment(q, pre.pk_ids, n_partitions)[big]
+                var = _segment(q * (1 - q), pre.pk_ids, n_partitions)[big]
+                m3 = _segment(q * (1 - q) * (1 - 2 * q), pre.pk_ids,
+                              n_partitions)[big]
             out[c, big] = _keep_prob_approx_vec(mean, var, m3, n_units[big],
                                                 strategy)
     return out
@@ -428,24 +539,61 @@ def compute_per_partition_arrays(pre: PreAggregates,
                                  configs: List[ConfigSpec],
                                  metrics: List[Metric],
                                  public_partitions: bool,
-                                 n_partitions: Optional[int] = None
+                                 n_partitions: Optional[int] = None,
+                                 use_device: Optional[bool] = None
                                  ) -> PerPartitionArrays:
-    """Runs every error model over the whole configuration grid."""
+    """Runs every error model over the whole configuration grid.
+
+    use_device: True forces the jitted device sweep
+    (analysis/device_sweep.py) — any device failure propagates; False
+    forces host numpy; None auto-selects (device when an accelerator is
+    present and the grid is large), falling back to host with a warning if
+    the device path fails.
+    """
     if n_partitions is None:
         n_partitions = max(len(pre.pk_vocab), 1)
     ordered_metrics = [m for m in METRIC_ORDER if m in metrics]
-    metric_errors = [
-        compute_metric_errors(pre, configs, m, n_partitions)
-        for m in ordered_metrics
-    ]
+    from pipelinedp_tpu.analysis import device_sweep
+    forced_device = use_device is True
+    if use_device is None:
+        use_device = device_sweep.should_use_device(pre.num_groups,
+                                                    len(configs))
+    n_units = np.bincount(pre.pk_ids, minlength=n_partitions)
+    metric_errors = None
+    approx_moments = None
+    device_state = None
+    if use_device:
+        try:
+            device_state, metric_errors, approx_moments = (
+                _build_device_sweep(pre, configs, ordered_metrics,
+                                    n_partitions, public_partitions,
+                                    n_units))
+        except Exception:
+            if forced_device:
+                raise
+            device_sweep.logger.warning(
+                "Device utility-analysis sweep failed; falling back to the "
+                "host path.",
+                exc_info=True)
+            metric_errors = None
+            approx_moments = None
+            device_state = None
+    if metric_errors is None:
+        metric_errors = [
+            compute_metric_errors(pre, configs, m, n_partitions)
+            for m in ordered_metrics
+        ]
     keep_prob = None
     if not public_partitions:
-        keep_prob = compute_keep_probabilities(pre, configs, n_partitions)
+        keep_prob = compute_keep_probabilities(pre, configs, n_partitions,
+                                               approx_moments=approx_moments,
+                                               n_units=n_units)
     return PerPartitionArrays(
         n_configs=len(configs),
         n_partitions=n_partitions,
         metric_errors=metric_errors,
         keep_prob=keep_prob,
-        raw_pid_count=np.bincount(pre.pk_ids, minlength=n_partitions),
+        raw_pid_count=n_units,
         raw_count=_segment(pre.counts, pre.pk_ids, n_partitions),
+        device=device_state,
     )

@@ -9,7 +9,8 @@ direct vectorized reductions over the analysis arrays
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Tuple, Union
+from collections.abc import Sequence as _SequenceABC
+from typing import Any, List, Sequence, Tuple, Union
 
 from pipelinedp_tpu.data_extractors import (DataExtractors,
                                             PreAggregateExtractors)
@@ -28,7 +29,7 @@ def perform_utility_analysis(
     options: data_structures.UtilityAnalysisOptions = None,
     data_extractors: Union[DataExtractors, PreAggregateExtractors] = None,
     public_partitions=None,
-) -> Tuple[List[metrics_lib.UtilityReport], List[Tuple[Tuple[
+) -> Tuple[List[metrics_lib.UtilityReport], Sequence[Tuple[Tuple[
         Any, int], metrics_lib.PerPartitionMetrics]]]:
     """Runs utility analysis for every parameter configuration.
 
@@ -37,7 +38,10 @@ def perform_utility_analysis(
         utility_reports — one UtilityReport per configuration, with the
           report-by-partition-size histogram attached;
         per_partition_result — ((partition_key, configuration_index),
-          PerPartitionMetrics) for every partition and configuration.
+          PerPartitionMetrics) for every partition and configuration, as a
+          lazily-built immutable Sequence (index/iterate/len; call
+          list(...) for a mutable copy) so report-only consumers never
+          materialize the per-partition grid.
       ``backend`` is accepted for signature parity and ignored (execution
       is columnar).
     """
@@ -60,8 +64,35 @@ def perform_utility_analysis(
             for bin_ in report.utility_report_histogram or []:
                 bin_.report.partitions_info.strategy = strategy
 
-    per_partition_result = []
-    for pk, per_config in analysis_result:
-        for c, ppm in enumerate(per_config):
-            per_partition_result.append(((pk, c), ppm))
-    return reports, per_partition_result
+    return reports, _LazyPerPartitionResult(analysis_result)
+
+
+class _LazyPerPartitionResult(_SequenceABC):
+    """((partition_key, configuration_index), PerPartitionMetrics) rows,
+    built on first access.
+
+    perform_utility_analysis always returns them (API parity with the
+    reference's per-partition output collection), but materializing them
+    pulls the whole [n_configs, n_partitions] grid off the device — so the
+    tuning path (parameter_tuning.tune), which reads only the reports,
+    never pays for it.
+    """
+
+    def __init__(self, analysis_result):
+        self._analysis_result = analysis_result
+        self._items = None
+
+    def _materialize(self):
+        if self._items is None:
+            items = []
+            for pk, per_config in self._analysis_result:
+                for c, ppm in enumerate(per_config):
+                    items.append(((pk, c), ppm))
+            self._items = items
+        return self._items
+
+    def __len__(self):
+        return len(self._materialize())
+
+    def __getitem__(self, index):
+        return self._materialize()[index]

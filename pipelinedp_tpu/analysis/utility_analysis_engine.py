@@ -117,7 +117,8 @@ class UtilityAnalysisEngine:
         ordered = [m for m in per_partition.METRIC_ORDER if m in metrics]
         arrays = per_partition.compute_per_partition_arrays(
             pre, configs, metrics, is_public,
-            n_partitions=max(len(pre.pk_vocab), 1))
+            n_partitions=max(len(pre.pk_vocab), 1),
+            use_device=options.use_device_sweep)
         return AnalysisResult(arrays, pre.pk_vocab, ordered, is_public)
 
 
