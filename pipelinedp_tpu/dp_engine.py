@@ -331,12 +331,12 @@ class DPEngine:
         if check_data_extractors:
             _check_data_extractors(data_extractors)
         if params.contribution_bounds_already_enforced:
-            if Metrics.PRIVACY_ID_COUNT in params.metrics:
+            if Metrics.PRIVACY_ID_COUNT in (params.metrics or []):
                 raise ValueError(
                     "PRIVACY_ID_COUNT cannot be computed when "
                     "contribution_bounds_already_enforced is True.")
         if params.post_aggregation_thresholding:
-            if Metrics.PRIVACY_ID_COUNT not in params.metrics:
+            if Metrics.PRIVACY_ID_COUNT not in (params.metrics or []):
                 raise ValueError("When post_aggregation_thresholding = True, "
                                  "PRIVACY_ID_COUNT must be in metrics")
 

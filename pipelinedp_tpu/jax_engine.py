@@ -146,6 +146,20 @@ class _LazyNoisedValues(_LazyColumns):
             yield (pk.item() if hasattr(pk, "item") else pk, float(value))
 
 
+class _LazyCustomResult(_LazyColumns):
+    """Deferred result of a custom-combiner aggregation: iterates
+    (partition_key, (metrics...)) like DPEngine's custom path."""
+
+    def __init__(self, compute_fn, pk_vocab: encoding.Vocabulary):
+        super().__init__(compute_fn)
+        self._pk_vocab = pk_vocab
+
+    def __iter__(self):
+        cols = self.to_columns()
+        for pk_id, metrics in zip(cols["partition_id"], cols["metrics"]):
+            yield self._pk_vocab.decode(int(pk_id)), metrics
+
+
 class JaxDPEngine:
     """Columnar DP engine. API parity with DPEngine for the aggregation
     surface; input may be Python rows (encoded on host) or pre-encoded
@@ -379,11 +393,11 @@ class JaxDPEngine:
         return result
 
     def _check_supported(self, params: AggregateParams):
-        if params.custom_combiners:
+        if params.custom_combiners and self._mesh is not None:
             raise NotImplementedError(
-                "Custom combiners run on DPEngine with LocalBackend; the "
-                "columnar engine supports the standard metrics.")
-        if any(m.is_percentile for m in params.metrics):
+                "Custom combiners are host-evaluated and not supported with "
+                "mesh=; run single-device or use DPEngine with LocalBackend.")
+        if any(m.is_percentile for m in params.metrics or []):
             if Metrics.VECTOR_SUM in params.metrics:
                 raise NotImplementedError(
                     "PERCENTILE cannot be combined with VECTOR_SUM: the "
@@ -400,6 +414,9 @@ class JaxDPEngine:
                     f"[{params.min_value}, {params.max_value}]).")
 
     def _aggregate(self, col, params, data_extractors, public_partitions):
+        if params.custom_combiners:
+            return self._aggregate_custom(col, params, data_extractors,
+                                          public_partitions)
         # Same budget requests as the reference graph.
         compound = combiners_lib.create_compound_combiner(
             params, self._budget_accountant)
@@ -502,6 +519,218 @@ class JaxDPEngine:
                                        is_vector, l1_cap=l1_cap)
 
         return LazyJaxResult(compute, pk_vocab)
+
+    def _aggregate_custom(self, col, params: AggregateParams,
+                          data_extractors, public_partitions):
+        """Custom-combiner escape hatch (parity:
+        create_compound_combiner_with_custom_combiners, reference
+        combiners.py:925).
+
+        Contribution bounding runs on the device — the fused kernel's row
+        mask (columnar.bound_row_mask), identical sampling to the standard
+        metrics path — and the user's combiner logic (arbitrary Python)
+        runs on host over the surviving rows, grouped per (privacy_id,
+        partition). Private partition selection uses the standard strategy
+        over the compound accumulator's privacy-unit counts.
+        """
+        compound = combiners_lib.create_compound_combiner_with_custom_combiners(
+            params, self._budget_accountant, params.custom_combiners)
+        selection_spec = None
+        if public_partitions is None:
+            selection_spec = self._budget_accountant.request_budget(
+                mechanism_type=MechanismType.GENERIC)
+
+        # Host columns in float64: custom combiners receive the extracted
+        # values exactly (the standard path's float32 encoding is for the
+        # device kernels; user combiner logic must see what DPEngine sees).
+        # Value-less pipelines (value_extractor=None / value column absent)
+        # feed zeros, like DPEngine._extract_columns.
+        if isinstance(col, encoding.EncodedColumns):
+            # Pre-encoded dense-id columns: the float32 value column IS the
+            # input format; promote it for the host combiner math.
+            pid_e, pk_e, val_e, _, vocab_e = encoding.encode_rows(
+                col, None if params.contribution_bounds_already_enforced
+                else True, None, None, public_partitions=public_partitions)
+            pid_col = (None if params.contribution_bounds_already_enforced
+                       else pid_e)
+            pk_col = pk_e
+            value64 = np.asarray(val_e, dtype=np.float64)
+            pre_encoded_vocab = vocab_e
+        elif isinstance(col, encoding.ColumnarData):
+            pre_encoded_vocab = None
+            pid_col = (None if params.contribution_bounds_already_enforced
+                       else np.asarray(col.pid))
+            pk_col = np.asarray(col.pk)
+            value64 = (np.zeros(len(pk_col))
+                       if col.value is None else np.asarray(
+                           col.value, dtype=np.float64))
+        else:
+            pre_encoded_vocab = None
+            rows = list(col)
+            pk_col = encoding._column_from_list(
+                [data_extractors.partition_extractor(row) for row in rows])
+            if params.contribution_bounds_already_enforced:
+                pid_col = None
+            else:
+                pid_col = encoding._column_from_list(
+                    [data_extractors.privacy_id_extractor(row)
+                     for row in rows])
+            if data_extractors.value_extractor is None:
+                value64 = np.zeros(len(rows))
+            else:
+                value64 = np.asarray(
+                    [data_extractors.value_extractor(row) for row in rows],
+                    dtype=np.float64)
+        if pre_encoded_vocab is not None:
+            # encode_rows already applied the public filter and vocabulary.
+            pk = pk_col
+            pk_vocab = pre_encoded_vocab
+        elif public_partitions is not None:
+            pk_vocab = encoding.Vocabulary(list(public_partitions))
+            pk = encoding._lookup_ids(pk_col, pk_vocab)
+            in_public = pk >= 0
+            pk = pk[in_public]
+            value64 = value64[in_public]
+            if pid_col is not None:
+                pid_col = pid_col[in_public]
+        else:
+            pk, pk_uniques = encoding._factorize(pk_col)
+            pk_vocab = encoding.Vocabulary.from_unique(pk_uniques)
+        if pid_col is None:
+            pid = np.arange(len(pk), dtype=np.int32)
+        elif pre_encoded_vocab is not None:
+            pid = np.asarray(pid_col, dtype=np.int32)
+        else:
+            pid, _ = encoding._factorize(pid_col)
+        num_partitions = max(len(pk_vocab), 1)
+
+        # Cap derivation mirrors the standard path (jax_engine._aggregate):
+        # Linf sampling only when the compound expects it; L1 mode samples
+        # per privacy unit; perform_cross_partition_contribution_bounding
+        # =False disables L0 dropping (noise stays calibrated to the
+        # declared bound).
+        if (compound.expects_per_partition_sampling() and
+                params.max_contributions_per_partition):
+            linf_cap = params.max_contributions_per_partition
+        else:
+            linf_cap = max(len(pid), 1)
+        l0_cap = (params.max_partitions_contributed
+                  if params.max_partitions_contributed else num_partitions)
+        if not params.perform_cross_partition_contribution_bounding:
+            l0_cap = num_partitions
+        l1_cap = None
+        if params.max_contributions is not None:
+            l1_cap = params.max_contributions
+            linf_cap = max(len(pid), 1)
+            l0_cap = num_partitions
+        if params.contribution_bounds_already_enforced:
+            linf_cap = max(len(pid), 1)
+            l0_cap = num_partitions
+            self._add_report_stage(
+                "Contribution bounding: skipped (already enforced by the "
+                "caller)")
+        elif l1_cap is not None:
+            self._add_report_stage(
+                f"Total contribution bounding: for each privacy_id randomly "
+                f"select max(actual_contributions, {l1_cap}) contributions "
+                f"across all partitions")
+        else:
+            if compound.expects_per_partition_sampling():
+                self._add_report_stage(
+                    f"Per-partition contribution bounding: for each "
+                    f"privacy_id and each partition, randomly select "
+                    f"max(actual_contributions_per_partition, {linf_cap}) "
+                    f"contributions.")
+            if params.perform_cross_partition_contribution_bounding:
+                self._add_report_stage(
+                    f"Cross-partition contribution bounding: for each "
+                    f"privacy_id randomly select max(actual_partition_"
+                    f"contributed, {l0_cap}) partitions")
+        if selection_spec is not None:
+            self._add_report_stage(
+                lambda: f"Private partition selection: using "
+                        f"{params.partition_selection_strategy.value} "
+                        f"method with (eps={selection_spec.eps}, "
+                        f"delta={selection_spec.delta})")
+        for stage in compound.explain_computation():
+            self._add_report_stage(stage)
+        key = self._next_key()
+
+        def compute():
+            k_kernel, _ = jax.random.split(key)
+            n_rows = len(pid)
+            no_bounding = (params.contribution_bounds_already_enforced or
+                           (linf_cap >= max(n_rows, 1) and
+                            l0_cap >= num_partitions and l1_cap is None))
+            if no_bounding or n_rows == 0:
+                keep = np.ones(n_rows, dtype=bool)
+            else:
+                keep = np.asarray(
+                    columnar.bound_row_mask(k_kernel, jnp.asarray(pid),
+                                            jnp.asarray(pk),
+                                            jnp.ones(n_rows, dtype=bool),
+                                            linf_cap, l0_cap,
+                                            l1_cap=l1_cap))
+            kpid, kpk, kval = pid[keep], pk[keep], value64[keep]
+            # Host grouping: one lexsort, one accumulator per (pid, pk)
+            # group, merged per partition (the reference's per-key
+            # dataflow, collapsed).
+            acc_by_pk = {}
+            if len(kpid):
+                order = np.lexsort((kpk, kpid))
+                spid, spk, sval = kpid[order], kpk[order], kval[order]
+                is_start = np.empty(len(spid), dtype=bool)
+                is_start[0] = True
+                np.not_equal(spid[1:], spid[:-1], out=is_start[1:])
+                is_start[1:] |= spk[1:] != spk[:-1]
+                starts = np.flatnonzero(is_start)
+                ends = np.append(starts[1:], len(spid))
+                for s, e in zip(starts, ends):
+                    pk_id = int(spk[s])
+                    acc = compound.create_accumulator(sval[s:e].tolist())
+                    if pk_id in acc_by_pk:
+                        acc = compound.merge_accumulators(
+                            acc_by_pk[pk_id], acc)
+                    acc_by_pk[pk_id] = acc
+            if public_partitions is not None:
+                # Empty public partitions release metrics too (parity:
+                # DPEngine._add_empty_public_partitions).
+                for pk_id in range(num_partitions):
+                    if pk_id not in acc_by_pk:
+                        acc_by_pk[pk_id] = compound.create_accumulator([])
+                kept_ids = sorted(acc_by_pk)
+            else:
+                declared_l0 = (params.max_partitions_contributed
+                               or params.max_contributions or 1)
+                # With contribution_bounds_already_enforced each row is its
+                # own encoded privacy unit: estimate true units by dividing
+                # out the declared rows-per-unit bound (same adjustment as
+                # the standard path / dp_engine.py).
+                rows_per_unit = 1
+                if params.contribution_bounds_already_enforced:
+                    rows_per_unit = (params.max_contributions or
+                                     params.max_contributions_per_partition)
+                strategy = ps_lib.create_partition_selection_strategy(
+                    params.partition_selection_strategy, selection_spec.eps,
+                    selection_spec.delta, declared_l0,
+                    params.pre_threshold)
+                # Selection draws come from the secure sampler, not the
+                # engine seed (same stance as the standard host path).
+                kept_ids = sorted(
+                    pk_id for pk_id, acc in acc_by_pk.items()
+                    if strategy.should_keep(
+                        int(np.ceil(acc[0] / rows_per_unit))))
+            metrics = [
+                compound.compute_metrics(acc_by_pk[pk_id])
+                for pk_id in kept_ids
+            ]
+            return {
+                "partition_id": np.asarray(kept_ids, dtype=np.int32),
+                "keep_mask": np.ones(len(kept_ids), dtype=bool),
+                "metrics": metrics,
+            }
+
+        return _LazyCustomResult(compute, pk_vocab)
 
     # -- execution (after budgets resolve) ----------------------------------
 
@@ -868,12 +1097,20 @@ class JaxDPEngine:
         if self._mesh is not None:
             from pipelinedp_tpu.parallel import sharded
             if not dense_fits:
-                raise ValueError(
-                    f"PERCENTILE over {num_out} partitions exceeds the "
-                    f"{quantile_ops.MAX_HISTOGRAM_ELEMENTS}-element device "
-                    f"budget on the mesh path; run without a mesh (the "
-                    f"single-device engine blocks the computation) or use "
-                    f"DPEngine with LocalBackend.")
+                # Partition-blocked under the mesh: one sharded bounding
+                # mask, then a sharded histogram + reduce-scatter per
+                # block (sharded.blocked_quantile_columns).
+                return sharded.blocked_quantile_columns(
+                    self._mesh, k_kernel, pid, pk, value, mesh_valid_rows,
+                    num_partitions=num_out,
+                    num_leaves=num_leaves,
+                    lower=p.min_value,
+                    upper=p.max_value,
+                    linf_cap=linf_cap,
+                    l0_cap=l0_cap,
+                    num_quantiles=len(quantiles),
+                    finish_fn=finish,
+                    l1_cap=l1_cap)
             hist = sharded.quantile_leaf_histograms(
                 self._mesh, k_kernel, pid, pk, value, mesh_valid_rows,
                 num_partitions=num_partitions,

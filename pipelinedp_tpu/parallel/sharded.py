@@ -278,6 +278,156 @@ def quantile_leaf_histograms(mesh: Mesh, key, pid, pk, value, valid, *,
     return kernel(*args)
 
 
+@functools.lru_cache(maxsize=None)
+def _row_mask_kernel(mesh: Mesh, has_l1: bool = False):
+    """Sharded contribution-bounding row mask (row-sharded in and out).
+
+    One sampling pass shared by every partition block of the blocked
+    quantile path — the expensive per-device sorts run once, not once per
+    block."""
+
+    axes = tuple(mesh.axis_names)
+
+    def local_step(key, pid, pk, valid, linf_cap, l0_cap, *l1_args):
+        return columnar.bound_row_mask(_device_key(key, axes), pid, pk,
+                                       valid, linf_cap, l0_cap,
+                                       l1_cap=l1_args[0] if has_l1 else None)
+
+    spec = _spec(mesh)
+    fn = jax.shard_map(local_step,
+                       mesh=mesh,
+                       in_specs=(P(),) + (spec,) * 3 + (P(),) *
+                       (3 if has_l1 else 2),
+                       out_specs=spec,
+                       check_vma=False)
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _local_pk_sort_kernel(mesh: Mesh):
+    """Sorts each device's rows by partition id (one argsort + gathers) so
+    the per-block kernels can window a contiguous row range instead of
+    rescanning every row for every block."""
+
+    def local_step(pk, value, mask):
+        order = jnp.argsort(pk)
+        return pk[order], value[order], mask[order]
+
+    spec = _spec(mesh)
+    fn = jax.shard_map(local_step,
+                       mesh=mesh,
+                       in_specs=(spec,) * 3,
+                       out_specs=(spec,) * 3,
+                       check_vma=False)
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _block_rows_cap_kernel(mesh: Mesh, block_p: int, n_blocks: int):
+    """Max rows any device holds for any partition block (replicated
+    scalar) — the static window size of the block-histogram kernel."""
+
+    axes = tuple(mesh.axis_names)
+
+    def local_step(spk, mask):
+        block_of_row = jnp.minimum(spk // block_p, n_blocks - 1)
+        counts = jax.ops.segment_sum(mask.astype(jnp.int32), block_of_row,
+                                     num_segments=n_blocks,
+                                     indices_are_sorted=True)
+        m = counts.max()
+        for axis in axes:
+            m = jax.lax.pmax(m, axis)
+        return m
+
+    spec = _spec(mesh)
+    fn = jax.shard_map(local_step,
+                       mesh=mesh,
+                       in_specs=(spec, spec),
+                       out_specs=P(),
+                       check_vma=False)
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _block_hist_kernel(mesh: Mesh, block_p: int, num_leaves: int,
+                       window: int):
+    """Sharded [block_p, num_leaves] leaf histogram of one partition block
+    [p0, p0 + block_p) over pk-sorted local rows: each device slices the
+    `window` rows starting at its block boundary (searchsorted), so a
+    block's cost is proportional to the window, not the full row set."""
+
+    scatter = _scatter_axes(mesh)
+
+    def local_step(spk, value, mask, p0, lower, upper):
+        n_local = spk.shape[0]
+        start = jnp.searchsorted(spk, p0).astype(jnp.int32)
+        start = jnp.clip(start, 0, max(n_local - window, 0))
+        wpk = jax.lax.dynamic_slice_in_dim(spk, start, window)
+        wval = jax.lax.dynamic_slice_in_dim(value, start, window)
+        wmask = jax.lax.dynamic_slice_in_dim(mask, start, window)
+        in_block = wmask & (wpk >= p0) & (wpk < p0 + block_p)
+        local_pk = jnp.clip(wpk - p0, 0, block_p - 1)
+        hist = quantile_ops.leaf_histograms(local_pk, wval, in_block,
+                                            num_partitions=block_p,
+                                            num_leaves=num_leaves,
+                                            lower=lower,
+                                            upper=upper)
+        return _reduce_scatter(hist, scatter)
+
+    spec = _spec(mesh)
+    fn = jax.shard_map(local_step,
+                       mesh=mesh,
+                       in_specs=(spec,) * 3 + (P(),) * 3,
+                       out_specs=_part_spec(mesh),
+                       check_vma=False)
+    return jax.jit(fn)
+
+
+def blocked_quantile_columns(mesh: Mesh, key, pid, pk, value, valid, *,
+                             num_partitions: int, num_leaves: int, lower,
+                             upper, linf_cap, l0_cap, num_quantiles: int,
+                             finish_fn, l1_cap=None) -> np.ndarray:
+    """[num_partitions, num_quantiles] DP quantiles on the mesh, blocked.
+
+    Mesh twin of ops/quantiles.blocked_quantile_columns for partition
+    counts whose dense [partitions, leaves] layout exceeds the device
+    budget: the contribution-bounding mask is computed once (sharded), each
+    device sorts its rows by pk once, and each partition block histograms
+    only a contiguous row window (searchsorted + dynamic slice, padded to
+    the max per-device block population so one kernel serves every block).
+    The [block_p, num_leaves] result feeds finish_fn (noise + tree walk) —
+    identical released values to the dense path, bounded memory. The
+    eps/delta split is per tree, so per-block noising composes exactly.
+    """
+    n_dev = mesh.devices.size
+    block_p = max(1, quantile_ops.MAX_HISTOGRAM_ELEMENTS // num_leaves)
+    block_p = max(n_dev, (block_p // n_dev) * n_dev)
+    n_blocks = (num_partitions + block_p - 1) // block_p
+    dpid, dpk, dval, dvalid = _shard_and_put(mesh, pid, pk, value, valid)
+    mask_kernel = _row_mask_kernel(mesh, has_l1=l1_cap is not None)
+    args = (key, dpid, dpk, dvalid, linf_cap, l0_cap)
+    if l1_cap is not None:
+        args += (l1_cap,)
+    mask = mask_kernel(*args)
+    spk, sval, smask = _local_pk_sort_kernel(mesh)(dpk, dval, mask)
+    n_local = int(np.asarray(dpk.shape[0])) // n_dev
+    # Window = max per-device rows in any block, rounded up to a power of
+    # two (few compiled shapes); counting masked-out rows too keeps the
+    # window an upper bound on any block's slice.
+    cap = int(
+        _block_rows_cap_kernel(mesh, block_p, n_blocks)(
+            spk, jnp.ones_like(smask)))
+    window = 1 << max(cap - 1, 0).bit_length()
+    window = int(min(max(window, 1024), max(n_local, 1)))
+    hist_kernel = _block_hist_kernel(mesh, block_p, num_leaves, window)
+    out = np.zeros((num_partitions, num_quantiles), dtype=np.float64)
+    for p0 in range(0, num_partitions, block_p):
+        p1 = min(p0 + block_p, num_partitions)
+        hist = hist_kernel(spk, sval, smask, p0, float(lower), float(upper))
+        out[p0:p1] = np.asarray(finish_fn(hist))[:p1 - p0]
+    return out
+
+
 def _shard_and_put(mesh: Mesh, pid, pk, value, valid):
     """Stages host rows onto the mesh; passes through already-staged
     jax.Arrays so callers running several kernels over the same rows (e.g.

@@ -768,3 +768,351 @@ class TestPerformCrossPartitionBounding:
         jax_res, _, _ = run_jax(data, params, public=public)
         counts = np.array([jax_res[pk].count for pk in public])
         assert counts.sum() == pytest.approx(2.0, abs=0.05)
+
+
+class _SumOfSquaresCombiner(pdp.CustomCombiner):
+    """Test custom combiner: DP sum of squared values (its own Laplace
+    mechanism, per the reference's experimental custom-combiners example)."""
+
+    def __init__(self, max_value):
+        self._max_value = max_value
+
+    def request_budget(self, budget_accountant):
+        self._spec = budget_accountant.request_budget(
+            pdp.MechanismType.LAPLACE)
+
+    def create_accumulator(self, values):
+        return float(sum(v * v for v in values))
+
+    def merge_accumulators(self, a, b):
+        return a + b
+
+    def compute_metrics(self, acc):
+        from pipelinedp_tpu import dp_computations
+        p = self._aggregate_params
+        sens = dp_computations.Sensitivities(
+            l0=p.max_partitions_contributed,
+            linf=p.max_contributions_per_partition * self._max_value**2)
+        mech = dp_computations.create_additive_mechanism(self._spec, sens)
+        return {"sum_squares": mech.add_noise(acc)}
+
+    def explain_computation(self):
+        return "Custom DP sum of squares"
+
+
+class TestCustomCombinersOnJaxEngine:
+    """Custom combiners on the columnar engine (VERDICT-r3 task 9): device
+    contribution bounding + host combiner logic, matching DPEngine."""
+
+    def _params(self, l0=2, linf=3):
+        return pdp.AggregateParams(
+            metrics=None,
+            custom_combiners=[_SumOfSquaresCombiner(max_value=4.0)],
+            max_partitions_contributed=l0,
+            max_contributions_per_partition=linf)
+
+    def _data(self):
+        rng = np.random.default_rng(4)
+        return [(int(u), f"pk{int(p)}", float(v)) for u, p, v in zip(
+            rng.integers(0, 50, 600), rng.integers(0, 6, 600),
+            rng.uniform(0.0, 4.0, 600))]
+
+    def _run_jax(self, data, public=None, eps=1e8, l0=2, linf=3):
+        accountant = pdp.NaiveBudgetAccountant(eps, 1e-6)
+        engine = pdp.JaxDPEngine(accountant, seed=3)
+        result = engine.aggregate(data, self._params(l0, linf), extractors(),
+                                  public_partitions=public)
+        accountant.compute_budgets()
+        return dict(result), engine
+
+    def _run_local(self, data, public=None, eps=1e8, l0=2, linf=3):
+        accountant = pdp.NaiveBudgetAccountant(eps, 1e-6)
+        engine = pdp.DPEngine(accountant, pdp.LocalBackend())
+        result = engine.aggregate(data, self._params(l0, linf), extractors(),
+                                  public_partitions=public)
+        accountant.compute_budgets()
+        return dict(result)
+
+    def test_matches_local_engine_public_no_bounding_pressure(self):
+        # Caps above the data bounds: no sampling randomness; values
+        # match the host engine up to the near-zero (but independently
+        # drawn) noise — empty public partitions release pure noise.
+        data = self._data()
+        public = [f"pk{i}" for i in range(8)]  # incl. 2 empty partitions
+        jax_res, _ = self._run_jax(data, public, l0=10, linf=1000)
+        local_res = self._run_local(data, public, l0=10, linf=1000)
+        assert set(jax_res) == set(local_res)
+        for pk in local_res:
+            assert jax_res[pk][0]["sum_squares"] == pytest.approx(
+                local_res[pk][0]["sum_squares"], rel=1e-4, abs=0.05)
+
+    def test_bounding_applies_on_device(self):
+        # One user with 100 rows in one partition, linf=3: the surviving
+        # sum of squares is bounded by 3 * 16.
+        data = [(1, "a", 4.0)] * 100
+        jax_res, _ = self._run_jax(data, public=["a"], l0=1, linf=3)
+        assert jax_res["a"][0]["sum_squares"] == pytest.approx(48.0, abs=1.0)
+
+    def test_private_selection_drops_small_partitions(self):
+        data = ([(u, "big", 1.0) for u in range(2000)] +
+                [(9999, "tiny", 1.0)])
+        jax_res, _ = self._run_jax(data, public=None, eps=1.0, l0=1, linf=1)
+        assert "big" in jax_res and "tiny" not in jax_res
+
+    def test_explain_report_carries_custom_stage(self):
+        data = self._data()
+        _, engine = self._run_jax(data, public=[f"pk{i}" for i in range(6)])
+        report = engine.explain_computations_report()[0]
+        assert "Custom DP sum of squares" in report
+
+    def test_custom_with_mesh_raises(self):
+        from pipelinedp_tpu.parallel import sharded
+        accountant = pdp.NaiveBudgetAccountant(1.0, 1e-6)
+        engine = pdp.JaxDPEngine(accountant, mesh=sharded.make_mesh(8))
+        with pytest.raises(NotImplementedError, match="mesh"):
+            engine.aggregate(self._data(), self._params(), extractors(),
+                             public_partitions=["pk0"])
+
+
+class TestNoiseSelectionMetricCrossProduct:
+    """noise kind x selection strategy x metric set, e2e on the columnar
+    engine with private partition selection (VERDICT-r3 task 8): a large
+    partition survives with roughly-right values, a lone-user partition is
+    dropped."""
+
+    @pytest.mark.parametrize("noise_kind",
+                             [pdp.NoiseKind.LAPLACE,
+                              pdp.NoiseKind.GAUSSIAN])
+    @pytest.mark.parametrize(
+        "strategy", [pdp.PartitionSelectionStrategy.TRUNCATED_GEOMETRIC,
+                     pdp.PartitionSelectionStrategy.LAPLACE_THRESHOLDING,
+                     pdp.PartitionSelectionStrategy.GAUSSIAN_THRESHOLDING])
+    @pytest.mark.parametrize("metric_set", [
+        [pdp.Metrics.COUNT, pdp.Metrics.SUM],
+        [pdp.Metrics.PRIVACY_ID_COUNT],
+        [pdp.Metrics.MEAN],
+    ])
+    def test_e2e_private_selection(self, noise_kind, strategy, metric_set):
+        data = ([(u, "big", 2.0) for u in range(3000)] +
+                [(777777, "lonely", 2.0)])
+        needs_bounds = (pdp.Metrics.SUM in metric_set or
+                        pdp.Metrics.MEAN in metric_set)
+        params = pdp.AggregateParams(
+            metrics=metric_set,
+            noise_kind=noise_kind,
+            partition_selection_strategy=strategy,
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            min_value=0.0 if needs_bounds else None,
+            max_value=4.0 if needs_bounds else None)
+        accountant = pdp.NaiveBudgetAccountant(1.0, 1e-6)
+        engine = pdp.JaxDPEngine(accountant, seed=7)
+        result = engine.aggregate(data, params, extractors())
+        accountant.compute_budgets()
+        res = dict(result)
+        assert "big" in res, (noise_kind, strategy, metric_set)
+        assert "lonely" not in res, (noise_kind, strategy, metric_set)
+        m = res["big"]
+        if pdp.Metrics.COUNT in metric_set:
+            assert m.count == pytest.approx(3000, rel=0.1)
+        if pdp.Metrics.SUM in metric_set:
+            assert m.sum == pytest.approx(6000, rel=0.1)
+        if pdp.Metrics.PRIVACY_ID_COUNT in metric_set:
+            assert m.privacy_id_count == pytest.approx(3000, rel=0.1)
+        if pdp.Metrics.MEAN in metric_set:
+            assert m.mean == pytest.approx(2.0, abs=0.5)
+
+
+class TestCustomCombinerParamModes:
+    """Parameter combinations on the custom-combiner path must track the
+    standard path's semantics (round-4 review findings)."""
+
+    def _ext(self):
+        return extractors()
+
+    def _agg(self, data, params, public=None, eps=1e8):
+        accountant = pdp.NaiveBudgetAccountant(eps, 1e-6)
+        engine = pdp.JaxDPEngine(accountant, seed=2)
+        result = engine.aggregate(data, params, self._ext(),
+                                  public_partitions=public)
+        accountant.compute_budgets()
+        return dict(result)
+
+    def test_l1_mode_bounds_total_contributions(self):
+        # One user, 100 rows of 1.0 in one partition; max_contributions=2
+        # bounds the TOTAL sample: the custom sum sees at most 2 rows.
+        class L1Sum(pdp.CustomCombiner):
+            def request_budget(self, accountant):
+                self._spec = accountant.request_budget(
+                    pdp.MechanismType.LAPLACE)
+
+            def create_accumulator(self, values):
+                return float(np.sum(np.clip(values, -4.0, 4.0) ** 2))
+
+            def merge_accumulators(self, a, b):
+                return a + b
+
+            def compute_metrics(self, acc):
+                from pipelinedp_tpu import dp_computations
+                p = self._aggregate_params
+                mech = dp_computations.create_additive_mechanism(
+                    self._spec,
+                    dp_computations.Sensitivities(
+                        l0=1, linf=p.max_contributions * 16.0))
+                return {"sum_squares": mech.add_noise(acc)}
+
+            def explain_computation(self):
+                return "L1-bounded sum of squares"
+
+        data = [(1, "a", 1.0)] * 100
+        params = pdp.AggregateParams(
+            metrics=None,
+            custom_combiners=[L1Sum()],
+            max_partitions_contributed=None,
+            max_contributions_per_partition=None,
+            max_contributions=2)
+        res = self._agg(data, params, public=["a"])
+        assert res["a"][0]["sum_squares"] == pytest.approx(2.0, abs=0.5)
+
+    def test_float64_values_exact(self):
+        # Values above 2^24 are exact (float32 encoding would round them).
+        big = float(1 << 25) + 1.0
+
+        class ExactSum(pdp.CustomCombiner):
+            def request_budget(self, accountant):
+                self._spec = accountant.request_budget(
+                    pdp.MechanismType.LAPLACE)
+
+            def create_accumulator(self, values):
+                return float(sum(values))
+
+            def merge_accumulators(self, a, b):
+                return a + b
+
+            def compute_metrics(self, acc):
+                return {"exact_sum": acc}  # no noise: precision test only
+
+            def explain_computation(self):
+                return "exact sum"
+
+        data = [(1, "a", big), (2, "a", big)]
+        params = pdp.AggregateParams(
+            metrics=None, custom_combiners=[ExactSum()],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1)
+        res = self._agg(data, params, public=["a"])
+        assert res["a"][0]["exact_sum"] == 2 * big  # bit-exact
+
+    def test_bounds_already_enforced_selection_adjustment(self):
+        # 30 rows, declared 10 rows per unit -> ~3 estimated units: with
+        # eps=1 and delta=1e-6 a 3-unit partition is (nearly) always
+        # dropped, while 3000 rows (~300 units) survives.
+        data = ([(0, "big", 1.0)] * 3000 + [(0, "small", 1.0)] * 30)
+        params = pdp.AggregateParams(
+            metrics=None,
+            custom_combiners=[_SumOfSquaresCombiner(max_value=4.0)],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=10,
+            contribution_bounds_already_enforced=True)
+        res = self._agg(data, params, eps=1.0)
+        assert "big" in res and "small" not in res
+
+    def test_no_cross_partition_bounding_mode(self):
+        # One user in 5 partitions with l0=1: with cross-partition
+        # bounding off, every partition keeps its contribution.
+        data = [(1, f"pk{i}", 1.0) for i in range(5)]
+        params = pdp.AggregateParams(
+            metrics=None,
+            custom_combiners=[_SumOfSquaresCombiner(max_value=4.0)],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            perform_cross_partition_contribution_bounding=False)
+        res = self._agg(data, params, public=[f"pk{i}" for i in range(5)])
+        values = [res[f"pk{i}"][0]["sum_squares"] for i in range(5)]
+        assert all(v == pytest.approx(1.0, abs=0.3) for v in values)
+
+    def test_post_aggregation_thresholding_rejected(self):
+        params = pdp.AggregateParams(
+            metrics=None,
+            custom_combiners=[_SumOfSquaresCombiner(max_value=4.0)],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            post_aggregation_thresholding=True)
+        accountant = pdp.NaiveBudgetAccountant(1.0, 1e-6)
+        engine = pdp.JaxDPEngine(accountant)
+        with pytest.raises(ValueError, match="PRIVACY_ID_COUNT"):
+            engine.aggregate([(1, "a", 1.0)], params, self._ext())
+
+    def test_no_linf_stage_when_combiner_owns_bounding(self):
+        class SelfBounding(_SumOfSquaresCombiner):
+            def expects_per_partition_sampling(self):
+                return False
+
+        params = pdp.AggregateParams(
+            metrics=None, custom_combiners=[SelfBounding(max_value=4.0)],
+            max_partitions_contributed=2,
+            max_contributions_per_partition=3)
+        accountant = pdp.NaiveBudgetAccountant(1e8, 1e-6)
+        engine = pdp.JaxDPEngine(accountant, seed=2)
+        engine.aggregate([(1, "a", 1.0)], params, self._ext(),
+                         public_partitions=["a"])
+        accountant.compute_budgets()
+        text = engine.explain_computations_report()[0]
+        assert "Per-partition contribution bounding" not in text
+        assert "Cross-partition contribution bounding" in text
+
+    def test_value_less_pipeline(self):
+        # value_extractor=None (count-style custom combiner): values are
+        # zeros, like DPEngine._extract_columns substitutes.
+        class CountRows(pdp.CustomCombiner):
+            def request_budget(self, accountant):
+                self._spec = accountant.request_budget(
+                    pdp.MechanismType.LAPLACE)
+
+            def create_accumulator(self, values):
+                return len(values)
+
+            def merge_accumulators(self, a, b):
+                return a + b
+
+            def compute_metrics(self, acc):
+                return {"rows": acc}
+
+            def explain_computation(self):
+                return "row count"
+
+        data = [(u, "a", None) for u in range(10)]
+        ext = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                 partition_extractor=lambda r: r[1],
+                                 value_extractor=None)
+        params = pdp.AggregateParams(
+            metrics=None, custom_combiners=[CountRows()],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1)
+        accountant = pdp.NaiveBudgetAccountant(1e8, 1e-6)
+        engine = pdp.JaxDPEngine(accountant, seed=2)
+        result = engine.aggregate(data, params, ext, public_partitions=["a"])
+        accountant.compute_budgets()
+        assert dict(result)["a"][0]["rows"] == 10
+
+    def test_encoded_columns_input(self):
+        from pipelinedp_tpu.ops import encoding
+        col = encoding.EncodedColumns(
+            pid=np.arange(12, dtype=np.int32) % 4,
+            pk=np.arange(12, dtype=np.int32) % 3,
+            num_partitions=3,
+            value=np.full(12, 3.0, dtype=np.float64))
+        params = pdp.AggregateParams(
+            metrics=None,
+            custom_combiners=[_SumOfSquaresCombiner(max_value=4.0)],
+            max_partitions_contributed=3,
+            max_contributions_per_partition=4)
+        accountant = pdp.NaiveBudgetAccountant(1e8, 1e-6)
+        engine = pdp.JaxDPEngine(accountant, seed=2)
+        result = engine.aggregate(col, params)
+        accountant.compute_budgets()
+        res = dict(result)
+        assert len(res) == 3
+        # 4 rows of 3.0^2 = 9 each per partition.
+        for v in res.values():
+            assert v[0]["sum_squares"] == pytest.approx(36.0, abs=1.0)
