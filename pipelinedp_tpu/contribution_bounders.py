@@ -117,6 +117,13 @@ class SamplingCrossPartitionContributionBounder(ContributionBounder):
         sample_size = params.max_partitions_contributed
         col = backend.map_values(col, lambda a: sample(a, sample_size),
                                  "Sample")
+        # The reference's twin adds no stage here (contribution_bounders
+        # .py:159-201) — an explain-report gap; the bound is real, so
+        # report it.
+        report_generator.add_stage(
+            f"Cross-partition contribution bounding: for each privacy_id "
+            f"randomly select max(actual_partition_contributed, "
+            f"{sample_size}) partitions")
 
         def unnest(pid, partition_values):
             return (((pid, pk), values) for pk, values in partition_values)

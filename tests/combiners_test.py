@@ -278,3 +278,177 @@ class TestCreateCompoundCombiner:
                           combiners.PostAggregationThresholdingCombiner)
         assert (acc._mechanisms[0].mechanism_spec.mechanism_type ==
                 MechanismType.LAPLACE_THRESHOLDING)
+
+
+def value_params(**overrides):
+    kwargs = dict(metrics=[pdp.Metrics.MEAN],
+                  max_partitions_contributed=2,
+                  max_contributions_per_partition=3,
+                  min_value=0.0,
+                  max_value=10.0)
+    kwargs.update(overrides)
+    return pdp.AggregateParams(**kwargs)
+
+
+class TestMergeAlgebra:
+    """Merge must be associative and commutative for every combiner —
+    the property the distributed reduce relies on (reference
+    tests/combiners_test.py's merge coverage)."""
+
+    def _combiners(self):
+        params = value_params()
+        yield combiners.CountCombiner(no_noise_spec(), count_params())
+        yield combiners.SumCombiner(
+            no_noise_spec(),
+            count_params(metrics=[pdp.Metrics.SUM], min_value=0.0,
+                         max_value=5.0))
+        yield combiners.PrivacyIdCountCombiner(
+            no_noise_spec(), count_params(
+                metrics=[pdp.Metrics.PRIVACY_ID_COUNT]))
+        yield combiners.MeanCombiner(no_noise_spec(), no_noise_spec(),
+                                     params, ["mean"])
+        yield combiners.VarianceCombiner(
+            combiners.CombinerParams(
+                no_noise_spec(),
+                value_params(metrics=[pdp.Metrics.VARIANCE])),
+            ["variance"])
+        yield combiners.VectorSumCombiner(
+            combiners.CombinerParams(
+                no_noise_spec(),
+                count_params(metrics=[pdp.Metrics.VECTOR_SUM],
+                             vector_size=3,
+                             vector_max_norm=10.0)))
+
+    def _random_batches(self, combiner, rng):
+        if isinstance(combiner, combiners.VectorSumCombiner):
+            return [[rng.uniform(0, 1, 3)] for _ in range(3)]
+        return [list(rng.uniform(0, 10, rng.integers(1, 6)))
+                for _ in range(3)]
+
+    def _flat(self, acc):
+        leaves = acc if isinstance(acc, tuple) else (acc,)
+        return np.concatenate([np.atleast_1d(np.asarray(leaf, dtype=float))
+                               for leaf in leaves])
+
+    def test_associative_and_commutative(self):
+        rng = np.random.default_rng(0)
+        for combiner in self._combiners():
+            a, b, c = (combiner.create_accumulator(batch)
+                       for batch in self._random_batches(combiner, rng))
+            left = combiner.merge_accumulators(
+                combiner.merge_accumulators(a, b), c)
+            right = combiner.merge_accumulators(
+                a, combiner.merge_accumulators(b, c))
+            np.testing.assert_allclose(self._flat(left), self._flat(right),
+                                       err_msg=type(combiner).__name__)
+            ab = combiner.merge_accumulators(a, b)
+            ba_merge = combiner.merge_accumulators(b, a)
+            np.testing.assert_allclose(self._flat(ab), self._flat(ba_merge),
+                                       err_msg=type(combiner).__name__)
+
+    def test_quantile_merge_associative(self):
+        params = combiners.CombinerParams(
+            no_noise_spec(),
+            value_params(metrics=[pdp.Metrics.PERCENTILE(50)]))
+        combiner = combiners.QuantileCombiner(params, [50])
+        rng = np.random.default_rng(1)
+        a, b, c = (combiner.create_accumulator(list(rng.uniform(0, 10, 20)))
+                   for _ in range(3))
+        left = combiner.merge_accumulators(
+            combiner.merge_accumulators(a, b), c)
+        right = combiner.merge_accumulators(
+            a, combiner.merge_accumulators(b, c))
+        assert left == right  # serialized summaries are bytes: exact
+
+
+class TestAccumulatorSerialization:
+    """Accumulators and combiners cross the driver/worker pickle boundary
+    (reference combiners.py:203-217 contract)."""
+
+    def test_all_accumulators_pickle_roundtrip(self):
+        params = value_params()
+        cases = [
+            (combiners.CountCombiner(no_noise_spec(), count_params()),
+             [1.0, 2.0]),
+            (combiners.MeanCombiner(no_noise_spec(), no_noise_spec(), params,
+                                    ["mean", "count", "sum"]), [3.0, 4.0]),
+            (combiners.VarianceCombiner(
+                combiners.CombinerParams(
+                    no_noise_spec(),
+                    value_params(metrics=[pdp.Metrics.VARIANCE])),
+                ["variance"]), [3.0, 4.0, 5.0]),
+            (combiners.QuantileCombiner(
+                combiners.CombinerParams(
+                    no_noise_spec(),
+                    value_params(metrics=[pdp.Metrics.PERCENTILE(50)])),
+                [50]), list(range(10))),
+        ]
+        for combiner, values in cases:
+            acc = combiner.create_accumulator(values)
+            restored = pickle.loads(pickle.dumps(acc))
+            merged = combiner.merge_accumulators(acc, restored)
+            # The round-tripped accumulator is still mergeable and
+            # produces finite metrics.
+            metrics = combiner.compute_metrics(merged)
+            assert all(np.isfinite(v) for v in np.atleast_1d(
+                list(metrics.values()) if isinstance(metrics, dict)
+                else metrics))
+
+    def test_mean_combiner_pickles_without_mechanism(self):
+        params = value_params()
+        combiner = combiners.MeanCombiner(no_noise_spec(), no_noise_spec(),
+                                          params, ["mean"])
+        combiner.compute_metrics((5, 2.0))  # instantiate the mechanism
+        restored = pickle.loads(pickle.dumps(combiner))
+        assert not hasattr(restored, "_mechanism")
+        result = restored.compute_metrics((5, 2.0))
+        assert result["mean"] == pytest.approx(5.4, abs=0.1)
+
+
+class TestBudgetSplitsPerMetric:
+    """(eps, delta) splits across metrics resolve exactly (reference
+    tests/combiners_test.py budget assertions + budget_accounting math)."""
+
+    def test_equal_split_three_metrics(self):
+        acc = ba.NaiveBudgetAccountant(3.0, 3e-6)
+        params = count_params(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM,
+                     pdp.Metrics.PRIVACY_ID_COUNT],
+            noise_kind=pdp.NoiseKind.GAUSSIAN,
+            min_value=0.0, max_value=1.0)
+        compound = combiners.create_compound_combiner(params, acc)
+        acc.compute_budgets()
+        for combiner in compound.combiners:
+            spec = combiner.mechanism_spec()
+            assert spec.eps == pytest.approx(1.0)
+            assert spec.delta == pytest.approx(1e-6)
+
+    def test_laplace_consumes_no_delta(self):
+        acc = ba.NaiveBudgetAccountant(2.0, 1e-6)
+        params = count_params(metrics=[pdp.Metrics.COUNT])
+        compound = combiners.create_compound_combiner(params, acc)
+        selection = acc.request_budget(MechanismType.GENERIC)
+        acc.compute_budgets()
+        # eps splits evenly; all delta goes to the GENERIC selection.
+        assert compound.combiners[0].mechanism_spec().eps == pytest.approx(
+            1.0)
+        assert compound.combiners[0].mechanism_spec().delta == 0.0
+        assert selection.delta == pytest.approx(1e-6)
+
+    def test_budget_weight_scales_share(self):
+        acc = ba.NaiveBudgetAccountant(3.0, 0.0)
+        with acc.scope(weight=1.0):
+            spec_a = acc.request_budget(MechanismType.LAPLACE)
+        with acc.scope(weight=2.0):
+            spec_b = acc.request_budget(MechanismType.LAPLACE)
+        acc.compute_budgets()
+        assert spec_a.eps == pytest.approx(1.0)
+        assert spec_b.eps == pytest.approx(2.0)
+
+    def test_mean_splits_between_count_and_sum(self):
+        acc = ba.NaiveBudgetAccountant(1.0, 0.0)
+        params = value_params(metrics=[pdp.Metrics.MEAN])
+        compound = combiners.create_compound_combiner(params, acc)
+        acc.compute_budgets()
+        count_spec, sum_spec = compound.combiners[0].mechanism_spec()
+        assert count_spec.eps + sum_spec.eps == pytest.approx(1.0)

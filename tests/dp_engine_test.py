@@ -330,3 +330,137 @@ class TestStatistical:
         expected_std = n_partitions * np.sqrt(2)
         assert abs(errors.mean()) < expected_std / 3
         assert errors.std() == pytest.approx(expected_std, rel=0.25)
+
+
+class TestComputationGraph:
+    """Stage-sequence assertions on the explain report (the reference's
+    computation-graph tests, tests/dp_engine_test.py:528-630): the report
+    is the contract for WHAT the engine did to the data."""
+
+    def _report(self, params, public=None, data=None):
+        engine, accountant = make_engine()
+        report = pdp.ExplainComputationReport()
+        engine.aggregate(data or dataset(), params, extractors(),
+                         public_partitions=public,
+                         out_explain_computation_report=report)
+        accountant.compute_budgets()
+        return report.text()
+
+    def test_standard_graph_stage_order(self):
+        text = self._report(
+            pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                max_partitions_contributed=2,
+                                max_contributions_per_partition=3),
+            public=["a", "b"])
+        stages = text.splitlines()
+        idx = {}
+        for marker in ("Per-partition contribution bounding",
+                       "Cross-partition contribution bounding",
+                       "Computed DP count"):
+            idx[marker] = next(i for i, s in enumerate(stages) if marker in s)
+        assert (idx["Per-partition contribution bounding"] <
+                idx["Cross-partition contribution bounding"] <
+                idx["Computed DP count"])
+
+    def test_l1_mode_graph(self):
+        text = self._report(
+            pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                max_partitions_contributed=None,
+                                max_contributions_per_partition=None,
+                                max_contributions=5),
+            public=["a", "b"])
+        assert "max_contributions" in text or "Total contribution" in text
+        assert "Cross-partition contribution bounding" not in text
+
+    def test_per_partition_sum_bounds_graph(self):
+        text = self._report(
+            pdp.AggregateParams(metrics=[pdp.Metrics.SUM],
+                                max_partitions_contributed=2,
+                                max_contributions_per_partition=3,
+                                min_sum_per_partition=0.0,
+                                max_sum_per_partition=5.0),
+            public=["a", "b"])
+        # Linf sampling is the combiner's job in this mode (per-partition
+        # sum clipping): only the cross-partition stage appears.
+        assert "Cross-partition contribution bounding" in text
+        assert "Per-partition contribution bounding" not in text
+
+    def test_private_selection_graph(self):
+        engine, accountant = make_engine(eps=1.0, delta=1e-6)
+        report = pdp.ExplainComputationReport()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1)
+        engine.aggregate(dataset(), params, extractors(),
+                         out_explain_computation_report=report)
+        accountant.compute_budgets()
+        text = report.text()
+        assert "Private Partition selection" in text
+        assert "Truncated Geometric" in text
+
+    def test_post_aggregation_thresholding_graph(self):
+        engine, accountant = make_engine(eps=1.0, delta=1e-6)
+        report = pdp.ExplainComputationReport()
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PRIVACY_ID_COUNT],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            post_aggregation_thresholding=True)
+        engine.aggregate(dataset(), params, extractors(),
+                         out_explain_computation_report=report)
+        accountant.compute_budgets()
+        assert "threshold" in report.text().lower()
+
+
+class TestValidationMatrix:
+    """Engine-level rejection of invalid requests (reference
+    tests/dp_engine_test.py validation coverage)."""
+
+    def test_row_input_requires_extractors(self):
+        engine, _ = make_engine()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1)
+        with pytest.raises((TypeError, ValueError)):
+            engine.aggregate(dataset(), params, None)
+
+    def test_select_partitions_validation(self):
+        engine, _ = make_engine()
+        with pytest.raises(ValueError):
+            engine.select_partitions(
+                dataset(), pdp.SelectPartitionsParams(
+                    max_partitions_contributed=0), extractors())
+
+    def test_sum_requires_bounds(self):
+        with pytest.raises(ValueError):
+            pdp.AggregateParams(metrics=[pdp.Metrics.SUM],
+                                max_partitions_contributed=1,
+                                max_contributions_per_partition=1)
+
+    def test_min_above_max_rejected(self):
+        with pytest.raises(ValueError):
+            pdp.AggregateParams(metrics=[pdp.Metrics.MEAN],
+                                max_partitions_contributed=1,
+                                max_contributions_per_partition=1,
+                                min_value=2.0, max_value=1.0)
+
+    def test_l1_mode_excludes_l0_linf(self):
+        with pytest.raises(ValueError):
+            pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                max_partitions_contributed=2,
+                                max_contributions_per_partition=1,
+                                max_contributions=5)
+
+    def test_second_aggregation_shares_budget(self):
+        # Two aggregations on one accountant: both resolve, splitting eps.
+        accountant = pdp.NaiveBudgetAccountant(2.0, 1e-6)
+        engine = pdp.DPEngine(accountant, pdp.LocalBackend())
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1)
+        r1 = engine.aggregate(dataset(), params, extractors(),
+                              public_partitions=["a"])
+        r2 = engine.aggregate(dataset(), params, extractors(),
+                              public_partitions=["a"])
+        accountant.compute_budgets()
+        assert len(list(r1)) == 1 and len(list(r2)) == 1
