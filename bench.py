@@ -111,7 +111,8 @@ def bench_kernel(pid, pk, value) -> float:
             num_partitions=N_PARTITIONS,
             linf_cap=LINF_CAP, l0_cap=L0_CAP,
             row_clip_lo=0.0, row_clip_hi=5.0, middle=2.5,
-            group_clip_lo=-jnp.inf, group_clip_hi=jnp.inf)
+            group_clip_lo=-jnp.inf, group_clip_hi=jnp.inf,
+            need_norm=False, need_norm_sq=False, has_group_clip=False)
         k_sel, k_c, k_s = jax.random.split(jax.random.fold_in(key, 1), 3)
         keep, _ = selection_ops.select_partitions(k_sel, accs.pid_count, sp,
                                                   accs.pid_count > 0)

@@ -742,6 +742,25 @@ class JaxDPEngine:
         has_quantile = any(
             isinstance(c, combiners_lib.QuantileCombiner)
             for c in compound.combiners)
+        # Accumulators no combiner reads are never computed: each dropped
+        # column saves two full-HBM segment passes in the kernel
+        # (columnar.bound_and_aggregate need_* flags).
+        need_flags = (
+            any(isinstance(c, (combiners_lib.CountCombiner,
+                               combiners_lib.MeanCombiner,
+                               combiners_lib.VarianceCombiner))
+                for c in compound.combiners),
+            any(isinstance(c, combiners_lib.SumCombiner)
+                for c in compound.combiners),
+            any(isinstance(c, (combiners_lib.MeanCombiner,
+                               combiners_lib.VarianceCombiner))
+                for c in compound.combiners),
+            any(isinstance(c, combiners_lib.VarianceCombiner)
+                for c in compound.combiners),
+        )
+        # Group-level sum clipping exists only in the per-partition-bounds
+        # mode; without it the kernel scatters rows straight to partitions.
+        has_group_clip = bool(params.bounds_per_partition_are_set)
 
         if params.bounds_per_partition_are_set:
             row_lo, row_hi = -np.inf, np.inf
@@ -787,7 +806,9 @@ class JaxDPEngine:
                     middle=middle,
                     group_clip_lo=glo,
                     group_clip_hi=ghi,
-                    l1_cap=l1_cap)
+                    l1_cap=l1_cap,
+                    need_flags=need_flags,
+                    has_group_clip=has_group_clip)
         elif is_vector:
             vector_sums, accs = columnar.bound_and_aggregate_vector(
                 k_kernel, jnp.asarray(pid), jnp.asarray(pk),
@@ -816,7 +837,9 @@ class JaxDPEngine:
                 group_clip_hi=ghi,
                 l1_cap=l1_cap,
                 n_chunks=self._stream_chunks,
-                value_transfer_dtype=self._value_transfer_dtype)
+                value_transfer_dtype=self._value_transfer_dtype,
+                need_flags=need_flags,
+                has_group_clip=has_group_clip)
         else:
             accs = columnar.bound_and_aggregate(
                 k_kernel, jnp.asarray(pid), jnp.asarray(pk),
@@ -829,7 +852,12 @@ class JaxDPEngine:
                 middle=middle,
                 group_clip_lo=glo,
                 group_clip_hi=ghi,
-                l1_cap=l1_cap)
+                l1_cap=l1_cap,
+                need_count=need_flags[0],
+                need_sum=need_flags[1],
+                need_norm=need_flags[2],
+                need_norm_sq=need_flags[3],
+                has_group_clip=has_group_clip)
 
         # On a mesh the accumulators are padded so the partition dimension
         # shards evenly; all downstream math runs on the padded arrays and

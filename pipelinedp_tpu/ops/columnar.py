@@ -2,22 +2,40 @@
 
 This is the TPU-native replacement for the reference's per-row dataflow
 (contribution_bounders.py + combiners.py + the per-key shuffle of
-pipeline_backend.py): the whole bound-and-aggregate stage is two sorts and a
+pipeline_backend.py): the whole bound-and-aggregate stage is ONE sort plus a
 handful of segment reductions over fixed-shape arrays, entirely inside jit.
 
 Dataflow (bound_and_aggregate):
-  1. lexsort rows by (privacy_id, partition_key, uniform) — the uniform
-     tiebreak makes each (pid, pk) group a random permutation, so "rank <
-     cap" is exact sampling without replacement (the sample_fixed_per_key of
-     the reference, done once for all keys).
+  1. lexsort rows by (privacy_id, group_hash, partition_key, uniform),
+     where group_hash is a keyed 32-bit mix of (pid, pk): within each
+     privacy id the (pid, pk) groups land in hash order — a uniform random
+     permutation of the groups — and within each group the rows land in
+     uniform-tiebreak order. One sort therefore provides BOTH sampling
+     permutations (the reference's two sample_fixed_per_key passes).
   2. rank rows within (pid, pk) via a cummax over group-start indices; keep
      rank < max_contributions_per_partition  (Linf bounding).
-  3. reduce rows -> (pid, pk) group accumulators with segment sums.
-  4. lexsort groups by (pid, uniform); rank within pid; keep rank <
-     max_partitions_contributed  (L0 bounding).
+  3. rank groups within pid via the group counter minus its value at the
+     pid's first row; keep rank < max_partitions_contributed (L0 bounding)
+     — no second sort: group order within a pid is already random.
+  4. reduce rows -> (pid, pk) group accumulators with per-column
+     segment-sums over the sorted (hence monotone) group ids.
   5. reduce kept groups -> per-partition accumulators (count, clipped sum,
      normalized sum, normalized sum of squares, privacy-id count) with
-     segment sums into [num_partitions] arrays.
+     per-column segment-sums into [num_partitions] arrays.
+
+The round-4 profile attributed the kernel plateau to pass count, not sort
+cost (each 100M-row segment-sum/gather is a full HBM round trip at ~1s on
+v5e; the 3-key sort itself is 0.8s): this layout runs 1 sort + the minimal
+set of reductions (static need_* flags drop the accumulators a metric set
+does not read) instead of 2 sorts + ~10 unconditional reductions. Columns
+stay separate [N] arrays: a "fused" [N, k] operand is tile-padded k -> 128
+lanes on TPU (a 20x memory blowup measured slower, not faster).
+
+Sampling exactness: the group permutation is uniform iff group hashes are
+i.i.d. uniform; the keyed murmur3-style finalizer gives 32-bit avalanche
+mixing, and ties (probability ~m^2/2^33 per privacy id with m groups) fall
+back to pk order — a negligible, documented bias. Row order within groups
+uses an exact uniform tiebreak as before.
 
 All shapes static; caps and clip bounds are runtime scalars. Padding rows
 (for sharding) carry valid=False and are routed to the end of the sort.
@@ -52,7 +70,8 @@ def _segment_rank(sorted_is_start: jnp.ndarray) -> jnp.ndarray:
 
 
 class SampledRows(NamedTuple):
-    """The Linf/L0 sampling decisions, in (pid, pk, uniform)-sorted order.
+    """The Linf/L0 sampling decisions, in (pid, ghash, pk, uniform)-sorted
+    order.
 
     The single source of truth for contribution bounding: every kernel
     (scalar, vector, row-mask) derives from this so their sampling stays
@@ -65,8 +84,23 @@ class SampledRows(NamedTuple):
     is_start: jnp.ndarray  # (pid, pk)-group start marker
     group_id: jnp.ndarray  # dense (pid, pk)-group index per sorted row
     keep_row: jnp.ndarray  # Linf sampling decision per sorted row
-    keep_group: jnp.ndarray  # L0 sampling decision per group slot
-    g_valid: jnp.ndarray  # group slot holds a real group
+    keep_group_row: jnp.ndarray  # L0 decision of the row's group, per row
+    sval: Optional[jnp.ndarray]  # sorted values (when passed to the sort)
+
+
+def _group_hash(pid: jnp.ndarray, pk: jnp.ndarray,
+                salt: jnp.ndarray) -> jnp.ndarray:
+    """Keyed 32-bit mix of (pid, pk): the random group order within each
+    privacy id (murmur3-style finalizer for avalanche; salt from the PRNG
+    key so sampling differs between kernel invocations)."""
+    x = pid.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+    x = x ^ (pk.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)) ^ salt
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
 
 
 def _l1_sample_mask(key: jax.Array, pid: jnp.ndarray, valid: jnp.ndarray,
@@ -91,12 +125,17 @@ def _l1_sample_mask(key: jax.Array, pid: jnp.ndarray, valid: jnp.ndarray,
 
 def _sample_rows_and_groups(key: jax.Array, pid: jnp.ndarray,
                             pk: jnp.ndarray, valid: jnp.ndarray, linf_cap,
-                            l0_cap, l1_cap=None) -> SampledRows:
-    """Sorts rows by (pid, pk, uniform) and samples Linf rows / L0 groups.
+                            l0_cap, l1_cap=None,
+                            value: Optional[jnp.ndarray] = None
+                            ) -> SampledRows:
+    """ONE sort of rows by (pid, group_hash, pk, uniform); samples Linf
+    rows and L0 groups from it (module docstring steps 1-3).
 
     The uniform tiebreak makes each (pid, pk) group a random permutation,
-    so "rank < cap" is exact sampling without replacement (the
-    sample_fixed_per_key of the reference, done once for all keys).
+    so "rank < cap" is exact sampling without replacement; the keyed group
+    hash makes the groups of each privacy id a random permutation, so
+    "group rank within pid < cap" is the cross-partition sample — the
+    reference's two sample_fixed_per_key passes from a single sort.
 
     l1_cap (max_contributions mode): when given, a uniform sample of at
     most l1_cap rows per privacy id is taken FIRST — the total-contribution
@@ -110,41 +149,53 @@ def _sample_rows_and_groups(key: jax.Array, pid: jnp.ndarray,
         valid = _l1_sample_mask(jax.random.fold_in(key, 3), pid, valid,
                                 l1_cap)
 
-    # Padding rows sort to the very end.
+    # Padding rows sort to the very end (pid is the primary key).
     pid_key = jnp.where(valid, pid, _INT32_MAX)
     pk_key = jnp.where(valid, pk, _INT32_MAX)
+    salt = jax.random.bits(k2, (), dtype=jnp.uint32)
+    ghash = _group_hash(pid_key, pk_key, salt)
 
-    # -- sort rows by (pid, pk, uniform), rank within (pid, pk) -----------
     tiebreak = jax.random.uniform(k1, (n,))
-    order = jnp.lexsort((tiebreak, pk_key, pid_key))
-    spid = pid_key[order]
-    spk = pk_key[order]
-    svalid = valid[order]
+    # One variadic sort carries every payload along: on TPU the sort moves
+    # data far cheaper than post-hoc random-access gathers (a single 100M
+    # gather costs more than the whole 4-key sort).
+    operands = [pid_key, ghash, pk_key, tiebreak, valid,
+                jnp.arange(n, dtype=jnp.int32)]
+    if value is not None:
+        operands.append(value)
+    # is_stable: float32 tiebreak collisions must resolve identically in
+    # every kernel sharing a PRNG key (bound_row_mask sorts one operand
+    # fewer than bound_and_aggregate; an unstable sort could order tied
+    # rows differently between the two programs, breaking the replayed
+    # sampling guarantee).
+    sorted_ops = jax.lax.sort(operands, num_keys=4, is_stable=True)
+    spid, sgh, spk, _, svalid, order = sorted_ops[:6]
+    sval = sorted_ops[6] if value is not None else None
     is_start = jnp.concatenate([
         jnp.ones((1,), dtype=bool),
-        (spid[1:] != spid[:-1]) | (spk[1:] != spk[:-1])
+        (spid[1:] != spid[:-1]) | (sgh[1:] != sgh[:-1]) |
+        (spk[1:] != spk[:-1])
     ])
     keep_row = svalid & (_segment_rank(is_start) < linf_cap)
     group_id = (jnp.cumsum(is_start) - 1).astype(jnp.int32)
 
-    # -- L0 sampling over (pid, pk) groups ---------------------------------
-    start_w = (is_start & svalid).astype(jnp.int32)
-    g_pid = jax.ops.segment_sum(spid * start_w, group_id, num_segments=n)
-    g_valid = jax.ops.segment_sum(start_w, group_id, num_segments=n) > 0
-    g_rand = jax.random.uniform(k2, (n,))
-    g_pid_key = jnp.where(g_valid, g_pid, _INT32_MAX)
-    order2 = jnp.lexsort((g_rand, g_pid_key))
-    sg_pid = g_pid_key[order2]
-    is_start2 = jnp.concatenate(
-        [jnp.ones((1,), dtype=bool), sg_pid[1:] != sg_pid[:-1]])
-    keep_sorted = _segment_rank(is_start2) < l0_cap
-    keep_group = jnp.zeros((n,), dtype=bool).at[order2].set(keep_sorted)
-    keep_group = keep_group & g_valid
+    # -- L0 sampling: rank of the row's group within its pid --------------
+    # group_id is nondecreasing, so a cummax over the pid-start markers
+    # yields the pid's first group id without a gather.
+    is_pid_start = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), spid[1:] != spid[:-1]])
+    first_group_of_pid = jax.lax.cummax(
+        jnp.where(is_pid_start, group_id, 0))
+    group_rank = group_id - first_group_of_pid
+    keep_group_row = svalid & (group_rank < l0_cap)
     return SampledRows(order, spid, spk, svalid, is_start, group_id,
-                       keep_row, keep_group, g_valid)
+                       keep_row, keep_group_row, sval)
 
 
-@functools.partial(jax.jit, static_argnames=("num_partitions",))
+@functools.partial(jax.jit,
+                   static_argnames=("num_partitions", "need_count",
+                                    "need_sum", "need_norm",
+                                    "need_norm_sq", "has_group_clip"))
 def bound_and_aggregate(key: jax.Array,
                         pid: jnp.ndarray,
                         pk: jnp.ndarray,
@@ -159,7 +210,13 @@ def bound_and_aggregate(key: jax.Array,
                         middle,
                         group_clip_lo,
                         group_clip_hi,
-                        l1_cap=None) -> PartitionAccumulators:
+                        l1_cap=None,
+                        need_count: bool = True,
+                        need_sum: bool = True,
+                        need_norm: bool = True,
+                        need_norm_sq: bool = True,
+                        has_group_clip: bool = True
+                        ) -> PartitionAccumulators:
     """Contribution bounding + per-partition aggregation, fully fused.
 
     Args:
@@ -182,36 +239,89 @@ def bound_and_aggregate(key: jax.Array,
         zeros = jnp.zeros((num_partitions,), dtype=value.dtype)
         return PartitionAccumulators(zeros, zeros, zeros, zeros, zeros)
     s = _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap,
-                                l1_cap)
-    sval = value[s.order]
+                                l1_cap, value=value)
+    sval = s.sval
 
     # -- rows -> (pid, pk) group accumulators ------------------------------
-    w = s.keep_row.astype(sval.dtype)
-    vclip = jnp.clip(sval, row_clip_lo, row_clip_hi)
+    # Separate scalar segment-sums over the sorted (monotone) group ids:
+    # on TPU a narrow [N, k] operand is tile-padded k -> 128 lanes (a 20x
+    # memory blowup), so per-column passes with indices_are_sorted=True are
+    # the fast layout. The normalized columns are reduced directly (not
+    # derived from sum/count algebra) so large-magnitude values keep full
+    # float precision — (v - middle) is small even when v is not.
+    # Accumulate in at least float32: a float16 value column must not
+    # degrade counts, sums, or routing (only individual contributions may
+    # carry reduced precision).
+    dtype = jnp.promote_types(sval.dtype, jnp.float32)
+    w = s.keep_row.astype(dtype)
+    vclip = jnp.clip(sval, row_clip_lo, row_clip_hi).astype(dtype)
     vnorm = vclip - middle
-    seg = functools.partial(jax.ops.segment_sum,
-                            segment_ids=s.group_id,
-                            num_segments=n)
-    g_count = seg(w)
-    g_sum = jnp.clip(seg(vclip * w), group_clip_lo, group_clip_hi)
-    g_norm = seg(vnorm * w)
-    g_norm_sq = seg(vnorm * vnorm * w)
-    start_w = (s.is_start & s.svalid).astype(jnp.int32)
-    g_pk = seg(s.spk * start_w)
+    start_w = (s.is_start & s.svalid).astype(dtype)
+    zeros = jnp.zeros((num_partitions,), dtype=dtype)
+    if not has_group_clip:
+        # No per-(pid, pk) group clipping: every accumulator is additive
+        # over rows, so rows scatter STRAIGHT into partitions — the whole
+        # group stage (and its per-column [N] passes) disappears.
+        # Identical results: keep_group_row is constant within a group, so
+        # sum_groups gw * (sum_rows w*x) == sum_rows (w * kg * x).
+        kg = s.keep_group_row.astype(dtype)
+        wk = w * kg
+        spk_safe = jnp.where(s.svalid & s.keep_group_row, s.spk,
+                             0).astype(jnp.int32)
+        prow = functools.partial(jax.ops.segment_sum,
+                                 segment_ids=spk_safe,
+                                 num_segments=num_partitions)
+        return PartitionAccumulators(
+            pid_count=prow(start_w * kg),
+            count=prow(wk) if need_count else zeros,
+            sum=prow(vclip * wk) if need_sum else zeros,
+            norm_sum=prow(vnorm * wk) if need_norm else zeros,
+            norm_sq_sum=prow(vnorm * vnorm * wk)
+            if need_norm_sq else zeros,
+        )
+    keepg_start = (s.is_start & s.svalid & s.keep_group_row).astype(dtype)
+    gseg = functools.partial(jax.ops.segment_sum,
+                             segment_ids=s.group_id,
+                             num_segments=n,
+                             indices_are_sorted=True)
+    # Each gated-off accumulator saves one full-HBM group pass and one
+    # partition pass (the kernel is pass-count bound; module docstring).
+    g_count = gseg(w) if need_count else None
+    g_sum = (jnp.clip(gseg(vclip * w), group_clip_lo, group_clip_hi)
+             if need_sum else None)
+    g_norm = gseg(vnorm * w) if need_norm else None
+    g_norm_sq = gseg(vnorm * vnorm * w) if need_norm_sq else None
+    g_pk = _group_pk(s, num_partitions, gseg)
+    g_keep = gseg(keepg_start)
+    gw = (g_keep > 0).astype(dtype)
 
     # -- kept groups -> per-partition accumulators -------------------------
-    gw = s.keep_group.astype(sval.dtype)
-    g_pk_safe = jnp.where(s.keep_group, g_pk, 0).astype(jnp.int32)
+    g_pk_safe = jnp.where(g_keep > 0, g_pk, 0).astype(jnp.int32)
     pseg = functools.partial(jax.ops.segment_sum,
                              segment_ids=g_pk_safe,
                              num_segments=num_partitions)
     return PartitionAccumulators(
         pid_count=pseg(gw),
-        count=pseg(g_count * gw),
-        sum=pseg(g_sum * gw),
-        norm_sum=pseg(g_norm * gw),
-        norm_sq_sum=pseg(g_norm_sq * gw),
+        count=pseg(g_count * gw) if need_count else zeros,
+        sum=pseg(g_sum * gw) if need_sum else zeros,
+        norm_sum=pseg(g_norm * gw) if need_norm else zeros,
+        norm_sq_sum=pseg(g_norm_sq * gw) if need_norm_sq else zeros,
     )
+
+
+def _group_pk(s: SampledRows, num_partitions: int, gseg) -> jnp.ndarray:
+    """Each group slot's partition id: a float32-reduced column when ids
+    fit float32 exactly (< 2^24), an integer pass otherwise. Always
+    float32 regardless of the value dtype — a narrower accumulation dtype
+    (e.g. float16 values) must never round partition ids. Single
+    definition so the scalar and vector kernels can never diverge on the
+    precision threshold or the padding mask."""
+    if num_partitions < (1 << 24):
+        start_w = (s.is_start & s.svalid).astype(jnp.float32)
+        return gseg(start_w *
+                    jnp.where(s.svalid, s.spk, 0).astype(jnp.float32))
+    start_w_i = (s.is_start & s.svalid).astype(jnp.int32)
+    return gseg(jnp.where(s.svalid, s.spk, 0) * start_w_i)
 
 
 @functools.partial(jax.jit, static_argnames=("num_partitions", "norm_ord"))
@@ -252,22 +362,28 @@ def bound_and_aggregate_vector(key: jax.Array,
         scale = jnp.minimum(1.0, max_norm / jnp.maximum(norms, 1e-30))
         sval = sval * scale
 
-    group_id = s.group_id
-    w1 = s.keep_row.astype(sval.dtype)
-    w = w1[:, None]
-    g_vec = jax.ops.segment_sum(sval * w, group_id, num_segments=n)
-    g_count = jax.ops.segment_sum(w1, group_id, num_segments=n)
-    start_w = (s.is_start & s.svalid).astype(jnp.int32)
-    g_pk = jax.ops.segment_sum(s.spk * start_w, group_id, num_segments=n)
-
-    keep_group = s.keep_group
-    gw = keep_group.astype(sval.dtype)
-    g_pk_safe = jnp.where(keep_group, g_pk, 0).astype(jnp.int32)
+    dtype = jnp.promote_types(sval.dtype, jnp.float32)
+    sval = sval.astype(dtype)
+    w1 = s.keep_row.astype(dtype)
+    keepg_start = (s.is_start & s.svalid & s.keep_group_row).astype(dtype)
+    gseg = functools.partial(jax.ops.segment_sum,
+                             segment_ids=s.group_id,
+                             num_segments=n,
+                             indices_are_sorted=True)
+    # The [N, D] vector payload is one segment-sum (D is a real data axis,
+    # already tile-friendly); scalar columns go per pass like the scalar
+    # kernel.
+    g_vec = gseg(sval * w1[:, None])
+    g_count = gseg(w1)
+    g_pk = _group_pk(s, num_partitions, gseg)
+    g_keep = gseg(keepg_start)
+    gw = (g_keep > 0).astype(dtype)
+    g_pk_safe = jnp.where(g_keep > 0, g_pk, 0).astype(jnp.int32)
     pseg = functools.partial(jax.ops.segment_sum,
                              segment_ids=g_pk_safe,
                              num_segments=num_partitions)
     vector_sums = pseg(g_vec * gw[:, None])
-    zeros = jnp.zeros((num_partitions,), dtype=sval.dtype)
+    zeros = jnp.zeros((num_partitions,), dtype=dtype)
     accs = PartitionAccumulators(pid_count=pseg(gw),
                                  count=pseg(g_count * gw),
                                  sum=zeros,
@@ -294,7 +410,7 @@ def bound_row_mask(key: jax.Array, pid: jnp.ndarray, pk: jnp.ndarray,
         return jnp.zeros((0,), dtype=bool)
     s = _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap,
                                 l1_cap)
-    keep_sorted_rows = s.keep_row & s.keep_group[s.group_id]
+    keep_sorted_rows = s.keep_row & s.keep_group_row
     return jnp.zeros((n,), dtype=bool).at[s.order].set(keep_sorted_rows)
 
 
@@ -316,5 +432,10 @@ def count_distinct_pids_per_partition(pid: jnp.ndarray, pk: jnp.ndarray,
                                row_clip_hi=jnp.inf,
                                middle=0.0,
                                group_clip_lo=-jnp.inf,
-                               group_clip_hi=jnp.inf)
+                               group_clip_hi=jnp.inf,
+                               need_count=False,
+                               need_sum=False,
+                               need_norm=False,
+                               need_norm_sq=False,
+                               has_group_clip=False)
     return accs.pid_count

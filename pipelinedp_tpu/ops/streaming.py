@@ -96,13 +96,15 @@ def _unpack_value(buf: jnp.ndarray, offset: int,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("num_partitions", "bytes_pid", "bytes_pk", "value_f16"),
+    static_argnames=("num_partitions", "bytes_pid", "bytes_pk", "value_f16",
+                     "need_flags", "has_group_clip"),
     donate_argnums=(3,))
 def _chunk_step(key, buf, n_valid, accs, linf_cap, l0_cap, row_clip_lo,
                 row_clip_hi, middle, group_clip_lo, group_clip_hi,
                 l1_cap=None, *,
                 num_partitions: int, bytes_pid: int, bytes_pk: int,
-                value_f16: bool):
+                value_f16: bool, need_flags=(True, True, True, True),
+                has_group_clip: bool = True):
     """Unpack one byte-packed chunk, bound+aggregate it, add into accs.
 
     Chunks are pid-disjoint, so the optional L1 (max_contributions) sample
@@ -122,7 +124,12 @@ def _chunk_step(key, buf, n_valid, accs, linf_cap, l0_cap, row_clip_lo,
         middle=middle,
         group_clip_lo=group_clip_lo,
         group_clip_hi=group_clip_hi,
-        l1_cap=l1_cap)
+        l1_cap=l1_cap,
+        need_count=need_flags[0],
+        need_sum=need_flags[1],
+        need_norm=need_flags[2],
+        need_norm_sq=need_flags[3],
+        has_group_clip=has_group_clip)
     return columnar.PartitionAccumulators(
         *(a + c for a, c in zip(accs, chunk_accs)))
 
@@ -144,6 +151,9 @@ def stream_bound_and_aggregate(
     l1_cap=None,
     n_chunks: Optional[int] = None,
     value_transfer_dtype: Optional[np.dtype] = None,
+    need_flags=(True, True, True, True),
+    has_group_clip: bool = True,
+    n_transfers: int = 2,
 ) -> columnar.PartitionAccumulators:
     """Chunked, transfer-overlapped twin of columnar.bound_and_aggregate.
 
@@ -187,29 +197,37 @@ def stream_bound_and_aggregate(
     packed = _pack_native(pid, pk, value, pid_lo, k, bytes_pid, bytes_pk,
                           value_f16, width)
     if packed is None:
-        # Lazy generator: bucket c+1 packs on host while bucket c's DMA
-        # and kernel run.
         packed = _pack_numpy(pid, pk, value, pid_lo, k, bytes_pid, bytes_pk,
                              value_f16, width, bytes_value)
+    buckets, counts = packed
+
+    # Transfers go in a few large slabs while execution stays per-bucket
+    # (device slices of the slab): host->device links with a high
+    # per-transfer fixed cost (PCIe doorbells, tunneled links) would eat
+    # the pipeline if every bucket shipped separately, and the slab after
+    # this one still overlaps the current slab's kernels (async dispatch).
+    slab_buckets = max(1, (k + n_transfers - 1) // n_transfers)
 
     # Five distinct buffers: the accumulators are donated into each chunk
     # step, and a donated buffer must not be aliased.
     accs = columnar.PartitionAccumulators(
         *(jnp.zeros((num_partitions,), dtype=jnp.float32) for _ in range(5)))
-    for c, (buf, m) in enumerate(packed):
-        # device_put enqueues the DMA and returns; the chunk kernel is
-        # dispatched right behind it, so host work on bucket c+1 overlaps
-        # both the transfer and the compute of bucket c.
-        with profiler.stage(f"dp/stream_chunk_{c}"):
-            dbuf = jax.device_put(buf)
-            accs = _chunk_step(jax.random.fold_in(key, c), dbuf,
-                               int(m), accs,
-                               linf_cap, l0_cap, row_clip_lo, row_clip_hi,
-                               middle, group_clip_lo, group_clip_hi, l1_cap,
-                               num_partitions=num_partitions,
-                               bytes_pid=bytes_pid,
-                               bytes_pk=bytes_pk,
-                               value_f16=value_f16)
+    for s0 in range(0, k, slab_buckets):
+        s1 = min(s0 + slab_buckets, k)
+        with profiler.stage(f"dp/stream_slab_{s0}"):
+            dslab = jax.device_put(buckets[s0:s1])
+            for c in range(s0, s1):
+                accs = _chunk_step(jax.random.fold_in(key, c), dslab[c - s0],
+                                   int(counts[c]), accs,
+                                   linf_cap, l0_cap, row_clip_lo,
+                                   row_clip_hi, middle, group_clip_lo,
+                                   group_clip_hi, l1_cap,
+                                   num_partitions=num_partitions,
+                                   bytes_pid=bytes_pid,
+                                   bytes_pk=bytes_pk,
+                                   value_f16=value_f16,
+                                   need_flags=tuple(need_flags),
+                                   has_group_clip=has_group_clip)
     return accs
 
 
@@ -254,7 +272,7 @@ def _pack_native(pid, pk, value, pid_lo, k, bytes_pid, bytes_pk, value_f16,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap,
             counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
         if rc == 0:
-            return list(zip(out, counts))
+            return out, counts
         if rc == 2:
             new_cap = int(counts.max())
             logging.warning(
@@ -269,19 +287,20 @@ def _pack_native(pid, pk, value, pid_lo, k, bytes_pid, bytes_pk, value_f16,
 
 def _pack_numpy(pid, pk, value, pid_lo, k, bytes_pid, bytes_pk, value_f16,
                 width, bytes_value):
-    """Numpy fallback: same buckets and byte layout as the native packer,
-    yielded lazily so per-bucket host work overlaps the pipeline."""
+    """Numpy fallback: same [k, cap, width] buckets and byte layout as the
+    native packer."""
     shifted = (pid - pid_lo).astype(np.uint32, copy=False)
     bucket = ((shifted * _HASH_MULT) >> np.uint32(16)) % np.uint32(k)
-    counts = np.bincount(bucket, minlength=k)
-    chunk_rows = int(counts.max())
+    counts = np.bincount(bucket, minlength=k).astype(np.int64)
+    chunk_rows = int(counts.max()) if k else 1
     if value is not None:
         value = np.asarray(value)
         value = value.astype(np.float16 if value_f16 else np.float32,
                              copy=False)
+    out = np.zeros((k, chunk_rows, width), dtype=np.uint8)
     for c in range(k):
         idx = np.flatnonzero(bucket == c)
-        buf = np.zeros((chunk_rows, width), dtype=np.uint8)
+        buf = out[c]
         m = len(idx)
         _pack_ints(buf[:m], shifted[idx], 0, bytes_pid)
         _pack_ints(buf[:m], pk[idx].astype(np.uint32, copy=False),
@@ -289,4 +308,4 @@ def _pack_numpy(pid, pk, value, pid_lo, k, bytes_pid, bytes_pk, value_f16,
         if value is not None:
             buf[:m, bytes_pid + bytes_pk:] = (
                 value[idx].view(np.uint8).reshape(m, bytes_value))
-        yield buf, m
+    return out, counts

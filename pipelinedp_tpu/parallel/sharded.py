@@ -162,7 +162,9 @@ def _reduce_scatter(x, scatter_axes):
 
 
 @functools.lru_cache(maxsize=None)
-def _scalar_kernel(mesh: Mesh, padded_p: int, has_l1: bool = False):
+def _scalar_kernel(mesh: Mesh, padded_p: int, has_l1: bool = False,
+                   need_flags=(True, True, True, True),
+                   has_group_clip: bool = True):
     """Sharded twin of columnar.bound_and_aggregate for a given mesh.
 
     has_l1 compiles the max_contributions variant (an extra runtime l1_cap
@@ -186,7 +188,12 @@ def _scalar_kernel(mesh: Mesh, padded_p: int, has_l1: bool = False):
             middle=middle,
             group_clip_lo=group_clip_lo,
             group_clip_hi=group_clip_hi,
-            l1_cap=l1_args[0] if has_l1 else None)
+            l1_cap=l1_args[0] if has_l1 else None,
+            need_count=need_flags[0],
+            need_sum=need_flags[1],
+            need_norm=need_flags[2],
+            need_norm_sq=need_flags[3],
+            has_group_clip=has_group_clip)
         return jax.tree.map(lambda x: _reduce_scatter(x, scatter), accs)
 
     spec = _spec(mesh)
@@ -466,13 +473,18 @@ def bound_and_aggregate(mesh: Mesh,
                         middle,
                         group_clip_lo,
                         group_clip_hi,
-                        l1_cap=None) -> columnar.PartitionAccumulators:
+                        l1_cap=None,
+                        need_flags=(True, True, True, True),
+                        has_group_clip: bool = True
+                        ) -> columnar.PartitionAccumulators:
     """Multi-chip bound-and-aggregate: host rows in, global sharded
     [padded_p] accumulators out (padding partitions are all-zero; callers
     trim to num_partitions when materializing)."""
     padded_p = padded_num_partitions(mesh, num_partitions)
     dpid, dpk, dval, dvalid = _shard_and_put(mesh, pid, pk, value, valid)
-    kernel = _scalar_kernel(mesh, padded_p, has_l1=l1_cap is not None)
+    kernel = _scalar_kernel(mesh, padded_p, has_l1=l1_cap is not None,
+                            need_flags=tuple(need_flags),
+                            has_group_clip=has_group_clip)
     args = (key, dpid, dpk, dval, dvalid, linf_cap, l0_cap,
             float(row_clip_lo), float(row_clip_hi), float(middle),
             float(group_clip_lo), float(group_clip_hi))

@@ -263,3 +263,83 @@ class TestNoiseSnapping:
         gau = np.asarray(noise_ops.add_gaussian_noise(key, zeros, 2.5,
                                                       2.5 * 2.0**-57))
         assert np.std(gau) == pytest.approx(2.5, rel=0.02)
+
+
+class TestHashGroupSampling:
+    """The single-sort design orders each privacy id's groups by a keyed
+    hash (columnar._group_hash): the induced L0 sample must be uniform not
+    just marginally but jointly — a structured hash bias would correlate
+    which partition PAIRS get selected together."""
+
+    def test_selected_pairs_are_uniform(self):
+        import jax
+        import jax.numpy as jnp
+        from pipelinedp_tpu.ops import columnar
+        from itertools import combinations
+
+        n_parts = 5
+        pid = jnp.zeros(n_parts, dtype=jnp.int32)
+        pk = jnp.arange(n_parts, dtype=jnp.int32)
+        valid = jnp.ones(n_parts, dtype=bool)
+        pair_counts = {pair: 0 for pair in combinations(range(n_parts), 2)}
+        trials = 400
+        for seed in range(trials):
+            mask = np.asarray(
+                columnar.bound_row_mask(jax.random.PRNGKey(seed), pid, pk,
+                                        valid, 1, 2))
+            kept = tuple(sorted(np.flatnonzero(mask).tolist()))
+            assert len(kept) == 2
+            pair_counts[kept] += 1
+        # 10 pairs, each with probability 1/10; binomial std ~ 0.015.
+        for pair, count in pair_counts.items():
+            assert abs(count / trials - 0.1) < 0.06, (pair, count)
+
+    def test_distinct_keys_give_distinct_samples(self):
+        import jax
+        import jax.numpy as jnp
+        from pipelinedp_tpu.ops import columnar
+
+        pid = jnp.zeros(30, dtype=jnp.int32)
+        pk = jnp.arange(30, dtype=jnp.int32)
+        valid = jnp.ones(30, dtype=bool)
+        masks = {
+            tuple(np.asarray(
+                columnar.bound_row_mask(jax.random.PRNGKey(seed), pid, pk,
+                                        valid, 1, 5)).tolist())
+            for seed in range(20)
+        }
+        assert len(masks) > 10  # the salt really re-randomizes the order
+
+
+class TestNarrowValueDtype:
+    """float16 value columns must not degrade counts or partition routing:
+    accumulation promotes to float32 (round-4 review regression test —
+    pk ids >= 2048 are not representable in float16)."""
+
+    def test_f16_values_route_and_count_exactly(self):
+        import jax
+        import jax.numpy as jnp
+        from pipelinedp_tpu.ops import columnar
+
+        n_parts = 4000
+        pk = np.arange(n_parts, dtype=np.int32)
+        pid = np.arange(n_parts, dtype=np.int32)
+        value16 = np.full(n_parts, 1.5, dtype=np.float16)
+
+        def run(val):
+            return columnar.bound_and_aggregate(
+                jax.random.PRNGKey(0), jnp.asarray(pid), jnp.asarray(pk),
+                jnp.asarray(val), jnp.ones(n_parts, dtype=bool),
+                num_partitions=n_parts, linf_cap=4, l0_cap=n_parts,
+                row_clip_lo=0.0, row_clip_hi=5.0, middle=0.0,
+                group_clip_lo=-jnp.inf, group_clip_hi=jnp.inf)
+
+        accs16 = run(value16)
+        accs32 = run(value16.astype(np.float32))
+        np.testing.assert_array_equal(np.asarray(accs16.count),
+                                      np.ones(n_parts))
+        np.testing.assert_array_equal(np.asarray(accs16.count),
+                                      np.asarray(accs32.count))
+        np.testing.assert_allclose(np.asarray(accs16.sum),
+                                   np.asarray(accs32.sum))
+        assert np.asarray(accs16.count).dtype == np.float32

@@ -164,13 +164,13 @@ class TestNativePacker:
         width = 3 + 2 + 4
         nat = streaming._pack_native(pid, pk, value, 500, 8, 3, 2, False,
                                      width)
-        ref = list(
-            streaming._pack_numpy(pid, pk, value, 500, 8, 3, 2, False,
-                                  width, 4))
+        ref_bufs, ref_counts = streaming._pack_numpy(
+            pid, pk, value, 500, 8, 3, 2, False, width, 4)
         assert nat is not None
+        nat_bufs, nat_counts = nat
         for c in range(8):
-            nb, nc = nat[c]
-            rb, rc = ref[c]
+            nb, nc = nat_bufs[c], nat_counts[c]
+            rb, rc = ref_bufs[c], ref_counts[c]
             assert nc == rc
             row_t = [("b", "u1", width)]
             a = np.sort(nb[:nc].copy().view(row_t).ravel())
@@ -188,12 +188,12 @@ class TestNativePacker:
         value = rng.uniform(-100, 100, n).astype(np.float32)
         width = 2 + 1 + 2
         nat = streaming._pack_native(pid, pk, value, 0, 4, 2, 1, True, width)
-        ref = list(
-            streaming._pack_numpy(pid, pk, value, 0, 4, 2, 1, True, width,
-                                  2))
+        ref_bufs, ref_counts = streaming._pack_numpy(
+            pid, pk, value, 0, 4, 2, 1, True, width, 2)
+        nat_bufs, nat_counts = nat
         for c in range(4):
-            nb, nc = nat[c]
-            rb, rc = ref[c]
+            nb, nc = nat_bufs[c], nat_counts[c]
+            rb, rc = ref_bufs[c], ref_counts[c]
             assert nc == rc
             row_t = [("b", "u1", width)]
             a = np.sort(nb[:nc].copy().view(row_t).ravel())
@@ -210,6 +210,6 @@ class TestNativePacker:
         nat = streaming._pack_native(pid, pk, value, 0, 4, 1, 1, False, 6)
         if nat is None:
             pytest.skip("native packer unavailable")
-        counts = [c for _, c in nat]
-        assert sum(counts) == n
-        assert max(counts) == n
+        _, counts = nat
+        assert counts.sum() == n
+        assert counts.max() == n
