@@ -44,12 +44,18 @@ CPU_PARTITIONS = max(CPU_ROWS * N_PARTITIONS // N_ROWS, 1)
 
 def _host_columns(seed=0):
     """Zipf-skewed partition popularity (movie-view-shaped): head partitions
-    clear the private-selection threshold, the long tail is dropped."""
+    clear the private-selection threshold, the long tail is dropped.
+
+    Values are integer star ratings 1..5 — the reference's north-star
+    workload aggregates the Netflix-prize rating column, which is integer
+    stars (/root/reference/examples/movie_view_ratings/
+    run_without_frameworks.py). The wire codec's continuous-value (raw
+    float32) path is exercised separately in tests/wirecodec_test.py."""
     rng = np.random.default_rng(seed)
     pk = (N_PARTITIONS * rng.random(N_ROWS)**4).astype(np.int32)
     return (rng.integers(0, N_USERS, N_ROWS, dtype=np.int32),
             np.minimum(pk, N_PARTITIONS - 1),
-            rng.uniform(0.0, 5.0, N_ROWS).astype(np.float32))
+            rng.integers(1, 6, N_ROWS).astype(np.float32))
 
 
 def _params():

@@ -81,6 +81,10 @@ class UtilityAnalysisOptions:
       host numpy, None (default) auto-selects: device when an accelerator
       is present and the [configurations x groups] grid is large enough to
       amortize the launch.
+    device_mesh: a jax.sharding.Mesh (parallel/sharded.make_mesh): the
+      sweep's group dimension shards over the mesh and the per-partition
+      grids ride the same ICI-first reduce-scatter as the aggregation
+      kernels. Implies the device sweep.
     """
     epsilon: float
     delta: float
@@ -89,6 +93,7 @@ class UtilityAnalysisOptions:
     partitions_sampling_prob: float = 1
     pre_aggregated_data: bool = False
     use_device_sweep: Optional[bool] = None
+    device_mesh: Optional[object] = None
 
     def __post_init__(self):
         input_validators.validate_epsilon_delta(self.epsilon, self.delta,

@@ -166,6 +166,134 @@ def _kernels():
     return metric_grids, moment_grids, report_sums, keep_sums
 
 
+# ---------------------------------------------------------------------------
+# Mesh (multi-chip) kernels: the same math shard_map'ed over the device
+# mesh. Groups shard over all mesh axes; the per-partition segment-sums
+# produce full-width partials that ride the same ICI-first reduce-scatter
+# as the aggregation kernels (parallel/sharded.py), leaving every grid
+# sharded over the partition dimension. The report reduction then runs
+# shard-local and psums its small [B, F, C] output.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _mesh_metric_kernel(mesh, padded_p: int, metric_kind: str):
+    jax, jnp = _jnp()
+    from jax.sharding import PartitionSpec as P
+    from pipelinedp_tpu.parallel import sharded
+
+    scatter_axes = sharded._scatter_axes(mesh)
+
+    def local_step(counts, sums, pk_ids, npart, lo, hi, l0):
+        if metric_kind == "sum":
+            v = sums
+        elif metric_kind == "count":
+            v = counts
+        else:  # privacy_id_count
+            v = (counts > 0).astype(counts.dtype)
+        vb = v[None, :]
+        q = jnp.minimum(1.0, l0[:, None] / jnp.maximum(npart, 1.0)[None, :])
+        x = jnp.clip(vb, lo[:, None], hi[:, None])
+        err = x - vb
+        below = jnp.where(vb < lo[:, None], err, 0.0)
+        above = jnp.where(vb > hi[:, None], err, 0.0)
+        data = jnp.stack(
+            [below, above, -x * (1.0 - q), x * x * q * (1.0 - q)])
+        # [P, 4, C] partials; padding groups carry pk == padded_p and drop.
+        grids = jax.ops.segment_sum(jnp.moveaxis(data, -1, 0), pk_ids,
+                                    num_segments=padded_p)
+        raw = jax.ops.segment_sum(v, pk_ids, num_segments=padded_p)
+        return (sharded._reduce_scatter(raw, scatter_axes),
+                sharded._reduce_scatter(grids, scatter_axes))
+
+    fn = jax.shard_map(local_step,
+                       mesh=mesh,
+                       in_specs=(sharded._spec(mesh),) * 4 + (P(),) * 3,
+                       out_specs=(sharded._part_spec(mesh),) * 2,
+                       check_vma=False)
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _mesh_moment_kernel(mesh, padded_p: int):
+    jax, jnp = _jnp()
+    from jax.sharding import PartitionSpec as P
+    from pipelinedp_tpu.parallel import sharded
+
+    scatter_axes = sharded._scatter_axes(mesh)
+
+    def local_step(pk_ids, npart, l0):
+        q = jnp.minimum(1.0, l0[:, None] / jnp.maximum(npart, 1.0)[None, :])
+        data = jnp.stack([q, q * (1.0 - q), q * (1.0 - q) * (1.0 - 2.0 * q)])
+        sums = jax.ops.segment_sum(jnp.moveaxis(data, -1, 0), pk_ids,
+                                   num_segments=padded_p)  # [P, 3, C]
+        return sharded._reduce_scatter(sums, scatter_axes)
+
+    fn = jax.shard_map(local_step,
+                       mesh=mesh,
+                       in_specs=(sharded._spec(mesh),) * 2 + (P(),),
+                       out_specs=sharded._part_spec(mesh),
+                       check_vma=False)
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _mesh_report_kernel(mesh, n_buckets_p1: int, with_keep_sums: bool):
+    jax, jnp = _jnp()
+    from jax.sharding import PartitionSpec as P
+    from pipelinedp_tpu.parallel import sharded
+
+    all_axes = tuple(mesh.axis_names)
+
+    def local_step(raw, grids, std_noise, keep, bucket_ids):
+        # Shard-local layout: raw [P_l], grids [P_l, 4, C], keep [P_l, C]
+        # (pre-transposed on host), bucket_ids [P_l]. Same field math as
+        # the single-device report_sums, partition-major.
+        clip_min, clip_max = grids[:, 0], grids[:, 1]
+        exp_l0, var_l0 = grids[:, 2], grids[:, 3]
+        rawb = jnp.broadcast_to(raw[:, None], exp_l0.shape)
+        bias = exp_l0 + clip_min + clip_max
+        variance = var_l0 + (std_noise * std_noise)[None, :]
+        rmse = jnp.sqrt(bias * bias + variance)
+        rmse_dropped = keep * rmse + (1.0 - keep) * jnp.abs(rawb)
+        safe_raw = jnp.where(rawb == 0.0, 1.0, rawb)
+        nz = (rawb != 0.0).astype(rmse.dtype)
+        inv = nz / safe_raw
+        inv2 = nz / (safe_raw * safe_raw)
+        abs_fields = (exp_l0, var_l0, clip_min, clip_max, bias, variance,
+                      rmse, rmse_dropped)
+        rel_fields = (exp_l0 * inv, var_l0 * inv2, clip_min * inv,
+                      clip_max * inv, bias * inv, variance * inv2,
+                      rmse * inv, rmse_dropped * inv)
+        l0_dropped = -exp_l0
+        linf_dropped = clip_min - clip_max
+        selection_dropped = (rawb - l0_dropped - linf_dropped) * (1.0 - keep)
+        data = jnp.stack(
+            [f * keep for f in abs_fields + rel_fields] +
+            [rawb, l0_dropped, linf_dropped, selection_dropped])  # [F, P, C]
+        sums = jax.ops.segment_sum(jnp.moveaxis(data, 1, 0), bucket_ids,
+                                   num_segments=n_buckets_p1)
+        for axis in all_axes:
+            sums = jax.lax.psum(sums, axis)
+        if not with_keep_sums:
+            return sums
+        kdata = jnp.stack([keep, keep * (1.0 - keep)])  # [2, P, C]
+        ksums = jax.ops.segment_sum(jnp.moveaxis(kdata, 1, 0), bucket_ids,
+                                    num_segments=n_buckets_p1)
+        for axis in all_axes:
+            ksums = jax.lax.psum(ksums, axis)
+        return sums, ksums
+
+    part = sharded._part_spec(mesh)
+    fn = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(part, part, P(), part, part),
+        out_specs=(P(), P()) if with_keep_sums else P(),
+        check_vma=False)
+    return jax.jit(fn)
+
+
 @dataclasses.dataclass
 class _MetricGrids:
     """Device-resident error grids of one metric."""
@@ -185,15 +313,41 @@ class DeviceSweep:
 
     def __init__(self, pk_ids: np.ndarray, counts: np.ndarray,
                  sums: np.ndarray, npart: np.ndarray, n_partitions: int,
-                 n_configs: int):
-        _, jnp = _jnp()
+                 n_configs: int, mesh=None):
+        jax, jnp = _jnp()
         self.n_partitions = n_partitions
         self.n_configs = n_configs
         self.n_groups = len(pk_ids)
-        self._counts = jnp.asarray(np.asarray(counts, dtype=np.float32))
-        self._sums = jnp.asarray(np.asarray(sums, dtype=np.float32))
-        self._pk_ids = jnp.asarray(np.asarray(pk_ids, dtype=np.int32))
-        self._npart = jnp.asarray(np.asarray(npart, dtype=np.float32))
+        self._mesh = mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from pipelinedp_tpu.parallel import sharded
+            self._padded_p = sharded.padded_num_partitions(
+                mesh, max(n_partitions, 1))
+            n_dev = mesh.devices.size
+            g = len(pk_ids)
+            g_pad = ((g + n_dev - 1) // n_dev) * n_dev if g else n_dev
+            # Padding groups point at the out-of-range partition id
+            # padded_p, which segment_sum drops.
+            def pad(a, dtype, fill):
+                out = np.full(g_pad, fill, dtype=dtype)
+                out[:g] = np.asarray(a, dtype=dtype)
+                return out
+            row_sharding = NamedSharding(mesh, sharded._spec(mesh))
+            self._counts = jax.device_put(pad(counts, np.float32, 0.0),
+                                          row_sharding)
+            self._sums = jax.device_put(pad(sums, np.float32, 0.0),
+                                        row_sharding)
+            self._pk_ids = jax.device_put(
+                pad(pk_ids, np.int32, self._padded_p), row_sharding)
+            self._npart = jax.device_put(pad(npart, np.float32, 1.0),
+                                         row_sharding)
+        else:
+            self._padded_p = n_partitions
+            self._counts = jnp.asarray(np.asarray(counts, dtype=np.float32))
+            self._sums = jnp.asarray(np.asarray(sums, dtype=np.float32))
+            self._pk_ids = jnp.asarray(np.asarray(pk_ids, dtype=np.int32))
+            self._npart = jnp.asarray(np.asarray(npart, dtype=np.float32))
         self.metrics: List[_MetricGrids] = []
         self._moments = None  # [3, C, P] device array when computed
         # Exact (float64, host) per-partition raw values of the first
@@ -215,25 +369,37 @@ class DeviceSweep:
 
         metric_kind: "sum" | "count" | "privacy_id_count".
         """
-        kernel, _, _, _ = _kernels()
         _, jnp = _jnp()
-        step = self._config_chunk(self.n_groups)
+        if self._mesh is not None:
+            kernel = _mesh_metric_kernel(self._mesh, self._padded_p,
+                                         metric_kind)
+            n_dev = self._mesh.devices.size
+            step = self._config_chunk(max(self.n_groups // n_dev, 1))
+            grid_axis = 2  # mesh layout is [P, 4, C]
+        else:
+            kernel, _, _, _ = _kernels()
+            step = self._config_chunk(self.n_groups)
+            grid_axis = 1
         raw = None
         parts = []
         for s in range(0, self.n_configs, step):
             e = min(s + step, self.n_configs)
-            r, grids = kernel(
-                self._counts, self._sums, self._pk_ids, self._npart,
-                jnp.asarray(np.asarray(lo[s:e], dtype=np.float32)),
-                jnp.asarray(np.asarray(hi[s:e], dtype=np.float32)),
-                jnp.asarray(np.asarray(l0[s:e], dtype=np.float32)),
-                n_partitions=self.n_partitions,
-                metric_kind=metric_kind)
+            clo = jnp.asarray(np.asarray(lo[s:e], dtype=np.float32))
+            chi = jnp.asarray(np.asarray(hi[s:e], dtype=np.float32))
+            cl0 = jnp.asarray(np.asarray(l0[s:e], dtype=np.float32))
+            if self._mesh is not None:
+                r, grids = kernel(self._counts, self._sums, self._pk_ids,
+                                  self._npart, clo, chi, cl0)
+            else:
+                r, grids = kernel(self._counts, self._sums, self._pk_ids,
+                                  self._npart, clo, chi, cl0,
+                                  n_partitions=self.n_partitions,
+                                  metric_kind=metric_kind)
             if raw is None:
                 raw = r
             parts.append(grids)
-        grids = parts[0] if len(parts) == 1 else jnp.concatenate(parts,
-                                                                 axis=1)
+        grids = parts[0] if len(parts) == 1 else jnp.concatenate(
+            parts, axis=grid_axis)
         self.metrics.append(
             _MetricGrids(raw=raw,
                          grids=grids,
@@ -251,7 +417,10 @@ class DeviceSweep:
                 "device grids; materialize before releasing to keep "
                 "per-partition access working.")
         grids = np.asarray(m.grids, dtype=np.float64)
-        raw = np.asarray(m.raw, dtype=np.float64)
+        if self._mesh is not None:
+            # Mesh layout is [P_pad, 4, C]: transpose and trim the padding.
+            grids = np.transpose(grids, (1, 2, 0))[:, :, :self.n_partitions]
+        raw = self.pull_raw(index)
         return {
             "raw": np.broadcast_to(raw,
                                    (self.n_configs,
@@ -264,30 +433,46 @@ class DeviceSweep:
 
     def pull_raw(self, index: int) -> np.ndarray:
         """[P] raw per-partition values of one metric (host float64)."""
-        return np.asarray(self.metrics[index].raw, dtype=np.float64)
+        raw = np.asarray(self.metrics[index].raw, dtype=np.float64)
+        return raw[:self.n_partitions]
 
     def compute_moments(self, l0: np.ndarray) -> None:
         """Computes the [3, C, P] keep-probability moment grids on device
         (configurations sharing an L0 bound share the kernel work)."""
-        _, kernel, _, _ = _kernels()
         _, jnp = _jnp()
         l0 = np.asarray(l0, dtype=np.float32)
         uniq, inverse = np.unique(l0, return_inverse=True)
-        step = self._config_chunk(self.n_groups)
+        if self._mesh is not None:
+            kernel = _mesh_moment_kernel(self._mesh, self._padded_p)
+            n_dev = self._mesh.devices.size
+            step = self._config_chunk(max(self.n_groups // n_dev, 1))
+            cfg_axis = 2  # [P, 3, C]
+        else:
+            _, kernel, _, _ = _kernels()
+            step = self._config_chunk(self.n_groups)
+            cfg_axis = 1
         parts = []
         for s in range(0, len(uniq), step):
             e = min(s + step, len(uniq))
-            parts.append(
-                kernel(self._pk_ids, self._npart, jnp.asarray(uniq[s:e]),
-                       n_partitions=self.n_partitions))
-        grids = parts[0] if len(parts) == 1 else jnp.concatenate(parts,
-                                                                 axis=1)
-        self._moments = jnp.take(grids, jnp.asarray(inverse), axis=1)
+            if self._mesh is not None:
+                parts.append(
+                    kernel(self._pk_ids, self._npart, jnp.asarray(uniq[s:e])))
+            else:
+                parts.append(
+                    kernel(self._pk_ids, self._npart, jnp.asarray(uniq[s:e]),
+                           n_partitions=self.n_partitions))
+        grids = parts[0] if len(parts) == 1 else jnp.concatenate(
+            parts, axis=cfg_axis)
+        self._moments = jnp.take(grids, jnp.asarray(inverse), axis=cfg_axis)
 
     def pull_moments(self) -> Optional[np.ndarray]:
         if self._moments is None:
             return None
-        return np.asarray(self._moments, dtype=np.float64)
+        moments = np.asarray(self._moments, dtype=np.float64)
+        if self._mesh is not None:
+            moments = np.transpose(moments,
+                                   (1, 2, 0))[:, :, :self.n_partitions]
+        return moments
 
     def drop_inputs(self) -> None:
         """Frees the uploaded input columns and the moments grid — called
@@ -323,8 +508,10 @@ class DeviceSweep:
         [B, 2, C] keep sums or None for public partitions). Only these
         small arrays leave the device.
         """
+        jax, jnp = _jnp()
+        if self._mesh is not None:
+            return self._report_sums_mesh(bucket_ids, n_buckets, keep_prob)
         _, _, report_kernel, keep_kernel = _kernels()
-        _, jnp = _jnp()
         dbuckets = jnp.asarray(np.asarray(bucket_ids, dtype=np.int32))
         if keep_prob is None:
             dkeep = jnp.ones((self.n_configs, self.n_partitions),
@@ -351,6 +538,48 @@ class DeviceSweep:
             ksums = np.asarray(keep_kernel(dkeep, dbuckets,
                                            n_buckets=n_buckets),
                                dtype=np.float64)
+        return metric_sums, ksums
+
+    def _report_sums_mesh(self, bucket_ids, n_buckets, keep_prob):
+        """Mesh twin of report_sums: per-shard bucket reductions + psum.
+
+        Padding partitions carry the extra bucket id n_buckets and zero
+        keep probability; the extra bucket row is trimmed before return.
+        """
+        jax, jnp = _jnp()
+        from jax.sharding import NamedSharding
+        from pipelinedp_tpu.parallel import sharded
+
+        pad_p = self._padded_p
+        part_sharding = NamedSharding(self._mesh,
+                                      sharded._part_spec(self._mesh))
+        buckets_padded = np.full(pad_p, n_buckets, dtype=np.int32)
+        buckets_padded[:self.n_partitions] = np.asarray(bucket_ids,
+                                                        dtype=np.int32)
+        dbuckets = jax.device_put(buckets_padded, part_sharding)
+        keep_pc = np.zeros((pad_p, self.n_configs), dtype=np.float32)
+        if keep_prob is None:
+            keep_pc[:self.n_partitions, :] = 1.0
+        else:
+            keep_pc[:self.n_partitions, :] = np.asarray(
+                keep_prob, dtype=np.float32).T
+        with_keep = keep_prob is not None
+        kernel = _mesh_report_kernel(self._mesh, n_buckets + 1, with_keep)
+        metric_sums = []
+        ksums = None
+        dkeep = jax.device_put(keep_pc, part_sharding)
+        for i, m in enumerate(self.metrics):
+            out = kernel(m.raw, m.grids,
+                         jnp.asarray(m.std_noise.astype(np.float32)), dkeep,
+                         dbuckets)
+            if with_keep:
+                sums, ks = out
+                if i == 0:
+                    ksums = np.asarray(ks, dtype=np.float64)[:n_buckets]
+            else:
+                sums = out
+            metric_sums.append(
+                np.asarray(sums, dtype=np.float64)[:n_buckets])
         return metric_sums, ksums
 
 

@@ -276,7 +276,8 @@ _METRIC_KIND = {
 
 def _build_device_sweep(pre: PreAggregates, configs: List[ConfigSpec],
                         ordered_metrics: List[Metric], n_partitions: int,
-                        public_partitions: bool, n_units: np.ndarray):
+                        public_partitions: bool, n_units: np.ndarray,
+                        mesh=None):
     """Computes the whole configuration sweep on the device.
 
     Returns (DeviceSweep, lazy metric_errors, approx_moments or None). The
@@ -288,7 +289,7 @@ def _build_device_sweep(pre: PreAggregates, configs: List[ConfigSpec],
 
     sweep = device_sweep.DeviceSweep(pre.pk_ids, pre.counts, pre.sums,
                                      pre.n_partitions, n_partitions,
-                                     len(configs))
+                                     len(configs), mesh=mesh)
     l0 = np.asarray(
         [config.params.max_partitions_contributed for config in configs],
         dtype=np.float64)
@@ -540,8 +541,8 @@ def compute_per_partition_arrays(pre: PreAggregates,
                                  metrics: List[Metric],
                                  public_partitions: bool,
                                  n_partitions: Optional[int] = None,
-                                 use_device: Optional[bool] = None
-                                 ) -> PerPartitionArrays:
+                                 use_device: Optional[bool] = None,
+                                 mesh=None) -> PerPartitionArrays:
     """Runs every error model over the whole configuration grid.
 
     use_device: True forces the jitted device sweep
@@ -549,11 +550,14 @@ def compute_per_partition_arrays(pre: PreAggregates,
     forces host numpy; None auto-selects (device when an accelerator is
     present and the grid is large), falling back to host with a warning if
     the device path fails.
+    mesh: a jax.sharding.Mesh to shard the sweep over (implies device).
     """
     if n_partitions is None:
         n_partitions = max(len(pre.pk_vocab), 1)
     ordered_metrics = [m for m in METRIC_ORDER if m in metrics]
     from pipelinedp_tpu.analysis import device_sweep
+    if mesh is not None:
+        use_device = True
     forced_device = use_device is True
     if use_device is None:
         use_device = device_sweep.should_use_device(pre.num_groups,
@@ -567,7 +571,7 @@ def compute_per_partition_arrays(pre: PreAggregates,
             device_state, metric_errors, approx_moments = (
                 _build_device_sweep(pre, configs, ordered_metrics,
                                     n_partitions, public_partitions,
-                                    n_units))
+                                    n_units, mesh=mesh))
         except Exception:
             if forced_device:
                 raise

@@ -118,7 +118,8 @@ class UtilityAnalysisEngine:
         arrays = per_partition.compute_per_partition_arrays(
             pre, configs, metrics, is_public,
             n_partitions=max(len(pre.pk_vocab), 1),
-            use_device=options.use_device_sweep)
+            use_device=options.use_device_sweep,
+            mesh=getattr(options, "device_mesh", None))
         return AnalysisResult(arrays, pre.pk_vocab, ordered, is_public)
 
 

@@ -18,6 +18,7 @@ conformance suite as the host backends (tests/pipeline_backend_test.py).
 
 from __future__ import annotations
 
+import operator
 from typing import Callable
 
 import numpy as np
@@ -59,19 +60,125 @@ def _try_columns(pairs):
 
 
 class JaxBackend(local.LocalBackend):
-    """LocalBackend semantics; numeric per-key reductions on the device."""
+    """LocalBackend semantics; numeric per-key reductions and the per-key
+    sampling hot-spot on the device."""
+
+    # sample_fixed_per_key engages the device kernel above this many pairs
+    # (below it, the kernel launch costs more than the host loop). Class
+    # attribute so tests can force the device path on small data.
+    SAMPLE_DEVICE_MIN_ROWS = 1 << 15
 
     def sum_per_key(self, col, stage_name: str = None):
 
         def gen():
             pairs, keys, values = _try_columns(col)
             if keys is None:
-                yield from local.LocalBackend.sum_per_key(
-                    self, pairs, stage_name)
+                yield from local.LocalBackend.reduce_per_key(
+                    self, pairs, operator.add, stage_name)
                 return
             yield from self._segment_reduce(keys, values)
 
         return gen()
+
+    def reduce_per_key(self, col, fn: Callable, stage_name: str = None):
+        """Host reduce with device offload for the recognizable numeric
+        reductions (operator.add, builtin min/max).
+
+        Arbitrary fns keep LocalBackend's arrival-order fold — a general
+        callable can be non-commutative, which a segment reduction must
+        not reorder."""
+        if fn is operator.add:
+            return self.sum_per_key(col, stage_name)
+        if fn is min or fn is max:
+
+            def gen():
+                pairs, keys, values = _try_columns(col)
+                if keys is None:
+                    yield from local.LocalBackend.reduce_per_key(
+                        self, pairs, fn, stage_name)
+                    return
+                yield from self._segment_extremum(keys, values, fn is min)
+
+            return gen()
+        return local.LocalBackend.reduce_per_key(self, col, fn, stage_name)
+
+    def sample_fixed_per_key(self, col, n: int, stage_name: str = None):
+        """Uniform sample of at most n values per key.
+
+        The sampling decision depends only on the keys, so the device
+        kernel (columnar.bound_row_mask with the key as the privacy id and
+        a single pseudo-partition: rank-below-n inside one random-tiebreak
+        sort — contribution_bounders.py:62-111 semantics) computes the
+        keep mask for any value type; values never leave the host. This
+        is the §3.1 `sample_fixed_per_key` hot spot of the reference
+        graph."""
+
+        def gen():
+            pairs = list(col)
+            if (len(pairs) < self.SAMPLE_DEVICE_MIN_ROWS or not all(
+                    isinstance(p, tuple) and len(p) == 2 for p in pairs)):
+                yield from local.LocalBackend.sample_fixed_per_key(
+                    self, pairs, n, stage_name)
+                return
+            try:
+                keys_arr = np.asarray([k for k, _ in pairs])
+                if keys_arr.dtype == object or keys_arr.ndim != 1:
+                    # Mixed-type or composite (tuple) keys: host path.
+                    raise TypeError("non-scalar keys")
+                ids, uniques = encoding._factorize(keys_arr)
+            except (TypeError, ValueError):
+                yield from local.LocalBackend.sample_fixed_per_key(
+                    self, pairs, n, stage_name)
+                return
+            import jax
+            import jax.numpy as jnp
+            from pipelinedp_tpu.ops import columnar
+            prng = jax.random.PRNGKey(
+                int(np.random.randint(0, 2**31 - 1)))
+            mask = np.asarray(
+                columnar.bound_row_mask(
+                    prng, jnp.asarray(ids),
+                    jnp.zeros(len(ids), dtype=jnp.int32),
+                    jnp.ones(len(ids), dtype=bool), n, 1))
+            kept: dict = {}
+            for keep, (k, v) in zip(mask, pairs):
+                if keep:
+                    kept.setdefault(k, []).append(v)
+            yield from kept.items()
+
+        return gen()
+
+    @staticmethod
+    def _segment_extremum(keys: np.ndarray, values: np.ndarray,
+                          is_min: bool):
+        """Per-key min/max on device. Exact for int32-range ints and all
+        floats (extrema never overflow); wider ints reduce on host."""
+        ids, uniques = encoding._factorize(keys)
+        int_values = np.issubdtype(values.dtype, np.integer)
+        fits_i32 = (int_values and len(values) > 0 and
+                    np.iinfo(np.int32).min <= values.min() and
+                    values.max() <= np.iinfo(np.int32).max)
+        if fits_i32 or not int_values:
+            import jax
+            import jax.numpy as jnp
+            op = jax.ops.segment_min if is_min else jax.ops.segment_max
+            dtype = jnp.int32 if int_values else jnp.float32
+            if not int_values and values.dtype == np.float64:
+                # float64 inputs reduce on host (device is f32).
+                out = np.full(len(uniques), np.inf if is_min else -np.inf)
+                (np.minimum if is_min else np.maximum).at(out, ids, values)
+            else:
+                out = jax.device_get(
+                    op(jnp.asarray(values, dtype=dtype), jnp.asarray(ids),
+                       num_segments=len(uniques)))
+        else:
+            out = np.full(len(uniques),
+                          np.iinfo(np.int64).max if is_min else
+                          np.iinfo(np.int64).min,
+                          dtype=np.int64)
+            (np.minimum if is_min else np.maximum).at(out, ids, values)
+        for key, v in zip(uniques, out):
+            yield int(key), (int(v) if int_values else float(v))
 
     def count_per_element(self, col, stage_name: str = None):
 

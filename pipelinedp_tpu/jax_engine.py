@@ -31,9 +31,10 @@ from pipelinedp_tpu import budget_accounting
 from pipelinedp_tpu import combiners as combiners_lib
 from pipelinedp_tpu import dp_computations
 from pipelinedp_tpu import report_generator as report_generator_lib
-from pipelinedp_tpu.aggregate_params import (AggregateParams, MechanismType,
-                                             Metrics, NoiseKind, NormKind,
-                                             SelectPartitionsParams)
+from pipelinedp_tpu.aggregate_params import (
+    AggregateParams, CalculatePrivateContributionBoundsParams, MechanismType,
+    Metrics, NoiseKind, NormKind, PrivateContributionBounds,
+    SelectPartitionsParams)
 from pipelinedp_tpu import dp_engine as dp_engine_lib
 from pipelinedp_tpu.data_extractors import DataExtractors
 from pipelinedp_tpu.ops import columnar, encoding, noise as noise_ops
@@ -199,7 +200,8 @@ class JaxDPEngine:
                  secure_host_noise: bool = True,
                  mesh=None,
                  stream_chunks: Optional[int] = None,
-                 value_transfer_dtype=None):
+                 value_transfer_dtype=None,
+                 transfer_encoding: str = "auto"):
         self._budget_accountant = budget_accountant
         self._report_generators = []
         self._root_key = jax.random.PRNGKey(seed)
@@ -214,6 +216,9 @@ class JaxDPEngine:
         # np.float16 halves the value-column transfer (lossy ingest,
         # opt-in; see ops/streaming.py).
         self._value_transfer_dtype = value_transfer_dtype
+        # "auto": the lossless RLE/bit-plane wire codec (ops/wirecodec.py);
+        # "bytes": the legacy fixed-width byte packing. Both exact.
+        self._transfer_encoding = transfer_encoding
 
     def _next_key(self):
         self._key_counter += 1
@@ -392,11 +397,90 @@ class JaxDPEngine:
             params.budget_weight)
         return result
 
+    def calculate_private_contribution_bounds(
+            self,
+            col,
+            params: CalculatePrivateContributionBoundsParams,
+            data_extractors: Optional[DataExtractors] = None,
+            partitions: Optional[Sequence[Any]] = None,
+            partitions_already_filtered: bool = False
+    ) -> PrivateContributionBounds:
+        """DP choice of max_partitions_contributed via the exponential
+        mechanism over dataset histograms, on the columnar path.
+
+        Columnar twin of DPEngine.calculate_private_contribution_bounds
+        (dp_engine.py:384; reference pipeline_dp/dp_engine.py:450-549):
+        the L0 contribution histogram comes from the vectorized columnar
+        histogram fast path (dataset_histograms/computing_histograms
+        .compute_dataset_histograms_columnar) instead of a per-row
+        pipeline, and the exponential-mechanism draw uses the same secure
+        uniform sampler as the host engine. Supported for COUNT /
+        PRIVACY_ID_COUNT aggregations.
+
+        col: ColumnarData / EncodedColumns, or row iterable with
+          data_extractors.
+        partitions: the partition keys the aggregation will use (public or
+          DP-selected). Required unless partitions_already_filtered and the
+          number of partitions is taken from the filtered data itself.
+
+        Returns the PrivateContributionBounds dataclass directly (the
+        columnar engine has no deferred backend collections to wrap it in;
+        DPEngine returns a 1-element collection with the same payload).
+        """
+        from pipelinedp_tpu.dataset_histograms import computing_histograms
+        from pipelinedp_tpu import private_contribution_bounds as pcb_lib
+
+        is_columnar = isinstance(
+            col, (encoding.ColumnarData, encoding.EncodedColumns))
+        dp_engine_lib.DPEngine.\
+            _check_calculate_private_contribution_bounds_params(
+                self, col, params, data_extractors,
+                check_data_extractors=not is_columnar)
+
+        if is_columnar:
+            pid = np.asarray(col.pid)
+            pk = np.asarray(col.pk)
+        else:
+            rows = list(col)
+            pid = encoding._column_from_list(
+                [data_extractors.privacy_id_extractor(r) for r in rows])
+            pk = encoding._column_from_list(
+                [data_extractors.partition_extractor(r) for r in rows])
+
+        if partitions is not None:
+            partitions = list(partitions)
+            if (isinstance(col, encoding.EncodedColumns)
+                    and col.pk_keys is not None):
+                # EncodedColumns pk are dense ids; `partitions` arrives as
+                # user-facing keys — translate through the vocabulary so
+                # the filter compares ids to ids.
+                id_of_key = {k: i for i, k in enumerate(col.pk_keys)}
+                partitions = [id_of_key[p] for p in partitions
+                              if p in id_of_key]
+            partition_keys = np.unique(
+                encoding._column_from_list(partitions))
+            number_of_partitions = len(partition_keys)
+            if not partitions_already_filtered:
+                mask = np.isin(pk, partition_keys)
+                pid, pk = pid[mask], pk[mask]
+        elif partitions_already_filtered:
+            number_of_partitions = len(np.unique(pk))
+        else:
+            raise ValueError(
+                "partitions must be provided unless "
+                "partitions_already_filtered=True")
+
+        histograms = computing_histograms.compute_dataset_histograms_columnar(
+            encoding.ColumnarData(pid=pid, pk=pk, value=None))
+        scoring = pcb_lib.L0ScoringFunction(params, number_of_partitions,
+                                            histograms.l0_contributions_histogram)
+        candidates = pcb_lib.generate_possible_contribution_bounds(
+            scoring.max_partitions_contributed_best_upper_bound())
+        bound = dp_computations.ExponentialMechanism(scoring).apply(
+            params.calculation_eps, candidates)
+        return PrivateContributionBounds(max_partitions_contributed=bound)
+
     def _check_supported(self, params: AggregateParams):
-        if params.custom_combiners and self._mesh is not None:
-            raise NotImplementedError(
-                "Custom combiners are host-evaluated and not supported with "
-                "mesh=; run single-device or use DPEngine with LocalBackend.")
         if any(m.is_percentile for m in params.metrics or []):
             if Metrics.VECTOR_SUM in params.metrics:
                 raise NotImplementedError(
@@ -655,6 +739,7 @@ class JaxDPEngine:
         for stage in compound.explain_computation():
             self._add_report_stage(stage)
         key = self._next_key()
+        engine = self
 
         def compute():
             k_kernel, _ = jax.random.split(key)
@@ -664,6 +749,14 @@ class JaxDPEngine:
                             l0_cap >= num_partitions and l1_cap is None))
             if no_bounding or n_rows == 0:
                 keep = np.ones(n_rows, dtype=bool)
+            elif engine._mesh is not None:
+                # Device bounding runs sharded over the mesh (pid-disjoint
+                # shards, exact); the combiner loop below stays on host
+                # with exact float64 values.
+                from pipelinedp_tpu.parallel import sharded
+                keep = sharded.host_row_mask(engine._mesh, k_kernel, pid,
+                                             pk, linf_cap=linf_cap,
+                                             l0_cap=l0_cap, l1_cap=l1_cap)
             else:
                 keep = np.asarray(
                     columnar.bound_row_mask(k_kernel, jnp.asarray(pid),
@@ -777,6 +870,7 @@ class JaxDPEngine:
                   if params.bounds_per_contribution_are_set else 0.0)
 
         vector_sums = None
+        streamed_qhist = None
         norm_ord = {NormKind.Linf: 0, NormKind.L1: 1,
                     NormKind.L2: 2}[params.vector_norm_kind or NormKind.Linf]
         if self._mesh is not None:
@@ -819,12 +913,21 @@ class JaxDPEngine:
                 max_norm=params.vector_max_norm,
                 norm_ord=norm_ord,
                 l1_cap=l1_cap)
-        elif (not has_quantile and self._stream_chunks != 1 and
+        elif (self._can_stream(has_quantile, num_partitions) and
+              self._stream_chunks != 1 and
               (self._stream_chunks is not None or
                n_rows >= streaming.MIN_STREAM_ROWS)):
             # Large single-device input: pid-disjoint chunked pipeline so
             # the host->device transfer overlaps the kernel and ships
-            # byte-packed columns (ops/streaming.py; exact, see module doc).
+            # wire-codec-compressed columns (ops/streaming.py; exact, see
+            # module doc). PERCENTILE rides the same stream: quantile-tree
+            # leaf counts are additive across the pid-disjoint chunks.
+            quantile_spec = None
+            if has_quantile:
+                quantile_spec = (
+                    quantile_tree_lib.DEFAULT_BRANCHING_FACTOR
+                    ** quantile_tree_lib.DEFAULT_TREE_HEIGHT,
+                    params.min_value, params.max_value)
             accs = streaming.stream_bound_and_aggregate(
                 k_kernel, pid, pk, value,
                 num_partitions=num_partitions,
@@ -839,7 +942,11 @@ class JaxDPEngine:
                 n_chunks=self._stream_chunks,
                 value_transfer_dtype=self._value_transfer_dtype,
                 need_flags=need_flags,
-                has_group_clip=has_group_clip)
+                has_group_clip=has_group_clip,
+                transfer_encoding=self._transfer_encoding,
+                quantile_spec=quantile_spec)
+            if has_quantile:
+                accs, streamed_qhist = accs
         else:
             accs = columnar.bound_and_aggregate(
                 k_kernel, jnp.asarray(pid), jnp.asarray(pk),
@@ -879,7 +986,8 @@ class JaxDPEngine:
                 qcombiner, pid, pk, value, n_rows, num_out,
                 num_partitions, linf_cap, l0_cap, l1_cap, k_kernel,
                 jax.random.fold_in(k_noise, 10_000),
-                valid_rows if self._mesh is not None else None)
+                valid_rows if self._mesh is not None else None,
+                precomputed_hist=streamed_qhist)
 
         # Partition selection. The selection strategy's L0 sensitivity is
         # the *declared* cross-partition bound: max_partitions_contributed,
@@ -936,6 +1044,20 @@ class JaxDPEngine:
         columns["partition_id"] = np.arange(num_partitions, dtype=np.int32)
         columns["keep_mask"] = keep_np
         return columns
+
+    def _can_stream(self, has_quantile: bool, num_partitions: int) -> bool:
+        """PERCENTILE can ride the stream when the dense [partitions,
+        leaves] histogram fits the device budget (the partition-blocked
+        quantile path needs pk-sorted residency, which is incompatible
+        with pid-chunking) and the wire codec is in use."""
+        if not has_quantile:
+            return True
+        if self._transfer_encoding == "bytes":
+            return False
+        num_leaves = (quantile_tree_lib.DEFAULT_BRANCHING_FACTOR
+                      ** quantile_tree_lib.DEFAULT_TREE_HEIGHT)
+        return (num_partitions * num_leaves
+                <= quantile_ops.MAX_HISTOGRAM_ELEMENTS)
 
     # -- selection dispatch: secure host path or device kernel --------------
 
@@ -1077,13 +1199,15 @@ class JaxDPEngine:
 
     def _quantile_columns(self, combiner, pid, pk, value, n_rows,
                           num_out, num_partitions, linf_cap, l0_cap, l1_cap,
-                          k_kernel, k_noise, mesh_valid_rows):
+                          k_kernel, k_noise, mesh_valid_rows,
+                          precomputed_hist=None):
         """[num_out, n_quantiles] DP quantile estimates for every
         partition. Dense single-histogram path when the [partitions,
         leaves] layout fits the device budget; otherwise partition-blocked
         over pk-sorted rows (ops/quantiles.blocked_quantile_columns). The
         row keep mask replays the fused kernel's sampling decisions (same
-        PRNG key)."""
+        PRNG key). precomputed_hist: the [num_out, leaves] leaf histogram
+        already accumulated by the streamed path (chunk-additive)."""
         p = combiner._params.aggregate_params
         eps, delta = combiner._params.eps, combiner._params.delta
         is_gaussian = p.noise_kind == NoiseKind.GAUSSIAN
@@ -1121,6 +1245,8 @@ class JaxDPEngine:
                     noised, jnp.asarray(quantiles, dtype=jnp.float32),
                     p.min_value, p.max_value, branching=branching))
 
+        if precomputed_hist is not None:
+            return finish(precomputed_hist)
         dense_fits = num_out * num_leaves <= quantile_ops.MAX_HISTOGRAM_ELEMENTS
         if self._mesh is not None:
             from pipelinedp_tpu.parallel import sharded

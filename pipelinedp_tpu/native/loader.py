@@ -113,8 +113,47 @@ def load() -> Optional[ctypes.CDLL]:
 
 def load_row_packer() -> Optional[ctypes.CDLL]:
     """The row bucketing/packing library; None on failure."""
-    lib = _load_lib("row_packer", "pdp_row_packer_abi_version")
+    lib = _load_lib("row_packer", "pdp_row_packer_abi_version",
+                    abi_version=3)
     if lib is not None and not getattr(lib, "_pdp_typed", False):
+        fn = lib.pdp_rle_prep
+        fn.restype = ctypes.c_void_p
+        fn.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),  # pid
+            ctypes.POINTER(ctypes.c_int32),  # pk
+            ctypes.c_void_p,  # value (float* or NULL)
+            ctypes.POINTER(ctypes.c_int32),  # vidx (or NULL)
+            ctypes.c_int64,  # n
+            ctypes.c_int32,  # pid_lo
+            ctypes.c_int64,  # k buckets
+            ctypes.c_int,  # value_mode
+            ctypes.POINTER(ctypes.c_int64),  # n_rows out
+        ]
+        fn = lib.pdp_rle_sort_range
+        fn.restype = ctypes.c_int
+        fn.argtypes = [
+            ctypes.c_void_p,  # handle
+            ctypes.c_int64,  # b0
+            ctypes.c_int64,  # b1
+            ctypes.POINTER(ctypes.c_int64),  # n_uniq out
+        ]
+        fn = lib.pdp_rle_emit_range
+        fn.restype = ctypes.c_int
+        fn.argtypes = [
+            ctypes.c_void_p,  # handle
+            ctypes.c_int64,  # b0
+            ctypes.c_int64,  # b1
+            ctypes.c_int,  # bytes_pid
+            ctypes.c_int,  # bits_pk
+            ctypes.c_int,  # bits_val
+            ctypes.c_int64,  # cap
+            ctypes.c_int64,  # ucap
+            ctypes.POINTER(ctypes.c_uint8),  # out slab rows
+            ctypes.c_int64,  # width
+        ]
+        fn = lib.pdp_rle_free
+        fn.restype = None
+        fn.argtypes = [ctypes.c_void_p]
         fn = lib.pdp_pack_buckets
         fn.restype = ctypes.c_int
         fn.argtypes = [

@@ -15,7 +15,10 @@
 // (bucket = knuth_hash(pid - pid_lo) % n_buckets, identical to the Python
 // fallback in streaming.py).
 
+#include <algorithm>
+#include <atomic>
 #include <cstdint>
+#include <functional>
 #include <cstring>
 #include <thread>
 #include <vector>
@@ -176,6 +179,309 @@ int pdp_pack_buckets(const int32_t* pid, const int32_t* pk,
   return 0;
 }
 
-int pdp_row_packer_abi_version() { return 1; }
+}  // extern "C"
+
+
+// ---------------------------------------------------------------------------
+// Lossless RLE + bit-plane wire codec (native fast path; the numpy
+// reference in ops/wirecodec.py produces bit-identical buffers).
+//
+// Three-call API so the per-slab encode can overlap the previous slab's
+// async host->device transfer (ops/streaming.py drives it):
+//   pdp_rle_prep        one pass: bucket rows (pid-hash, same bucketing as
+//                       pdp_pack_buckets) into bucket-major SoA temps.
+//   pdp_rle_sort_range  per bucket: LSD radix sort by shifted pid (stable,
+//                       byte passes only up to the bucket's max id) +
+//                       exact RLE entry counts. The expensive step.
+//   pdp_rle_emit_range  per bucket: write one flat slab row =
+//                       [uniq ids | uint16 run lengths | pk bit-planes |
+//                       value planes/raw], runs split at 65535.
+//   pdp_rle_free        release the state.
+//
+// Bit-planes are LSB-first: plane j, byte r>>3, bit r&7 = bit j of row r.
+// Packing works in 8-row register groups (one byte store per plane per 8
+// rows) — this box may have a single core, so the encoder is tuned for
+// single-thread throughput first, with an optional bucket-parallel pool.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr int64_t kRunSplit = 65535;
+
+struct RleState {
+  int64_t n = 0;
+  int64_t k = 0;
+  int value_mode = 0;  // 0 none, 1 planes(vidx), 2 raw f32, 3 raw f16
+  std::vector<int64_t> bucket_start;  // [k+1]
+  // Bucket-major SoA; after sort_range a bucket's slice is pid-sorted.
+  std::vector<uint32_t> tpid;
+  std::vector<int32_t> tpk;
+  std::vector<float> tval;
+  std::vector<int32_t> tvidx;
+  std::vector<char> sorted;  // per bucket
+};
+
+// Stable LSD radix sort of (pid << 32 | local_index) pairs, low `nbytes`
+// key bytes only. Stability (index in the low bits) makes the row order
+// identical to numpy's kind="stable" argsort in the reference encoder.
+void RadixSortPairs(uint64_t* a, uint64_t* tmp, int64_t m, int nbytes) {
+  for (int p = 0; p < nbytes; ++p) {
+    const int shift = 32 + 8 * p;
+    int64_t hist[256] = {0};
+    for (int64_t i = 0; i < m; ++i) hist[(a[i] >> shift) & 0xff]++;
+    int64_t acc = 0;
+    for (int v = 0; v < 256; ++v) {
+      int64_t c = hist[v];
+      hist[v] = acc;
+      acc += c;
+    }
+    for (int64_t i = 0; i < m; ++i) tmp[hist[(a[i] >> shift) & 0xff]++] = a[i];
+    std::swap(a, tmp);
+  }
+  if (nbytes & 1) std::memcpy(tmp, a, m * 8);  // result back into caller's a
+}
+
+void SortBucket(RleState* st, int64_t b) {
+  const int64_t s = st->bucket_start[b];
+  const int64_t m = st->bucket_start[b + 1] - s;
+  if (m == 0 || st->sorted[b]) {
+    st->sorted[b] = 1;
+    return;
+  }
+  uint32_t maxpid = 0;
+  for (int64_t i = 0; i < m; ++i) maxpid |= st->tpid[s + i];
+  int nbytes = 1;
+  while (nbytes < 4 && (maxpid >> (8 * nbytes))) ++nbytes;
+  std::vector<uint64_t> a(m), tmp(m);
+  for (int64_t i = 0; i < m; ++i) {
+    a[i] = (static_cast<uint64_t>(st->tpid[s + i]) << 32) |
+           static_cast<uint64_t>(i);
+  }
+  // RadixSortPairs leaves the sorted pairs in `a` for any pass count (odd
+  // counts copy back).
+  RadixSortPairs(a.data(), tmp.data(), m, nbytes);
+  const uint64_t* order = a.data();
+  // Permute payload columns into sorted order via one gather each.
+  {
+    std::vector<int32_t> scratch(m);
+    for (int64_t i = 0; i < m; ++i) {
+      scratch[i] = st->tpk[s + (order[i] & 0xffffffffu)];
+    }
+    std::memcpy(&st->tpk[s], scratch.data(), m * 4);
+    if (st->value_mode == 1) {
+      for (int64_t i = 0; i < m; ++i) {
+        scratch[i] = st->tvidx[s + (order[i] & 0xffffffffu)];
+      }
+      std::memcpy(&st->tvidx[s], scratch.data(), m * 4);
+    } else if (st->value_mode == 2 || st->value_mode == 3) {
+      float* fs = reinterpret_cast<float*>(scratch.data());
+      for (int64_t i = 0; i < m; ++i) {
+        fs[i] = st->tval[s + (order[i] & 0xffffffffu)];
+      }
+      std::memcpy(&st->tval[s], scratch.data(), m * 4);
+    }
+  }
+  for (int64_t i = 0; i < m; ++i) {
+    st->tpid[s + i] = static_cast<uint32_t>(order[i] >> 32);
+  }
+  st->sorted[b] = 1;
+}
+
+int64_t CountRleEntries(const RleState* st, int64_t b) {
+  const int64_t s = st->bucket_start[b];
+  const int64_t m = st->bucket_start[b + 1] - s;
+  int64_t entries = 0, run = 0;
+  uint32_t prev = 0;
+  for (int64_t i = 0; i < m; ++i) {
+    const uint32_t id = st->tpid[s + i];
+    if (i == 0 || id != prev || run == kRunSplit) {
+      if (i != 0) ++entries;
+      prev = id;
+      run = 0;
+    }
+    ++run;
+  }
+  if (m > 0) ++entries;
+  return entries;
+}
+
+// Bit-plane pack `col[0..m)` (values < 2^bits) into planes at out
+// (stride cap8 bytes per plane), 8 rows per byte store.
+void PackPlanes(const int32_t* col, int64_t m, int bits, int64_t cap8,
+                uint8_t* out) {
+  for (int64_t r8 = 0; r8 * 8 < m; ++r8) {
+    const int g = static_cast<int>(m - r8 * 8 < 8 ? m - r8 * 8 : 8);
+    uint32_t vals[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+    for (int i = 0; i < g; ++i) {
+      vals[i] = static_cast<uint32_t>(col[r8 * 8 + i]);
+    }
+    for (int j = 0; j < bits; ++j) {
+      uint8_t byte = 0;
+      for (int i = 0; i < 8; ++i) byte |= ((vals[i] >> j) & 1u) << i;
+      out[j * cap8 + r8] = byte;
+    }
+  }
+}
+
+void RunPool(int64_t k0, int64_t k1, const std::function<void(int64_t)>& fn) {
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t pool = hw < 1 ? 1 : static_cast<int64_t>(hw);
+  if (pool > 16) pool = 16;
+  if (pool > k1 - k0) pool = k1 - k0;
+  if (pool <= 1) {
+    for (int64_t b = k0; b < k1; ++b) fn(b);
+    return;
+  }
+  std::atomic<int64_t> next{k0};
+  std::vector<std::thread> threads;
+  for (int64_t t = 0; t < pool; ++t) {
+    threads.emplace_back([&] {
+      for (;;) {
+        const int64_t b = next.fetch_add(1);
+        if (b >= k1) return;
+        fn(b);
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pdp_rle_prep(const int32_t* pid, const int32_t* pk, const float* value,
+                   const int32_t* vidx, int64_t n, int32_t pid_lo, int64_t k,
+                   int value_mode, int64_t* n_rows) {
+  if (!pid || !pk || !n_rows || n < 0 || k <= 0) return nullptr;
+  if (value_mode == 1 && !vidx) return nullptr;
+  if ((value_mode == 2 || value_mode == 3) && !value) return nullptr;
+  auto* st = new RleState();
+  st->n = n;
+  st->k = k;
+  st->value_mode = value_mode;
+  st->bucket_start.assign(k + 1, 0);
+  st->sorted.assign(k, 0);
+  {
+    std::vector<int64_t> counts(k, 0);
+    for (int64_t i = 0; i < n; ++i) {
+      counts[BucketOf(pid[i] - pid_lo, static_cast<uint32_t>(k))]++;
+    }
+    for (int64_t b = 0; b < k; ++b) {
+      st->bucket_start[b + 1] = st->bucket_start[b] + counts[b];
+      n_rows[b] = counts[b];
+    }
+  }
+  st->tpid.resize(n);
+  st->tpk.resize(n);
+  if (value_mode == 2 || value_mode == 3) st->tval.resize(n);
+  if (value_mode == 1) st->tvidx.resize(n);
+  {
+    std::vector<int64_t> cursor(st->bucket_start.begin(),
+                                st->bucket_start.end() - 1);
+    for (int64_t i = 0; i < n; ++i) {
+      const uint32_t b = BucketOf(pid[i] - pid_lo, static_cast<uint32_t>(k));
+      const int64_t slot = cursor[b]++;
+      st->tpid[slot] = static_cast<uint32_t>(pid[i] - pid_lo);
+      st->tpk[slot] = pk[i];
+      if (value_mode == 2 || value_mode == 3) st->tval[slot] = value[i];
+      if (value_mode == 1) st->tvidx[slot] = vidx[i];
+    }
+  }
+  return st;
+}
+
+int pdp_rle_sort_range(void* handle, int64_t b0, int64_t b1,
+                       int64_t* n_uniq) {
+  auto* st = static_cast<RleState*>(handle);
+  if (!st || !n_uniq || b0 < 0 || b1 > st->k || b0 > b1) return 1;
+  RunPool(b0, b1, [&](int64_t b) {
+    SortBucket(st, b);
+    n_uniq[b - b0] = CountRleEntries(st, b);
+  });
+  return 0;
+}
+
+// out: [b1-b0, width] flat slab rows; width must match the layout
+// ucap*bytes_pid + ucap*2 + bits_pk*cap/8 + value bytes.
+int pdp_rle_emit_range(void* handle, int64_t b0, int64_t b1, int bytes_pid,
+                       int bits_pk, int bits_val, int64_t cap, int64_t ucap,
+                       uint8_t* out, int64_t width) {
+  auto* st = static_cast<RleState*>(handle);
+  if (!st || !out || b0 < 0 || b1 > st->k || b0 > b1 || cap < 8 ||
+      (cap % 8) != 0 || bytes_pid < 1 || bytes_pid > 4 || bits_pk < 1 ||
+      bits_pk > 31 || ucap < 1) {
+    return 1;
+  }
+  if (st->value_mode == 1 && (bits_val < 1 || bits_val > 31)) return 1;
+  const int64_t cap8 = cap / 8;
+  const int64_t o_cnt = ucap * bytes_pid;
+  const int64_t o_pk = o_cnt + ucap * 2;
+  const int64_t o_val = o_pk + bits_pk * cap8;
+  int64_t want = o_val;
+  if (st->value_mode == 1) want += bits_val * cap8;
+  if (st->value_mode == 2) want += cap * 4;
+  if (st->value_mode == 3) want += cap * 2;
+  if (want != width) return 1;
+
+  std::atomic<int> rc{0};
+  RunPool(b0, b1, [&](int64_t b) {
+    const int64_t s = st->bucket_start[b];
+    const int64_t m = st->bucket_start[b + 1] - s;
+    if (!st->sorted[b] || m > cap) {
+      rc.store(2);
+      return;
+    }
+    uint8_t* row = out + (b - b0) * width;
+    std::memset(row, 0, width);
+    // RLE of the sorted pid column.
+    int64_t entries = 0, run = 0;
+    uint32_t prev = 0;
+    auto flush = [&](uint32_t id, int64_t len) {
+      if (entries >= ucap) {
+        rc.store(3);
+        return false;
+      }
+      uint8_t* u = row + entries * bytes_pid;
+      for (int bb = 0; bb < bytes_pid; ++bb) u[bb] = (id >> (8 * bb)) & 0xff;
+      row[o_cnt + entries * 2] = len & 0xff;
+      row[o_cnt + entries * 2 + 1] = (len >> 8) & 0xff;
+      ++entries;
+      return true;
+    };
+    for (int64_t i = 0; i < m; ++i) {
+      const uint32_t id = st->tpid[s + i];
+      if (i == 0) {
+        prev = id;
+        run = 0;
+      } else if (id != prev || run == kRunSplit) {
+        if (!flush(prev, run)) return;
+        prev = id;
+        run = 0;
+      }
+      ++run;
+    }
+    if (m > 0 && !flush(prev, run)) return;
+    // pk planes, then the value column.
+    PackPlanes(&st->tpk[s], m, bits_pk, cap8, row + o_pk);
+    if (st->value_mode == 1) {
+      PackPlanes(&st->tvidx[s], m, bits_val, cap8, row + o_val);
+    } else if (st->value_mode == 2) {
+      std::memcpy(row + o_val, &st->tval[s], m * 4);
+    } else if (st->value_mode == 3) {
+      uint8_t* v = row + o_val;
+      for (int64_t i = 0; i < m; ++i) {
+        const uint16_t h = F32ToF16(st->tval[s + i]);
+        v[i * 2] = h & 0xff;
+        v[i * 2 + 1] = (h >> 8) & 0xff;
+      }
+    }
+  });
+  return rc.load();
+}
+
+void pdp_rle_free(void* handle) { delete static_cast<RleState*>(handle); }
+
+int pdp_row_packer_abi_version() { return 3; }
 
 }  // extern "C"

@@ -236,7 +236,10 @@ def bound_and_aggregate(key: jax.Array,
     """
     n = pid.shape[0]
     if n == 0:
-        zeros = jnp.zeros((num_partitions,), dtype=value.dtype)
+        # Same dtype contract as the non-empty path, which accumulates in
+        # at least float32 regardless of the value dtype.
+        zeros = jnp.zeros((num_partitions,),
+                          dtype=jnp.promote_types(value.dtype, jnp.float32))
         return PartitionAccumulators(zeros, zeros, zeros, zeros, zeros)
     s = _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap,
                                 l1_cap, value=value)

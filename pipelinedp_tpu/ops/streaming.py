@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pipelinedp_tpu.ops import columnar
+from pipelinedp_tpu.ops import columnar, wirecodec
 from pipelinedp_tpu import profiler
 
 # Knuth multiplicative hash so that structured pid spaces (all-even ids,
@@ -50,10 +50,21 @@ MIN_STREAM_ROWS = 2_000_000
 
 DEFAULT_NUM_CHUNKS = 16
 
+# Transfers are sized by a byte budget, not a fixed count: small inputs take
+# 2 slabs (the minimum that overlaps transfer with compute), huge inputs
+# take as many as keep a slab near the budget so peak device residency per
+# slab stays bounded.
+SLAB_BYTE_BUDGET = 192 * 1024 * 1024
+
 
 def _num_chunks(n_rows: int) -> int:
     # ~8 MB of packed bytes per chunk minimum, capped at the default.
     return int(min(DEFAULT_NUM_CHUNKS, max(2, n_rows // 1_000_000)))
+
+
+def _num_transfers(total_bytes: int, k: int) -> int:
+    want = -(-total_bytes // SLAB_BYTE_BUDGET)  # ceil
+    return int(max(2, min(k, want)))
 
 
 def _int_bytes(max_value: int) -> int:
@@ -134,6 +145,96 @@ def _chunk_step(key, buf, n_valid, accs, linf_cap, l0_cap, row_clip_lo,
         *(a + c for a, c in zip(accs, chunk_accs)))
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_partitions", "fmt", "need_flags",
+                     "has_group_clip"),
+    donate_argnums=(4,))
+def _chunk_step_rle(key, row, n_valid, n_uniq, accs, linf_cap, l0_cap,
+                    row_clip_lo, row_clip_hi, middle, group_clip_lo,
+                    group_clip_hi, l1_cap=None, *,
+                    num_partitions: int, fmt: wirecodec.WireFormat,
+                    need_flags=(True, True, True, True),
+                    has_group_clip: bool = True):
+    """Decode one wire-codec bucket, bound+aggregate it, add into accs.
+
+    Buckets are pid-disjoint, so bounding each independently with the full
+    caps and summing accumulators is exact (see module docstring).
+    """
+    pid, pk, value, valid = wirecodec.decode_bucket(row, n_valid, n_uniq,
+                                                    fmt)
+    if value is None:
+        value = jnp.zeros((fmt.cap,), dtype=jnp.float32)
+    chunk_accs = columnar.bound_and_aggregate(
+        key, pid, pk, value, valid,
+        num_partitions=num_partitions,
+        linf_cap=linf_cap,
+        l0_cap=l0_cap,
+        row_clip_lo=row_clip_lo,
+        row_clip_hi=row_clip_hi,
+        middle=middle,
+        group_clip_lo=group_clip_lo,
+        group_clip_hi=group_clip_hi,
+        l1_cap=l1_cap,
+        need_count=need_flags[0],
+        need_sum=need_flags[1],
+        need_norm=need_flags[2],
+        need_norm_sq=need_flags[3],
+        has_group_clip=has_group_clip)
+    return columnar.PartitionAccumulators(
+        *(a + c for a, c in zip(accs, chunk_accs)))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_partitions", "fmt", "num_leaves", "need_flags",
+                     "has_group_clip"),
+    donate_argnums=(4, 5))
+def _chunk_step_rle_quantile(key, row, n_valid, n_uniq, accs, qhist,
+                             linf_cap, l0_cap, row_clip_lo, row_clip_hi,
+                             middle, group_clip_lo, group_clip_hi,
+                             q_lower, q_upper, l1_cap=None, *,
+                             num_partitions: int, fmt: wirecodec.WireFormat,
+                             num_leaves: int,
+                             need_flags=(True, True, True, True),
+                             has_group_clip: bool = True):
+    """_chunk_step_rle plus the quantile-tree leaf histogram.
+
+    Leaf counts are additive across pid-disjoint chunks, and the row keep
+    mask derives from the same per-chunk PRNG key as the accumulator
+    kernel, so the histogrammed contributions are exactly the rows the
+    aggregation kept (columnar.bound_row_mask shares
+    _sample_rows_and_groups with bound_and_aggregate).
+    """
+    from pipelinedp_tpu.ops import quantiles as quantile_ops
+    pid, pk, value, valid = wirecodec.decode_bucket(row, n_valid, n_uniq,
+                                                    fmt)
+    chunk_accs = columnar.bound_and_aggregate(
+        key, pid, pk, value, valid,
+        num_partitions=num_partitions,
+        linf_cap=linf_cap,
+        l0_cap=l0_cap,
+        row_clip_lo=row_clip_lo,
+        row_clip_hi=row_clip_hi,
+        middle=middle,
+        group_clip_lo=group_clip_lo,
+        group_clip_hi=group_clip_hi,
+        l1_cap=l1_cap,
+        need_count=need_flags[0],
+        need_sum=need_flags[1],
+        need_norm=need_flags[2],
+        need_norm_sq=need_flags[3],
+        has_group_clip=has_group_clip)
+    row_keep = columnar.bound_row_mask(key, pid, pk, valid, linf_cap,
+                                       l0_cap, l1_cap=l1_cap)
+    chunk_hist = quantile_ops.leaf_histograms(pk, value, row_keep,
+                                              num_partitions=num_partitions,
+                                              num_leaves=num_leaves,
+                                              lower=q_lower, upper=q_upper)
+    return (columnar.PartitionAccumulators(
+        *(a + c for a, c in zip(accs, chunk_accs))), qhist + chunk_hist)
+
+
 def stream_bound_and_aggregate(
     key: jax.Array,
     pid: np.ndarray,
@@ -153,7 +254,9 @@ def stream_bound_and_aggregate(
     value_transfer_dtype: Optional[np.dtype] = None,
     need_flags=(True, True, True, True),
     has_group_clip: bool = True,
-    n_transfers: int = 2,
+    n_transfers: Optional[int] = None,
+    transfer_encoding: str = "auto",
+    quantile_spec: Optional[Tuple[int, float, float]] = None,
 ) -> columnar.PartitionAccumulators:
     """Chunked, transfer-overlapped twin of columnar.bound_and_aggregate.
 
@@ -167,15 +270,29 @@ def stream_bound_and_aggregate(
       (opt-in: the f16 rounding of individual contributions is far below
       any DP noise scale, but it is a lossy ingest step so the caller must
       ask for it).
+    transfer_encoding: "auto" (the lossless RLE/bit-plane wire codec,
+      ops/wirecodec.py) or "bytes" (the legacy fixed-width byte packing).
+      Both are exact; "auto" ships a fraction of the bytes.
+    quantile_spec: optional (num_leaves, lower, upper) — also accumulate
+      the [num_partitions, num_leaves] quantile-tree leaf histogram across
+      chunks (PERCENTILE metrics on the streamed path; wire-codec
+      encoding only). When set the return value is (accs, hist).
 
     Returns per-partition accumulators on device, identical in distribution
     to the single-shot kernel.
     """
     n = len(pid)
+    if quantile_spec is not None and transfer_encoding == "bytes":
+        raise ValueError(
+            "quantile_spec requires the wire-codec transfer encoding")
     if n == 0:
         zeros = jnp.zeros((num_partitions,), dtype=jnp.float32)
-        return columnar.PartitionAccumulators(zeros, zeros, zeros, zeros,
-                                              zeros)
+        accs0 = columnar.PartitionAccumulators(zeros, zeros, zeros, zeros,
+                                               zeros)
+        if quantile_spec is not None:
+            return accs0, jnp.zeros((num_partitions, quantile_spec[0]),
+                                    dtype=jnp.float32)
+        return accs0
     k = n_chunks or _num_chunks(n)
 
     pid = np.asarray(pid)
@@ -191,9 +308,90 @@ def stream_bound_and_aggregate(
     bytes_pid = _int_bytes(pid_span)
     bytes_pk = _int_bytes(max(num_partitions - 1, 0))
     value_f16 = value_transfer_dtype == np.float16
+
+    # Five distinct buffers: the accumulators are donated into each chunk
+    # step, and a donated buffer must not be aliased.
+    accs = columnar.PartitionAccumulators(
+        *(jnp.zeros((num_partitions,), dtype=jnp.float32) for _ in range(5)))
+
+    if transfer_encoding != "bytes":
+        bits_pk = max(1, int(max(num_partitions - 1, 0)).bit_length())
+        plan, vidx = wirecodec.plan_and_index(value, value_f16)
+        qhist = (jnp.zeros((num_partitions, quantile_spec[0]),
+                           dtype=jnp.float32)
+                 if quantile_spec is not None else None)
+
+        def run_chunk(accs, qhist, c, bucket_row, n_valid, n_uniq_c, fmt):
+            if quantile_spec is not None:
+                return _chunk_step_rle_quantile(
+                    jax.random.fold_in(key, c), bucket_row, n_valid,
+                    n_uniq_c, accs, qhist, linf_cap, l0_cap, row_clip_lo,
+                    row_clip_hi, middle, group_clip_lo, group_clip_hi,
+                    quantile_spec[1], quantile_spec[2], l1_cap,
+                    num_partitions=num_partitions, fmt=fmt,
+                    num_leaves=quantile_spec[0],
+                    need_flags=tuple(need_flags),
+                    has_group_clip=has_group_clip)
+            return _chunk_step_rle(
+                jax.random.fold_in(key, c), bucket_row, n_valid, n_uniq_c,
+                accs, linf_cap, l0_cap, row_clip_lo, row_clip_hi, middle,
+                group_clip_lo, group_clip_hi, l1_cap,
+                num_partitions=num_partitions, fmt=fmt,
+                need_flags=tuple(need_flags),
+                has_group_clip=has_group_clip), qhist
+
+        enc = wirecodec.NativeRleEncoder.create(pid, pk, value, vidx,
+                                                pid_lo=pid_lo, k=k,
+                                                plan=plan)
+        if enc is not None:
+            # Pipelined encode: every slab shares ONE wire format (so the
+            # chunk kernel compiles once — the sort runs upfront to learn
+            # the global RLE entry max, ~5% of the encode), then slab s+1
+            # is emitted on the host CPU while slab s's device_put is
+            # still on the wire (device_put and the kernels are async).
+            with enc:
+                counts = enc.counts
+                with profiler.stage("dp/wire_sort"):
+                    n_uniq = enc.sort_range(0, k)
+                fmt = wirecodec.WireFormat(
+                    bytes_pid=bytes_pid, bits_pk=bits_pk,
+                    cap=wirecodec._round8(int(counts.max())),
+                    ucap=wirecodec.round_ucap(int(n_uniq.max())),
+                    value=plan)
+                n_t = n_transfers or _num_transfers(fmt.width * k, k)
+                slab_buckets = max(1, (k + n_t - 1) // n_t)
+                for s0 in range(0, k, slab_buckets):
+                    s1 = min(s0 + slab_buckets, k)
+                    with profiler.stage(f"dp/stream_slab_{s0}"):
+                        slab = enc.emit_range(s0, s1, fmt)
+                        dslab = jax.device_put(slab)
+                        for c in range(s0, s1):
+                            accs, qhist = run_chunk(accs, qhist, c,
+                                                    dslab[c - s0],
+                                                    int(counts[c]),
+                                                    int(n_uniq[c]), fmt)
+        else:
+            with profiler.stage("dp/wire_encode"):
+                slab, counts, n_uniq, fmt = wirecodec.encode_buckets_numpy(
+                    pid, pk, value, pid_lo=pid_lo, k=k, bytes_pid=bytes_pid,
+                    bits_pk=bits_pk, plan=plan)
+            n_t = n_transfers or _num_transfers(slab.nbytes, k)
+            slab_buckets = max(1, (k + n_t - 1) // n_t)
+            for s0 in range(0, k, slab_buckets):
+                s1 = min(s0 + slab_buckets, k)
+                with profiler.stage(f"dp/stream_slab_{s0}"):
+                    dslab = jax.device_put(slab[s0:s1])
+                    for c in range(s0, s1):
+                        accs, qhist = run_chunk(accs, qhist, c,
+                                                dslab[c - s0],
+                                                int(counts[c]),
+                                                int(n_uniq[c]), fmt)
+        if quantile_spec is not None:
+            return accs, qhist
+        return accs
+
     bytes_value = 2 if value_f16 else 4
     width = bytes_pid + bytes_pk + bytes_value
-
     packed = _pack_native(pid, pk, value, pid_lo, k, bytes_pid, bytes_pk,
                           value_f16, width)
     if packed is None:
@@ -206,12 +404,8 @@ def stream_bound_and_aggregate(
     # per-transfer fixed cost (PCIe doorbells, tunneled links) would eat
     # the pipeline if every bucket shipped separately, and the slab after
     # this one still overlaps the current slab's kernels (async dispatch).
-    slab_buckets = max(1, (k + n_transfers - 1) // n_transfers)
-
-    # Five distinct buffers: the accumulators are donated into each chunk
-    # step, and a donated buffer must not be aliased.
-    accs = columnar.PartitionAccumulators(
-        *(jnp.zeros((num_partitions,), dtype=jnp.float32) for _ in range(5)))
+    n_t = n_transfers or _num_transfers(buckets.nbytes, k)
+    slab_buckets = max(1, (k + n_t - 1) // n_t)
     for s0 in range(0, k, slab_buckets):
         s1 = min(s0 + slab_buckets, k)
         with profiler.stage(f"dp/stream_slab_{s0}"):

@@ -1,0 +1,509 @@
+"""Lossless wire codec for the host->device row columns.
+
+The end-to-end cost of the streaming engine is the host->device transfer
+(BASELINE.md round-4 e2e analysis: ~1 GB of byte-packed columns over the
+bench link vs a 3.25 s kernel). This module shrinks the bytes on the wire
+*losslessly* by exploiting the structure the byte-packed layout ignores:
+
+  * privacy ids repeat (~rows/users times each). Rows are stably sorted by
+    pid inside each pid-disjoint bucket, so the pid column becomes a
+    run-length list (unique id + uint16 run length; runs longer than 65535
+    are split). 3 bytes/row -> ~0.3 bits/row at the benchmark shape.
+  * partition keys are dense ids in [0, P): they need exactly
+    ceil(log2(P)) bits, not a whole number of bytes. They ship as LSB-first
+    bit-planes (bit j of 8 consecutive rows per byte) and are rebuilt on
+    device with shifts and ors only — no gathers.
+  * values are frequently discrete (the reference's north-star workload is
+    movie ratings — /root/reference/examples/movie_view_ratings/
+    run_without_frameworks.py: integer star ratings). `plan_value_encoding`
+    detects an exact affine-integer representation v = lo + idx * scale,
+    VERIFIES bit-exact float32 round-trip on the host, and ships idx as
+    bit-planes. Values that fail the check ship as raw float32 (or float16
+    under the existing lossy opt-in) — the codec never loses bits.
+
+Everything for one bucket is flattened into a single row of a [k, W] uint8
+slab, so a slab still ships as ONE device_put (per-transfer fixed costs on
+tunneled links made many small puts strictly worse — see streaming.py).
+
+Decode is elementwise + one cumsum + one small gather per bucket, far below
+the kernel cost, and overlaps the next slab's transfer like the kernel does.
+
+Host encode has two implementations that produce bit-identical buffers: the
+multithreaded C++ packer (native/row_packer.cc, pdp_pack_buckets_rle) and
+the numpy reference below (used as fallback and as the test oracle).
+
+Role vs the reference: this is the TPU answer to the loader/shuffle layer
+the reference delegates to Beam/Spark native runners
+(pipeline_backend.py:38-195) — columnar, entropy-aware, and exact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Same Knuth multiplicative hash as streaming.py's bucketing (buckets must
+# stay pid-disjoint and identical across the codec and the legacy packer).
+_HASH_MULT = np.uint32(2654435761)
+
+# Value transfer modes (wire format tag; also the C++ ABI contract).
+VALUE_NONE = 0  # COUNT-style: no value bytes on the wire
+VALUE_PLANES = 1  # affine-integer bit-planes (lossless, host-verified)
+VALUE_F32 = 2  # raw little-endian float32
+VALUE_F16 = 3  # raw float16 (lossy ingest, existing opt-in)
+
+_MAX_VALUE_BITS = 20  # beyond ~1M distinct levels the planes stop paying
+_RUN_SPLIT = 65535  # uint16 run-length limit; longer runs split
+
+
+@dataclasses.dataclass(frozen=True)
+class ValuePlan:
+    """How the value column ships. lo/scale only meaningful for PLANES."""
+    mode: int
+    bits: int = 0
+    lo: float = 0.0
+    scale: float = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class WireFormat:
+    """Static shape/layout info shared by encoder and decoder.
+
+    All fields are jit-static: one compile serves every bucket of a call.
+    """
+    bytes_pid: int
+    bits_pk: int
+    cap: int  # padded rows per bucket, multiple of 8
+    ucap: int  # padded RLE entries per bucket
+    value: ValuePlan
+
+    @property
+    def cap_bytes(self) -> int:
+        return self.cap // 8
+
+    @property
+    def _offsets(self) -> Tuple[int, int, int, int]:
+        o_cnt = self.ucap * self.bytes_pid
+        o_pk = o_cnt + self.ucap * 2
+        o_val = o_pk + self.bits_pk * self.cap_bytes
+        if self.value.mode == VALUE_PLANES:
+            end = o_val + self.value.bits * self.cap_bytes
+        elif self.value.mode == VALUE_F32:
+            end = o_val + self.cap * 4
+        elif self.value.mode == VALUE_F16:
+            end = o_val + self.cap * 2
+        else:
+            end = o_val
+        return o_cnt, o_pk, o_val, end
+
+    @property
+    def width(self) -> int:
+        """Bytes per bucket row of the flat slab."""
+        return self._offsets[3]
+
+
+def plan_and_index(value: Optional[np.ndarray],
+                   value_f16: bool = False
+                   ) -> Tuple[ValuePlan, Optional[np.ndarray]]:
+    """Chooses the value wire mode, verifying losslessness on the host.
+
+    Tries v = lo + idx * scale for scale in a small dyadic/decimal ladder
+    (a cheap sample-first check gates the full-array verification). The
+    reconstruction check is done in float32 with the exact expression the
+    device uses, so PLANES is bit-exact by construction. NaN/inf anywhere
+    falls through to raw (NaN != NaN fails the check).
+
+    Returns (plan, idx int32 array when plan is PLANES else None) — the
+    index is computed once here and reused by the encoders (this host is
+    single-pass-precious: one core, see BASELINE.md).
+    """
+    if value is None:
+        return ValuePlan(VALUE_NONE), None
+    if value_f16:
+        return ValuePlan(VALUE_F16), None
+    value = np.asarray(value, dtype=np.float32)
+    if value.size == 0:
+        return ValuePlan(VALUE_F32), None
+    lo64 = float(np.min(value))
+    if not math.isfinite(lo64):
+        return ValuePlan(VALUE_F32), None
+    lo = np.float32(lo64)
+    sample = value[:65536]
+    v64 = None
+    for scale in (1.0, 0.5, 0.25, 0.125, 0.1, 0.05, 0.025, 0.01):
+        s = np.float32(scale)
+        # Cheap gate on a prefix sample before paying a full-array pass.
+        sidx = np.rint((sample.astype(np.float64) - lo64) / scale)
+        if (sidx.max(initial=0.0) >= (1 << _MAX_VALUE_BITS)
+                or sidx.min(initial=0.0) < 0):
+            continue
+        if not np.array_equal(lo + sidx.astype(np.float32) * s, sample):
+            continue
+        if v64 is None:
+            v64 = value.astype(np.float64)
+        idx = np.rint((v64 - lo64) / scale)
+        if idx.max() >= (1 << _MAX_VALUE_BITS) or idx.min() < 0:
+            continue
+        if np.array_equal(lo + idx.astype(np.float32) * s, value):
+            bits = max(1, int(idx.max()).bit_length())
+            return (ValuePlan(VALUE_PLANES, bits=bits, lo=float(lo),
+                              scale=float(s)), idx.astype(np.int32))
+    return ValuePlan(VALUE_F32), None
+
+
+def plan_value_encoding(value: Optional[np.ndarray],
+                        value_f16: bool = False) -> ValuePlan:
+    """plan_and_index without the index (compatibility surface)."""
+    return plan_and_index(value, value_f16)[0]
+
+
+def _pack_le(out: np.ndarray, col: np.ndarray, nbytes: int) -> None:
+    """Little-endian byte split of an int column into out[:, :nbytes]."""
+    col = col.astype(np.uint32, copy=False)
+    for b in range(nbytes):
+        out[:, b] = (col >> np.uint32(8 * b)).astype(np.uint8)
+
+
+def _pack_planes(out: np.ndarray, col: np.ndarray, bits: int) -> None:
+    """LSB-first bit-planes: out[j, r >> 3] bit (r & 7) = bit j of col[r].
+
+    out: [bits, cap // 8] uint8 (zeroed); col: [m] nonneg ints, m <= cap.
+    """
+    m = len(col)
+    if m == 0:
+        return
+    col = col.astype(np.uint32, copy=False)
+    cap8 = out.shape[1]
+    for j in range(bits):
+        bitvals = ((col >> np.uint32(j)) & np.uint32(1)).astype(np.uint8)
+        padded = np.zeros(cap8 * 8, dtype=np.uint8)
+        padded[:m] = bitvals
+        # LSB-first within each byte (np.packbits is MSB-first -> bitorder).
+        out[j, :] = np.packbits(padded, bitorder="little")
+
+
+def encode_buckets_numpy(
+    pid: np.ndarray,
+    pk: np.ndarray,
+    value: Optional[np.ndarray],
+    *,
+    pid_lo: int,
+    k: int,
+    bytes_pid: int,
+    bits_pk: int,
+    plan: ValuePlan,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, WireFormat]:
+    """Numpy reference encoder. Returns (slab [k, W] uint8, n_rows [k],
+    n_uniq [k], fmt). Bit-identical to the native packer's output."""
+    n = len(pid)
+    shifted = (np.asarray(pid) - pid_lo).astype(np.uint32, copy=False)
+    bucket = ((shifted * _HASH_MULT) >> np.uint32(16)) % np.uint32(k)
+    counts = np.bincount(bucket, minlength=k).astype(np.int64)
+    cap = _round8(int(counts.max()) if n else 8)
+
+    vidx = None
+    if plan.mode == VALUE_PLANES:
+        vidx = np.rint(
+            (np.asarray(value, dtype=np.float64) - float(plan.lo))
+            / float(plan.scale)).astype(np.int64)
+
+    # Pass 1: per-bucket stable pid sort + RLE to size ucap exactly.
+    orders, uniq_cols, cnt_cols = [], [], []
+    for c in range(k):
+        rows = np.flatnonzero(bucket == c)
+        order = rows[np.argsort(shifted[rows], kind="stable")]
+        orders.append(order)
+        u, cts = _rle_split(shifted[order])
+        uniq_cols.append(u)
+        cnt_cols.append(cts)
+    n_uniq = np.array([len(u) for u in uniq_cols], dtype=np.int64)
+    ucap = _round8(int(n_uniq.max()) if n else 8)
+    fmt = WireFormat(bytes_pid=bytes_pid, bits_pk=bits_pk, cap=cap,
+                     ucap=ucap, value=plan)
+
+    slab = np.zeros((k, fmt.width), dtype=np.uint8)
+    o_cnt, o_pk, o_val, _ = fmt._offsets
+    for c in range(k):
+        order, u, cts = orders[c], uniq_cols[c], cnt_cols[c]
+        row = slab[c]
+        _pack_le(row[:len(u) * bytes_pid].reshape(-1, bytes_pid), u,
+                 bytes_pid)
+        _pack_le(row[o_cnt:o_cnt + len(cts) * 2].reshape(-1, 2), cts, 2)
+        pk_planes = row[o_pk:o_pk + bits_pk * fmt.cap_bytes].reshape(
+            bits_pk, fmt.cap_bytes)
+        _pack_planes(pk_planes, np.asarray(pk)[order], bits_pk)
+        if plan.mode == VALUE_PLANES:
+            val_planes = row[o_val:o_val + plan.bits * fmt.cap_bytes
+                             ].reshape(plan.bits, fmt.cap_bytes)
+            _pack_planes(val_planes, vidx[order], plan.bits)
+        elif plan.mode == VALUE_F32:
+            m = len(order)
+            row[o_val:o_val + m * 4] = (np.asarray(
+                value, dtype=np.float32)[order].view(np.uint8))
+        elif plan.mode == VALUE_F16:
+            m = len(order)
+            row[o_val:o_val + m * 2] = (np.asarray(
+                value, dtype=np.float32)[order].astype(
+                    np.float16).view(np.uint8))
+    return slab, counts, n_uniq, fmt
+
+
+def _round8(x: int) -> int:
+    return max(8, (x + 7) & ~7)
+
+
+def _rle_split(sorted_ids: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Run-length encode a sorted id column, splitting runs at _RUN_SPLIT."""
+    if len(sorted_ids) == 0:
+        return (np.zeros(0, dtype=np.uint32), np.zeros(0, dtype=np.uint32))
+    change = np.flatnonzero(np.diff(sorted_ids)) + 1
+    starts = np.concatenate([[0], change])
+    ends = np.concatenate([change, [len(sorted_ids)]])
+    u_out, c_out = [], []
+    for s, e in zip(starts, ends):
+        run = int(e - s)
+        uid = sorted_ids[s]
+        while run > _RUN_SPLIT:
+            u_out.append(uid)
+            c_out.append(_RUN_SPLIT)
+            run -= _RUN_SPLIT
+        u_out.append(uid)
+        c_out.append(run)
+    return (np.asarray(u_out, dtype=np.uint32),
+            np.asarray(c_out, dtype=np.uint32))
+
+
+# ---------------------------------------------------------------------------
+# Device-side decode (all inside jit; fmt fields are static).
+# ---------------------------------------------------------------------------
+
+
+def _unpack_le(buf: jnp.ndarray, nbytes: int) -> jnp.ndarray:
+    """[m, nbytes] uint8 -> int32 (little-endian)."""
+    acc = buf[:, 0].astype(jnp.int32)
+    for b in range(1, nbytes):
+        acc = acc | (buf[:, b].astype(jnp.int32) << (8 * b))
+    return acc
+
+
+def _unpack_planes(planes: jnp.ndarray, bits: int, cap: int) -> jnp.ndarray:
+    """[bits, cap//8] uint8 bit-planes -> int32 [cap]. Elementwise only."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    acc = jnp.zeros((cap,), dtype=jnp.int32)
+    for j in range(bits):
+        b = ((planes[j][:, None] >> shifts) & jnp.uint8(1)).reshape(cap)
+        acc = acc | (b.astype(jnp.int32) << j)
+    return acc
+
+
+def decode_bucket(
+    row: jnp.ndarray,
+    n_valid: jnp.ndarray,
+    n_uniq: jnp.ndarray,
+    fmt: WireFormat,
+) -> Tuple[jnp.ndarray, jnp.ndarray, Optional[jnp.ndarray], jnp.ndarray]:
+    """Decode one bucket row of the slab -> (pid, pk, value|None, valid).
+
+    pid is the shifted (pid - pid_lo) id; rows come back in the bucket's
+    pid-sorted order, which the kernel is insensitive to (it re-sorts with
+    its own random tiebreaks). Rows >= n_valid are garbage with valid=False.
+    """
+    o_cnt, o_pk, o_val, _ = fmt._offsets
+    cap, ucap = fmt.cap, fmt.ucap
+
+    uniq = _unpack_le(row[:o_cnt].reshape(ucap, fmt.bytes_pid),
+                      fmt.bytes_pid)
+    cnts = _unpack_le(row[o_cnt:o_pk].reshape(ucap, 2), 2)
+    uvalid = jnp.arange(ucap, dtype=jnp.int32) < n_uniq
+    cnts = jnp.where(uvalid, cnts, 0)
+    starts = jnp.cumsum(cnts) - cnts
+    # Padded entries scatter out of range and are dropped.
+    starts = jnp.where(uvalid, starts, cap)
+    run_of_row = jnp.cumsum(
+        jnp.zeros((cap,), jnp.int32).at[starts].add(1, mode="drop")) - 1
+    run_of_row = jnp.clip(run_of_row, 0, ucap - 1)
+    pid = uniq[run_of_row]
+
+    pk = _unpack_planes(
+        row[o_pk:o_val].reshape(fmt.bits_pk, fmt.cap_bytes), fmt.bits_pk,
+        cap)
+
+    plan = fmt.value
+    if plan.mode == VALUE_PLANES:
+        idx = _unpack_planes(
+            row[o_val:o_val + plan.bits * fmt.cap_bytes].reshape(
+                plan.bits, fmt.cap_bytes), plan.bits, cap)
+        # Must mirror the host verification expression exactly (f32 ops).
+        value = (jnp.float32(plan.lo)
+                 + idx.astype(jnp.float32) * jnp.float32(plan.scale))
+    elif plan.mode == VALUE_F32:
+        b = row[o_val:o_val + cap * 4].reshape(cap, 4)
+        u32 = (b[:, 0].astype(jnp.uint32)
+               | (b[:, 1].astype(jnp.uint32) << 8)
+               | (b[:, 2].astype(jnp.uint32) << 16)
+               | (b[:, 3].astype(jnp.uint32) << 24))
+        value = jax.lax.bitcast_convert_type(u32, jnp.float32)
+    elif plan.mode == VALUE_F16:
+        b = row[o_val:o_val + cap * 2].reshape(cap, 2)
+        u16 = (b[:, 0].astype(jnp.uint16)
+               | (b[:, 1].astype(jnp.uint16) << 8))
+        value = jax.lax.bitcast_convert_type(u16, jnp.float16).astype(
+            jnp.float32)
+    else:
+        value = None
+
+    valid = jnp.arange(cap, dtype=jnp.int32) < n_valid
+    return pid, pk, value, valid
+
+
+# ---------------------------------------------------------------------------
+# Native dispatch.
+# ---------------------------------------------------------------------------
+
+
+class NativeRleEncoder:
+    """Stateful handle over the native prep/sort/emit codec.
+
+    The split API exists for pipelining: `sort_range`+`emit_range` of slab
+    s+1 runs on the host CPU while slab s's async device_put is still on
+    the wire (ops/streaming.py drives this). Use as a context manager or
+    call close(); create() returns None when the native library is
+    unavailable (callers fall back to encode_buckets_numpy).
+    """
+
+    def __init__(self, lib, handle, counts, k, plan):
+        self._lib = lib
+        self._handle = handle
+        self.counts = counts
+        self._k = k
+        self._plan = plan
+
+    @classmethod
+    def create(cls, pid, pk, value, vidx, *, pid_lo: int, k: int,
+               plan: ValuePlan) -> Optional["NativeRleEncoder"]:
+        try:
+            from pipelinedp_tpu.native import loader
+            lib = loader.load_row_packer()
+        except Exception:  # noqa: BLE001 — codec is an optimization only
+            return None
+        if lib is None or not hasattr(lib, "pdp_rle_prep"):
+            return None
+        import ctypes
+
+        n = len(pid)
+        pid32 = np.ascontiguousarray(pid, dtype=np.int32)
+        pk32 = np.ascontiguousarray(pk, dtype=np.int32)
+        val32 = (np.ascontiguousarray(value, dtype=np.float32)
+                 if value is not None
+                 and plan.mode in (VALUE_F32, VALUE_F16) else None)
+        vidx32 = (np.ascontiguousarray(vidx, dtype=np.int32)
+                  if plan.mode == VALUE_PLANES else None)
+        counts = np.zeros(k, dtype=np.int64)
+        handle = lib.pdp_rle_prep(
+            pid32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            pk32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            val32.ctypes.data_as(ctypes.c_void_p) if val32 is not None
+            else None,
+            vidx32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            if vidx32 is not None else None,
+            n, int(pid_lo), k, int(plan.mode),
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if not handle:
+            return None
+        return cls(lib, handle, counts, k, plan)
+
+    def sort_range(self, b0: int, b1: int) -> np.ndarray:
+        """Sorts buckets [b0, b1) by pid; returns their RLE entry counts."""
+        import ctypes
+        n_uniq = np.zeros(b1 - b0, dtype=np.int64)
+        rc = self._lib.pdp_rle_sort_range(
+            self._handle, b0, b1,
+            n_uniq.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if rc != 0:
+            raise RuntimeError(f"pdp_rle_sort_range failed (rc={rc})")
+        return n_uniq
+
+    def emit_range(self, b0: int, b1: int, fmt: WireFormat) -> np.ndarray:
+        """Writes the flat [b1-b0, fmt.width] slab for sorted buckets."""
+        import ctypes
+        out = np.empty((b1 - b0, fmt.width), dtype=np.uint8)
+        rc = self._lib.pdp_rle_emit_range(
+            self._handle, b0, b1, fmt.bytes_pid, fmt.bits_pk,
+            int(self._plan.bits), fmt.cap, fmt.ucap,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), fmt.width)
+        if rc != 0:
+            raise RuntimeError(f"pdp_rle_emit_range failed (rc={rc})")
+        return out
+
+    def close(self):
+        if self._handle:
+            self._lib.pdp_rle_free(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        self.close()
+
+
+def encode_buckets_native(
+    pid: np.ndarray,
+    pk: np.ndarray,
+    value: Optional[np.ndarray],
+    *,
+    pid_lo: int,
+    k: int,
+    bytes_pid: int,
+    bits_pk: int,
+    plan: ValuePlan,
+    vidx: Optional[np.ndarray] = None,
+):
+    """C++ fast path (single shot over all buckets); returns the same
+    tuple as encode_buckets_numpy, or None when unavailable."""
+    if plan.mode == VALUE_PLANES and vidx is None:
+        vidx = np.rint(
+            (np.asarray(value, dtype=np.float64) - float(plan.lo))
+            / float(plan.scale)).astype(np.int32)
+    enc = NativeRleEncoder.create(pid, pk, value, vidx, pid_lo=pid_lo, k=k,
+                                  plan=plan)
+    if enc is None:
+        return None
+    with enc:
+        n = len(pid)
+        n_uniq = enc.sort_range(0, k)
+        fmt = WireFormat(bytes_pid=bytes_pid, bits_pk=bits_pk,
+                         cap=_round8(int(enc.counts.max()) if n else 8),
+                         ucap=_round8(int(n_uniq.max()) if n else 8),
+                         value=plan)
+        slab = enc.emit_range(0, k, fmt)
+        return slab, enc.counts, n_uniq, fmt
+
+
+def encode_buckets(pid, pk, value, *, pid_lo, k, bytes_pid, bits_pk, plan,
+                   vidx=None):
+    """Native encoder with numpy fallback; identical outputs either way."""
+    out = encode_buckets_native(pid, pk, value, pid_lo=pid_lo, k=k,
+                                bytes_pid=bytes_pid, bits_pk=bits_pk,
+                                plan=plan, vidx=vidx)
+    if out is None:
+        out = encode_buckets_numpy(pid, pk, value, pid_lo=pid_lo, k=k,
+                                   bytes_pid=bytes_pid, bits_pk=bits_pk,
+                                   plan=plan)
+    return out
+
+
+def round_ucap(umax: int) -> int:
+    """Rounds an RLE entry count up with ~12.5% granularity so slab shapes
+    recur across slabs/runs (each distinct shape is a fresh XLA compile)."""
+    umax = max(umax, 8)
+    g = max(8, 1 << max(3, umax.bit_length() - 3))
+    return -(-umax // g) * g

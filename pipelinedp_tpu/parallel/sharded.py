@@ -285,6 +285,58 @@ def quantile_leaf_histograms(mesh: Mesh, key, pid, pk, value, valid, *,
     return kernel(*args)
 
 
+def host_row_mask(mesh: Mesh, key, pid, pk, *, linf_cap, l0_cap,
+                  l1_cap=None) -> np.ndarray:
+    """Contribution-bounding keep mask for host rows, computed on the mesh.
+
+    The custom-combiner path under mesh=: rows are hash-sharded by privacy
+    id (pid-disjoint shards make Linf/L0/L1 sampling per shard exact —
+    same argument as ops/streaming.py), the sharded row-mask kernel runs
+    on every device, and the mask comes back scattered to the caller's row
+    order. Only the two id columns ship; the value column stays on host so
+    user combiners keep exact float64 inputs (reference behavior: custom
+    combiners run on every backend, combiners.py:925).
+    """
+    pid = np.asarray(pid)
+    pk = np.asarray(pk, dtype=np.int32)
+    n = len(pid)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    n_dev = mesh.devices.size
+    hashed = ((pid.astype(np.uint32) * np.uint32(2654435761)) >>
+              np.uint32(16))
+    shard_of_row = hashed % np.uint32(n_dev)
+    order = np.argsort(shard_of_row, kind="stable")
+    counts = np.bincount(shard_of_row, minlength=n_dev)
+    shard_len = int(counts.max())
+    total = n_dev * shard_len
+    spid = np.zeros(total, dtype=np.int32)
+    spk = np.zeros(total, dtype=np.int32)
+    svalid = np.zeros(total, dtype=bool)
+    # staged slot -> original row (for the scatter back).
+    src = np.zeros(total, dtype=np.int64)
+    offsets = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    for s in range(n_dev):
+        lo, m = offsets[s], counts[s]
+        dst = s * shard_len
+        rows = order[lo:lo + m]
+        spid[dst:dst + m] = pid[rows]
+        spk[dst:dst + m] = pk[rows]
+        svalid[dst:dst + m] = True
+        src[dst:dst + m] = rows
+    sharding = NamedSharding(mesh, _spec(mesh))
+    dpid, dpk, dvalid = (jax.device_put(a, sharding)
+                         for a in (spid, spk, svalid))
+    kernel = _row_mask_kernel(mesh, has_l1=l1_cap is not None)
+    args = (key, dpid, dpk, dvalid, linf_cap, l0_cap)
+    if l1_cap is not None:
+        args += (l1_cap,)
+    staged_mask = np.asarray(kernel(*args))
+    out = np.zeros(n, dtype=bool)
+    out[src[svalid]] = staged_mask[svalid]
+    return out
+
+
 @functools.lru_cache(maxsize=None)
 def _row_mask_kernel(mesh: Mesh, has_l1: bool = False):
     """Sharded contribution-bounding row mask (row-sharded in and out).

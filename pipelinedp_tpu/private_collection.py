@@ -77,6 +77,42 @@ class PrivateCollection:
         return self._aggregate(params, agg.Metrics.PRIVACY_ID_COUNT,
                                "privacy_id_count")
 
+    def aggregate(self,
+                  params: agg.AggregateParams,
+                  partition_extractor: Callable[[Any], Any],
+                  value_extractor: Optional[Callable[[Any], Any]] = None,
+                  public_partitions=None):
+        """Full AggregateParams aggregation on the wrapped collection —
+        including custom combiners (params.metrics=None,
+        params.custom_combiners=[...]) and multi-metric sets.
+
+        Role parity: the reference's private_beam custom-combiner transform
+        (PrivateCombineFn / CombinePerKey, private_beam.py:491-649); this
+        framework's engine-level CustomCombiner API plugs in directly.
+        Returns lazy (pk, metrics) pairs; budget is drawn from the shared
+        accountant like every other aggregation on this collection.
+        """
+        value_free = {agg.Metrics.COUNT, agg.Metrics.PRIVACY_ID_COUNT}
+        needs_values = (params.custom_combiners is not None
+                        or any(m not in value_free
+                               for m in params.metrics or []))
+        if value_extractor is None and needs_values:
+            # A constant-0 extractor would return plausible noisy zeros for
+            # SUM/MEAN/custom metrics — silently wrong DP output.
+            raise ValueError(
+                "value_extractor is required for value-dependent metrics "
+                "or custom combiners")
+        engine = dp_engine_lib.DPEngine(self._budget_accountant,
+                                        self._backend)
+        extractors = DataExtractors(
+            privacy_id_extractor=lambda pair: pair[0],
+            partition_extractor=lambda pair: partition_extractor(pair[1]),
+            value_extractor=((lambda pair: value_extractor(pair[1]))
+                             if value_extractor is not None else
+                             (lambda pair: 0)))
+        return engine.aggregate(self._pairs, params, extractors,
+                                public_partitions=public_partitions)
+
     def select_partitions(self, params: agg.SelectPartitionsParams,
                           partition_extractor: Callable[[Any], Any]):
         """DP-selected partition keys (lazy)."""

@@ -865,13 +865,43 @@ class TestCustomCombinersOnJaxEngine:
         report = engine.explain_computations_report()[0]
         assert "Custom DP sum of squares" in report
 
-    def test_custom_with_mesh_raises(self):
+    def _run_mesh(self, data, public=None, eps=1e8, l0=2, linf=3):
         from pipelinedp_tpu.parallel import sharded
-        accountant = pdp.NaiveBudgetAccountant(1.0, 1e-6)
-        engine = pdp.JaxDPEngine(accountant, mesh=sharded.make_mesh(8))
-        with pytest.raises(NotImplementedError, match="mesh"):
-            engine.aggregate(self._data(), self._params(), extractors(),
-                             public_partitions=["pk0"])
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        accountant = pdp.NaiveBudgetAccountant(eps, 1e-6)
+        engine = pdp.JaxDPEngine(accountant, seed=3,
+                                 mesh=sharded.make_mesh(8))
+        result = engine.aggregate(data, self._params(l0, linf), extractors(),
+                                  public_partitions=public)
+        accountant.compute_budgets()
+        return dict(result)
+
+    def test_mesh_matches_local_when_caps_do_not_bind(self):
+        # Mirrors TestEngineOnMesh for the custom path (VERDICT-r4 item 5):
+        # device bounding runs sharded; host combiner logic is unchanged.
+        data = self._data()
+        public = [f"pk{i}" for i in range(8)]
+        mesh_res = self._run_mesh(data, public, l0=10, linf=1000)
+        local_res = self._run_local(data, public, l0=10, linf=1000)
+        assert set(mesh_res) == set(local_res)
+        for pk in local_res:
+            assert mesh_res[pk][0]["sum_squares"] == pytest.approx(
+                local_res[pk][0]["sum_squares"], rel=1e-4, abs=0.05)
+
+    def test_mesh_bounding_enforces_caps(self):
+        # One user, 100 identical rows, linf=3 on the mesh: the combiner
+        # must see at most 3 surviving rows.
+        data = [(1, "a", 4.0)] * 100
+        mesh_res = self._run_mesh(data, public=["a"], l0=1, linf=3)
+        assert mesh_res["a"][0]["sum_squares"] == pytest.approx(48.0,
+                                                               abs=1.0)
+
+    def test_mesh_private_selection_custom(self):
+        data = ([(u, "big", 1.0) for u in range(3000)] +
+                [(999999, "tiny", 1.0)])
+        mesh_res = self._run_mesh(data, public=None, eps=1.0, l0=1, linf=1)
+        assert "big" in mesh_res and "tiny" not in mesh_res
 
 
 class TestNoiseSelectionMetricCrossProduct:
@@ -1116,3 +1146,174 @@ class TestCustomCombinerParamModes:
         # 4 rows of 3.0^2 = 9 each per partition.
         for v in res.values():
             assert v[0]["sum_squares"] == pytest.approx(36.0, abs=1.0)
+
+
+class TestPrivateContributionBounds:
+    """JaxDPEngine.calculate_private_contribution_bounds parity vs DPEngine
+    (same seeded exponential-mechanism draw => same chosen bound)."""
+
+    def _params(self, calc_eps=20.0, upper=10):
+        return pdp.CalculatePrivateContributionBoundsParams(
+            aggregation_noise_kind=pdp.NoiseKind.LAPLACE,
+            aggregation_eps=1.0,
+            aggregation_delta=0.0,
+            calculation_eps=calc_eps,
+            max_partitions_contributed_upper_bound=upper)
+
+    def _rows(self):
+        # 50 users x 4 partitions each, plus a few heavy users.
+        rows = [(u, f"pk{i}", 1.0) for u in range(50) for i in range(4)]
+        rows += [(100 + u, f"pk{i}", 1.0) for u in range(5)
+                 for i in range(8)]
+        return rows
+
+    def _extractors(self):
+        return pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                  partition_extractor=lambda r: r[1],
+                                  value_extractor=lambda r: r[2])
+
+    def test_parity_with_dp_engine_rows(self):
+        from pipelinedp_tpu import dp_computations
+        rows = self._rows()
+        partitions = [f"pk{i}" for i in range(8)]
+
+        dp_computations.ExponentialMechanism.seed_rng(7)
+        host_engine = pdp.DPEngine(pdp.NaiveBudgetAccountant(1.0, 1e-6),
+                                   pdp.LocalBackend())
+        host = list(host_engine.calculate_private_contribution_bounds(
+            rows, self._params(), self._extractors(),
+            partitions=partitions))[0]
+
+        dp_computations.ExponentialMechanism.seed_rng(7)
+        jax_engine = pdp.JaxDPEngine(pdp.NaiveBudgetAccountant(1.0, 1e-6))
+        col = jax_engine.calculate_private_contribution_bounds(
+            rows, self._params(), self._extractors(), partitions=partitions)
+        dp_computations.ExponentialMechanism.seed_rng(None)
+
+        assert isinstance(col, pdp.PrivateContributionBounds)
+        assert col.max_partitions_contributed == \
+            host.max_partitions_contributed
+
+    def test_columnar_input(self):
+        from pipelinedp_tpu import dp_computations
+        rows = self._rows()
+        data = pdp.ColumnarData(
+            pid=np.array([r[0] for r in rows]),
+            pk=np.array([r[1] for r in rows]),
+            value=np.array([r[2] for r in rows], dtype=np.float32))
+        partitions = [f"pk{i}" for i in range(8)]
+
+        dp_computations.ExponentialMechanism.seed_rng(11)
+        jax_engine = pdp.JaxDPEngine(pdp.NaiveBudgetAccountant(1.0, 1e-6))
+        got = jax_engine.calculate_private_contribution_bounds(
+            data, self._params(), partitions=partitions)
+
+        dp_computations.ExponentialMechanism.seed_rng(11)
+        host_engine = pdp.DPEngine(pdp.NaiveBudgetAccountant(1.0, 1e-6),
+                                   pdp.LocalBackend())
+        host = list(host_engine.calculate_private_contribution_bounds(
+            rows, self._params(), self._extractors(),
+            partitions=partitions))[0]
+        dp_computations.ExponentialMechanism.seed_rng(None)
+
+        assert got.max_partitions_contributed == \
+            host.max_partitions_contributed
+        assert 1 <= got.max_partitions_contributed <= 10
+
+    def test_partition_filtering(self):
+        # Rows outside `partitions` must not influence the histogram:
+        # an engine fed junk rows in other partitions picks the same bound.
+        from pipelinedp_tpu import dp_computations
+        rows = self._rows()
+        junk = [(u, "junk", 1.0) for u in range(200) for _ in range(3)]
+        partitions = [f"pk{i}" for i in range(8)]
+
+        dp_computations.ExponentialMechanism.seed_rng(3)
+        eng = pdp.JaxDPEngine(pdp.NaiveBudgetAccountant(1.0, 1e-6))
+        clean = eng.calculate_private_contribution_bounds(
+            rows, self._params(), self._extractors(), partitions=partitions)
+
+        dp_computations.ExponentialMechanism.seed_rng(3)
+        eng2 = pdp.JaxDPEngine(pdp.NaiveBudgetAccountant(1.0, 1e-6))
+        noisy = eng2.calculate_private_contribution_bounds(
+            rows + junk, self._params(), self._extractors(),
+            partitions=partitions)
+        dp_computations.ExponentialMechanism.seed_rng(None)
+        assert clean.max_partitions_contributed == \
+            noisy.max_partitions_contributed
+
+    def test_requires_partitions(self):
+        eng = pdp.JaxDPEngine(pdp.NaiveBudgetAccountant(1.0, 1e-6))
+        with pytest.raises(ValueError, match="partitions"):
+            eng.calculate_private_contribution_bounds(
+                self._rows(), self._params(), self._extractors())
+
+
+class TestPLDOnColumnarEngine:
+    """E2E: JaxDPEngine under PLDBudgetAccountant (VERDICT-r4 item 7). The
+    lazy sigma-from-PLD resolution through _mechanism_noise_params must
+    reach the device kernels: the emitted noise stddev equals the
+    PLD-resolved per-unit-sensitivity std times the L1 sensitivity."""
+
+    def _run(self, metrics, noise_kind, l0=2, linf=3, eps=1.0, delta=1e-6):
+        data = [(u, pk, 1.0) for u in range(400) for pk in ("a", "b")]
+        accountant = pdp.PLDBudgetAccountant(eps, delta,
+                                             pld_discretization=1e-3)
+        engine = pdp.JaxDPEngine(accountant, seed=5)
+        params = pdp.AggregateParams(
+            metrics=metrics,
+            noise_kind=noise_kind,
+            max_partitions_contributed=l0,
+            max_contributions_per_partition=linf,
+            min_value=0.0 if pdp.Metrics.SUM in metrics else None,
+            max_value=2.0 if pdp.Metrics.SUM in metrics else None,
+            output_noise_stddev=True)
+        result = engine.aggregate(data, params, extractors(),
+                                  public_partitions=["a", "b"])
+        accountant.compute_budgets()
+        return dict(result), accountant
+
+    def test_laplace_count_uses_pld_std(self):
+        res, accountant = self._run([pdp.Metrics.COUNT],
+                                    pdp.NoiseKind.LAPLACE)
+        spec = accountant._mechanisms[0].mechanism_spec
+        l1_sens = 2 * 3
+        assert res["a"].count_noise_stddev == pytest.approx(
+            spec.noise_standard_deviation * l1_sens, rel=1e-6)
+        assert res["a"].count == pytest.approx(400, rel=0.2)
+
+    def test_gaussian_count_sum_use_pld_std(self):
+        res, accountant = self._run(
+            [pdp.Metrics.COUNT, pdp.Metrics.SUM], pdp.NoiseKind.GAUSSIAN)
+        specs = [m.mechanism_spec for m in accountant._mechanisms]
+        # COUNT L2 sensitivity = sqrt(l0) * linf; SUM = sqrt(l0) * linf*max.
+        l2_count = np.sqrt(2) * 3
+        l2_sum = np.sqrt(2) * 3 * 2.0
+        assert res["a"].count_noise_stddev == pytest.approx(
+            specs[0].noise_standard_deviation * l2_count, rel=1e-6)
+        assert res["a"].sum_noise_stddev == pytest.approx(
+            specs[1].noise_standard_deviation * l2_sum, rel=1e-6)
+        assert res["a"].count == pytest.approx(400, rel=0.2)
+        assert res["a"].sum == pytest.approx(400, rel=0.25)
+
+    def test_pld_noise_smaller_than_naive(self):
+        # PLD composition is tighter: for multiple mechanisms the resolved
+        # std must be below the naive equal-split calibration.
+        res_pld, _ = self._run([pdp.Metrics.COUNT, pdp.Metrics.SUM],
+                               pdp.NoiseKind.GAUSSIAN)
+        data = [(u, pk, 1.0) for u in range(400) for pk in ("a", "b")]
+        accountant = pdp.NaiveBudgetAccountant(1.0, 1e-6)
+        engine = pdp.JaxDPEngine(accountant, seed=5)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            noise_kind=pdp.NoiseKind.GAUSSIAN,
+            max_partitions_contributed=2,
+            max_contributions_per_partition=3,
+            min_value=0.0, max_value=2.0,
+            output_noise_stddev=True)
+        result = engine.aggregate(data, params, extractors(),
+                                  public_partitions=["a", "b"])
+        accountant.compute_budgets()
+        res_naive = dict(result)
+        assert (res_pld["a"].count_noise_stddev
+                < res_naive["a"].count_noise_stddev)

@@ -232,3 +232,76 @@ class TestMultiProcProcessesMode:
         out = dict(backend.sum_per_key([(i % 5, 2) for i in range(1000)],
                                        "s"))
         assert out == {k: 400 for k in range(5)}
+
+
+class TestJaxBackendOffload:
+    """The device-offloaded ops of JaxBackend (VERDICT-r4 item 9): the
+    sampling hot-spot and recognizable numeric reductions."""
+
+    def test_sample_fixed_per_key_device_path(self, monkeypatch):
+        import numpy as np
+        from pipelinedp_tpu.backends.jax_backend import JaxBackend
+        backend = JaxBackend()
+        monkeypatch.setattr(JaxBackend, "SAMPLE_DEVICE_MIN_ROWS", 1)
+        rng = np.random.default_rng(0)
+        pairs = [(int(k), (int(k), i))
+                 for i, k in enumerate(rng.integers(0, 40, 2000))]
+        out = dict(backend.sample_fixed_per_key(pairs, 5, "s"))
+        from collections import Counter
+        totals = Counter(k for k, _ in pairs)
+        assert set(out) == set(totals)
+        for k, sampled in out.items():
+            assert len(sampled) == min(totals[k], 5)
+            # Sampled values are genuine rows of this key.
+            assert all(v[0] == k for v in sampled)
+            assert len(set(sampled)) == len(sampled)
+
+    def test_sample_fixed_per_key_string_keys_device(self, monkeypatch):
+        from pipelinedp_tpu.backends.jax_backend import JaxBackend
+        backend = JaxBackend()
+        monkeypatch.setattr(JaxBackend, "SAMPLE_DEVICE_MIN_ROWS", 1)
+        pairs = [(f"k{i % 3}", i) for i in range(90)]
+        out = dict(backend.sample_fixed_per_key(pairs, 10, "s"))
+        assert set(out) == {"k0", "k1", "k2"}
+        assert all(len(v) == 10 for v in out.values())
+
+    def test_reduce_per_key_operator_add_offloads(self):
+        import operator
+        from pipelinedp_tpu.backends.jax_backend import JaxBackend
+        backend = JaxBackend()
+        pairs = [(i % 7, i) for i in range(5000)]
+        got = dict(backend.reduce_per_key(pairs, operator.add, "r"))
+        want = {}
+        for k, v in pairs:
+            want[k] = want.get(k, 0) + v
+        assert got == want
+
+    def test_reduce_per_key_min_max(self):
+        from pipelinedp_tpu.backends.jax_backend import JaxBackend
+        backend = JaxBackend()
+        pairs = [(i % 5, (i * 37) % 101 - 50) for i in range(3000)]
+        got_min = dict(backend.reduce_per_key(list(pairs), min, "m"))
+        got_max = dict(backend.reduce_per_key(list(pairs), max, "M"))
+        want_min, want_max = {}, {}
+        for k, v in pairs:
+            want_min[k] = min(want_min.get(k, 10**9), v)
+            want_max[k] = max(want_max.get(k, -10**9), v)
+        assert got_min == want_min
+        assert got_max == want_max
+
+    def test_reduce_per_key_min_max_floats_exact(self):
+        from pipelinedp_tpu.backends.jax_backend import JaxBackend
+        backend = JaxBackend()
+        pairs = [(i % 3, float(i) * 1e-7 + 1.0) for i in range(1000)]
+        got = dict(backend.reduce_per_key(list(pairs), max, "M"))
+        want = {}
+        for k, v in pairs:
+            want[k] = max(want.get(k, -1e18), v)
+        assert got == pytest.approx(want)
+
+    def test_reduce_per_key_arbitrary_fn_stays_host(self):
+        from pipelinedp_tpu.backends.jax_backend import JaxBackend
+        backend = JaxBackend()
+        pairs = [(1, "a"), (1, "b"), (2, "c")]
+        got = dict(backend.reduce_per_key(pairs, lambda a, b: a + b, "r"))
+        assert got == {1: "ab", 2: "c"}

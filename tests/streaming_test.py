@@ -213,3 +213,62 @@ class TestNativePacker:
         _, counts = nat
         assert counts.sum() == n
         assert counts.max() == n
+
+
+class TestStreamedQuantiles:
+    """PERCENTILE on the streamed path: the quantile-tree leaf histogram is
+    accumulated chunk by chunk and must reproduce the single-shot result
+    exactly when contribution bounding does not bind (identical histograms,
+    identical noise keys)."""
+
+    def _percentile_cols(self, stream_chunks, seed=0, caps=(200, 1000)):
+        rng = np.random.default_rng(seed)
+        n, n_parts = 60_000, 50
+        pid = rng.integers(0, 5_000, n).astype(np.int64)
+        pk = rng.integers(0, n_parts, n).astype(np.int32)
+        value = rng.uniform(0.0, 10.0, n).astype(np.float32)
+        accountant = pdp.NaiveBudgetAccountant(1e9, 1 - 1e-9)
+        engine = pdp.JaxDPEngine(accountant, seed=7,
+                                 stream_chunks=stream_chunks,
+                                 secure_host_noise=False)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT,
+                     pdp.Metrics.PERCENTILE(50),
+                     pdp.Metrics.PERCENTILE(90)],
+            max_partitions_contributed=caps[0],
+            max_contributions_per_partition=caps[1],
+            min_value=0.0,
+            max_value=10.0)
+        result = engine.aggregate(
+            pdp.ColumnarData(pid=pid, pk=pk, value=value), params,
+            public_partitions=list(range(n_parts)))
+        accountant.compute_budgets()
+        return result.to_columns()
+
+    def test_streamed_equals_single_shot_exactly(self):
+        single = self._percentile_cols(stream_chunks=1)
+        streamed = self._percentile_cols(stream_chunks=6)
+        for name in ("percentile_50", "percentile_90", "count"):
+            np.testing.assert_array_equal(single[name], streamed[name],
+                                          err_msg=name)
+
+    def test_streamed_quantiles_sane_with_binding_caps(self):
+        cols = self._percentile_cols(stream_chunks=6, caps=(10, 4))
+        p50 = cols["percentile_50"]
+        p90 = cols["percentile_90"]
+        # Uniform[0,10) values: medians near 5, p90 near 9.
+        assert np.nanmedian(p50) == pytest.approx(5.0, abs=1.0)
+        assert np.nanmedian(p90) == pytest.approx(9.0, abs=1.0)
+
+    def test_bytes_encoding_rejects_quantile_spec(self):
+        import jax
+        pid = np.arange(100, dtype=np.int64)
+        pk = np.zeros(100, dtype=np.int32)
+        value = np.ones(100, dtype=np.float32)
+        with pytest.raises(ValueError, match="quantile_spec"):
+            streaming.stream_bound_and_aggregate(
+                jax.random.PRNGKey(0), pid, pk, value, num_partitions=1,
+                linf_cap=10, l0_cap=10, row_clip_lo=0.0, row_clip_hi=1.0,
+                middle=0.5, group_clip_lo=-np.inf, group_clip_hi=np.inf,
+                n_chunks=2, transfer_encoding="bytes",
+                quantile_spec=(16, 0.0, 1.0))
