@@ -39,9 +39,10 @@ def perform_utility_analysis(
           report-by-partition-size histogram attached;
         per_partition_result — ((partition_key, configuration_index),
           PerPartitionMetrics) for every partition and configuration, as a
-          lazily-built immutable Sequence (index/iterate/len; call
-          list(...) for a mutable copy) so report-only consumers never
-          materialize the per-partition grid.
+          lazily-built list-like Sequence: index/iterate/len plus the
+          common list mutators (append/extend/sort/item assignment), all
+          of which materialize on first use — so report-only consumers
+          never pay for the per-partition grid.
       ``backend`` is accepted for signature parity and ignored (execution
       is columnar).
     """
@@ -96,3 +97,17 @@ class _LazyPerPartitionResult(_SequenceABC):
 
     def __getitem__(self, index):
         return self._materialize()[index]
+
+    # Reference-parity callers treat the result as a plain list; the
+    # common mutators materialize and then behave exactly like one.
+    def append(self, item):
+        self._materialize().append(item)
+
+    def extend(self, items):
+        self._materialize().extend(items)
+
+    def sort(self, **kwargs):
+        self._materialize().sort(**kwargs)
+
+    def __setitem__(self, index, value):
+        self._materialize()[index] = value

@@ -875,23 +875,16 @@ class JaxDPEngine:
                     NormKind.L2: 2}[params.vector_norm_kind or NormKind.Linf]
         if self._mesh is not None:
             from pipelinedp_tpu.parallel import sharded
-            # Stage (hash-shard + device_put) once; both the aggregate and
-            # the quantile-histogram kernels reuse the staged arrays.
-            valid_rows = np.ones(n_rows, dtype=bool)
-            pid, pk, value, valid_rows = sharded.stage_rows(
-                self._mesh, pid, pk, value, valid_rows)
-            if is_vector:
-                vector_sums, accs = sharded.bound_and_aggregate_vector(
-                    self._mesh, k_kernel, pid, pk, value, valid_rows,
-                    num_partitions=num_partitions,
-                    linf_cap=linf_cap,
-                    l0_cap=l0_cap,
-                    max_norm=params.vector_max_norm,
-                    norm_ord=norm_ord,
-                    l1_cap=l1_cap)
-            else:
-                accs = sharded.bound_and_aggregate(
-                    self._mesh, k_kernel, pid, pk, value, valid_rows,
+            if (not is_vector and not has_quantile and
+                    self._stream_chunks != 1 and
+                    self._transfer_encoding != "bytes" and
+                    (self._stream_chunks is not None or
+                     n_rows >= streaming.MIN_STREAM_ROWS)):
+                # Large mesh input: chunked wire-codec ingest — each
+                # chunk's sharded device_put overlaps the previous chunk's
+                # kernels (parallel/sharded.stream_bound_and_aggregate).
+                accs = sharded.stream_bound_and_aggregate(
+                    self._mesh, k_kernel, pid, pk, value,
                     num_partitions=num_partitions,
                     linf_cap=linf_cap,
                     l0_cap=l0_cap,
@@ -901,8 +894,40 @@ class JaxDPEngine:
                     group_clip_lo=glo,
                     group_clip_hi=ghi,
                     l1_cap=l1_cap,
+                    n_chunks=self._stream_chunks,
+                    value_transfer_dtype=self._value_transfer_dtype,
                     need_flags=need_flags,
                     has_group_clip=has_group_clip)
+            else:
+                # Stage (hash-shard + device_put) once; both the aggregate
+                # and the quantile-histogram kernels reuse the staged
+                # arrays.
+                valid_rows = np.ones(n_rows, dtype=bool)
+                pid, pk, value, valid_rows = sharded.stage_rows(
+                    self._mesh, pid, pk, value, valid_rows)
+                if is_vector:
+                    vector_sums, accs = sharded.bound_and_aggregate_vector(
+                        self._mesh, k_kernel, pid, pk, value, valid_rows,
+                        num_partitions=num_partitions,
+                        linf_cap=linf_cap,
+                        l0_cap=l0_cap,
+                        max_norm=params.vector_max_norm,
+                        norm_ord=norm_ord,
+                        l1_cap=l1_cap)
+                else:
+                    accs = sharded.bound_and_aggregate(
+                        self._mesh, k_kernel, pid, pk, value, valid_rows,
+                        num_partitions=num_partitions,
+                        linf_cap=linf_cap,
+                        l0_cap=l0_cap,
+                        row_clip_lo=row_lo,
+                        row_clip_hi=row_hi,
+                        middle=middle,
+                        group_clip_lo=glo,
+                        group_clip_hi=ghi,
+                        l1_cap=l1_cap,
+                        need_flags=need_flags,
+                        has_group_clip=has_group_clip)
         elif is_vector:
             vector_sums, accs = columnar.bound_and_aggregate_vector(
                 k_kernel, jnp.asarray(pid), jnp.asarray(pk),

@@ -165,6 +165,11 @@ def _factorize(column: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
             shifted = column - lo if lo else column
             present = np.zeros(span, dtype=bool)
             present[shifted] = True
+            if present.all():
+                # Ids are already dense on [lo, hi]: identity mapping, no
+                # remap gather (2 s saved at the 100M-row benchmark shape).
+                return (shifted.astype(np.int32, copy=False),
+                        np.arange(lo, hi + 1, dtype=column.dtype))
             ids_map = np.cumsum(present, dtype=np.int32) - 1
             ids = ids_map[shifted]
             uniques = np.flatnonzero(present) + lo

@@ -48,7 +48,12 @@ _HASH_MULT = np.uint32(2654435761)
 # dispatch latency when the transfer is small).
 MIN_STREAM_ROWS = 2_000_000
 
-DEFAULT_NUM_CHUNKS = 16
+# Each chunk re-scatters into the full [num_partitions] accumulators, so
+# chunk count multiplies the per-partition segment-sum cost (measured ~1 s
+# per 4 chunks at the 100M/1M headline shape) while overlap only needs a
+# few slabs in flight. 8 balances the two; 4 made the per-chunk shape so
+# large that the tunneled-backend compile blew past 9 minutes.
+DEFAULT_NUM_CHUNKS = 8
 
 # Transfers are sized by a byte budget, not a fixed count: small inputs take
 # 2 slabs (the minimum that overlaps transfer with compute), huge inputs
@@ -65,6 +70,14 @@ def _num_chunks(n_rows: int) -> int:
 def _num_transfers(total_bytes: int, k: int) -> int:
     want = -(-total_bytes // SLAB_BYTE_BUDGET)  # ceil
     return int(max(2, min(k, want)))
+
+
+# Encoding choice: the wire codec was measured faster end-to-end than the
+# legacy fixed-width packing at BOTH link extremes on the bench host (slow
+# 35 MB/s link: 3x fewer bytes dominate; fast 1.4 GB/s link: the codec's
+# contiguous bit-plane decode beats the legacy layout's strided byte
+# unpack on device, 29.4 s vs 35.9 s at the 100M headline shape) — so
+# "auto" is simply the codec. "bytes" stays available explicitly.
 
 
 def _int_bytes(max_value: int) -> int:
@@ -294,20 +307,7 @@ def stream_bound_and_aggregate(
                                     dtype=jnp.float32)
         return accs0
     k = n_chunks or _num_chunks(n)
-
     pid = np.asarray(pid)
-    pid_lo = int(pid.min())
-    pid_span = int(pid.max()) - pid_lo
-    if pid_span >= np.iinfo(np.int32).max - 1:
-        # The kernel reserves INT32_MAX as its padding sentinel; a shifted
-        # pid colliding with it would be silently dropped. Callers with a
-        # wider id space must factorize to dense ids first.
-        raise ValueError(
-            f"privacy-id span {pid_span} does not fit int32; factorize the "
-            f"ids to dense int32 before streaming")
-    bytes_pid = _int_bytes(pid_span)
-    bytes_pk = _int_bytes(max(num_partitions - 1, 0))
-    value_f16 = value_transfer_dtype == np.float16
 
     # Five distinct buffers: the accumulators are donated into each chunk
     # step, and a donated buffer must not be aliased.
@@ -315,8 +315,11 @@ def stream_bound_and_aggregate(
         *(jnp.zeros((num_partitions,), dtype=jnp.float32) for _ in range(5)))
 
     if transfer_encoding != "bytes":
-        bits_pk = max(1, int(max(num_partitions - 1, 0)).bit_length())
-        plan, vidx = wirecodec.plan_and_index(value, value_f16)
+        # Shared prologue with the mesh streaming path (pid-span
+        # validation, width/bit planning, value plan, native encoder).
+        enc, plan, vidx, pid_lo, bytes_pid, bits_pk = wirecodec.make_encoder(
+            pid, pk, value, num_partitions=num_partitions, k=k,
+            value_transfer_dtype=value_transfer_dtype)
         qhist = (jnp.zeros((num_partitions, quantile_spec[0]),
                            dtype=jnp.float32)
                  if quantile_spec is not None else None)
@@ -340,9 +343,6 @@ def stream_bound_and_aggregate(
                 need_flags=tuple(need_flags),
                 has_group_clip=has_group_clip), qhist
 
-        enc = wirecodec.NativeRleEncoder.create(pid, pk, value, vidx,
-                                                pid_lo=pid_lo, k=k,
-                                                plan=plan)
         if enc is not None:
             # Pipelined encode: every slab shares ONE wire format (so the
             # chunk kernel compiles once — the sort runs upfront to learn
@@ -390,6 +390,17 @@ def stream_bound_and_aggregate(
             return accs, qhist
         return accs
 
+    # Legacy fixed-width byte packing (explicit transfer_encoding="bytes").
+    pid_lo = int(pid.min())
+    pid_span = int(pid.max()) - pid_lo
+    if pid_span >= np.iinfo(np.int32).max - 1:
+        raise ValueError(
+            f"privacy-id span {pid_span} does not fit int32; factorize the "
+            f"ids to dense int32 before streaming")
+    bytes_pid = _int_bytes(pid_span)
+    bytes_pk = _int_bytes(max(num_partitions - 1, 0))
+    value_f16 = (value_transfer_dtype is not None
+                 and np.dtype(value_transfer_dtype) == np.float16)
     bytes_value = 2 if value_f16 else 4
     width = bytes_pid + bytes_pk + bytes_value
     packed = _pack_native(pid, pk, value, pid_lo, k, bytes_pid, bytes_pk,

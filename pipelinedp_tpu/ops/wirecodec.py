@@ -134,7 +134,6 @@ def plan_and_index(value: Optional[np.ndarray],
         return ValuePlan(VALUE_F32), None
     lo = np.float32(lo64)
     sample = value[:65536]
-    v64 = None
     for scale in (1.0, 0.5, 0.25, 0.125, 0.1, 0.05, 0.025, 0.01):
         s = np.float32(scale)
         # Cheap gate on a prefix sample before paying a full-array pass.
@@ -144,16 +143,36 @@ def plan_and_index(value: Optional[np.ndarray],
             continue
         if not np.array_equal(lo + sidx.astype(np.float32) * s, sample):
             continue
-        if v64 is None:
-            v64 = value.astype(np.float64)
-        idx = np.rint((v64 - lo64) / scale)
-        if idx.max() >= (1 << _MAX_VALUE_BITS) or idx.min() < 0:
-            continue
-        if np.array_equal(lo + idx.astype(np.float32) * s, value):
-            bits = max(1, int(idx.max()).bit_length())
+        idx = _verified_index(value, lo, s, lo64, scale)
+        if idx is not None:
+            bits = max(1, int(idx.max(initial=0)).bit_length())
             return (ValuePlan(VALUE_PLANES, bits=bits, lo=float(lo),
-                              scale=float(s)), idx.astype(np.int32))
+                              scale=float(s)), idx)
     return ValuePlan(VALUE_F32), None
+
+
+def _verified_index(value: np.ndarray, lo: np.float32, s: np.float32,
+                    lo64: float, scale: float) -> Optional[np.ndarray]:
+    """idx with lo + idx*scale == value verified bit-exact, or None.
+
+    Chunked: the float64 intermediates live per-chunk (a full-array pass
+    at 100M rows allocates multiple 800 MB temporaries and was measured
+    ~6x slower than this on the single-core bench host).
+    """
+    n = len(value)
+    out = np.empty(n, dtype=np.int32)
+    step = 1 << 22
+    for c0 in range(0, n, step):
+        chunk = value[c0:c0 + step]
+        idx = np.rint((chunk.astype(np.float64) - lo64) / scale)
+        if (idx.max(initial=0.0) >= (1 << _MAX_VALUE_BITS)
+                or idx.min(initial=0.0) < 0):
+            return None
+        idx32 = idx.astype(np.int32)
+        if not np.array_equal(lo + idx32.astype(np.float32) * s, chunk):
+            return None
+        out[c0:c0 + step] = idx32
+    return out
 
 
 def plan_value_encoding(value: Optional[np.ndarray],
@@ -499,6 +518,35 @@ def encode_buckets(pid, pk, value, *, pid_lo, k, bytes_pid, bits_pk, plan,
                                    bytes_pid=bytes_pid, bits_pk=bits_pk,
                                    plan=plan)
     return out
+
+
+def make_encoder(pid: np.ndarray, pk, value, *, num_partitions: int, k: int,
+                 value_transfer_dtype=None):
+    """Shared encode prologue of the single-device and mesh streaming
+    paths: pid-span validation, width/bit planning, value plan + index,
+    and the native encoder (None -> numpy fallback).
+
+    Returns (enc_or_None, plan, vidx, pid_lo, bytes_pid, bits_pk).
+    """
+    pid = np.asarray(pid)
+    pid_lo = int(pid.min())
+    pid_span = int(pid.max()) - pid_lo
+    if pid_span >= np.iinfo(np.int32).max - 1:
+        # The kernel reserves INT32_MAX as its padding sentinel; a shifted
+        # pid colliding with it would be silently dropped.
+        raise ValueError(
+            f"privacy-id span {pid_span} does not fit int32; factorize the "
+            f"ids to dense int32 before streaming")
+    bytes_pid = 1
+    while pid_span >= (1 << (8 * bytes_pid)):
+        bytes_pid += 1
+    bits_pk = max(1, int(max(num_partitions - 1, 0)).bit_length())
+    value_f16 = (value_transfer_dtype is not None
+                 and np.dtype(value_transfer_dtype) == np.float16)
+    plan, vidx = plan_and_index(value, value_f16)
+    enc = NativeRleEncoder.create(pid, pk, value, vidx, pid_lo=pid_lo, k=k,
+                                  plan=plan)
+    return enc, plan, vidx, pid_lo, bytes_pid, bits_pk
 
 
 def round_ucap(umax: int) -> int:

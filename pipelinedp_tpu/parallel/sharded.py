@@ -545,6 +545,171 @@ def bound_and_aggregate(mesh: Mesh,
     return kernel(*args)
 
 
+@functools.lru_cache(maxsize=None)
+def _codec_scalar_kernel(mesh: Mesh, padded_p: int, fmt, has_l1: bool,
+                         need_flags, has_group_clip: bool):
+    """Wire-codec decode + bound-and-aggregate, shard-local.
+
+    Each device receives ONE codec bucket row of the [n_dev, W] slab,
+    decodes it with elementwise ops (ops/wirecodec.decode_bucket), runs
+    the fused kernel, and reduce-scatters the per-partition partials —
+    the multi-chip twin of streaming._chunk_step_rle."""
+    from pipelinedp_tpu.ops import wirecodec
+
+    axes = tuple(mesh.axis_names)
+    scatter_axes = _scatter_axes(mesh)
+
+    def local_step(key, row, n_valid, n_uniq, linf_cap, l0_cap, row_clip_lo,
+                   row_clip_hi, middle, group_clip_lo, group_clip_hi,
+                   *l1_args):
+        pid, pk, value, valid = wirecodec.decode_bucket(
+            row[0], n_valid[0], n_uniq[0], fmt)
+        if value is None:
+            value = jnp.zeros((fmt.cap,), dtype=jnp.float32)
+        accs = columnar.bound_and_aggregate(
+            _device_key(key, axes), pid, pk, value, valid,
+            num_partitions=padded_p,
+            linf_cap=linf_cap,
+            l0_cap=l0_cap,
+            row_clip_lo=row_clip_lo,
+            row_clip_hi=row_clip_hi,
+            middle=middle,
+            group_clip_lo=group_clip_lo,
+            group_clip_hi=group_clip_hi,
+            l1_cap=l1_args[0] if has_l1 else None,
+            need_count=need_flags[0],
+            need_sum=need_flags[1],
+            need_norm=need_flags[2],
+            need_norm_sq=need_flags[3],
+            has_group_clip=has_group_clip)
+        return columnar.PartitionAccumulators(
+            *(_reduce_scatter(a, scatter_axes) for a in accs))
+
+    spec = _spec(mesh)
+    fn = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(), spec, spec, spec) + (P(),) * (8 if has_l1 else 7),
+        out_specs=columnar.PartitionAccumulators(*(_part_spec(mesh),) * 5),
+        check_vma=False)
+    return jax.jit(fn)
+
+
+def stream_bound_and_aggregate(mesh: Mesh,
+                               key: jax.Array,
+                               pid: np.ndarray,
+                               pk: np.ndarray,
+                               value,
+                               *,
+                               num_partitions: int,
+                               linf_cap,
+                               l0_cap,
+                               row_clip_lo,
+                               row_clip_hi,
+                               middle,
+                               group_clip_lo,
+                               group_clip_hi,
+                               l1_cap=None,
+                               n_chunks: Optional[int] = None,
+                               value_transfer_dtype=None,
+                               need_flags=(True, True, True, True),
+                               has_group_clip: bool = True
+                               ) -> columnar.PartitionAccumulators:
+    """Chunked, transfer-overlapped multi-chip bound-and-aggregate.
+
+    Rows are wire-codec-encoded into n_chunks x n_dev pid-disjoint
+    buckets (one per device per chunk); each chunk ships as ONE sharded
+    [n_dev, W] device_put whose async transfer overlaps the previous
+    chunk's kernels — the mesh generalization of the single-device
+    streaming pipeline (ops/streaming.py), with identical exactness
+    (pid-disjoint buckets bound independently, accumulators add).
+    Returns globally-sharded [padded_p] accumulators like
+    bound_and_aggregate.
+    """
+    from pipelinedp_tpu.ops import streaming, wirecodec
+
+    n = len(pid)
+    n_dev = mesh.devices.size
+    padded_p = padded_num_partitions(mesh, num_partitions)
+    pid = np.asarray(pid)
+    if n == 0:
+        return bound_and_aggregate(
+            mesh, key, pid, pk, np.zeros(0, np.float32),
+            np.zeros(0, bool), num_partitions=num_partitions,
+            linf_cap=linf_cap, l0_cap=l0_cap, row_clip_lo=row_clip_lo,
+            row_clip_hi=row_clip_hi, middle=middle,
+            group_clip_lo=group_clip_lo, group_clip_hi=group_clip_hi,
+            l1_cap=l1_cap, need_flags=need_flags,
+            has_group_clip=has_group_clip)
+    n_c = n_chunks or streaming._num_chunks(max(n // n_dev, 1))
+    k = n_c * n_dev
+    # Shared encode prologue with ops/streaming.py (pid-span validation,
+    # width/bit planning, value plan, native encoder).
+    enc, plan, vidx, pid_lo, bytes_pid, bits_pk = wirecodec.make_encoder(
+        pid, pk, value, num_partitions=num_partitions, k=k,
+        value_transfer_dtype=value_transfer_dtype)
+    if enc is not None:
+        with enc:
+            counts = enc.counts
+            n_uniq = enc.sort_range(0, k)
+            fmt = wirecodec.WireFormat(
+                bytes_pid=bytes_pid, bits_pk=bits_pk,
+                cap=wirecodec._round8(int(counts.max())),
+                ucap=wirecodec.round_ucap(int(n_uniq.max())), value=plan)
+            def emit(c):
+                return enc.emit_range(c * n_dev, (c + 1) * n_dev, fmt)
+
+            return _run_codec_chunks(mesh, key, emit, counts, n_uniq, fmt,
+                                     n_c, n_dev, padded_p, linf_cap, l0_cap,
+                                     row_clip_lo, row_clip_hi, middle,
+                                     group_clip_lo, group_clip_hi, l1_cap,
+                                     tuple(need_flags), has_group_clip)
+    slab, counts, n_uniq, fmt = wirecodec.encode_buckets_numpy(
+        pid, pk, value, pid_lo=pid_lo, k=k, bytes_pid=bytes_pid,
+        bits_pk=bits_pk, plan=plan)
+    return _run_codec_chunks(mesh, key,
+                             lambda c: slab[c * n_dev:(c + 1) * n_dev],
+                             counts, n_uniq, fmt, n_c,
+                             n_dev, padded_p, linf_cap, l0_cap, row_clip_lo,
+                             row_clip_hi, middle, group_clip_lo,
+                             group_clip_hi, l1_cap, tuple(need_flags),
+                             has_group_clip)
+
+
+def _run_codec_chunks(mesh, key, emit, counts, n_uniq, fmt, n_c, n_dev,
+                      padded_p, linf_cap, l0_cap, row_clip_lo, row_clip_hi,
+                      middle, group_clip_lo, group_clip_hi, l1_cap,
+                      need_flags, has_group_clip):
+    from pipelinedp_tpu import profiler
+
+    kernel = _codec_scalar_kernel(mesh, padded_p, fmt,
+                                  l1_cap is not None, need_flags,
+                                  has_group_clip)
+    sharding = NamedSharding(mesh, _spec(mesh))
+    accs = None
+    counts = np.asarray(counts, dtype=np.int32)
+    n_uniq = np.asarray(n_uniq, dtype=np.int32)
+    for c in range(n_c):
+        with profiler.stage(f"dp/mesh_stream_chunk_{c}"):
+            slab = emit(c)
+            dslab = jax.device_put(slab, sharding)
+            dvalid = jax.device_put(counts[c * n_dev:(c + 1) * n_dev],
+                                    sharding)
+            duniq = jax.device_put(n_uniq[c * n_dev:(c + 1) * n_dev],
+                                   sharding)
+            args = (jax.random.fold_in(key, c), dslab, dvalid, duniq,
+                    linf_cap, l0_cap, float(row_clip_lo),
+                    float(row_clip_hi), float(middle),
+                    float(group_clip_lo), float(group_clip_hi))
+            if l1_cap is not None:
+                args += (l1_cap,)
+            chunk_accs = kernel(*args)
+            accs = chunk_accs if accs is None else (
+                columnar.PartitionAccumulators(
+                    *(a + b for a, b in zip(accs, chunk_accs))))
+    return accs
+
+
 def bound_and_aggregate_vector(mesh: Mesh,
                                key: jax.Array,
                                pid: np.ndarray,

@@ -65,3 +65,29 @@ class TestCodelabNotebook:
         text = out.getvalue()
         assert "kept partitions:" in text
         assert "COUNT RMSE" in text
+
+
+class TestUtilityAnalysisNotebook:
+
+    def test_all_code_cells_execute(self):
+        nb = json.loads(
+            (EXAMPLES / "utility_analysis_demo.ipynb").read_text())
+        namespace = {}
+        out = io.StringIO()
+        cwd = os.getcwd()
+        try:
+            os.chdir(EXAMPLES)
+            sys.path.insert(0, str(EXAMPLES.parent))
+            for cell in nb["cells"]:
+                if cell["cell_type"] != "code":
+                    continue
+                with redirect_stdout(out):
+                    exec("".join(cell["source"]), namespace)  # noqa: S102
+        finally:
+            os.chdir(cwd)
+            sys.path.remove(str(EXAMPLES.parent))
+        text = out.getvalue()
+        assert "quantiles:" in text
+        assert "count RMSE" in text
+        assert "recommended: l0 =" in text
+        assert "released" in text

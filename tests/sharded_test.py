@@ -298,3 +298,72 @@ class TestMeshBlockedQuantiles:
         # max over 40 partitions can reach ~4 sigma.
         assert np.abs(cols["percentile_50"] - 5.0).max() < 1.0
         assert np.abs(cols["percentile_90"] - 9.0).max() < 1.0
+
+
+class TestMeshStreaming:
+    """Chunked wire-codec ingest on the mesh (VERDICT-r4 item 8): each
+    chunk's sharded transfer overlaps the previous chunk's kernels; the
+    results must match the single-shot mesh kernel."""
+
+    def test_stream_matches_single_shot_when_caps_do_not_bind(self, mesh):
+        pid, pk, value = make_inputs(n_rows=6000, n_users=500, n_parts=32)
+        value = np.round(value * 4) / 4  # affine-int encodable
+        import jax.random as jrandom
+        key = jrandom.PRNGKey(0)
+        kw = dict(num_partitions=32, linf_cap=10**6, l0_cap=32,
+                  row_clip_lo=0.0, row_clip_hi=1.0, middle=0.5,
+                  group_clip_lo=-np.inf, group_clip_hi=np.inf,
+                  has_group_clip=False)
+        streamed = sharded.stream_bound_and_aggregate(
+            mesh, key, pid, pk, value, n_chunks=3, **kw)
+        single = sharded.bound_and_aggregate(
+            mesh, key, pid, pk, value, np.ones(len(pid), dtype=bool), **kw)
+        np.testing.assert_array_equal(np.asarray(streamed.count),
+                                      np.asarray(single.count))
+        np.testing.assert_array_equal(np.asarray(streamed.pid_count),
+                                      np.asarray(single.pid_count))
+        np.testing.assert_allclose(np.asarray(streamed.sum),
+                                   np.asarray(single.sum), rtol=1e-5)
+
+    def test_stream_enforces_caps(self, mesh):
+        import jax.random as jrandom
+        # One user with 200 rows in one partition, linf=3.
+        pid = np.zeros(200, dtype=np.int32)
+        pk = np.zeros(200, dtype=np.int32)
+        value = np.ones(200, dtype=np.float32)
+        out = sharded.stream_bound_and_aggregate(
+            mesh, jrandom.PRNGKey(1), pid, pk, value, n_chunks=2,
+            num_partitions=8, linf_cap=3, l0_cap=1, row_clip_lo=0.0,
+            row_clip_hi=1.0, middle=0.5, group_clip_lo=-np.inf,
+            group_clip_hi=np.inf, has_group_clip=False)
+        assert float(np.asarray(out.count).sum()) == 3.0
+        assert float(np.asarray(out.pid_count).sum()) == 1.0
+
+    def test_engine_mesh_streaming_end_to_end(self, mesh):
+        # Public API: mesh engine with streaming forced == unstreamed.
+        rng = np.random.default_rng(5)
+        n = 5000
+        pid = rng.integers(0, 800, n, dtype=np.int32)
+        pk = rng.integers(0, 20, n, dtype=np.int32)
+        value = rng.integers(0, 6, n).astype(np.float32)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=20,
+            max_contributions_per_partition=10**6,
+            min_value=0.0, max_value=5.0)
+
+        def run(chunks):
+            acc = pdp.NaiveBudgetAccountant(1e9, 1 - 1e-9)
+            eng = pdp.JaxDPEngine(acc, seed=2, mesh=mesh,
+                                  stream_chunks=chunks,
+                                  secure_host_noise=False)
+            res = eng.aggregate(
+                pdp.ColumnarData(pid=pid, pk=pk, value=value), params,
+                public_partitions=list(range(20)))
+            acc.compute_budgets()
+            return res.to_columns()
+
+        a = run(1)   # single-shot staged path
+        b = run(3)   # streamed codec path
+        np.testing.assert_allclose(a["count"], b["count"], atol=0.5)
+        np.testing.assert_allclose(a["sum"], b["sum"], rtol=1e-3, atol=2.0)

@@ -207,7 +207,7 @@ class TestStreamingEncodings:
                   group_clip_lo=-np.inf, group_clip_hi=np.inf,
                   n_chunks=4, has_group_clip=False)
         a = streaming.stream_bound_and_aggregate(
-            key, pid, pk, value, transfer_encoding="auto", **kw)
+            key, pid, pk, value, transfer_encoding="rle", **kw)
         b = streaming.stream_bound_and_aggregate(
             key, pid, pk, value, transfer_encoding="bytes", **kw)
         for x, y in zip(a, b):
@@ -228,7 +228,7 @@ class TestStreamingEncodings:
                   n_chunks=3, has_group_clip=False,
                   need_flags=(True, False, False, False))
         a = streaming.stream_bound_and_aggregate(
-            key, pid, pk, None, transfer_encoding="auto", **kw)
+            key, pid, pk, None, transfer_encoding="rle", **kw)
         b = streaming.stream_bound_and_aggregate(
             key, pid, pk, None, transfer_encoding="bytes", **kw)
         np.testing.assert_array_equal(np.asarray(a.count),
@@ -249,7 +249,7 @@ class TestStreamingEncodings:
                   group_clip_lo=-np.inf, group_clip_hi=np.inf,
                   n_chunks=4, has_group_clip=False)
         a = streaming.stream_bound_and_aggregate(
-            key, pid, pk, value, transfer_encoding="auto", **kw)
+            key, pid, pk, value, transfer_encoding="rle", **kw)
         total = float(np.asarray(a.count).sum())
         assert total <= n_users * 3 * 5
         assert total > 0
