@@ -34,11 +34,14 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 # [config-chunk, n_groups] float intermediates are bounded to roughly this
-# many elements (the stacked segment-sum operand peaks at ~4x this) so a
-# wide sweep over tens of millions of groups never overflows device memory;
-# configurations beyond the chunk run in further launches of the same
-# compiled kernel.
-_CHUNK_ELEMENT_BUDGET = 1 << 25
+# many elements (the stacked segment-sum operand peaks at ~4x this, i.e.
+# ~2 GB of f32 at this setting — well inside one v5e chip's HBM) so a
+# wide sweep over tens of millions of groups never overflows device
+# memory; configurations beyond the chunk run in further launches of the
+# same compiled kernel. Sized so the 64-config benchmark sweep over 2M
+# groups is ONE launch per metric: every extra launch pays a dispatch
+# round trip, which dominates on tunneled links.
+_CHUNK_ELEMENT_BUDGET = 1 << 27
 
 # Order of the per-(config, bucket) report sums produced by _report_kernel.
 # ABS/REL blocks mirror cross_partition._metric_utility's ValueErrors

@@ -114,7 +114,7 @@ def load() -> Optional[ctypes.CDLL]:
 def load_row_packer() -> Optional[ctypes.CDLL]:
     """The row bucketing/packing library; None on failure."""
     lib = _load_lib("row_packer", "pdp_row_packer_abi_version",
-                    abi_version=3)
+                    abi_version=4)
     if lib is not None and not getattr(lib, "_pdp_typed", False):
         fn = lib.pdp_rle_prep
         fn.restype = ctypes.c_void_p
@@ -122,12 +122,15 @@ def load_row_packer() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int32),  # pid
             ctypes.POINTER(ctypes.c_int32),  # pk
             ctypes.c_void_p,  # value (float* or NULL)
-            ctypes.POINTER(ctypes.c_int32),  # vidx (or NULL)
+            ctypes.POINTER(ctypes.c_int32),  # vidx (or NULL => inline)
+            ctypes.c_double,  # v_lo
+            ctypes.c_double,  # v_scale
             ctypes.c_int64,  # n
             ctypes.c_int32,  # pid_lo
             ctypes.c_int64,  # k buckets
             ctypes.c_int,  # value_mode
             ctypes.POINTER(ctypes.c_int64),  # n_rows out
+            ctypes.POINTER(ctypes.c_int64),  # stats out [fail, max_idx]
         ]
         fn = lib.pdp_rle_sort_range
         fn.restype = ctypes.c_int

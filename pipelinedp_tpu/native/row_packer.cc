@@ -18,6 +18,7 @@
 #include <algorithm>
 #include <atomic>
 #include <cstdint>
+#include <cmath>
 #include <functional>
 #include <cstring>
 #include <thread>
@@ -212,6 +213,7 @@ struct RleState {
   int64_t n = 0;
   int64_t k = 0;
   int value_mode = 0;  // 0 none, 1 planes(vidx), 2 raw f32, 3 raw f16
+  bool low_grouped = false;  // prep pre-grouped rows by pid low byte
   std::vector<int64_t> bucket_start;  // [k+1]
   // Bucket-major SoA; after sort_range a bucket's slice is pid-sorted.
   std::vector<uint32_t> tpid;
@@ -221,11 +223,14 @@ struct RleState {
   std::vector<char> sorted;  // per bucket
 };
 
-// Stable LSD radix sort of (pid << 32 | local_index) pairs, low `nbytes`
-// key bytes only. Stability (index in the low bits) makes the row order
-// identical to numpy's kind="stable" argsort in the reference encoder.
-void RadixSortPairs(uint64_t* a, uint64_t* tmp, int64_t m, int nbytes) {
-  for (int p = 0; p < nbytes; ++p) {
+// Stable LSD radix sort of (pid << 32 | local_index) pairs, key byte
+// passes [first_pass, nbytes). Stability (index in the low bits) makes
+// the row order identical to numpy's kind="stable" argsort in the
+// reference encoder; prep's scatter already performed pass 0 (grouping by
+// the pid low byte), so sorts normally start at pass 1.
+void RadixSortPairs(uint64_t* a, uint64_t* tmp, int64_t m, int first_pass,
+                    int nbytes) {
+  for (int p = first_pass; p < nbytes; ++p) {
     const int shift = 32 + 8 * p;
     int64_t hist[256] = {0};
     for (int64_t i = 0; i < m; ++i) hist[(a[i] >> shift) & 0xff]++;
@@ -238,7 +243,9 @@ void RadixSortPairs(uint64_t* a, uint64_t* tmp, int64_t m, int nbytes) {
     for (int64_t i = 0; i < m; ++i) tmp[hist[(a[i] >> shift) & 0xff]++] = a[i];
     std::swap(a, tmp);
   }
-  if (nbytes & 1) std::memcpy(tmp, a, m * 8);  // result back into caller's a
+  if ((nbytes - first_pass) & 1) {
+    std::memcpy(tmp, a, m * 8);  // result back into caller's a
+  }
 }
 
 void SortBucket(RleState* st, int64_t b) {
@@ -252,6 +259,11 @@ void SortBucket(RleState* st, int64_t b) {
   for (int64_t i = 0; i < m; ++i) maxpid |= st->tpid[s + i];
   int nbytes = 1;
   while (nbytes < 4 && (maxpid >> (8 * nbytes))) ++nbytes;
+  const int first_pass = st->low_grouped ? 1 : 0;
+  if (nbytes <= first_pass) {
+    st->sorted[b] = 1;  // single-byte ids: the prep grouping IS the sort
+    return;
+  }
   std::vector<uint64_t> a(m), tmp(m);
   for (int64_t i = 0; i < m; ++i) {
     a[i] = (static_cast<uint64_t>(st->tpid[s + i]) << 32) |
@@ -259,7 +271,7 @@ void SortBucket(RleState* st, int64_t b) {
   }
   // RadixSortPairs leaves the sorted pairs in `a` for any pass count (odd
   // counts copy back).
-  RadixSortPairs(a.data(), tmp.data(), m, nbytes);
+  RadixSortPairs(a.data(), tmp.data(), m, first_pass, nbytes);
   const uint64_t* order = a.data();
   // Permute payload columns into sorted order via one gather each.
   {
@@ -350,44 +362,106 @@ void RunPool(int64_t k0, int64_t k1, const std::function<void(int64_t)>& fn) {
 
 extern "C" {
 
+// Prep: one counting pass + one scatter pass into bucket-major SoA temps.
+// The scatter ALSO groups rows by the pid low byte inside each bucket —
+// that is pass 0 of the stable LSD radix sort, so sort_range only runs
+// the remaining byte passes.
+//
+// value_mode 1 with vidx == NULL computes the affine value index inline:
+// idx = rint((value - v_lo) / v_scale), verified bit-exact against the
+// float32 reconstruction the device performs. stats[0] is set to 1 (and
+// nullptr returned) if any row fails verification or leaves [0, 2^20);
+// stats[1] returns the maximum index (for the bit-width of the planes).
 void* pdp_rle_prep(const int32_t* pid, const int32_t* pk, const float* value,
-                   const int32_t* vidx, int64_t n, int32_t pid_lo, int64_t k,
-                   int value_mode, int64_t* n_rows) {
-  if (!pid || !pk || !n_rows || n < 0 || k <= 0) return nullptr;
-  if (value_mode == 1 && !vidx) return nullptr;
-  if ((value_mode == 2 || value_mode == 3) && !value) return nullptr;
+                   const int32_t* vidx, double v_lo, double v_scale,
+                   int64_t n, int32_t pid_lo, int64_t k, int value_mode,
+                   int64_t* n_rows, int64_t* stats) {
+  if (!pid || !pk || !n_rows || !stats || n < 0 || k <= 0) return nullptr;
+  const bool inline_vidx = value_mode == 1 && vidx == nullptr;
+  if (value_mode == 1 && !vidx && !value) return nullptr;
+  if ((value_mode == 2 || value_mode == 3 || inline_vidx) && !value) {
+    return nullptr;
+  }
+  stats[0] = 0;
+  stats[1] = 0;
   auto* st = new RleState();
   st->n = n;
   st->k = k;
   st->value_mode = value_mode;
+  st->low_grouped = true;
   st->bucket_start.assign(k + 1, 0);
   st->sorted.assign(k, 0);
+  // Pass 1: counts per (bucket, pid low byte) — the sub-cursor table that
+  // makes the scatter double as radix pass 0.
+  std::vector<int64_t> sub(k * 256, 0);
+  for (int64_t i = 0; i < n; ++i) {
+    const uint32_t spid = static_cast<uint32_t>(pid[i] - pid_lo);
+    sub[(static_cast<int64_t>(BucketOf(pid[i] - pid_lo,
+                                       static_cast<uint32_t>(k)))
+         << 8) | (spid & 0xff)]++;
+  }
   {
-    std::vector<int64_t> counts(k, 0);
-    for (int64_t i = 0; i < n; ++i) {
-      counts[BucketOf(pid[i] - pid_lo, static_cast<uint32_t>(k))]++;
-    }
+    int64_t acc = 0;
     for (int64_t b = 0; b < k; ++b) {
-      st->bucket_start[b + 1] = st->bucket_start[b] + counts[b];
-      n_rows[b] = counts[b];
+      st->bucket_start[b] = acc;
+      int64_t bucket_total = 0;
+      for (int v = 0; v < 256; ++v) {
+        const int64_t c = sub[(b << 8) | v];
+        sub[(b << 8) | v] = acc + bucket_total;
+        bucket_total += c;
+      }
+      n_rows[b] = bucket_total;
+      acc += bucket_total;
     }
+    st->bucket_start[k] = acc;
   }
   st->tpid.resize(n);
   st->tpk.resize(n);
   if (value_mode == 2 || value_mode == 3) st->tval.resize(n);
   if (value_mode == 1) st->tvidx.resize(n);
-  {
-    std::vector<int64_t> cursor(st->bucket_start.begin(),
-                                st->bucket_start.end() - 1);
-    for (int64_t i = 0; i < n; ++i) {
-      const uint32_t b = BucketOf(pid[i] - pid_lo, static_cast<uint32_t>(k));
-      const int64_t slot = cursor[b]++;
-      st->tpid[slot] = static_cast<uint32_t>(pid[i] - pid_lo);
-      st->tpk[slot] = pk[i];
-      if (value_mode == 2 || value_mode == 3) st->tval[slot] = value[i];
-      if (value_mode == 1) st->tvidx[slot] = vidx[i];
+  const float lo_f = static_cast<float>(v_lo);
+  const float scale_f = static_cast<float>(v_scale);
+  bool verify_failed = false;
+  int64_t max_idx = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint32_t spid = static_cast<uint32_t>(pid[i] - pid_lo);
+    const uint32_t b = BucketOf(pid[i] - pid_lo, static_cast<uint32_t>(k));
+    const int64_t slot = sub[(static_cast<int64_t>(b) << 8) |
+                             (spid & 0xff)]++;
+    st->tpid[slot] = spid;
+    st->tpk[slot] = pk[i];
+    if (value_mode == 2 || value_mode == 3) st->tval[slot] = value[i];
+    if (value_mode == 1) {
+      if (inline_vidx) {
+        if (verify_failed) break;  // state is discarded on failure
+        const float v = value[i];
+        // nearbyint: ties-to-even, matching the numpy reference's np.rint
+        // so native and fallback encoders emit bit-identical buffers.
+        const int64_t idx = static_cast<int64_t>(
+            std::nearbyint((static_cast<double>(v) - v_lo) / v_scale));
+        if (idx < 0 || idx >= (1 << 20)) {
+          verify_failed = true;
+          st->tvidx[slot] = 0;
+          continue;
+        }
+        const float rec = lo_f + static_cast<float>(idx) * scale_f;
+        uint32_t rb, vb;
+        std::memcpy(&rb, &rec, 4);
+        std::memcpy(&vb, &v, 4);
+        if (rb != vb) verify_failed = true;
+        if (idx > max_idx) max_idx = idx;
+        st->tvidx[slot] = static_cast<int32_t>(idx);
+      } else {
+        st->tvidx[slot] = vidx[i];
+      }
     }
   }
+  if (inline_vidx && verify_failed) {
+    stats[0] = 1;
+    delete st;
+    return nullptr;
+  }
+  stats[1] = max_idx;
   return st;
 }
 
@@ -482,6 +556,6 @@ int pdp_rle_emit_range(void* handle, int64_t b0, int64_t b1, int bytes_pid,
 
 void pdp_rle_free(void* handle) { delete static_cast<RleState*>(handle); }
 
-int pdp_row_packer_abi_version() { return 3; }
+int pdp_row_packer_abi_version() { return 4; }
 
 }  // extern "C"

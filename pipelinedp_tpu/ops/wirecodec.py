@@ -107,6 +107,39 @@ class WireFormat:
         return self._offsets[3]
 
 
+_SCALE_LADDER = (1.0, 0.5, 0.25, 0.125, 0.1, 0.05, 0.025, 0.01)
+
+
+def _plan_preamble(value, value_f16):
+    """Shared trivial-case handling. Returns (final_plan, None, ...) when
+    the mode is decided without looking at scales, else
+    (None, value_f32, lo, lo64, sample)."""
+    if value is None:
+        return ValuePlan(VALUE_NONE), None, None, None, None
+    if value_f16:
+        return ValuePlan(VALUE_F16), None, None, None, None
+    value = np.asarray(value, dtype=np.float32)
+    if value.size == 0:
+        return ValuePlan(VALUE_F32), None, None, None, None
+    lo64 = float(np.min(value))
+    if not math.isfinite(lo64):
+        return ValuePlan(VALUE_F32), None, None, None, None
+    return None, value, np.float32(lo64), lo64, value[:65536]
+
+
+def _gated_scales(sample, lo, lo64):
+    """Scales from the ladder that pass the cheap 64k-sample gate (range
+    check + bit-exact float32 reconstruction on the sample)."""
+    for scale in _SCALE_LADDER:
+        s = np.float32(scale)
+        sidx = np.rint((sample.astype(np.float64) - lo64) / scale)
+        if (sidx.max(initial=0.0) >= (1 << _MAX_VALUE_BITS)
+                or sidx.min(initial=0.0) < 0):
+            continue
+        if np.array_equal(lo + sidx.astype(np.float32) * s, sample):
+            yield scale, s
+
+
 def plan_and_index(value: Optional[np.ndarray],
                    value_f16: bool = False
                    ) -> Tuple[ValuePlan, Optional[np.ndarray]]:
@@ -122,27 +155,10 @@ def plan_and_index(value: Optional[np.ndarray],
     index is computed once here and reused by the encoders (this host is
     single-pass-precious: one core, see BASELINE.md).
     """
-    if value is None:
-        return ValuePlan(VALUE_NONE), None
-    if value_f16:
-        return ValuePlan(VALUE_F16), None
-    value = np.asarray(value, dtype=np.float32)
-    if value.size == 0:
-        return ValuePlan(VALUE_F32), None
-    lo64 = float(np.min(value))
-    if not math.isfinite(lo64):
-        return ValuePlan(VALUE_F32), None
-    lo = np.float32(lo64)
-    sample = value[:65536]
-    for scale in (1.0, 0.5, 0.25, 0.125, 0.1, 0.05, 0.025, 0.01):
-        s = np.float32(scale)
-        # Cheap gate on a prefix sample before paying a full-array pass.
-        sidx = np.rint((sample.astype(np.float64) - lo64) / scale)
-        if (sidx.max(initial=0.0) >= (1 << _MAX_VALUE_BITS)
-                or sidx.min(initial=0.0) < 0):
-            continue
-        if not np.array_equal(lo + sidx.astype(np.float32) * s, sample):
-            continue
+    final, value, lo, lo64, sample = _plan_preamble(value, value_f16)
+    if final is not None:
+        return final, None
+    for scale, s in _gated_scales(sample, lo, lo64):
         idx = _verified_index(value, lo, s, lo64, scale)
         if idx is not None:
             bits = max(1, int(idx.max(initial=0)).bit_length())
@@ -385,6 +401,18 @@ def decode_bucket(
 # ---------------------------------------------------------------------------
 
 
+def _load_packer():
+    """The native row-packer library, or None (cached by the loader)."""
+    try:
+        from pipelinedp_tpu.native import loader
+        lib = loader.load_row_packer()
+    except Exception:  # noqa: BLE001 — codec is an optimization only
+        return None
+    if lib is None or not hasattr(lib, "pdp_rle_prep"):
+        return None
+    return lib
+
+
 class NativeRleEncoder:
     """Stateful handle over the native prep/sort/emit codec.
 
@@ -402,27 +430,41 @@ class NativeRleEncoder:
         self._k = k
         self._plan = plan
 
+    @property
+    def plan(self) -> ValuePlan:
+        """The value plan in effect (inline-vidx preps correct the bit
+        width to the observed max index)."""
+        return self._plan
+
     @classmethod
     def create(cls, pid, pk, value, vidx, *, pid_lo: int, k: int,
-               plan: ValuePlan) -> Optional["NativeRleEncoder"]:
-        try:
-            from pipelinedp_tpu.native import loader
-            lib = loader.load_row_packer()
-        except Exception:  # noqa: BLE001 — codec is an optimization only
-            return None
-        if lib is None or not hasattr(lib, "pdp_rle_prep"):
+               plan: ValuePlan,
+               inline_vidx: bool = False,
+               out_status: Optional[dict] = None
+               ) -> Optional["NativeRleEncoder"]:
+        """inline_vidx: for PLANES plans, let the C++ prep compute AND
+        bit-verify the value index during its scatter pass (vidx must be
+        None). On verification failure returns None and sets
+        out_status["inline_failed"] = True — callers re-plan. The
+        returned encoder's plan carries the true bit width (from the
+        observed max index)."""
+        lib = _load_packer()
+        if lib is None:
             return None
         import ctypes
 
         n = len(pid)
         pid32 = np.ascontiguousarray(pid, dtype=np.int32)
         pk32 = np.ascontiguousarray(pk, dtype=np.int32)
+        use_inline = inline_vidx and plan.mode == VALUE_PLANES
         val32 = (np.ascontiguousarray(value, dtype=np.float32)
                  if value is not None
-                 and plan.mode in (VALUE_F32, VALUE_F16) else None)
+                 and (use_inline or plan.mode in (VALUE_F32, VALUE_F16))
+                 else None)
         vidx32 = (np.ascontiguousarray(vidx, dtype=np.int32)
-                  if plan.mode == VALUE_PLANES else None)
+                  if plan.mode == VALUE_PLANES and not use_inline else None)
         counts = np.zeros(k, dtype=np.int64)
+        stats = np.zeros(2, dtype=np.int64)
         handle = lib.pdp_rle_prep(
             pid32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             pk32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
@@ -430,10 +472,17 @@ class NativeRleEncoder:
             else None,
             vidx32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
             if vidx32 is not None else None,
+            float(plan.lo), float(plan.scale),
             n, int(pid_lo), k, int(plan.mode),
-            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            stats.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
         if not handle:
+            if out_status is not None and use_inline and stats[0] == 1:
+                out_status["inline_failed"] = True
             return None
+        if use_inline:
+            plan = dataclasses.replace(
+                plan, bits=max(1, int(stats[1]).bit_length()))
         return cls(lib, handle, counts, k, plan)
 
     def sort_range(self, b0: int, b1: int) -> np.ndarray:
@@ -520,11 +569,31 @@ def encode_buckets(pid, pk, value, *, pid_lo, k, bytes_pid, bits_pk, plan,
     return out
 
 
+def _sample_plan(value: Optional[np.ndarray],
+                 value_f16: bool) -> ValuePlan:
+    """Tentative plan from the 64k-sample gate only (one cheap pass plus
+    the global min). A PLANES result is provisional: the native prep
+    verifies the full array bit-exactly during its scatter pass. Shares
+    the scale ladder and gate with plan_and_index."""
+    final, value, lo, lo64, sample = _plan_preamble(value, value_f16)
+    if final is not None:
+        return final
+    for scale, s in _gated_scales(sample, lo, lo64):
+        return ValuePlan(VALUE_PLANES, bits=1, lo=float(lo),
+                         scale=float(s))
+    return ValuePlan(VALUE_F32)
+
+
 def make_encoder(pid: np.ndarray, pk, value, *, num_partitions: int, k: int,
                  value_transfer_dtype=None):
     """Shared encode prologue of the single-device and mesh streaming
     paths: pid-span validation, width/bit planning, value plan + index,
     and the native encoder (None -> numpy fallback).
+
+    With the native library, the full-array value verification happens
+    INSIDE the C++ scatter pass (no separate host pass); without it, the
+    chunked host verification of plan_and_index runs for the numpy
+    fallback.
 
     Returns (enc_or_None, plan, vidx, pid_lo, bytes_pid, bits_pk).
     """
@@ -543,10 +612,30 @@ def make_encoder(pid: np.ndarray, pk, value, *, num_partitions: int, k: int,
     bits_pk = max(1, int(max(num_partitions - 1, 0)).bit_length())
     value_f16 = (value_transfer_dtype is not None
                  and np.dtype(value_transfer_dtype) == np.float16)
+
+    if _load_packer() is None:
+        # Numpy fallback: needs the fully verified plan and index on the
+        # host (and must not pay the sample pass twice).
+        plan, vidx = plan_and_index(value, value_f16)
+        return None, plan, vidx, pid_lo, bytes_pid, bits_pk
+
+    tentative = _sample_plan(value, value_f16)
+    status: dict = {}
+    enc = NativeRleEncoder.create(pid, pk, value, None, pid_lo=pid_lo, k=k,
+                                  plan=tentative, inline_vidx=True,
+                                  out_status=status)
+    if enc is not None:
+        return enc, enc.plan, None, pid_lo, bytes_pid, bits_pk
+    if status.get("inline_failed"):
+        # The sample-chosen scale failed the full array: re-plan with the
+        # full chunked host verification (which tries the other scales)
+        # and retry — rare, and only costs the fallback pass.
+        plan, vidx = plan_and_index(value, value_f16)
+        enc = NativeRleEncoder.create(pid, pk, value, vidx, pid_lo=pid_lo,
+                                      k=k, plan=plan)
+        return enc, plan, vidx, pid_lo, bytes_pid, bits_pk
     plan, vidx = plan_and_index(value, value_f16)
-    enc = NativeRleEncoder.create(pid, pk, value, vidx, pid_lo=pid_lo, k=k,
-                                  plan=plan)
-    return enc, plan, vidx, pid_lo, bytes_pid, bits_pk
+    return None, plan, vidx, pid_lo, bytes_pid, bits_pk
 
 
 def round_ucap(umax: int) -> int:

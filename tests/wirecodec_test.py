@@ -257,3 +257,70 @@ class TestStreamingEncodings:
             key, pid, pk, value, transfer_encoding="bytes", **kw)
         total_b = float(np.asarray(b.count).sum())
         assert abs(total - total_b) / total_b < 0.02
+
+
+class TestInlineVerification:
+    """make_encoder verifies the affine value plan inside the native prep
+    pass; a sample that looks integral but a full array that is not must
+    fall back losslessly."""
+
+    def test_sample_integral_full_not(self):
+        n = 200_000
+        rng = np.random.default_rng(2)
+        pid = rng.integers(0, 5_000, n, dtype=np.int32)
+        pk = rng.integers(0, 64, n, dtype=np.int32)
+        value = rng.integers(1, 6, n).astype(np.float32)
+        value[150_000:] = rng.uniform(0, 5, 50_000).astype(np.float32)
+        enc, plan, vidx, pid_lo, bytes_pid, bits_pk = wirecodec.make_encoder(
+            pid, pk, value, num_partitions=64, k=4)
+        # The 64k sample is integral, the tail is not: the plan must end
+        # raw (either via inline-verify failure or host verification).
+        assert plan.mode == wirecodec.VALUE_F32
+        if enc is None:
+            pytest.skip("native encoder unavailable")
+        with enc:
+            nu = enc.sort_range(0, 4)
+            fmt = wirecodec.WireFormat(
+                bytes_pid=bytes_pid, bits_pk=bits_pk,
+                cap=wirecodec._round8(int(enc.counts.max())),
+                ucap=wirecodec.round_ucap(int(nu.max())), value=plan)
+            slab = enc.emit_range(0, 4, fmt)
+        # Decode must reproduce the values bit-exactly despite the mixed
+        # content.
+        vals = []
+        for c in range(4):
+            _, _, v, _ = wirecodec.decode_bucket(
+                jnp.asarray(slab[c]), int(enc.counts[c]), int(nu[c]), fmt)
+            vals.append(np.asarray(v)[:int(enc.counts[c])])
+        got = np.sort(np.concatenate(vals))
+        np.testing.assert_array_equal(got, np.sort(value))
+
+    def test_inline_bits_match_full_range(self):
+        # Sample max is 5 but the full array reaches 900: the inline path
+        # must size the planes from the TRUE max index.
+        n = 100_000
+        rng = np.random.default_rng(3)
+        pid = rng.integers(0, 2_000, n, dtype=np.int32)
+        pk = rng.integers(0, 32, n, dtype=np.int32)
+        value = rng.integers(1, 6, n).astype(np.float32)
+        value[90_000:] = rng.integers(100, 901, 10_000).astype(np.float32)
+        enc, plan, vidx, pid_lo, bytes_pid, bits_pk = wirecodec.make_encoder(
+            pid, pk, value, num_partitions=32, k=4)
+        if enc is None:
+            pytest.skip("native encoder unavailable")
+        assert plan.mode == wirecodec.VALUE_PLANES
+        assert plan.bits >= 10  # max idx 899 -> 10 bits
+        with enc:
+            nu = enc.sort_range(0, 4)
+            fmt = wirecodec.WireFormat(
+                bytes_pid=bytes_pid, bits_pk=bits_pk,
+                cap=wirecodec._round8(int(enc.counts.max())),
+                ucap=wirecodec.round_ucap(int(nu.max())), value=plan)
+            slab = enc.emit_range(0, 4, fmt)
+        vals = []
+        for c in range(4):
+            _, _, v, _ = wirecodec.decode_bucket(
+                jnp.asarray(slab[c]), int(enc.counts[c]), int(nu[c]), fmt)
+            vals.append(np.asarray(v)[:int(enc.counts[c])])
+        np.testing.assert_array_equal(np.sort(np.concatenate(vals)),
+                                      np.sort(value))
