@@ -78,36 +78,39 @@ def _kernels():
     jax, jnp = _jnp()
 
     @functools.partial(jax.jit,
-                       static_argnames=("n_partitions", "metric_kind"))
-    def metric_grids(counts, sums, pk_ids, npart, lo, hi, l0, n_partitions,
-                     metric_kind):
-        """[4, C, P] error grids + [P] raw values for one metric.
+                       static_argnames=("n_partitions", "metric_kinds"))
+    def metric_grids_multi(counts, sums, pk_ids, npart, lo, hi, l0,
+                           n_partitions, metric_kinds):
+        """All metrics' error grids in ONE dispatch.
 
-        counts/sums: [G] per-group pre-aggregates; npart: [G] L0 load of
-        each group's privacy id; lo/hi/l0: [C] per-configuration clip
-        bounds and L0 bound. Grid order: clip_min_err, clip_max_err,
-        exp_l0_err, var_l0_err — the same accumulators as the host error
-        model (per_partition.compute_metric_errors).
+        lo/hi: [n_metrics, C] per-metric clip bounds; l0: [C] (shared
+        across metrics, so the keep-probability ratio q is computed
+        once). Returns a tuple of (raw [P], grids [4, C, P]) per metric.
+        Every launch saved is a dispatch round trip on tunneled links.
         """
-        if metric_kind == "sum":
-            v = sums
-        elif metric_kind == "count":
-            v = counts
-        else:  # privacy_id_count
-            v = (counts > 0).astype(counts.dtype)
-        vb = v[None, :]
         q = jnp.minimum(1.0, l0[:, None] / jnp.maximum(npart, 1.0)[None, :])
-        x = jnp.clip(vb, lo[:, None], hi[:, None])
-        err = x - vb
-        below = jnp.where(vb < lo[:, None], err, 0.0)
-        above = jnp.where(vb > hi[:, None], err, 0.0)
-        data = jnp.stack(
-            [below, above, -x * (1.0 - q), x * x * q * (1.0 - q)])
-        grids = jax.ops.segment_sum(jnp.moveaxis(data, -1, 0),
-                                    pk_ids,
-                                    num_segments=n_partitions)
-        raw = jax.ops.segment_sum(v, pk_ids, num_segments=n_partitions)
-        return raw, jnp.moveaxis(grids, 0, -1)
+        outs = []
+        for m, kind in enumerate(metric_kinds):
+            if kind == "sum":
+                v = sums
+            elif kind == "count":
+                v = counts
+            else:  # privacy_id_count
+                v = (counts > 0).astype(counts.dtype)
+            vb = v[None, :]
+            x = jnp.clip(vb, lo[m][:, None], hi[m][:, None])
+            err = x - vb
+            below = jnp.where(vb < lo[m][:, None], err, 0.0)
+            above = jnp.where(vb > hi[m][:, None], err, 0.0)
+            data = jnp.stack(
+                [below, above, -x * (1.0 - q), x * x * q * (1.0 - q)])
+            grids = jax.ops.segment_sum(jnp.moveaxis(data, -1, 0),
+                                        pk_ids,
+                                        num_segments=n_partitions)
+            raw = jax.ops.segment_sum(v, pk_ids,
+                                      num_segments=n_partitions)
+            outs.append((raw, jnp.moveaxis(grids, 0, -1)))
+        return tuple(outs)
 
     @functools.partial(jax.jit, static_argnames=("n_partitions",))
     def moment_grids(pk_ids, npart, l0, n_partitions):
@@ -166,7 +169,7 @@ def _kernels():
                                    bucket_ids,
                                    num_segments=n_buckets)
 
-    return metric_grids, moment_grids, report_sums, keep_sums
+    return moment_grids, report_sums, keep_sums, metric_grids_multi
 
 
 # ---------------------------------------------------------------------------
@@ -380,7 +383,9 @@ class DeviceSweep:
             step = self._config_chunk(max(self.n_groups // n_dev, 1))
             grid_axis = 2  # mesh layout is [P, 4, C]
         else:
-            kernel, _, _, _ = _kernels()
+            # The single-metric case IS the 1-tuple case of the fused
+            # kernel — one error-model body to maintain per backend.
+            kernel = _kernels()[3]
             step = self._config_chunk(self.n_groups)
             grid_axis = 1
         raw = None
@@ -394,10 +399,11 @@ class DeviceSweep:
                 r, grids = kernel(self._counts, self._sums, self._pk_ids,
                                   self._npart, clo, chi, cl0)
             else:
-                r, grids = kernel(self._counts, self._sums, self._pk_ids,
-                                  self._npart, clo, chi, cl0,
-                                  n_partitions=self.n_partitions,
-                                  metric_kind=metric_kind)
+                ((r, grids),) = kernel(self._counts, self._sums,
+                                       self._pk_ids, self._npart,
+                                       clo[None, :], chi[None, :], cl0,
+                                       n_partitions=self.n_partitions,
+                                       metric_kinds=(metric_kind,))
             if raw is None:
                 raw = r
             parts.append(grids)
@@ -409,6 +415,57 @@ class DeviceSweep:
                          std_noise=np.asarray(std_noise, dtype=np.float64),
                          metric_kind=metric_kind))
         return len(self.metrics) - 1
+
+    def add_metrics(self, metric_kinds, los, his, l0,
+                    std_noises) -> List[int]:
+        """All metrics' error grids in one device dispatch (single-device
+        path; the mesh path runs per-metric kernels). Equivalent to
+        calling add_metric per metric — pinned by tests — but pays one
+        launch round trip instead of len(metrics), and computes the shared
+        keep-probability ratio once."""
+        if self._mesh is not None or not metric_kinds:
+            return [
+                self.add_metric(kind, lo, hi, l0, std)
+                for kind, lo, hi, std in zip(metric_kinds, los, his,
+                                             std_noises)
+            ]
+        _, jnp = _jnp()
+        kernel = _kernels()[3]
+        # Chunk by the SINGLE-metric footprint: the fused kernel's metric
+        # blocks are data-independent and written sequentially, so XLA's
+        # buffer assignment reuses the big [4, C, G] intermediates between
+        # them (worst case — no reuse — is len(metrics) x ~2 GB at the
+        # benchmark shape, still well inside one v5e chip's HBM).
+        step = self._config_chunk(self.n_groups)
+        parts = [[] for _ in metric_kinds]
+        raws = [None] * len(metric_kinds)
+        lo_arr = np.asarray(los, dtype=np.float32)
+        hi_arr = np.asarray(his, dtype=np.float32)
+        for s in range(0, self.n_configs, step):
+            e = min(s + step, self.n_configs)
+            outs = kernel(self._counts, self._sums, self._pk_ids,
+                          self._npart, jnp.asarray(lo_arr[:, s:e]),
+                          jnp.asarray(hi_arr[:, s:e]),
+                          jnp.asarray(np.asarray(l0[s:e],
+                                                 dtype=np.float32)),
+                          n_partitions=self.n_partitions,
+                          metric_kinds=tuple(metric_kinds))
+            for m, (r, grids) in enumerate(outs):
+                if raws[m] is None:
+                    raws[m] = r
+                parts[m].append(grids)
+        indices = []
+        for m, kind in enumerate(metric_kinds):
+            grids = (parts[m][0] if len(parts[m]) == 1 else
+                     jnp.concatenate(parts[m], axis=1))
+            self.metrics.append(
+                _MetricGrids(raw=raws[m],
+                             grids=grids,
+                             std_noise=np.asarray(std_noises[m],
+                                                  dtype=np.float64),
+                             metric_kind=kind))
+            indices.append(len(self.metrics) - 1)
+        return indices
 
     def materialize_metric(self, index: int) -> Dict[str, np.ndarray]:
         """Pulls one metric's grids to host numpy (float64), in the
@@ -451,7 +508,7 @@ class DeviceSweep:
             step = self._config_chunk(max(self.n_groups // n_dev, 1))
             cfg_axis = 2  # [P, 3, C]
         else:
-            _, kernel, _, _ = _kernels()
+            kernel = _kernels()[0]
             step = self._config_chunk(self.n_groups)
             cfg_axis = 1
         parts = []
@@ -514,7 +571,7 @@ class DeviceSweep:
         jax, jnp = _jnp()
         if self._mesh is not None:
             return self._report_sums_mesh(bucket_ids, n_buckets, keep_prob)
-        _, _, report_kernel, keep_kernel = _kernels()
+        report_kernel, keep_kernel = _kernels()[1:3]
         dbuckets = jnp.asarray(np.asarray(bucket_ids, dtype=np.int32))
         if keep_prob is None:
             dkeep = jnp.ones((self.n_configs, self.n_partitions),

@@ -293,16 +293,22 @@ def _build_device_sweep(pre: PreAggregates, configs: List[ConfigSpec],
     l0 = np.asarray(
         [config.params.max_partitions_contributed for config in configs],
         dtype=np.float64)
-    metric_errors = []
+    kinds, los, his, stds, noise_kind_lists = [], [], [], [], []
     for metric in ordered_metrics:
         bounds = [_metric_bounds(metric, config.params) for config in configs]
-        lo = np.asarray([b[0] for b in bounds], dtype=np.float64)
-        hi = np.asarray([b[1] for b in bounds], dtype=np.float64)
+        kinds.append(_METRIC_KIND[metric])
+        los.append(np.asarray([b[0] for b in bounds], dtype=np.float64))
+        his.append(np.asarray([b[1] for b in bounds], dtype=np.float64))
         std_noise, noise_kinds = _metric_noise(configs, metric)
-        index = sweep.add_metric(_METRIC_KIND[metric], lo, hi, l0, std_noise)
-        metric_errors.append(
-            device_sweep.LazyMetricErrorArrays(metric, std_noise,
-                                               noise_kinds, sweep, index))
+        stds.append(std_noise)
+        noise_kind_lists.append(noise_kinds)
+    indices = sweep.add_metrics(kinds, los, his, l0, stds)
+    metric_errors = [
+        device_sweep.LazyMetricErrorArrays(metric, stds[m],
+                                           noise_kind_lists[m], sweep,
+                                           indices[m])
+        for m, metric in enumerate(ordered_metrics)
+    ]
     if ordered_metrics:
         # Exact (float64) per-partition sizes for report bucketing: the
         # device raw values are float32 and could land on the other side

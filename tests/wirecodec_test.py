@@ -324,3 +324,77 @@ class TestInlineVerification:
             vals.append(np.asarray(v)[:int(enc.counts[c])])
         np.testing.assert_array_equal(np.sort(np.concatenate(vals)),
                                       np.sort(value))
+
+
+class TestCodecWidthEdges:
+    """Byte/bit-width edges: wide privacy-id spans (4-byte ids), negative
+    affine values (lo < 0), and >20-bit partition vocabularies."""
+
+    def test_wide_pid_span_roundtrip(self):
+        n = 30_000
+        rng = np.random.default_rng(21)
+        pid = rng.integers(0, 1 << 25, n, dtype=np.int64)  # 4-byte span
+        pk = rng.integers(0, 100, n, dtype=np.int32)
+        value = rng.integers(-3, 4, n).astype(np.float32)  # lo = -3
+        import jax
+        kw = dict(num_partitions=100, linf_cap=10**9, l0_cap=100,
+                  row_clip_lo=-5.0, row_clip_hi=5.0, middle=0.0,
+                  group_clip_lo=-np.inf, group_clip_hi=np.inf,
+                  n_chunks=3, has_group_clip=False)
+        a = streaming.stream_bound_and_aggregate(
+            jax.random.PRNGKey(0), pid, pk, value,
+            transfer_encoding="rle", **kw)
+        truth_cnt = np.bincount(pk, minlength=100)
+        truth_sum = np.zeros(100)
+        np.add.at(truth_sum, pk, value)
+        np.testing.assert_array_equal(np.asarray(a.count), truth_cnt)
+        np.testing.assert_allclose(np.asarray(a.sum), truth_sum, atol=1e-3)
+
+    def test_negative_affine_values_get_planes(self):
+        v = np.array([-3, -1, 0, 2, 3], dtype=np.float32)
+        plan = wirecodec.plan_value_encoding(v)
+        assert plan.mode == wirecodec.VALUE_PLANES
+        assert plan.lo == -3.0
+
+    def test_wide_partition_vocabulary(self):
+        # 21-bit pk ids through the full encode/decode.
+        n = 20_000
+        rng = np.random.default_rng(5)
+        pid = rng.integers(0, 1_000, n, dtype=np.int32)
+        pk = rng.integers(0, 1 << 21, n, dtype=np.int32)
+        plan = wirecodec.plan_value_encoding(None)
+        slab, n_rows, n_uniq, fmt = wirecodec.encode_buckets_numpy(
+            pid, pk, None, pid_lo=0, k=3, bytes_pid=2, bits_pk=21,
+            plan=plan)
+        dpid, dpk, _ = _decode_all(slab, n_rows, n_uniq, fmt)
+        np.testing.assert_array_equal(np.sort(dpk), np.sort(pk))
+
+    def test_make_encoder_wide_span_native_matches_numpy(self):
+        from pipelinedp_tpu.native import loader
+        if loader.load_row_packer() is None:
+            pytest.skip("native unavailable")
+        n = 25_000
+        rng = np.random.default_rng(8)
+        pid = (rng.integers(0, 1 << 25, n, dtype=np.int64)
+               + (1 << 27))  # nonzero pid_lo, 4-byte span
+        pk = rng.integers(0, 500, n, dtype=np.int32)
+        value = (rng.integers(-6, 7, n) * 0.5).astype(np.float32)
+        enc, plan, vidx, pid_lo, bytes_pid, bits_pk = wirecodec.make_encoder(
+            pid, pk, value, num_partitions=500, k=4)
+        assert enc is not None and plan.mode == wirecodec.VALUE_PLANES
+        with enc:
+            nu = enc.sort_range(0, 4)
+            fmt = wirecodec.WireFormat(
+                bytes_pid=bytes_pid, bits_pk=bits_pk,
+                cap=wirecodec._round8(int(enc.counts.max())),
+                ucap=wirecodec._round8(int(nu.max())), value=plan)
+            slab_n = enc.emit_range(0, 4, fmt)
+        full_plan, full_vidx = wirecodec.plan_and_index(value)
+        slab_r, rows_r, uniq_r, fmt_r = wirecodec.encode_buckets_numpy(
+            pid, pk, value, pid_lo=pid_lo, k=4, bytes_pid=bytes_pid,
+            bits_pk=bits_pk, plan=full_plan)
+        np.testing.assert_array_equal(nu, uniq_r)
+        np.testing.assert_array_equal(enc.counts, rows_r)
+        assert fmt.cap == fmt_r.cap and plan == full_plan
+        assert fmt.ucap == fmt_r.ucap  # _round8 of equal maxima
+        np.testing.assert_array_equal(slab_n, slab_r)
