@@ -69,6 +69,9 @@ class DPStrategySelector:
             # Delta split per Delta_For_Thresholding.pdf: half to noise,
             # half to the threshold.
             noise_kind = self.select_noise_kind(
+                # Predicts the engine's documented thresholding split for
+                # strategy scoring; no budget is spent here.
+                # dplint: disable=DPL005 — scoring-only mirror of the split
                 self._epsilon, self._delta / 2,
                 dp_computations.Sensitivities(l0=sensitivities.l0, linf=1))
             return DPStrategy(noise_kind=noise_kind,
@@ -76,6 +79,9 @@ class DPStrategySelector:
                                   noise_kind).to_partition_selection_strategy(),
                               post_aggregation_thresholding=True)
         # Private selection: budget halved between noise and selection.
+        # This mirrors the accountant's even two-way split for strategy
+        # scoring only; the real split stays with the BudgetAccountant.
+        # dplint: disable=DPL005 — scoring-only mirror of the split
         half_eps, half_delta = self._epsilon / 2, self._delta / 2
         return DPStrategy(
             noise_kind=self.select_noise_kind(half_eps, half_delta,

@@ -19,6 +19,7 @@ conformance suite as the host backends (tests/pipeline_backend_test.py).
 from __future__ import annotations
 
 import operator
+import secrets
 from typing import Callable
 
 import numpy as np
@@ -133,8 +134,9 @@ class JaxBackend(local.LocalBackend):
             import jax
             import jax.numpy as jnp
             from pipelinedp_tpu.ops import columnar
-            prng = jax.random.PRNGKey(
-                int(np.random.randint(0, 2**31 - 1)))
+            # Sampling keeps/drops user contributions, so the key must not
+            # be predictable: seed from the OS CSPRNG, not np.random.
+            prng = jax.random.PRNGKey(secrets.randbits(31))
             mask = np.asarray(
                 columnar.bound_row_mask(
                     prng, jnp.asarray(ids),

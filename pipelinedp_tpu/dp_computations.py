@@ -567,6 +567,9 @@ class ExponentialMechanism:
 
         Pass seed_rng(None) to restore the secure non-replayable source.
         """
+        # The default draw in apply() is noise_core.sample_uniform; this
+        # generator only exists so tests can replay the candidate choice.
+        # dplint: disable=DPL004 — test-only seeded fallback
         cls._seeded_rng = None if seed is None else np.random.default_rng(seed)
 
     def __init__(self, scoring_function: "ExponentialMechanism.ScoringFunction"):

@@ -1,0 +1,43 @@
+"""dplint: AST-based privacy & JAX-correctness static analysis.
+
+PipelineDP-TPU's DP guarantees rest on invariants the type system cannot
+see: every noise draw must trace back to a ``MechanismSpec`` issued by
+``BudgetAccountant.request_budget()``, every JAX PRNG key must be consumed
+exactly once, jitted kernels must not concretize traced values, and
+release-path randomness must come from the secure sampler. dplint checks
+these machine-checkably on every change — the same role secure-RNG review
+plays for Google's C++ differential-privacy library.
+
+Rules:
+  DPL001 prng-key-reuse        — key consumed twice without split/fold_in
+  DPL002 unaccounted-noise     — noise drawn with no MechanismSpec in sight
+  DPL003 jit-hostile-construct — .item()/np.*/branching on traced values
+  DPL004 insecure-rng          — np.random / stdlib random on release path
+  DPL005 budget-literal-misuse — eps<=0, delta>=1, hand-rolled eps/2 splits
+  DPL006 unguarded-float64     — jnp.float64 that silently becomes float32
+
+Run: ``python -m pipelinedp_tpu.lint pipelinedp_tpu/`` (exits nonzero on
+new findings) — see LINT.md for the rule catalog with before/after
+examples, suppression syntax, and baseline workflow.
+"""
+
+from pipelinedp_tpu.lint.config import DEFAULT_CONFIG, LintConfig
+from pipelinedp_tpu.lint.engine import (
+    Finding,
+    LintResult,
+    ModuleContext,
+    Rule,
+    default_rules,
+    lint_paths,
+)
+
+__all__ = [
+    "DEFAULT_CONFIG",
+    "Finding",
+    "LintConfig",
+    "LintResult",
+    "ModuleContext",
+    "Rule",
+    "default_rules",
+    "lint_paths",
+]

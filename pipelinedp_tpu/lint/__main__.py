@@ -1,0 +1,8 @@
+"""Entry point for `python -m pipelinedp_tpu.lint`."""
+
+import sys
+
+from pipelinedp_tpu.lint.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,112 @@
+"""Shared AST helpers for dplint rules: import-alias resolution.
+
+Every rule needs to answer "what fully-qualified callable does this
+expression refer to?" — `jnp.float64`, `np.random.choice`,
+`random.laplace` (which is `jax.random.laplace` under
+``from jax import random``) all look different syntactically. The alias
+map built from the module's import statements lets rules match on
+canonical dotted names (``jax.random.laplace``, ``numpy.random.choice``)
+regardless of local import style.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+
+def build_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Maps local names to the fully-qualified names they were imported as.
+
+    ``import numpy as np``            -> {"np": "numpy"}
+    ``import jax.numpy as jnp``       -> {"jnp": "jax.numpy"}
+    ``import jax``                    -> {"jax": "jax"}
+    ``from jax import random``        -> {"random": "jax.random"}
+    ``from functools import partial`` -> {"partial": "functools.partial"}
+    """
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    aliases[alias.asname] = alias.name
+                else:
+                    # `import a.b.c` binds `a` to the root package.
+                    root = alias.name.split(".")[0]
+                    aliases[root] = root
+        elif isinstance(node, ast.ImportFrom):
+            if node.module is None or node.level:  # relative imports: the
+                continue  # caller's package is unknown; leave unresolved
+            for alias in node.names:
+                local = alias.asname or alias.name
+                aliases[local] = f"{node.module}.{alias.name}"
+    return aliases
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """The source dotted path of a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def resolve(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Canonical fully-qualified dotted name of an expression, else None.
+
+    Resolves the leading component through the module's import aliases, so
+    ``jnp.float64`` -> ``jax.numpy.float64`` and a bare ``partial`` ->
+    ``functools.partial``. Unimported leading names resolve to themselves
+    (a local variable shadowing an import is indistinguishable without
+    type inference; dplint accepts that imprecision).
+    """
+    dotted = dotted_name(node)
+    if dotted is None:
+        return None
+    head, _, rest = dotted.partition(".")
+    full_head = aliases.get(head, head)
+    return f"{full_head}.{rest}" if rest else full_head
+
+
+def call_target(node: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
+    return resolve(node.func, aliases)
+
+
+def literal_number(node: ast.AST) -> Optional[float]:
+    """The value of a numeric literal, including a leading unary minus."""
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = literal_number(node.operand)
+        return None if inner is None else -inner
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)) \
+            and not isinstance(node.value, bool):
+        return float(node.value)
+    return None
+
+
+def annotation_nodes(tree: ast.AST) -> set:
+    """ids of every AST node that lives inside a type annotation.
+
+    Rules that flag attribute references (e.g. ``np.random.Generator``)
+    must not fire on annotations — ``Optional[np.random.Generator]`` is
+    type information, not an RNG use.
+    """
+    skip: set = set()
+
+    def mark(node):
+        if node is None:
+            return
+        for sub in ast.walk(node):
+            skip.add(id(sub))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AnnAssign):
+            mark(node.annotation)
+        elif isinstance(node, ast.arg):
+            mark(node.annotation)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mark(node.returns)
+    return skip

@@ -1,0 +1,134 @@
+"""dplint command line: `python -m pipelinedp_tpu.lint [paths...]`.
+
+Exit codes: 0 = clean (or every finding baselined/suppressed), 1 = new
+findings, 2 = usage or internal error. The default baseline file,
+``dplint-baseline.json`` in the current directory, is loaded when present;
+``--write-baseline`` snapshots the current findings so existing debt can
+be ratcheted down without blocking CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from pipelinedp_tpu.lint import engine
+from pipelinedp_tpu.lint.config import DEFAULT_CONFIG
+
+DEFAULT_BASELINE = "dplint-baseline.json"
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="pipelinedp-tpu-lint",
+        description="AST-based privacy & JAX-correctness linter for "
+                    "pipelinedp_tpu (rules DPL001-DPL006).")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories to scan (default: "
+                             "pipelinedp_tpu/ under the current directory)")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline JSON path (default: "
+                             f"./{DEFAULT_BASELINE} when it exists)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="snapshot current findings into the baseline "
+                             "file and exit 0")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore any baseline file")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids to run "
+                             "(e.g. DPL001,DPL003)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text", dest="fmt")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also print suppressed findings (informational)")
+    parser.add_argument("-v", "--verbose", action="store_true",
+                        help="print fix hints with each finding")
+    return parser
+
+
+def _select_rules(spec: Optional[str]) -> List[engine.Rule]:
+    rules = engine.default_rules()
+    if spec is None:
+        return rules
+    wanted = {s.strip().upper() for s in spec.split(",") if s.strip()}
+    by_id = {r.rule_id: r for r in rules}
+    unknown = wanted - set(by_id)
+    if unknown:
+        raise SystemExit(
+            f"pipelinedp-tpu-lint: unknown rule id(s): "
+            f"{', '.join(sorted(unknown))} (known: "
+            f"{', '.join(sorted(by_id))})")
+    return [by_id[rid] for rid in sorted(wanted)]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = _build_parser()
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in engine.default_rules():
+            print(f"{rule.rule_id}  {rule.name}: {rule.description}")
+        return 0
+
+    paths = args.paths or ["pipelinedp_tpu"]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"pipelinedp-tpu-lint: path not found: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+
+    try:
+        rules = _select_rules(args.rules)
+    except SystemExit as e:
+        print(e, file=sys.stderr)
+        return 2
+
+    result = engine.lint_paths(paths, config=DEFAULT_CONFIG, rules=rules)
+    findings = result.all_reportable
+
+    baseline_path = args.baseline or (
+        DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None)
+
+    if args.write_baseline:
+        target = args.baseline or DEFAULT_BASELINE
+        engine.write_baseline(target, findings, result.lines_by_path)
+        print(f"pipelinedp-tpu-lint: wrote {len(findings)} finding(s) to "
+              f"{target}")
+        return 0
+
+    if baseline_path and not args.no_baseline:
+        try:
+            baseline = engine.load_baseline(baseline_path)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"pipelinedp-tpu-lint: cannot load baseline "
+                  f"{baseline_path}: {e}", file=sys.stderr)
+            return 2
+        findings = engine.filter_baselined(findings, result.lines_by_path,
+                                           baseline)
+
+    if args.fmt == "json":
+        payload = [{
+            "rule": f.rule_id, "path": f.path, "line": f.line,
+            "col": f.col, "message": f.message, "hint": f.hint,
+        } for f in findings]
+        print(json.dumps(payload, indent=2))
+    else:
+        for f in findings:
+            print(f.format(verbose=args.verbose))
+        if args.show_suppressed:
+            for f in result.suppressed:
+                print(f"[suppressed] {f.format()}")
+        summary = (f"pipelinedp-tpu-lint: {len(findings)} new finding(s), "
+                   f"{len(result.suppressed)} suppressed")
+        print(summary, file=sys.stderr)
+
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,73 @@
+"""dplint configuration: which modules are exempt from which rules.
+
+The default stance is deny-by-default: every scanned module is treated as
+privacy-critical unless a pattern below says otherwise. Exemptions are
+*narrow and documented* — each entry names the structural reason the rule
+does not apply there. Tests construct custom configs to exercise rules in
+isolation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+from typing import Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class LintConfig:
+    """Per-rule module exemptions (fnmatch patterns over dotted modules)."""
+
+    # DPL004 — modules allowed to reference numpy/stdlib RNGs.
+    #  * noise_core: the declared seedable numpy fallback sampler
+    #    (noise_core.py `_fallback_*`) — distributionally equivalent,
+    #    documented weaker bit-level guarantees, test-reseedable.
+    #  * analysis / dataset_histograms: utility-analysis tooling; estimates
+    #    error on non-released intermediates, not on the DP release path.
+    insecure_rng_exempt: Tuple[str, ...] = (
+        "pipelinedp_tpu.noise_core",
+        "pipelinedp_tpu.analysis.*",
+        "pipelinedp_tpu.dataset_histograms.*",
+        "pipelinedp_tpu.lint.*",
+    )
+
+    # DPL002 — the mechanism-primitive layer: these modules *are* the noise
+    # sinks; their scales/eps/delta arrive pre-calibrated from MechanismSpecs
+    # resolved upstream (jax_engine/dp_computations read the specs and pass
+    # scalars down).
+    unaccounted_noise_exempt: Tuple[str, ...] = (
+        "pipelinedp_tpu.noise_core",
+        "pipelinedp_tpu.ops.noise",
+        "pipelinedp_tpu.ops.selection",
+        "pipelinedp_tpu.ops.quantiles",
+        "pipelinedp_tpu.partition_selection",
+        "pipelinedp_tpu.quantile_tree",
+        "pipelinedp_tpu.native.*",
+        "pipelinedp_tpu.lint.*",
+    )
+
+    # DPL005 — modules whose job is budget arithmetic: the accountant
+    # itself, and dp_computations.equally_split_budget (the sanctioned
+    # splitter the reference uses for MEAN/VARIANCE internal splits).
+    budget_literal_exempt: Tuple[str, ...] = (
+        "pipelinedp_tpu.budget_accounting",
+        "pipelinedp_tpu.dp_computations",
+        "pipelinedp_tpu.pld",
+        "pipelinedp_tpu.lint.*",
+    )
+
+    @staticmethod
+    def _matches(module: str, patterns: Sequence[str]) -> bool:
+        return any(fnmatch.fnmatch(module, p) for p in patterns)
+
+    def is_insecure_rng_exempt(self, module: str) -> bool:
+        return self._matches(module, self.insecure_rng_exempt)
+
+    def is_unaccounted_noise_exempt(self, module: str) -> bool:
+        return self._matches(module, self.unaccounted_noise_exempt)
+
+    def is_budget_literal_exempt(self, module: str) -> bool:
+        return self._matches(module, self.budget_literal_exempt)
+
+
+DEFAULT_CONFIG = LintConfig()

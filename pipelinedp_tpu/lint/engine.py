@@ -1,0 +1,289 @@
+"""dplint rule engine: findings, suppressions, baselines, and the runner.
+
+Architecture: one AST parse per file, shared by every rule through a
+``ModuleContext``; rules are stateless objects returning ``Finding``s.
+Three layers decide what the CLI ultimately reports:
+
+1. inline suppressions — ``# dplint: disable=DPL001  <justification>`` on
+   the offending line (or on a comment-only line directly above it), and
+   ``# dplint: disable-file=DPL004`` anywhere in the file;
+2. the baseline — a JSON snapshot of accepted findings, matched by
+   content fingerprint (rule id + file + normalized line text + occurrence
+   index) so findings don't resurrect when unrelated lines shift;
+3. everything left is "new" and makes the CLI exit nonzero.
+"""
+
+from __future__ import annotations
+
+import abc
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+import re
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from pipelinedp_tpu.lint import astutils
+from pipelinedp_tpu.lint.config import DEFAULT_CONFIG, LintConfig
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dplint:\s*(disable|disable-file)\s*=\s*"
+    r"(all|DPL\d{3}(?:\s*,\s*DPL\d{3})*)", re.IGNORECASE)
+_COMMENT_ONLY_RE = re.compile(r"^\s*#")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+    rule_id: str
+    path: str  # repo-relative, '/'-separated
+    line: int
+    col: int
+    message: str
+    hint: str = ""
+
+    def format(self, verbose: bool = False) -> str:
+        text = f"{self.path}:{self.line}:{self.col}: {self.rule_id} " \
+               f"{self.message}"
+        if verbose and self.hint:
+            text += f"\n    hint: {self.hint}"
+        return text
+
+
+@dataclasses.dataclass
+class ModuleContext:
+    """Everything a rule needs about one parsed file."""
+    path: str          # absolute
+    relpath: str       # repo-relative, '/'-separated (used in findings)
+    module: str        # dotted module name, e.g. pipelinedp_tpu.ops.noise
+    tree: ast.AST
+    lines: List[str]   # source lines, 0-indexed
+    aliases: Dict[str, str]
+    config: LintConfig
+
+    def finding(self, rule: "Rule", node: ast.AST, message: str,
+                hint: Optional[str] = None) -> Finding:
+        return Finding(rule.rule_id, self.relpath,
+                       getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0) + 1, message,
+                       rule.hint if hint is None else hint)
+
+    def source_contains(self, *tokens: str) -> bool:
+        return any(any(t in line for t in tokens) for line in self.lines)
+
+
+class Rule(abc.ABC):
+    """A dplint rule: stateless; ``check`` returns findings for one module."""
+
+    rule_id: str = "DPL000"
+    name: str = ""
+    description: str = ""
+    hint: str = ""
+
+    @abc.abstractmethod
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        ...
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+# ---------------------------------------------------------------------------
+
+
+class Suppressions:
+    """Inline `# dplint: disable=...` directives of one file."""
+
+    def __init__(self, lines: Sequence[str]):
+        self.file_level: Set[str] = set()
+        self.by_line: Dict[int, Set[str]] = {}
+        for i, line in enumerate(lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            kind = m.group(1).lower()
+            codes = {c.strip().upper() for c in m.group(2).split(",")}
+            if kind == "disable-file":
+                self.file_level |= codes
+            else:
+                target = i
+                if _COMMENT_ONLY_RE.match(line):
+                    # A comment-only directive line guards the next line.
+                    target = i + 1
+                self.by_line.setdefault(target, set()).update(codes)
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        def covers(codes: Set[str]) -> bool:
+            return "ALL" in codes or finding.rule_id in codes
+
+        if covers(self.file_level):
+            return True
+        codes = self.by_line.get(finding.line)
+        return codes is not None and covers(codes)
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def _fingerprints(findings: Sequence[Finding],
+                  lines_by_path: Dict[str, List[str]]) -> List[str]:
+    """Content fingerprint per finding: stable across pure line shifts.
+
+    Duplicate (rule, path, line-text) triples are disambiguated by an
+    occurrence counter so a second identical violation in the same file is
+    still "new" relative to a one-entry baseline.
+    """
+    seen: Counter = Counter()
+    prints = []
+    for f in findings:
+        lines = lines_by_path.get(f.path, [])
+        text = lines[f.line - 1].strip() if 0 < f.line <= len(lines) else ""
+        base = f"{f.rule_id}|{f.path}|{text}"
+        occurrence = seen[base]
+        seen[base] += 1
+        digest = hashlib.sha1(f"{base}|{occurrence}".encode()).hexdigest()
+        prints.append(digest[:20])
+    return prints
+
+
+def write_baseline(path: str, findings: Sequence[Finding],
+                   lines_by_path: Dict[str, List[str]]) -> None:
+    entries = [{
+        "rule": f.rule_id,
+        "path": f.path,
+        "fingerprint": fp,
+    } for f, fp in zip(findings, _fingerprints(findings, lines_by_path))]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": BASELINE_VERSION, "findings": entries}, fh,
+                  indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def load_baseline(path: str) -> Counter:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(f"Unsupported baseline version in {path}: "
+                         f"{data.get('version')!r}")
+    return Counter(e["fingerprint"] for e in data.get("findings", []))
+
+
+def filter_baselined(findings: Sequence[Finding],
+                     lines_by_path: Dict[str, List[str]],
+                     baseline: Counter) -> List[Finding]:
+    """Findings not accounted for by the baseline (multiset semantics)."""
+    remaining = Counter(baseline)
+    new = []
+    for f, fp in zip(findings, _fingerprints(findings, lines_by_path)):
+        if remaining[fp] > 0:
+            remaining[fp] -= 1
+        else:
+            new.append(f)
+    return new
+
+
+# ---------------------------------------------------------------------------
+# Runner
+# ---------------------------------------------------------------------------
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__"
+                                     and not d.startswith("."))
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        yield os.path.join(dirpath, name)
+
+
+def module_name(relpath: str) -> str:
+    """Dotted module for a repo-relative path, anchored at the package
+    root when the path runs through ``pipelinedp_tpu``."""
+    parts = relpath.split("/")
+    if parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts[-1] == "__init__":
+        parts.pop()
+    if "pipelinedp_tpu" in parts:
+        parts = parts[parts.index("pipelinedp_tpu"):]
+    return ".".join(parts)
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]          # post-suppression, pre-baseline
+    suppressed: List[Finding]
+    parse_errors: List[Finding]
+    lines_by_path: Dict[str, List[str]]
+
+    @property
+    def all_reportable(self) -> List[Finding]:
+        return self.parse_errors + self.findings
+
+
+def default_rules() -> List[Rule]:
+    from pipelinedp_tpu.lint.rules import ALL_RULES
+    return [cls() for cls in ALL_RULES]
+
+
+def lint_paths(paths: Sequence[str],
+               config: Optional[LintConfig] = None,
+               rules: Optional[Sequence[Rule]] = None,
+               root: Optional[str] = None) -> LintResult:
+    """Runs every rule over every .py file under ``paths``."""
+    config = config or DEFAULT_CONFIG
+    rules = list(rules) if rules is not None else default_rules()
+    root = os.path.abspath(root or os.getcwd())
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    parse_errors: List[Finding] = []
+    lines_by_path: Dict[str, List[str]] = {}
+
+    for path in iter_python_files(paths):
+        abspath = os.path.abspath(path)
+        relpath = os.path.relpath(abspath, root).replace(os.sep, "/")
+        try:
+            with open(abspath, encoding="utf-8") as fh:
+                source = fh.read()
+        except (OSError, UnicodeDecodeError) as e:
+            parse_errors.append(
+                Finding("DPL000", relpath, 1, 1, f"cannot read file: {e}"))
+            continue
+        lines = source.splitlines()
+        lines_by_path[relpath] = lines
+        try:
+            tree = ast.parse(source, filename=relpath)
+        except SyntaxError as e:
+            parse_errors.append(
+                Finding("DPL000", relpath, e.lineno or 1, 1,
+                        f"syntax error: {e.msg}"))
+            continue
+        ctx = ModuleContext(path=abspath, relpath=relpath,
+                            module=module_name(relpath), tree=tree,
+                            lines=lines,
+                            aliases=astutils.build_aliases(tree),
+                            config=config)
+        suppressions = Suppressions(lines)
+        for rule in rules:
+            for finding in rule.check(ctx):
+                if suppressions.is_suppressed(finding):
+                    suppressed.append(finding)
+                else:
+                    findings.append(finding)
+
+    key = lambda f: (f.path, f.line, f.col, f.rule_id)
+    findings.sort(key=key)
+    suppressed.sort(key=key)
+    parse_errors.sort(key=key)
+    return LintResult(findings, suppressed, parse_errors, lines_by_path)

@@ -1,0 +1,19 @@
+"""dplint rule registry: one module per rule, registered in ID order."""
+
+from pipelinedp_tpu.lint.rules.key_reuse import KeyReuseRule
+from pipelinedp_tpu.lint.rules.unaccounted_noise import UnaccountedNoiseRule
+from pipelinedp_tpu.lint.rules.jit_hostility import JitHostilityRule
+from pipelinedp_tpu.lint.rules.insecure_rng import InsecureRngRule
+from pipelinedp_tpu.lint.rules.budget_literals import BudgetLiteralRule
+from pipelinedp_tpu.lint.rules.float64_guard import Float64GuardRule
+
+ALL_RULES = (
+    KeyReuseRule,
+    UnaccountedNoiseRule,
+    JitHostilityRule,
+    InsecureRngRule,
+    BudgetLiteralRule,
+    Float64GuardRule,
+)
+
+__all__ = [cls.__name__ for cls in ALL_RULES] + ["ALL_RULES"]

@@ -1,0 +1,111 @@
+"""DPL005 — epsilon/delta literal misuse and hand-rolled budget splits.
+
+Two hazards:
+
+1. **Invalid literals**: ``eps=-1`` or ``delta=1.5`` passed to a
+   mechanism. Negative epsilon is meaningless; delta >= 1 voids the
+   guarantee entirely (every outcome is "allowed to fail"). These are
+   caught at runtime by input validators *if* the call path has one — the
+   lint catches them everywhere, including test/fixture code that never
+   executes the validator.
+
+2. **Manual budget splitting**: ``eps / 2`` scattered through pipeline
+   code. The BudgetAccountant owns the composition ledger — splitting by
+   raw literals bypasses weight normalization (BudgetAccountantScope) and
+   silently diverges from the accounted total when an aggregation is
+   added or removed. Sanctioned splitters (budget_accounting,
+   dp_computations.equally_split_budget) are exempt by config.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List
+
+from pipelinedp_tpu.lint import astutils
+from pipelinedp_tpu.lint.engine import Finding, ModuleContext, Rule
+
+_EPS_KWARGS = frozenset({
+    "eps", "epsilon", "total_epsilon", "calculation_eps",
+    "eps_per_coordinate",
+})
+_DELTA_KWARGS = frozenset({
+    "delta", "total_delta", "delta_per_coordinate",
+})
+_BUDGET_NAME_RE = re.compile(r"(?:^|_)(?:eps|epsilon|delta)(?:$|_)")
+
+
+def _budget_name(node: ast.expr) -> str:
+    """The eps/delta-ish variable a BinOp operand refers to, or ''."""
+    if isinstance(node, ast.Name) and _BUDGET_NAME_RE.search(node.id):
+        return node.id
+    if isinstance(node, ast.Attribute) and _BUDGET_NAME_RE.search(node.attr):
+        return astutils.dotted_name(node) or node.attr
+    return ""
+
+
+class BudgetLiteralRule(Rule):
+    rule_id = "DPL005"
+    name = "budget-literal-misuse"
+    description = ("Invalid epsilon/delta literals (eps <= 0, delta >= 1) "
+                   "or privacy budget split by raw literals instead of "
+                   "the BudgetAccountant.")
+    hint = ("Valid ranges: eps > 0, 0 <= delta < 1. For splits, use "
+            "BudgetAccountant weights (request_budget(weight=...)) or "
+            "dp_computations.equally_split_budget.")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                self._check_call_literals(node, ctx, findings)
+            elif isinstance(node, ast.BinOp) and \
+                    not ctx.config.is_budget_literal_exempt(ctx.module):
+                self._check_split(node, ctx, findings)
+        return findings
+
+    def _check_call_literals(self, call: ast.Call, ctx: ModuleContext,
+                             findings: List[Finding]) -> None:
+        for kw in call.keywords:
+            if kw.arg is None:
+                continue
+            value = astutils.literal_number(kw.value)
+            if value is None:
+                continue
+            if kw.arg in _EPS_KWARGS and value <= 0:
+                findings.append(ctx.finding(
+                    self, kw.value,
+                    f"epsilon literal {value:g} passed as `{kw.arg}=` — "
+                    f"epsilon must be strictly positive"))
+            elif kw.arg in _DELTA_KWARGS and (value >= 1 or value < 0):
+                findings.append(ctx.finding(
+                    self, kw.value,
+                    f"delta literal {value:g} passed as `{kw.arg}=` — "
+                    f"delta must be in [0, 1); delta >= 1 voids the DP "
+                    f"guarantee"))
+
+    def _check_split(self, node: ast.BinOp, ctx: ModuleContext,
+                     findings: List[Finding]) -> None:
+        if not isinstance(node.op, (ast.Div, ast.Mult)):
+            return
+        # `eps / 2` or `0.5 * delta`: a budget variable *shrunk* by a bare
+        # numeric literal — a hand-rolled share. Growth (`2 * delta_p` in
+        # CDF-inversion threshold math) is not a split and is left alone.
+        pairs = [(node.left, node.right)]
+        if isinstance(node.op, ast.Mult):
+            pairs.append((node.right, node.left))
+        for var_side, lit_side in pairs:
+            name = _budget_name(var_side)
+            literal = astutils.literal_number(lit_side)
+            if literal is None or not name:
+                continue
+            is_split = (literal > 1 if isinstance(node.op, ast.Div)
+                        else 0 < literal < 1)
+            if is_split:
+                findings.append(ctx.finding(
+                    self, node,
+                    f"privacy budget `{name}` split by raw literal "
+                    f"{literal:g} — budget shares belong to the "
+                    f"BudgetAccountant, not inline arithmetic"))
+                return

@@ -1,0 +1,64 @@
+"""DPL006 — jnp.float64 without an x64 guard.
+
+JAX defaults to 32-bit: ``jnp.asarray(x, dtype=jnp.float64)`` silently
+produces a float32 array unless ``jax_enable_x64`` is set. For this
+codebase that silence is dangerous twice over — the Mironov granularity
+snapping assumes float64's 52-bit mantissa (noise_core), and secure host
+finalization is float64 end-to-end. A silent downcast re-opens the
+least-significant-bit channel the snapping exists to close.
+
+A module that demonstrably guards (references ``jax_enable_x64`` /
+``x64_enabled``) may use jnp.float64 freely; host-side ``np.float64`` is
+always fine and never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from pipelinedp_tpu.lint import astutils
+from pipelinedp_tpu.lint.engine import Finding, ModuleContext, Rule
+
+_X64_GUARD_TOKENS = ("jax_enable_x64", "enable_x64", "x64_enabled")
+_JNP_F64 = "jax.numpy.float64"
+
+
+class Float64GuardRule(Rule):
+    rule_id = "DPL006"
+    name = "unguarded-float64"
+    description = ("jnp.float64 used without an x64-mode guard — JAX "
+                   "silently downcasts to float32 unless jax_enable_x64 "
+                   "is set.")
+    hint = ("Either verify the mode (`assert jax.config.x64_enabled` / "
+            "`jax.config.update('jax_enable_x64', True)`) or keep float64 "
+            "math on host with np.float64 (the secure_host_noise path).")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if ctx.source_contains(*_X64_GUARD_TOKENS):
+            return []
+        findings: List[Finding] = []
+        flagged = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute) and \
+                    astutils.resolve(node, ctx.aliases) == _JNP_F64:
+                flagged.add(id(node))
+                findings.append(ctx.finding(
+                    self, node,
+                    "`jnp.float64` without an x64 guard: silently float32 "
+                    "unless jax_enable_x64 is set"))
+            elif isinstance(node, ast.Call):
+                target = astutils.call_target(node, ctx.aliases)
+                if target is None or not target.startswith("jax."):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "dtype":
+                        continue
+                    if isinstance(kw.value, ast.Constant) and \
+                            kw.value.value == "float64":
+                        findings.append(ctx.finding(
+                            self, kw.value,
+                            f"dtype='float64' passed to `{target}` "
+                            f"without an x64 guard: silently float32 "
+                            f"unless jax_enable_x64 is set"))
+        return findings

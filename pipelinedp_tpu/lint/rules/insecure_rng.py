@@ -1,0 +1,70 @@
+"""DPL004 — insecure RNG in privacy-critical code.
+
+`np.random.*` and the stdlib `random` module are Mersenne-Twister/PCG
+generators: fast, seedable, and *predictable*. A DP release whose noise an
+attacker can reconstruct provides no privacy at all (the reference
+implementation delegates to a kernel-CSPRNG C++ sampler for exactly this
+reason — see noise_core's security note and native/secure_noise.cc).
+
+Every scanned module is privacy-critical by default; the narrow exemptions
+(the declared numpy fallback in noise_core, the utility-analysis layer)
+live in LintConfig.insecure_rng_exempt. Type annotations like
+``Optional[np.random.Generator]`` are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from pipelinedp_tpu.lint import astutils
+from pipelinedp_tpu.lint.engine import Finding, ModuleContext, Rule
+
+_NUMPY_RANDOM_PREFIX = "numpy.random."
+_STDLIB_RANDOM = "random"
+
+
+class InsecureRngRule(Rule):
+    rule_id = "DPL004"
+    name = "insecure-rng"
+    description = ("numpy/stdlib RNG (predictable, seedable) used in a "
+                   "privacy-critical module.")
+    hint = ("Draw from the secure sampler instead: noise_core."
+            "sample_uniform / sample_laplace / sample_gaussian (kernel "
+            "CSPRNG when the native library is available), or `secrets` "
+            "for seed material.")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if ctx.config.is_insecure_rng_exempt(ctx.module):
+            return []
+        annotations = astutils.annotation_nodes(ctx.tree)
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or id(node) in annotations:
+                continue
+            target = astutils.call_target(node, ctx.aliases)
+            if target is None:
+                continue
+            if target.startswith(_NUMPY_RANDOM_PREFIX):
+                findings.append(ctx.finding(
+                    self, node,
+                    f"`{target}` is a predictable (non-cryptographic) RNG "
+                    f"in privacy-critical module `{ctx.module}`"))
+            elif target.startswith(_STDLIB_RANDOM + ".") and \
+                    self._stdlib_random_imported(ctx):
+                findings.append(ctx.finding(
+                    self, node,
+                    f"stdlib `{target}` (Mersenne Twister) in "
+                    f"privacy-critical module `{ctx.module}`"))
+        return findings
+
+    @staticmethod
+    def _stdlib_random_imported(ctx: ModuleContext) -> bool:
+        # `random` must actually be the stdlib module: `from jax import
+        # random` resolves to jax.random in the alias map and never
+        # reaches here; a bare local named `random` would, so require an
+        # explicit toplevel `import random`.
+        return ctx.aliases.get("random") == "random" and any(
+            isinstance(n, ast.Import) and
+            any(a.name == "random" for a in n.names)
+            for n in ast.walk(ctx.tree))

@@ -1,0 +1,178 @@
+"""DPL003 — jit-hostile constructs inside jitted functions.
+
+Inside a function compiled with ``jax.jit``, host-only operations on traced
+values either fail at trace time (`if tracer:`, `float(tracer)`) or — worse
+for a DP system — silently execute at *trace* time and bake one concrete
+value into the compiled kernel (a `np.` call on a traced argument). For
+noise code that means a "random" draw frozen into XLA and replayed on
+every call: a privacy incident, not a crash.
+
+Detected as jitted: ``@jax.jit``-decorated, ``@functools.partial(jax.jit,
+...)``-decorated, and local ``def fn(...)`` later wrapped as
+``jax.jit(fn)``. Arguments named in ``static_argnames``/``static_argnums``
+are excluded from the traced set — branching and host math on statics is
+the idiomatic pattern.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from pipelinedp_tpu.lint import astutils
+from pipelinedp_tpu.lint.engine import Finding, ModuleContext, Rule
+
+_PARTIAL_NAMES = ("functools.partial", "partial")
+_CASTS = ("float", "int", "bool")
+
+
+def _static_names_from_call(call: ast.Call,
+                            param_order: List[str]) -> Set[str]:
+    statics: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            if isinstance(kw.value, ast.Constant) and \
+                    isinstance(kw.value.value, str):
+                statics.add(kw.value.value)
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                for elt in kw.value.elts:
+                    if isinstance(elt, ast.Constant) and \
+                            isinstance(elt.value, str):
+                        statics.add(elt.value)
+        elif kw.arg == "static_argnums":
+            nums = []
+            if isinstance(kw.value, ast.Constant) and \
+                    isinstance(kw.value.value, int):
+                nums = [kw.value.value]
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                nums = [e.value for e in kw.value.elts
+                        if isinstance(e, ast.Constant) and
+                        isinstance(e.value, int)]
+            for n in nums:
+                if 0 <= n < len(param_order):
+                    statics.add(param_order[n])
+    return statics
+
+
+def _param_names(fn) -> List[str]:
+    args = fn.args
+    return [a.arg for a in (list(args.posonlyargs) + list(args.args) +
+                            list(args.kwonlyargs))]
+
+
+class JitHostilityRule(Rule):
+    rule_id = "DPL003"
+    name = "jit-hostile-construct"
+    description = ("Host-only operations (.item(), np.*, float()/int(), "
+                   "Python branching) on traced values inside a "
+                   "jax.jit-compiled function.")
+    hint = ("Use jnp ops / jnp.where / lax.cond on traced values, or "
+            "declare the argument in static_argnames if it is genuinely "
+            "compile-time constant.")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        jitted = self._collect_jitted(ctx)
+        findings: List[Finding] = []
+        for fn, statics in jitted:
+            traced = set(_param_names(fn)) - statics
+            self._check_body(fn, traced, ctx, findings)
+        return findings
+
+    # -- jitted-function discovery ------------------------------------------
+
+    def _collect_jitted(self, ctx: ModuleContext
+                        ) -> List[Tuple[ast.AST, Set[str]]]:
+        jitted: List[Tuple[ast.AST, Set[str]]] = []
+        # jax.jit(fn) wrapping sites, resolved to same-module FunctionDefs.
+        wrapped: Dict[str, Set[str]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and \
+                    astutils.call_target(node, ctx.aliases) == "jax.jit" \
+                    and node.args and isinstance(node.args[0], ast.Name):
+                name = node.args[0].id
+                wrapped.setdefault(name, set())
+                # static names resolved per-function below (needs params)
+                wrapped[name] |= _static_names_from_call(node, [])
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            statics = self._decorator_statics(node, ctx)
+            if statics is not None:
+                jitted.append((node, statics))
+            elif node.name in wrapped:
+                params = _param_names(node)
+                # Re-resolve static_argnums now that params are known.
+                statics = set(wrapped[node.name])
+                for call in ast.walk(ctx.tree):
+                    if isinstance(call, ast.Call) and \
+                            astutils.call_target(call, ctx.aliases) == \
+                            "jax.jit" and call.args and \
+                            isinstance(call.args[0], ast.Name) and \
+                            call.args[0].id == node.name:
+                        statics |= _static_names_from_call(call, params)
+                jitted.append((node, statics))
+        return jitted
+
+    def _decorator_statics(self, fn, ctx: ModuleContext) -> Optional[Set[str]]:
+        """Static argnames if ``fn`` is decorator-jitted, else None."""
+        params = _param_names(fn)
+        for dec in fn.decorator_list:
+            target = astutils.resolve(dec, ctx.aliases)
+            if target == "jax.jit":
+                return set()
+            if isinstance(dec, ast.Call):
+                dec_target = astutils.call_target(dec, ctx.aliases)
+                if dec_target == "jax.jit":
+                    return _static_names_from_call(dec, params)
+                if dec_target in _PARTIAL_NAMES and dec.args and \
+                        astutils.resolve(dec.args[0], ctx.aliases) == \
+                        "jax.jit":
+                    return _static_names_from_call(dec, params)
+        return None
+
+    # -- body checks --------------------------------------------------------
+
+    def _check_body(self, fn, traced: Set[str], ctx: ModuleContext,
+                    findings: List[Finding]) -> None:
+        def references_traced(node: ast.AST) -> bool:
+            return any(isinstance(sub, ast.Name) and sub.id in traced
+                       for sub in ast.walk(node))
+
+        def is_none_check(test: ast.expr) -> bool:
+            return isinstance(test, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                target = astutils.call_target(node, ctx.aliases)
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "item" and not node.args:
+                    findings.append(ctx.finding(
+                        self, node,
+                        f"`.item()` inside jitted `{fn.name}` forces a "
+                        f"host sync and fails on traced values"))
+                elif target is not None and target.startswith("numpy.") \
+                        and any(references_traced(a) for a in
+                                list(node.args) +
+                                [kw.value for kw in node.keywords]):
+                    findings.append(ctx.finding(
+                        self, node,
+                        f"NumPy call `{target}` on traced argument inside "
+                        f"jitted `{fn.name}` executes at trace time — the "
+                        f"result is baked into the compiled kernel"))
+                elif target in _CASTS and node.args and \
+                        references_traced(node.args[0]):
+                    findings.append(ctx.finding(
+                        self, node,
+                        f"`{target}()` on a traced value inside jitted "
+                        f"`{fn.name}` fails at trace time (concretization "
+                        f"of an abstract tracer)"))
+            elif isinstance(node, (ast.If, ast.While)):
+                test = node.test
+                if references_traced(test) and not is_none_check(test):
+                    findings.append(ctx.finding(
+                        self, test,
+                        f"Python branching on traced value inside jitted "
+                        f"`{fn.name}`: the branch is resolved once at "
+                        f"trace time, not per-input — use jnp.where or "
+                        f"lax.cond"))

@@ -1,0 +1,282 @@
+"""DPL001 — JAX PRNG key reuse.
+
+Consuming the same key in two sampling calls yields *correlated* noise
+draws: for DP release code that silently destroys the privacy guarantee
+(two "independent" Laplace draws that are bitwise identical). The rule
+tracks, per function scope, which key variables have already been consumed
+by a `jax.random.*` sampler (or handed to a callee that samples from them)
+and flags a second consumption that is not separated by a re-derivation
+(`split` / `fold_in` / reassignment).
+
+Precision over recall: a variable is only treated as a PRNG key with
+*provenance* — it was assigned from a `jax.random` derivation call, was
+already consumed as the key argument of a `jax.random` sampler, or is a
+strictly key-named parameter (`key`, `rng_key`, `k_noise`, ...) of a
+function that demonstrably works with `jax.random`. Dict keys, sort keys
+and chunk counters named `k`/`key` never enter the analysis.
+
+The analysis is branch-aware: consumption in mutually exclusive `if`/`elif`
+arms does not conflict, and loop bodies are analyzed twice so a key drawn
+from outside the loop is caught on the simulated second iteration.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List
+
+from pipelinedp_tpu.lint import astutils
+from pipelinedp_tpu.lint.engine import Finding, ModuleContext, Rule
+
+# Derivation calls: produce fresh keys, do NOT consume their key argument.
+_DERIVERS = frozenset({
+    "jax.random.PRNGKey",
+    "jax.random.key",
+    "jax.random.split",
+    "jax.random.fold_in",
+    "jax.random.clone",
+    "jax.random.wrap_key_data",
+    "jax.random.key_data",
+})
+
+# Parameters with these names are PRNG keys — but only inside functions
+# that reference jax.random at all (see _function_uses_jax_random).
+_STRICT_PARAM_RE = re.compile(
+    r"^(?:key|rng|prng|rng_key|prng_key|root_key|kernel_key|sub_key|"
+    r"noise_key)$|^k_\w+$")
+
+# Method-name suffixes treated as derivation: the audited KeyStream idiom
+# (jax_engine.KeyStream.derive / .next_key) and lookalikes.
+_DERIVER_SUFFIXES = (".derive", ".next_key")
+
+# Handing a key to these never samples from it.
+_NON_CONSUMING_BUILTINS = frozenset({
+    "len", "range", "min", "max", "zip", "enumerate", "list", "tuple",
+    "sorted", "reversed", "print", "isinstance", "issubclass", "type",
+    "id", "repr", "str", "int", "float", "bool", "sum", "abs", "hash",
+    "getattr", "hasattr", "format",
+})
+
+_FRESH = -1  # sentinel: key derived but not yet consumed
+
+
+def _is_deriver(target) -> bool:
+    return target is not None and (
+        target in _DERIVERS or
+        target.endswith(_DERIVER_SUFFIXES))
+
+
+def _terminates(stmts: List[ast.stmt]) -> bool:
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+class KeyReuseRule(Rule):
+    rule_id = "DPL001"
+    name = "prng-key-reuse"
+    description = ("A JAX PRNG key is consumed by more than one sampling "
+                   "call without an intervening split/fold_in.")
+    hint = ("Derive a fresh key per draw: `k1, k2 = jax.random.split(key)` "
+            "or `jax.random.fold_in(key, tag)` — or route through "
+            "jax_engine.KeyStream, the audited key source.")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        self._scan_scopes(ctx.tree, ctx, findings)
+        # Dedupe (the loop double-pass reports each reuse twice).
+        seen = set()
+        out = []
+        for f in findings:
+            key = (f.line, f.col, f.message)
+            if key not in seen:
+                seen.add(key)
+                out.append(f)
+        return out
+
+    # -- scope discovery ----------------------------------------------------
+
+    def _scan_scopes(self, node: ast.AST, ctx: ModuleContext,
+                     findings: List[Finding]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._analyze_function(child, ctx, findings)
+            self._scan_scopes(child, ctx, findings)
+
+    def _function_uses_jax_random(self, fn, ctx: ModuleContext) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Attribute):
+                resolved = astutils.resolve(node, ctx.aliases)
+                if resolved is not None and \
+                        resolved.startswith("jax.random."):
+                    return True
+        return False
+
+    def _analyze_function(self, fn, ctx: ModuleContext,
+                          findings: List[Finding]) -> None:
+        state: Dict[str, int] = {}
+        if self._function_uses_jax_random(fn, ctx):
+            args = fn.args
+            for a in (list(args.posonlyargs) + list(args.args) +
+                      list(args.kwonlyargs)):
+                if _STRICT_PARAM_RE.match(a.arg):
+                    state[a.arg] = _FRESH
+        self._block(fn.body, state, ctx, findings)
+
+    # -- statement walk -----------------------------------------------------
+
+    def _block(self, stmts: List[ast.stmt], state: Dict[str, int],
+               ctx: ModuleContext, findings: List[Finding]) -> None:
+        for stmt in stmts:
+            self._statement(stmt, state, ctx, findings)
+
+    def _statement(self, stmt: ast.stmt, state: Dict[str, int],
+                   ctx: ModuleContext, findings: List[Finding]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # separate scope, handled by _scan_scopes
+        if isinstance(stmt, ast.Assign):
+            self._expr(stmt.value, state, ctx, findings)
+            for target in stmt.targets:
+                self._bind(target, stmt.value, state, ctx)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._expr(stmt.value, state, ctx, findings)
+                self._bind(stmt.target, stmt.value, state, ctx)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._expr(stmt.value, state, ctx, findings)
+            return
+        if isinstance(stmt, ast.If):
+            self._expr(stmt.test, state, ctx, findings)
+            merged: Dict[str, int] = dict(state)
+            for branch in (stmt.body, stmt.orelse):
+                branch_state = dict(state)
+                self._block(branch, branch_state, ctx, findings)
+                if not _terminates(branch):
+                    for name, line in branch_state.items():
+                        # Union consumption from surviving branches; a
+                        # consumed mark beats fresh.
+                        if merged.get(name, _FRESH) == _FRESH:
+                            merged[name] = line
+            state.clear()
+            state.update(merged)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter, state, ctx, findings)
+            # Two passes simulate a second iteration: consumption of a key
+            # defined outside the loop is a reuse on iteration 2.
+            loop_state = dict(state)
+            for _ in range(2):
+                self._block(stmt.body, loop_state, ctx, findings)
+            state.update(loop_state)
+            self._block(stmt.orelse, state, ctx, findings)
+            return
+        if isinstance(stmt, ast.While):
+            self._expr(stmt.test, state, ctx, findings)
+            loop_state = dict(state)
+            for _ in range(2):
+                self._block(stmt.body, loop_state, ctx, findings)
+            state.update(loop_state)
+            self._block(stmt.orelse, state, ctx, findings)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._expr(item.context_expr, state, ctx, findings)
+            self._block(stmt.body, state, ctx, findings)
+            return
+        if isinstance(stmt, ast.Try):
+            body_state = dict(state)
+            self._block(stmt.body, body_state, ctx, findings)
+            merged = dict(body_state)
+            for handler in stmt.handlers:
+                h_state = dict(state)
+                self._block(handler.body, h_state, ctx, findings)
+                if not _terminates(handler.body):
+                    for name, line in h_state.items():
+                        if merged.get(name, _FRESH) == _FRESH:
+                            merged[name] = line
+            state.clear()
+            state.update(merged)
+            self._block(stmt.orelse, state, ctx, findings)
+            self._block(stmt.finalbody, state, ctx, findings)
+            return
+        # Expression-bearing statements (Expr, Return, Assert, Raise, ...).
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(child, state, ctx, findings)
+
+    def _bind(self, target: ast.expr, value: ast.expr,
+              state: Dict[str, int], ctx: ModuleContext) -> None:
+        """Assignment from a `jax.random` derivation makes the target(s)
+        fresh tracked keys; any other assignment to a tracked name clears
+        it (provenance lost — stop tracking rather than guess)."""
+        is_derivation = (isinstance(value, ast.Call) and
+                         _is_deriver(astutils.call_target(value,
+                                                          ctx.aliases)))
+        names: List[str] = []
+        if isinstance(target, ast.Name):
+            names = [target.id]
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            names = [e.id for e in target.elts if isinstance(e, ast.Name)]
+        for name in names:
+            if is_derivation:
+                state[name] = _FRESH
+            else:
+                state.pop(name, None)
+
+    # -- expression walk ----------------------------------------------------
+
+    def _expr(self, node: ast.expr, state: Dict[str, int],
+              ctx: ModuleContext, findings: List[Finding]) -> None:
+        if isinstance(node, ast.Lambda):
+            return  # deferred execution; analyzed as its own scope? no state
+        if isinstance(node, ast.Call):
+            target = astutils.call_target(node, ctx.aliases)
+            # Recurse first so nested calls (fold_in(key, i) as an
+            # argument) are classified before the outer call consumes.
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                self._expr(arg, state, ctx, findings)
+            if _is_deriver(target):
+                return  # derivation: the key argument stays fresh
+            if target is not None and target.startswith("jax.random."):
+                # Sampler: the first positional argument is the key by
+                # signature. First consumption also *establishes*
+                # provenance for untracked names.
+                if node.args and isinstance(node.args[0], ast.Name):
+                    self._consume(node.args[0], node, state, ctx, findings,
+                                  via=target.rsplit(".", 1)[-1],
+                                  establish=True)
+                return
+            if target is not None and target in _NON_CONSUMING_BUILTINS:
+                return
+            # Other callee: a *tracked* key argument is assumed consumed
+            # (the callee samples from it); two hand-offs of the same key
+            # mean two callees drawing identical streams.
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in state:
+                    self._consume(arg, node, state, ctx, findings,
+                                  via=target or "a function call",
+                                  establish=False)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, state, ctx, findings)
+
+    def _consume(self, name_node: ast.Name, call: ast.Call,
+                 state: Dict[str, int], ctx: ModuleContext,
+                 findings: List[Finding], via: str,
+                 establish: bool) -> None:
+        name = name_node.id
+        prior = state.get(name)
+        if prior is None and not establish:
+            return
+        if prior is not None and prior != _FRESH:
+            findings.append(ctx.finding(
+                self, call,
+                f"PRNG key `{name}` is consumed again by `{via}` but was "
+                f"already consumed at line {prior}; reusing a key yields "
+                f"correlated (non-independent) draws"))
+        else:
+            state[name] = getattr(call, "lineno", 0)

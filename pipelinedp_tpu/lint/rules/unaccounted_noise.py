@@ -1,0 +1,109 @@
+"""DPL002 — noise drawn in a module that never touches a MechanismSpec.
+
+Every DP noise draw must be calibrated by a spec issued by
+``BudgetAccountant.request_budget()`` — that is the only place the
+(eps, delta) ledger is debited. A module that calls the noise primitives
+(``noise_core.add_*`` / ``noise_core.sample_*`` / ``jax.random.laplace`` /
+``jax.random.normal``) but contains no trace of MechanismSpec handling is
+releasing unaccounted noise: the draw happens, the ledger never moves.
+
+The mechanism-primitive layer (noise_core itself, ops/noise, ops/selection,
+ops/quantiles, partition_selection, quantile_tree) is exempt by config —
+those modules *are* the sinks; their scales arrive pre-calibrated from
+specs resolved upstream.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from pipelinedp_tpu.lint import astutils
+from pipelinedp_tpu.lint.engine import Finding, ModuleContext, Rule
+
+_NOISE_CALLS = frozenset({
+    "jax.random.laplace",
+    "jax.random.normal",
+})
+_NOISE_CORE_PREFIX = "pipelinedp_tpu.noise_core."
+_NOISE_CORE_FUNCS = frozenset({
+    "add_laplace_noise", "add_gaussian_noise",
+    "add_laplace_noise_array", "add_gaussian_noise_array",
+    "add_noise_array",
+    "sample_laplace", "sample_gaussian",
+})
+
+# Any of these appearing in the module counts as "touches the accountant":
+# the module either requests budget or parameterizes mechanisms from specs.
+_SPEC_TOKENS = frozenset({
+    "MechanismSpec", "MechanismSpecInternal", "request_budget",
+    "mechanism_spec", "BudgetAccountant",
+})
+
+
+def _touches_mechanism_spec(ctx: ModuleContext) -> bool:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Name) and node.id in _SPEC_TOKENS:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in _SPEC_TOKENS:
+            return True
+        if isinstance(node, ast.ImportFrom) and node.module and \
+                "budget_accounting" in node.module:
+            return True
+        if isinstance(node, ast.Import) and any(
+                "budget_accounting" in a.name for a in node.names):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            for a in (list(args.posonlyargs) + list(args.args) +
+                      list(args.kwonlyargs)):
+                if a.arg in ("spec", "mechanism_spec"):
+                    return True
+    return False
+
+
+class UnaccountedNoiseRule(Rule):
+    rule_id = "DPL002"
+    name = "unaccounted-noise"
+    description = ("Noise is drawn in a module that never touches a "
+                   "MechanismSpec issued by BudgetAccountant."
+                   "request_budget().")
+    hint = ("Request the budget first: `spec = budget_accountant."
+            "request_budget(mechanism_type)` and calibrate the draw from "
+            "the resolved spec (see dp_computations."
+            "create_additive_mechanism).")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if ctx.config.is_unaccounted_noise_exempt(ctx.module):
+            return []
+        noise_sites: List[ast.Call] = []
+        labels: List[str] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = astutils.call_target(node, ctx.aliases)
+            if target is None:
+                continue
+            if target in _NOISE_CALLS:
+                noise_sites.append(node)
+                labels.append(target)
+            elif (target.startswith(_NOISE_CORE_PREFIX) and
+                  target[len(_NOISE_CORE_PREFIX):] in _NOISE_CORE_FUNCS):
+                noise_sites.append(node)
+                labels.append(target)
+            elif target in _NOISE_CORE_FUNCS:
+                # `from pipelinedp_tpu.noise_core import add_laplace_noise`
+                # resolves through the alias map; a bare matching name that
+                # did NOT resolve to noise_core is a local redefinition —
+                # skip it.
+                continue
+        if not noise_sites or _touches_mechanism_spec(ctx):
+            return []
+        return [
+            ctx.finding(
+                self, node,
+                f"`{label}` draws noise but module `{ctx.module}` never "
+                f"handles a MechanismSpec — this draw is invisible to the "
+                f"privacy-budget ledger")
+            for node, label in zip(noise_sites, labels)
+        ]

@@ -61,6 +61,10 @@ def seed_rng(seed: Optional[int]) -> None:
     Pass seed_rng(None) to restore the secure non-replayable source.
     """
     global _seeded_rng
+    # Production draws come from noise_core.sample_uniform (kernel CSPRNG
+    # when the native library is available); this generator only exists so
+    # tests can replay selection decisions.
+    # dplint: disable=DPL004 — test-only seeded fallback
     _seeded_rng = None if seed is None else np.random.default_rng(seed)
 
 
@@ -362,7 +366,11 @@ class GaussianThresholdingPartitionSelection(_ThresholdingPartitionSelection):
         super().__init__(epsilon, delta, max_partitions_contributed,
                          pre_threshold)
         m = max_partitions_contributed
+        # In-mechanism calibration split (class docstring; parity with the
+        # reference's gaussian thresholding) — not a pipeline budget split.
+        # dplint: disable=DPL005 — documented mechanism-internal split
         delta_noise = delta / 2.0
+        # dplint: disable=DPL005 — documented mechanism-internal split
         delta_thresh = delta / 2.0
         self._sigma = noise_core.analytic_gaussian_sigma(
             epsilon, delta_noise, math.sqrt(m))

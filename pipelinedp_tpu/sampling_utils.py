@@ -13,6 +13,8 @@ from typing import Any, List
 
 import numpy as np
 
+from pipelinedp_tpu import noise_core
+
 
 def choose_from_list_without_replacement(a: List[Any], size: int) -> List[Any]:
     """Uniformly samples ``size`` elements without replacement.
@@ -20,10 +22,19 @@ def choose_from_list_without_replacement(a: List[Any], size: int) -> List[Any]:
     Returns the input list unchanged when it is already small enough. Sampling
     is done over indices so elements keep their native Python types (no numpy
     casting — matters for both serialization and arbitrary-precision ints).
+
+    Which contributions survive bounding decides whose data reaches the
+    mechanism, so the draw comes from noise_core's secure uniform sampler
+    (kernel CSPRNG when the native library is available; the seedable
+    fallback only after noise_core.seed_fallback_rng) rather than the
+    predictable global numpy state: each index gets a uniform draw and the
+    ``size`` smallest are kept — distributionally identical to
+    np.random.choice(replace=False).
     """
     if len(a) <= size:
         return a
-    picked = np.random.choice(len(a), size, replace=False)
+    uniforms = np.asarray(noise_core.sample_uniform(len(a)))
+    picked = np.argpartition(uniforms, size)[:size]
     return [a[i] for i in picked]
 
 

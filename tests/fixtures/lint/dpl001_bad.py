@@ -1,0 +1,26 @@
+"""dplint fixture — DPL001 violations: PRNG key reuse.
+
+Uses uniform draws (not laplace/normal) so the module stays out of
+DPL002's scope — this fixture exercises key discipline only.
+"""
+
+import jax
+
+
+def double_draw(key, shape):
+    a = jax.random.uniform(key, shape)
+    b = jax.random.uniform(key, shape)  # second draw from the same key
+    return a + b
+
+
+def loop_draw(key, n):
+    out = []
+    for _ in range(n):
+        out.append(jax.random.uniform(key, ()))  # same key every iteration
+    return out
+
+
+def handoff_twice(key, values, kernel_a, kernel_b):
+    k_init = jax.random.fold_in(key, 0)
+    masked = kernel_a(k_init, values)
+    return kernel_b(k_init, masked)  # both callees sample the same stream

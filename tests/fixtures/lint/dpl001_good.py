@@ -1,0 +1,35 @@
+"""dplint fixture — DPL001 clean: one consumption per derived key.
+
+Uses uniform/bits draws (not laplace/normal) so the module stays out of
+DPL002's scope — this fixture exercises key discipline only.
+"""
+
+import jax
+
+
+def split_draw(key, shape):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.uniform(k1, shape)
+    b = jax.random.bits(k2, shape)
+    return a, b
+
+
+def branch_draw(key, shape, low_bits):
+    if low_bits:
+        return jax.random.bits(key, shape)
+    return jax.random.uniform(key, shape)
+
+
+def loop_fold(key, n):
+    out = []
+    for i in range(n):
+        sub_key = jax.random.fold_in(key, i)
+        out.append(jax.random.uniform(sub_key, ()))
+    return out
+
+
+def rederive_between_draws(key, shape):
+    a = jax.random.uniform(key, shape)
+    key = jax.random.fold_in(key, 1)
+    b = jax.random.uniform(key, shape)
+    return a + b

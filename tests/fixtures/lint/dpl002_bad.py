@@ -1,0 +1,10 @@
+"""dplint fixture — DPL002 violation: noise with no MechanismSpec."""
+
+import numpy as np
+
+from pipelinedp_tpu import noise_core
+
+
+def leak_count(values):
+    # The scale is invented locally; no budget was ever requested.
+    return noise_core.add_laplace_noise_array(np.asarray(values), 1.0)

@@ -1,0 +1,11 @@
+"""dplint fixture — DPL002 clean: noise calibrated from a MechanismSpec."""
+
+import numpy as np
+
+from pipelinedp_tpu import noise_core
+
+
+def noised_count(values, spec, l1_sensitivity):
+    """``spec`` is a resolved budget_accounting.MechanismSpec."""
+    scale = l1_sensitivity / spec.eps
+    return noise_core.add_laplace_noise_array(np.asarray(values), scale)

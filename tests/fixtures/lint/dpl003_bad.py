@@ -1,0 +1,29 @@
+"""dplint fixture — DPL003 violations: jit-hostile constructs."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def host_sync(x):
+    return x.sum().item()  # forces a device sync; fails under jit
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def trace_branch(x, n):
+    if x > 0:  # x is traced: branch freezes at trace time
+        return x * n
+    return -x
+
+
+@jax.jit
+def numpy_on_traced(x):
+    return jnp.asarray(np.clip(x, 0.0, 1.0))  # np on a tracer
+
+
+@jax.jit
+def concretize(x):
+    return float(x) * 2.0

@@ -1,0 +1,29 @@
+"""dplint fixture — DPL003 clean: static branching, jnp ops, local jit."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("n", "mode"))
+def static_branch(x, n, mode):
+    if mode == "scaled" and n > 2:  # static args: trace-time dispatch is
+        return x * n                # exactly what static_argnames is for
+    return jnp.where(x > 0, x, -x)
+
+
+@functools.partial(jax.jit, static_argnames=("size",))
+def static_host_math(x, size):
+    pad = np.zeros(size)  # np on a *static* value: computed at trace time
+    return jnp.concatenate([x, jnp.asarray(pad)])
+
+
+def make_kernel():
+    def fn(x, threshold):
+        if threshold is None:  # `is None` checks are trace-safe
+            return jnp.maximum(x, 0.0)
+        return jnp.minimum(x, threshold)
+
+    return jax.jit(fn)

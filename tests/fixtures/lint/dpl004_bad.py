@@ -1,0 +1,17 @@
+"""dplint fixture — DPL004 violations: insecure RNG on the release path."""
+
+import random
+
+import numpy as np
+
+
+def insecure_noise(scale, size):
+    return np.random.laplace(0.0, scale, size)
+
+
+def insecure_seed():
+    return np.random.default_rng().integers(0, 2**31)
+
+
+def insecure_uniform():
+    return random.random()

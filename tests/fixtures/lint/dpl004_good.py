@@ -1,0 +1,18 @@
+"""dplint fixture — DPL004 clean: secure sampler + CSPRNG seed material."""
+
+import secrets
+
+from pipelinedp_tpu import noise_core
+
+
+def secure_noise(spec, l1_sensitivity, size):
+    """``spec`` is a resolved budget_accounting.MechanismSpec."""
+    return noise_core.sample_laplace(l1_sensitivity / spec.eps, size)
+
+
+def secure_uniform():
+    return noise_core.sample_uniform()
+
+
+def secure_seed():
+    return secrets.randbits(31)

@@ -1,0 +1,14 @@
+"""dplint fixture — DPL005 violations: bad eps/delta literals, hand splits."""
+
+
+def invalid_literals(run_query):
+    return run_query(eps=-1.0, delta=1.5)
+
+
+def zero_epsilon(run_query):
+    return run_query(eps=0)
+
+
+def manual_split(eps, delta, run_query):
+    # Budget shares belong to the accountant, not inline arithmetic.
+    return run_query(eps=eps / 2, delta=0.5 * delta)

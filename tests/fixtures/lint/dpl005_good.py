@@ -1,0 +1,12 @@
+"""dplint fixture — DPL005 clean: budget splits via the accountant."""
+
+
+def accounted_aggregation(budget_accountant, mechanism_type):
+    # Shares come from weight normalization inside the accountant scope.
+    spec = budget_accountant.request_budget(mechanism_type, weight=0.5)
+    other = budget_accountant.request_budget(mechanism_type, weight=0.5)
+    return spec, other
+
+
+def valid_literals(run_query):
+    return run_query(eps=1.0, delta=1e-9)

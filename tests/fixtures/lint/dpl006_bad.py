@@ -1,0 +1,8 @@
+"""dplint fixture — DPL006 violation: jnp.float64 with no x64 guard."""
+
+import jax.numpy as jnp
+
+
+def unguarded(values):
+    # Silently float32 unless 64-bit mode was turned on at process start.
+    return jnp.asarray(values, dtype=jnp.float64)

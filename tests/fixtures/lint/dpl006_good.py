@@ -1,0 +1,15 @@
+"""dplint fixture — DPL006 clean: guarded jnp.float64, host np.float64."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def guarded(values):
+    assert jax.config.x64_enabled, "requires jax_enable_x64"
+    return jnp.asarray(values, dtype=jnp.float64)
+
+
+def host_f64(values):
+    # Host-side float64 (the secure finalization path) needs no guard.
+    return np.asarray(values, dtype=np.float64)

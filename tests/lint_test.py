@@ -1,0 +1,298 @@
+"""Tests for dplint (pipelinedp_tpu/lint): rule engine, rules, CLI.
+
+The last test class doubles as the CI lint gate: the production tree must
+be clean, so any new DPL finding fails the tier-1 suite.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from pipelinedp_tpu.lint import engine as lint_engine
+from pipelinedp_tpu.lint import lint_paths
+from pipelinedp_tpu.lint.cli import main as lint_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO_ROOT, "tests", "fixtures", "lint")
+PACKAGE = os.path.join(REPO_ROOT, "pipelinedp_tpu")
+
+# Minimum finding count per rule in its bad fixture (each fixture contains
+# several distinct violation shapes).
+MIN_BAD_FINDINGS = {
+    "DPL001": 3,  # double draw, loop reuse, double hand-off
+    "DPL002": 1,
+    "DPL003": 4,  # .item(), traced branch, np-on-traced, float()
+    "DPL004": 3,  # np.random x2, stdlib random
+    "DPL005": 5,  # eps=-1, delta=1.5, eps=0, eps/2, 0.5*delta
+    "DPL006": 1,
+}
+ALL_RULE_IDS = sorted(MIN_BAD_FINDINGS)
+
+
+def fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def findings_for(path: str, rule_id=None):
+    result = lint_paths([path], root=REPO_ROOT)
+    assert result.parse_errors == []
+    if rule_id is None:
+        return result.findings
+    return [f for f in result.findings if f.rule_id == rule_id]
+
+
+class TestRuleFixtures:
+
+    @pytest.mark.parametrize("rule_id", ALL_RULE_IDS)
+    def test_bad_fixture_triggers(self, rule_id):
+        path = fixture(f"{rule_id.lower()}_bad.py")
+        found = findings_for(path, rule_id)
+        assert len(found) >= MIN_BAD_FINDINGS[rule_id], (
+            f"{rule_id} bad fixture produced {len(found)} findings: "
+            f"{[f.format() for f in found]}")
+        for f in found:
+            assert f.line > 0 and f.message
+
+    @pytest.mark.parametrize("rule_id", ALL_RULE_IDS)
+    def test_good_fixture_clean_under_every_rule(self, rule_id):
+        path = fixture(f"{rule_id.lower()}_good.py")
+        found = findings_for(path)
+        assert found == [], [f.format() for f in found]
+
+
+class TestKeyReuseSpecifics:
+
+    def _lint_source(self, tmp_path, source):
+        mod = tmp_path / "mod.py"
+        mod.write_text(source)
+        return findings_for(str(mod), "DPL001")
+
+    def test_exclusive_branches_do_not_conflict(self, tmp_path):
+        src = ("import jax\n"
+               "def f(key, g):\n"
+               "    if g:\n"
+               "        return jax.random.uniform(key, ())\n"
+               "    return jax.random.bits(key, ())\n")
+        assert self._lint_source(tmp_path, src) == []
+
+    def test_consumption_after_branch_consumption_flags(self, tmp_path):
+        src = ("import jax\n"
+               "def f(key, g):\n"
+               "    if g:\n"
+               "        a = jax.random.uniform(key, ())\n"
+               "    return jax.random.bits(key, ())\n")
+        found = self._lint_source(tmp_path, src)
+        assert len(found) == 1 and found[0].line == 5
+
+    def test_reassignment_resets(self, tmp_path):
+        src = ("import jax\n"
+               "def f(key):\n"
+               "    a = jax.random.uniform(key, ())\n"
+               "    key = jax.random.split(key)[0]\n"
+               "    return a + jax.random.uniform(key, ())\n")
+        assert self._lint_source(tmp_path, src) == []
+
+    def test_keystream_idiom_is_blessed(self, tmp_path):
+        src = ("import jax\n"
+               "from pipelinedp_tpu.jax_engine import KeyStream\n"
+               "def f(key, n):\n"
+               "    out = []\n"
+               "    for i in range(n):\n"
+               "        out.append(jax.random.uniform("
+               "KeyStream.derive(key, i), ()))\n"
+               "    return out\n")
+        assert self._lint_source(tmp_path, src) == []
+
+    def test_dict_keys_named_key_ignored(self, tmp_path):
+        src = ("def f(vocab, items):\n"
+               "    for key in items:\n"
+               "        vocab.setdefault(key, len(vocab))\n"
+               "        vocab.lookup(key)\n"
+               "    return vocab\n")
+        assert self._lint_source(tmp_path, src) == []
+
+
+class TestSuppressions:
+
+    BAD = "def f(run):\n    return run(eps=-1.0)\n"
+
+    def _lint_file(self, tmp_path, source):
+        mod = tmp_path / "mod.py"
+        mod.write_text(source)
+        return lint_paths([str(mod)], root=str(tmp_path))
+
+    def test_same_line_suppression(self, tmp_path):
+        src = ("def f(run):\n"
+               "    return run(eps=-1.0)  # dplint: disable=DPL005 — test\n")
+        result = self._lint_file(tmp_path, src)
+        assert result.findings == []
+        assert [f.rule_id for f in result.suppressed] == ["DPL005"]
+
+    def test_comment_line_above_suppression(self, tmp_path):
+        src = ("def f(run):\n"
+               "    # dplint: disable=DPL005 — justified\n"
+               "    return run(eps=-1.0)\n")
+        result = self._lint_file(tmp_path, src)
+        assert result.findings == []
+        assert len(result.suppressed) == 1
+
+    def test_file_level_suppression(self, tmp_path):
+        src = "# dplint: disable-file=DPL005\n" + self.BAD
+        result = self._lint_file(tmp_path, src)
+        assert result.findings == []
+
+    def test_disable_all(self, tmp_path):
+        src = ("def f(run):\n"
+               "    return run(eps=-1.0)  # dplint: disable=all\n")
+        result = self._lint_file(tmp_path, src)
+        assert result.findings == []
+
+    def test_wrong_rule_id_does_not_suppress(self, tmp_path):
+        src = ("def f(run):\n"
+               "    return run(eps=-1.0)  # dplint: disable=DPL001\n")
+        result = self._lint_file(tmp_path, src)
+        assert [f.rule_id for f in result.findings] == ["DPL005"]
+
+
+class TestBaseline:
+
+    BAD = "def f(run):\n    return run(eps=-1.0)\n"
+    MORE = "\n\ndef g(run):\n    return run(delta=2.0)\n"
+
+    def test_round_trip_and_ratchet(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        (tmp_path / "mod.py").write_text(self.BAD)
+        assert lint_main(["mod.py"]) == 1
+        assert lint_main(["mod.py", "--baseline", "b.json",
+                         "--write-baseline"]) == 0
+        # Baselined: clean exit.
+        assert lint_main(["mod.py", "--baseline", "b.json"]) == 0
+        # A new violation is not masked by the baseline.
+        (tmp_path / "mod.py").write_text(self.BAD + self.MORE)
+        assert lint_main(["mod.py", "--baseline", "b.json"]) == 1
+
+    def test_fingerprints_survive_line_shifts(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        (tmp_path / "mod.py").write_text(self.BAD)
+        assert lint_main(["mod.py", "--baseline", "b.json",
+                         "--write-baseline"]) == 0
+        (tmp_path / "mod.py").write_text("# pushed down two lines\n\n" +
+                                         self.BAD)
+        assert lint_main(["mod.py", "--baseline", "b.json"]) == 0
+
+    def test_duplicate_violations_need_two_entries(self, tmp_path,
+                                                   monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        one = "def f(run):\n    return run(eps=-1.0)\n"
+        (tmp_path / "mod.py").write_text(one)
+        assert lint_main(["mod.py", "--baseline", "b.json",
+                         "--write-baseline"]) == 0
+        # The same violation line appearing twice: one occurrence is
+        # baselined, the second is new.
+        (tmp_path / "mod.py").write_text(
+            one + "\n\ndef g(run):\n    return run(eps=-1.0)\n")
+        assert lint_main(["mod.py", "--baseline", "b.json"]) == 1
+
+    def test_default_baseline_discovery(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        (tmp_path / "mod.py").write_text(self.BAD)
+        assert lint_main(["mod.py", "--baseline",
+                          lint_engine.os.path.join(str(tmp_path),
+                                                   "dplint-baseline.json"),
+                          "--write-baseline"]) == 0
+        # No --baseline flag: ./dplint-baseline.json is picked up.
+        assert lint_main(["mod.py"]) == 0
+
+
+class TestCli:
+
+    def test_exit_zero_on_clean_file(self):
+        assert lint_main([fixture("dpl005_good.py"), "--no-baseline"]) == 0
+
+    def test_exit_one_on_findings(self):
+        assert lint_main([fixture("dpl005_bad.py"), "--no-baseline"]) == 1
+
+    def test_exit_two_on_missing_path(self):
+        assert lint_main(["definitely/not/a/path.py"]) == 2
+
+    def test_exit_two_on_unknown_rule(self):
+        assert lint_main([fixture("dpl005_bad.py"), "--rules", "DPL999"]) == 2
+
+    def test_list_rules(self, capsys):
+        assert lint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule_id in ALL_RULE_IDS:
+            assert rule_id in out
+
+    def test_rule_filter(self):
+        # dpl005_bad has only DPL005 violations; filtering to DPL001 is
+        # clean.
+        assert lint_main([fixture("dpl005_bad.py"), "--rules", "DPL001",
+                          "--no-baseline"]) == 0
+
+    def test_json_format(self, capsys):
+        import json
+        assert lint_main([fixture("dpl006_bad.py"), "--format", "json",
+                          "--no-baseline"]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload and payload[0]["rule"] == "DPL006"
+        assert payload[0]["line"] > 0
+
+    def test_module_entry_point_subprocess(self):
+        """Acceptance: `python -m pipelinedp_tpu.lint` exits 0 on the
+        shipped tree and nonzero on a violating fixture."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        clean = subprocess.run(
+            [sys.executable, "-m", "pipelinedp_tpu.lint",
+             "pipelinedp_tpu"],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+            timeout=300)
+        assert clean.returncode == 0, clean.stdout + clean.stderr
+        bad = subprocess.run(
+            [sys.executable, "-m", "pipelinedp_tpu.lint",
+             fixture("dpl004_bad.py"), "--no-baseline"],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+            timeout=300)
+        assert bad.returncode == 1, bad.stdout + bad.stderr
+        assert "DPL004" in bad.stdout
+
+
+class TestEngineInternals:
+
+    def test_module_name_anchors_at_package(self):
+        assert lint_engine.module_name(
+            "pipelinedp_tpu/ops/noise.py") == "pipelinedp_tpu.ops.noise"
+        assert lint_engine.module_name(
+            "src/pipelinedp_tpu/lint/__init__.py") == "pipelinedp_tpu.lint"
+        assert lint_engine.module_name(
+            "tests/fixtures/lint/dpl001_bad.py") == \
+            "tests.fixtures.lint.dpl001_bad"
+
+    def test_finding_format(self):
+        f = lint_engine.Finding("DPL001", "a/b.py", 3, 7, "msg", "do this")
+        assert f.format() == "a/b.py:3:7: DPL001 msg"
+        assert "hint: do this" in f.format(verbose=True)
+
+    def test_parse_error_reported_not_crashing(self, tmp_path):
+        bad = tmp_path / "broken.py"
+        bad.write_text("def f(:\n")
+        result = lint_paths([str(bad)], root=str(tmp_path))
+        assert len(result.parse_errors) == 1
+        assert result.parse_errors[0].rule_id == "DPL000"
+
+
+class TestProductionTreeGate:
+    """The CI lint job: a new DPL violation in pipelinedp_tpu/ fails here."""
+
+    def test_production_tree_is_clean(self):
+        result = lint_paths([PACKAGE], root=REPO_ROOT)
+        assert result.parse_errors == []
+        assert result.findings == [], "\n".join(
+            f.format(verbose=True) for f in result.findings)
+
+    def test_shipped_baseline_is_empty(self):
+        baseline = lint_engine.load_baseline(
+            os.path.join(REPO_ROOT, "dplint-baseline.json"))
+        assert sum(baseline.values()) == 0
