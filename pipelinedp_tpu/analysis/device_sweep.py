@@ -212,7 +212,7 @@ def _mesh_metric_kernel(mesh, padded_p: int, metric_kind: str):
         return (sharded._reduce_scatter(raw, scatter_axes),
                 sharded._reduce_scatter(grids, scatter_axes))
 
-    fn = jax.shard_map(local_step,
+    fn = sharded.shard_map(local_step,
                        mesh=mesh,
                        in_specs=(sharded._spec(mesh),) * 4 + (P(),) * 3,
                        out_specs=(sharded._part_spec(mesh),) * 2,
@@ -235,7 +235,7 @@ def _mesh_moment_kernel(mesh, padded_p: int):
                                    num_segments=padded_p)  # [P, 3, C]
         return sharded._reduce_scatter(sums, scatter_axes)
 
-    fn = jax.shard_map(local_step,
+    fn = sharded.shard_map(local_step,
                        mesh=mesh,
                        in_specs=(sharded._spec(mesh),) * 2 + (P(),),
                        out_specs=sharded._part_spec(mesh),
@@ -291,7 +291,7 @@ def _mesh_report_kernel(mesh, n_buckets_p1: int, with_keep_sums: bool):
         return sums, ksums
 
     part = sharded._part_spec(mesh)
-    fn = jax.shard_map(
+    fn = sharded.shard_map(
         local_step,
         mesh=mesh,
         in_specs=(part, part, P(), part, part),
@@ -431,12 +431,15 @@ class DeviceSweep:
             ]
         _, jnp = _jnp()
         kernel = _kernels()[3]
-        # Chunk by the SINGLE-metric footprint: the fused kernel's metric
-        # blocks are data-independent and written sequentially, so XLA's
-        # buffer assignment reuses the big [4, C, G] intermediates between
-        # them (worst case — no reuse — is len(metrics) x ~2 GB at the
-        # benchmark shape, still well inside one v5e chip's HBM).
-        step = self._config_chunk(self.n_groups)
+        # Chunk by the FUSED footprint — the single-metric element count
+        # times the metric count. XLA's buffer assignment usually reuses
+        # the big [4, C, G] intermediates between the kernel's
+        # data-independent metric blocks, but the admitted worst case (no
+        # reuse) is len(metric_kinds) x the single-metric peak, which
+        # OOMed smaller-HBM accelerators when chunking ignored the metric
+        # count. Dividing the budget by len(metric_kinds) keeps the
+        # worst case inside the same envelope as add_metric.
+        step = self._config_chunk(self.n_groups * len(metric_kinds))
         parts = [[] for _ in metric_kinds]
         raws = [None] * len(metric_kinds)
         lo_arr = np.asarray(los, dtype=np.float32)

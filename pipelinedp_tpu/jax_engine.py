@@ -491,17 +491,24 @@ class JaxDPEngine:
 
         if partitions is not None:
             partitions = list(partitions)
+            # Count the partitions from the USER-PROVIDED list before any
+            # vocabulary translation: the exponential-mechanism scoring
+            # must see every public partition, including keys with no data
+            # (DPEngine parity — translating first silently dropped
+            # unknown keys and deflated number_of_partitions).
+            number_of_partitions = len(
+                np.unique(encoding._column_from_list(partitions)))
             if (isinstance(col, encoding.EncodedColumns)
                     and col.pk_keys is not None):
                 # EncodedColumns pk are dense ids; `partitions` arrives as
                 # user-facing keys — translate through the vocabulary so
-                # the filter compares ids to ids.
+                # the filter compares ids to ids (keys absent from the
+                # vocabulary cannot match any data row).
                 id_of_key = {k: i for i, k in enumerate(col.pk_keys)}
                 partitions = [id_of_key[p] for p in partitions
                               if p in id_of_key]
             partition_keys = np.unique(
                 encoding._column_from_list(partitions))
-            number_of_partitions = len(partition_keys)
             if not partitions_already_filtered:
                 mask = np.isin(pk, partition_keys)
                 pid, pk = pid[mask], pk[mask]

@@ -114,7 +114,7 @@ def load() -> Optional[ctypes.CDLL]:
 def load_row_packer() -> Optional[ctypes.CDLL]:
     """The row bucketing/packing library; None on failure."""
     lib = _load_lib("row_packer", "pdp_row_packer_abi_version",
-                    abi_version=4)
+                    abi_version=5)
     if lib is not None and not getattr(lib, "_pdp_typed", False):
         fn = lib.pdp_rle_prep
         fn.restype = ctypes.c_void_p
@@ -129,6 +129,8 @@ def load_row_packer() -> Optional[ctypes.CDLL]:
             ctypes.c_int32,  # pid_lo
             ctypes.c_int64,  # k buckets
             ctypes.c_int,  # value_mode
+            ctypes.c_int64,  # pid_span (for exact entry counting)
+            ctypes.POINTER(ctypes.c_int64),  # n_entries out (or NULL)
             ctypes.POINTER(ctypes.c_int64),  # n_rows out
             ctypes.POINTER(ctypes.c_int64),  # stats out [fail, max_idx]
         ]
@@ -146,7 +148,9 @@ def load_row_packer() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p,  # handle
             ctypes.c_int64,  # b0
             ctypes.c_int64,  # b1
+            ctypes.c_int,  # pid_mode (0 RLE, 1 unsorted bit-planes)
             ctypes.c_int,  # bytes_pid
+            ctypes.c_int,  # bits_pid (planes mode)
             ctypes.c_int,  # bits_pk
             ctypes.c_int,  # bits_val
             ctypes.c_int64,  # cap

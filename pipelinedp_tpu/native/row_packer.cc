@@ -190,13 +190,20 @@ int pdp_pack_buckets(const int32_t* pid, const int32_t* pk,
 // Three-call API so the per-slab encode can overlap the previous slab's
 // async host->device transfer (ops/streaming.py drives it):
 //   pdp_rle_prep        one pass: bucket rows (pid-hash, same bucketing as
-//                       pdp_pack_buckets) into bucket-major SoA temps.
+//                       pdp_pack_buckets) into bucket-major SoA temps, and
+//                       (span permitting) exact per-bucket RLE entry
+//                       counts — so the wire format is known BEFORE any
+//                       sorting and the sort can pipeline per slab.
 //   pdp_rle_sort_range  per bucket: LSD radix sort by shifted pid (stable,
 //                       byte passes only up to the bucket's max id) +
-//                       exact RLE entry counts. The expensive step.
+//                       exact RLE entry counts. The expensive step —
+//                       callers interleave it slab-by-slab with emit +
+//                       device_put so it hides behind transfer + kernel.
 //   pdp_rle_emit_range  per bucket: write one flat slab row =
 //                       [uniq ids | uint16 run lengths | pk bit-planes |
-//                       value planes/raw], runs split at 65535.
+//                       value planes/raw], runs split at 65535 — or, in
+//                       pid_mode 1, unsorted pid bit-planes (no host sort;
+//                       the device kernel sorts anyway).
 //   pdp_rle_free        release the state.
 //
 // Bit-planes are LSB-first: plane j, byte r>>3, bit r&7 = bit j of row r.
@@ -208,6 +215,14 @@ int pdp_pack_buckets(const int32_t* pid, const int32_t* pk,
 namespace {
 
 constexpr int64_t kRunSplit = 65535;
+
+// Largest (pid_span + 1) for which prep builds the per-pid count table
+// that yields exact RLE entry counts BEFORE any sorting (the count table
+// is 4 bytes per id in the span). Knowing the entry counts up front lets
+// the caller fix the wire format immediately and pipeline the per-bucket
+// radix sort behind the transfers instead of running it all up front
+// (ops/streaming.py drives this).
+constexpr int64_t kMaxEntryCountSpan = int64_t{1} << 26;
 
 struct RleState {
   int64_t n = 0;
@@ -372,9 +387,18 @@ extern "C" {
 // float32 reconstruction the device performs. stats[0] is set to 1 (and
 // nullptr returned) if any row fails verification or leaves [0, 2^20);
 // stats[1] returns the maximum index (for the bit-width of the planes).
+//
+// pid_span / n_entries: when n_entries is non-null and the shifted pid
+// span fits the count-table budget, n_entries[b] receives the EXACT
+// post-sort RLE entry count of bucket b (sum of ceil(rows_per_pid /
+// 65535) over the bucket's pids — a pid maps to exactly one bucket, so
+// this equals what pdp_rle_sort_range will report), computed without
+// sorting. Otherwise n_entries[0] is set to -1 and the caller falls back
+// to learning entry counts from the upfront sort.
 void* pdp_rle_prep(const int32_t* pid, const int32_t* pk, const float* value,
                    const int32_t* vidx, double v_lo, double v_scale,
                    int64_t n, int32_t pid_lo, int64_t k, int value_mode,
+                   int64_t pid_span, int64_t* n_entries,
                    int64_t* n_rows, int64_t* stats) {
   if (!pid || !pk || !n_rows || !stats || n < 0 || k <= 0) return nullptr;
   const bool inline_vidx = value_mode == 1 && vidx == nullptr;
@@ -392,13 +416,36 @@ void* pdp_rle_prep(const int32_t* pid, const int32_t* pk, const float* value,
   st->bucket_start.assign(k + 1, 0);
   st->sorted.assign(k, 0);
   // Pass 1: counts per (bucket, pid low byte) — the sub-cursor table that
-  // makes the scatter double as radix pass 0.
+  // makes the scatter double as radix pass 0 — plus (when the span fits
+  // the budget) a per-pid count table for the exact RLE entry counts.
+  const bool count_entries =
+      n_entries != nullptr && pid_span >= 0 &&
+      pid_span + 1 <= kMaxEntryCountSpan &&
+      n <= static_cast<int64_t>(UINT32_MAX) / 2;
+  std::vector<uint32_t> pid_count;
+  if (count_entries) pid_count.assign(pid_span + 1, 0);
   std::vector<int64_t> sub(k * 256, 0);
   for (int64_t i = 0; i < n; ++i) {
     const uint32_t spid = static_cast<uint32_t>(pid[i] - pid_lo);
     sub[(static_cast<int64_t>(BucketOf(pid[i] - pid_lo,
                                        static_cast<uint32_t>(k)))
          << 8) | (spid & 0xff)]++;
+    if (count_entries) pid_count[spid]++;
+  }
+  if (n_entries != nullptr) {
+    if (count_entries) {
+      for (int64_t b = 0; b < k; ++b) n_entries[b] = 0;
+      for (int64_t s = 0; s <= pid_span; ++s) {
+        const uint32_t c = pid_count[s];
+        if (c) {
+          n_entries[BucketOf(static_cast<int32_t>(s),
+                             static_cast<uint32_t>(k))] +=
+              (c + kRunSplit - 1) / kRunSplit;
+        }
+      }
+    } else {
+      n_entries[0] = -1;
+    }
   }
   {
     int64_t acc = 0;
@@ -476,21 +523,28 @@ int pdp_rle_sort_range(void* handle, int64_t b0, int64_t b1,
   return 0;
 }
 
-// out: [b1-b0, width] flat slab rows; width must match the layout
-// ucap*bytes_pid + ucap*2 + bits_pk*cap/8 + value bytes.
-int pdp_rle_emit_range(void* handle, int64_t b0, int64_t b1, int bytes_pid,
+// out: [b1-b0, width] flat slab rows.
+// pid_mode 0 (RLE): buckets must be sorted; width = ucap*bytes_pid +
+//   ucap*2 + bits_pk*cap/8 + value bytes.
+// pid_mode 1 (bit-planes): pids ship as bits_pid planes in arrival order —
+//   NO host sort required (the device kernel sorts anyway); width =
+//   bits_pid*cap/8 + bits_pk*cap/8 + value bytes, and ucap is ignored.
+int pdp_rle_emit_range(void* handle, int64_t b0, int64_t b1, int pid_mode,
+                       int bytes_pid, int bits_pid,
                        int bits_pk, int bits_val, int64_t cap, int64_t ucap,
                        uint8_t* out, int64_t width) {
   auto* st = static_cast<RleState*>(handle);
+  const bool planes = pid_mode == 1;
   if (!st || !out || b0 < 0 || b1 > st->k || b0 > b1 || cap < 8 ||
-      (cap % 8) != 0 || bytes_pid < 1 || bytes_pid > 4 || bits_pk < 1 ||
-      bits_pk > 31 || ucap < 1) {
+      (cap % 8) != 0 || bits_pk < 1 || bits_pk > 31 ||
+      (planes ? (bits_pid < 1 || bits_pid > 31)
+              : (bytes_pid < 1 || bytes_pid > 4 || ucap < 1))) {
     return 1;
   }
   if (st->value_mode == 1 && (bits_val < 1 || bits_val > 31)) return 1;
   const int64_t cap8 = cap / 8;
-  const int64_t o_cnt = ucap * bytes_pid;
-  const int64_t o_pk = o_cnt + ucap * 2;
+  const int64_t o_cnt = planes ? bits_pid * cap8 : ucap * bytes_pid;
+  const int64_t o_pk = planes ? o_cnt : o_cnt + ucap * 2;
   const int64_t o_val = o_pk + bits_pk * cap8;
   int64_t want = o_val;
   if (st->value_mode == 1) want += bits_val * cap8;
@@ -502,40 +556,48 @@ int pdp_rle_emit_range(void* handle, int64_t b0, int64_t b1, int bytes_pid,
   RunPool(b0, b1, [&](int64_t b) {
     const int64_t s = st->bucket_start[b];
     const int64_t m = st->bucket_start[b + 1] - s;
-    if (!st->sorted[b] || m > cap) {
+    if ((!planes && !st->sorted[b]) || m > cap) {
       rc.store(2);
       return;
     }
     uint8_t* row = out + (b - b0) * width;
     std::memset(row, 0, width);
-    // RLE of the sorted pid column.
-    int64_t entries = 0, run = 0;
-    uint32_t prev = 0;
-    auto flush = [&](uint32_t id, int64_t len) {
-      if (entries >= ucap) {
-        rc.store(3);
-        return false;
+    if (planes) {
+      // Arrival-order pid planes (shifted ids < 2^bits_pid).
+      PackPlanes(reinterpret_cast<const int32_t*>(&st->tpid[s]), m,
+                 bits_pid, cap8, row);
+    } else {
+      // RLE of the sorted pid column.
+      int64_t entries = 0, run = 0;
+      uint32_t prev = 0;
+      auto flush = [&](uint32_t id, int64_t len) {
+        if (entries >= ucap) {
+          rc.store(3);
+          return false;
+        }
+        uint8_t* u = row + entries * bytes_pid;
+        for (int bb = 0; bb < bytes_pid; ++bb) {
+          u[bb] = (id >> (8 * bb)) & 0xff;
+        }
+        row[o_cnt + entries * 2] = len & 0xff;
+        row[o_cnt + entries * 2 + 1] = (len >> 8) & 0xff;
+        ++entries;
+        return true;
+      };
+      for (int64_t i = 0; i < m; ++i) {
+        const uint32_t id = st->tpid[s + i];
+        if (i == 0) {
+          prev = id;
+          run = 0;
+        } else if (id != prev || run == kRunSplit) {
+          if (!flush(prev, run)) return;
+          prev = id;
+          run = 0;
+        }
+        ++run;
       }
-      uint8_t* u = row + entries * bytes_pid;
-      for (int bb = 0; bb < bytes_pid; ++bb) u[bb] = (id >> (8 * bb)) & 0xff;
-      row[o_cnt + entries * 2] = len & 0xff;
-      row[o_cnt + entries * 2 + 1] = (len >> 8) & 0xff;
-      ++entries;
-      return true;
-    };
-    for (int64_t i = 0; i < m; ++i) {
-      const uint32_t id = st->tpid[s + i];
-      if (i == 0) {
-        prev = id;
-        run = 0;
-      } else if (id != prev || run == kRunSplit) {
-        if (!flush(prev, run)) return;
-        prev = id;
-        run = 0;
-      }
-      ++run;
+      if (m > 0 && !flush(prev, run)) return;
     }
-    if (m > 0 && !flush(prev, run)) return;
     // pk planes, then the value column.
     PackPlanes(&st->tpk[s], m, bits_pk, cap8, row + o_pk);
     if (st->value_mode == 1) {
@@ -556,6 +618,6 @@ int pdp_rle_emit_range(void* handle, int64_t b0, int64_t b1, int bytes_pid,
 
 void pdp_rle_free(void* handle) { delete static_cast<RleState*>(handle); }
 
-int pdp_row_packer_abi_version() { return 4; }
+int pdp_row_packer_abi_version() { return 5; }
 
 }  // extern "C"

@@ -39,6 +39,17 @@ uses an exact uniform tiebreak as before.
 
 All shapes static; caps and clip bounds are runtime scalars. Padding rows
 (for sharding) carry valid=False and are routed to the end of the sort.
+
+Pre-sorted ingest (pid_sorted=True): the wire codec delivers rows already
+sorted by privacy id within each bucket (ops/wirecodec.py RLE requires it),
+so the arrival order IS the primary sort key. The presorted sampler packs
+(dense pid-segment index, group_hash, pk, random tiebreak) into THREE
+uint32 keys (bit-concatenated, so the 3-key comparison is exactly the
+4-field lexicographic order) and carries the value as the only payload —
+4 sort operands instead of the general path's 7, and validity becomes
+positional (padding is a suffix, so no valid or order operands ride the
+sort). Same sampling distribution, cheaper sort: this is the ~2x-headroom
+item of BASELINE.md's round-4 floor analysis.
 """
 
 from __future__ import annotations
@@ -77,7 +88,7 @@ class SampledRows(NamedTuple):
     (scalar, vector, row-mask) derives from this so their sampling stays
     bit-identical for the same PRNG key.
     """
-    order: jnp.ndarray  # row permutation into sorted order
+    order: Optional[jnp.ndarray]  # row permutation (None when not needed)
     spid: jnp.ndarray  # sorted pid keys (padding -> INT32_MAX)
     spk: jnp.ndarray  # sorted pk keys (padding -> INT32_MAX)
     svalid: jnp.ndarray  # sorted validity
@@ -126,8 +137,8 @@ def _l1_sample_mask(key: jax.Array, pid: jnp.ndarray, valid: jnp.ndarray,
 def _sample_rows_and_groups(key: jax.Array, pid: jnp.ndarray,
                             pk: jnp.ndarray, valid: jnp.ndarray, linf_cap,
                             l0_cap, l1_cap=None,
-                            value: Optional[jnp.ndarray] = None
-                            ) -> SampledRows:
+                            value: Optional[jnp.ndarray] = None,
+                            need_order: bool = True) -> SampledRows:
     """ONE sort of rows by (pid, group_hash, pk, uniform); samples Linf
     rows and L0 groups from it (module docstring steps 1-3).
 
@@ -158,9 +169,14 @@ def _sample_rows_and_groups(key: jax.Array, pid: jnp.ndarray,
     tiebreak = jax.random.uniform(k1, (n,))
     # One variadic sort carries every payload along: on TPU the sort moves
     # data far cheaper than post-hoc random-access gathers (a single 100M
-    # gather costs more than the whole 4-key sort).
-    operands = [pid_key, ghash, pk_key, tiebreak, valid,
-                jnp.arange(n, dtype=jnp.int32)]
+    # gather costs more than the whole 4-key sort). The order payload rides
+    # only for callers that map decisions back to input order (row-mask,
+    # vector gather) — the scalar aggregation never reads it, and dropping
+    # the operand cannot change the permutation (is_stable fixes tie
+    # resolution from the keys alone).
+    operands = [pid_key, ghash, pk_key, tiebreak, valid]
+    if need_order:
+        operands.append(jnp.arange(n, dtype=jnp.int32))
     if value is not None:
         operands.append(value)
     # is_stable: float32 tiebreak collisions must resolve identically in
@@ -169,8 +185,9 @@ def _sample_rows_and_groups(key: jax.Array, pid: jnp.ndarray,
     # rows differently between the two programs, breaking the replayed
     # sampling guarantee).
     sorted_ops = jax.lax.sort(operands, num_keys=4, is_stable=True)
-    spid, sgh, spk, _, svalid, order = sorted_ops[:6]
-    sval = sorted_ops[6] if value is not None else None
+    spid, sgh, spk, _, svalid = sorted_ops[:5]
+    order = sorted_ops[5] if need_order else None
+    sval = sorted_ops[-1] if value is not None else None
     is_start = jnp.concatenate([
         jnp.ones((1,), dtype=bool),
         (spid[1:] != spid[:-1]) | (sgh[1:] != sgh[:-1]) |
@@ -192,10 +209,183 @@ def _sample_rows_and_groups(key: jax.Array, pid: jnp.ndarray,
                        keep_row, keep_group_row, sval)
 
 
+# -- presorted-pid fast path -------------------------------------------------
+#
+# Minimum random tiebreak bits for the packed-key sort. Ties fall back to
+# stable (arrival) order like the general path's float32 tiebreak ties; 8
+# bits would make ties common, so below this the presorted path refuses and
+# the caller falls back to the general 4-key sort.
+_MIN_RAND_BITS = 12
+_KEY_BITS = 96  # three uint32 sort keys
+
+
+def presorted_fits(n: int, num_partitions: int,
+                   max_segments: Optional[int] = None) -> bool:
+    """Whether the packed 3-key presorted sort has enough bits for the
+    (segment, ghash, pk, rand) fields at this shape."""
+    seg_cap = int(max_segments) if max_segments is not None else int(n)
+    segbits = max(1, seg_cap.bit_length())
+    pkbits = max(1, int(max(num_partitions - 1, 0)).bit_length())
+    return segbits + 32 + pkbits + _MIN_RAND_BITS <= _KEY_BITS
+
+
+def _pack_key_bits(fields) -> list:
+    """Concatenates (uint32 array, nbits) fields MSB-first into uint32 keys.
+
+    Lexicographic comparison of the returned key list equals lexicographic
+    comparison of the field tuple (bit concatenation preserves order).
+    Total bits must not exceed _KEY_BITS; a trailing partial key is
+    left-aligned (zero-padded on the right, same order).
+    """
+    keys = []
+    acc = None
+    filled = 0
+    for arr, nbits in fields:
+        arr = arr.astype(jnp.uint32)
+        remaining = nbits
+        while remaining > 0:
+            if acc is None:
+                acc = jnp.zeros(arr.shape, dtype=jnp.uint32)
+                filled = 0
+            take = min(32 - filled, remaining)
+            part = (arr >> jnp.uint32(remaining - take)) & jnp.uint32(
+                (1 << take) - 1)
+            acc = (acc << jnp.uint32(take)) | part if filled else part
+            filled += take
+            remaining -= take
+            if filled == 32:
+                keys.append(acc)
+                acc = None
+    if acc is not None:
+        keys.append(acc << jnp.uint32(32 - filled))
+    return keys
+
+
+def _extract_key_bits(keys, start: int, nbits: int) -> jnp.ndarray:
+    """Reads bit field [start, start+nbits) back out of packed keys.
+
+    Bit 0 is the MSB of keys[0] (the packing order of _pack_key_bits).
+    nbits must be < 32.
+    """
+    out = None
+    end = start + nbits
+    for i, kk in enumerate(keys):
+        k_lo, k_hi = 32 * i, 32 * i + 32
+        lo, hi = max(start, k_lo), min(end, k_hi)
+        if lo >= hi:
+            continue
+        part = (kk >> jnp.uint32(k_hi - hi)) & jnp.uint32(
+            (1 << (hi - lo)) - 1)
+        out = part if out is None else (out << jnp.uint32(hi - lo)) | part
+    return out
+
+
+def _prefix_changed(keys, prefix_bits: int) -> jnp.ndarray:
+    """bool[n]: row's first prefix_bits differ from the previous row's
+    (row 0 -> True). Used to find group/pid boundaries in packed-key
+    sorted order without re-deriving the fields."""
+    changed = None
+    remaining = prefix_bits
+    for kk in keys:
+        if remaining <= 0:
+            break
+        if remaining >= 32:
+            part = kk
+        else:
+            part = kk >> jnp.uint32(32 - remaining)
+        c = part[1:] != part[:-1]
+        changed = c if changed is None else (changed | c)
+        remaining -= 32
+    return jnp.concatenate([jnp.ones((1,), dtype=bool), changed])
+
+
+def _sample_rows_and_groups_presorted(key: jax.Array, pid: jnp.ndarray,
+                                      pk: jnp.ndarray, valid: jnp.ndarray,
+                                      linf_cap, l0_cap, *,
+                                      num_partitions: int,
+                                      max_segments: int,
+                                      value: Optional[jnp.ndarray] = None,
+                                      need_order: bool = False
+                                      ) -> SampledRows:
+    """The presorted-ingest twin of _sample_rows_and_groups.
+
+    Contract (guaranteed structurally by wirecodec.decode_bucket):
+      * valid is a prefix mask (valid == iota < n_valid);
+      * pid is nondecreasing over the valid prefix;
+      * the number of distinct pids among valid rows is <= max_segments.
+
+    Because arrival order is already pid-major, the privacy id never rides
+    the sort: rows get a dense pid-segment index (one cumsum), and
+    (segment, group_hash, pk, random tiebreak) are bit-packed into three
+    uint32 keys whose 3-key lexicographic comparison equals the general
+    path's 4-field order. The value is the only payload, so the sort moves
+    4 operands instead of 7. Validity is positional after the sort
+    (padding keys are all-ones, strictly above any valid key), and ghash
+    collisions resolve exactly like the general path: equal (seg, ghash)
+    keys compare by the pk field, then the tiebreak, then stable order.
+
+    Returned SampledRows: spid holds the segment index (the kernels only
+    use pid equality structure); order is None unless need_order.
+    """
+    n = pid.shape[0]
+    k1, k2 = jax.random.split(key)
+    salt = jax.random.bits(k2, (), dtype=jnp.uint32)
+    ghash = _group_hash(pid, pk, salt)
+
+    segbits = max(1, int(max_segments).bit_length())
+    pkbits = max(1, int(max(num_partitions - 1, 0)).bit_length())
+    randbits = min(32, _KEY_BITS - segbits - 32 - pkbits)
+    padbits = _KEY_BITS - segbits - 32 - pkbits - randbits
+
+    is_new_pid = valid & jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), pid[1:] != pid[:-1]])
+    seg = jnp.maximum(jnp.cumsum(is_new_pid.astype(jnp.int32)) - 1,
+                      0).astype(jnp.uint32)
+    rand = jax.random.bits(k1, (n,), dtype=jnp.uint32)
+    if randbits < 32:
+        rand = rand >> jnp.uint32(32 - randbits)
+    fields = [(seg, segbits), (ghash, 32),
+              (pk.astype(jnp.uint32), pkbits), (rand, randbits)]
+    if padbits:
+        fields.append((jnp.zeros((n,), dtype=jnp.uint32), padbits))
+    keys = _pack_key_bits(fields)
+    # Padding rows sort strictly last: all-ones keys, and a valid row's
+    # segment field is <= max_segments - 1 < 2^segbits - 1.
+    ones = jnp.uint32(0xFFFFFFFF)
+    keys = [jnp.where(valid, kk, ones) for kk in keys]
+
+    operands = list(keys)
+    if value is not None:
+        operands.append(value)
+    if need_order:
+        operands.append(jnp.arange(n, dtype=jnp.int32))
+    sorted_ops = jax.lax.sort(operands, num_keys=3, is_stable=True)
+    skeys = sorted_ops[:3]
+    sval = sorted_ops[3] if value is not None else None
+    order = sorted_ops[-1] if need_order else None
+
+    n_valid = jnp.sum(valid.astype(jnp.int32))
+    svalid = jnp.arange(n, dtype=jnp.int32) < n_valid
+    sseg = _extract_key_bits(skeys, 0, segbits).astype(jnp.int32)
+    spk = _extract_key_bits(skeys, segbits + 32, pkbits).astype(jnp.int32)
+
+    is_start = _prefix_changed(skeys, segbits + 32 + pkbits)
+    keep_row = svalid & (_segment_rank(is_start) < linf_cap)
+    group_id = (jnp.cumsum(is_start) - 1).astype(jnp.int32)
+    is_pid_start = _prefix_changed(skeys, segbits)
+    first_group_of_pid = jax.lax.cummax(
+        jnp.where(is_pid_start, group_id, 0))
+    group_rank = group_id - first_group_of_pid
+    keep_group_row = svalid & (group_rank < l0_cap)
+    return SampledRows(order, sseg, spk, svalid, is_start, group_id,
+                       keep_row, keep_group_row, sval)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("num_partitions", "need_count",
                                     "need_sum", "need_norm",
-                                    "need_norm_sq", "has_group_clip"))
+                                    "need_norm_sq", "has_group_clip",
+                                    "pid_sorted", "max_segments"))
 def bound_and_aggregate(key: jax.Array,
                         pid: jnp.ndarray,
                         pk: jnp.ndarray,
@@ -215,7 +405,9 @@ def bound_and_aggregate(key: jax.Array,
                         need_sum: bool = True,
                         need_norm: bool = True,
                         need_norm_sq: bool = True,
-                        has_group_clip: bool = True
+                        has_group_clip: bool = True,
+                        pid_sorted: bool = False,
+                        max_segments: Optional[int] = None
                         ) -> PartitionAccumulators:
     """Contribution bounding + per-partition aggregation, fully fused.
 
@@ -233,6 +425,15 @@ def bound_and_aggregate(key: jax.Array,
         the min/max_sum_per_partition mode of SumCombiner.
       l1_cap: max_contributions mode — uniform per-privacy-id total sample
         applied before everything else (pass linf/l0 caps >= data bounds).
+      pid_sorted: the input satisfies the presorted-ingest contract (pid
+        nondecreasing over a valid prefix — see
+        _sample_rows_and_groups_presorted); the sampler then runs the
+        cheaper packed-3-key sort. Same sampling distribution, different
+        draws. Ignored in L1 mode (the L1 pre-sample breaks the
+        prefix-validity invariant).
+      max_segments: static upper bound on distinct pids among valid rows
+        (presorted path only; tightens the packed segment field — the wire
+        decode path passes its RLE entry capacity).
     """
     n = pid.shape[0]
     if n == 0:
@@ -241,8 +442,20 @@ def bound_and_aggregate(key: jax.Array,
         zeros = jnp.zeros((num_partitions,),
                           dtype=jnp.promote_types(value.dtype, jnp.float32))
         return PartitionAccumulators(zeros, zeros, zeros, zeros, zeros)
-    s = _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap,
-                                l1_cap, value=value)
+    # Trace-time dispatch: pid_sorted/max_segments are static and
+    # `l1_cap is None` is a pytree-structure (not value) test — the branch
+    # is deliberately resolved at trace time, like the need_* flags.
+    # dplint: disable=DPL003 — static/structural branch, resolved per compile
+    if (pid_sorted and l1_cap is None
+            and presorted_fits(n, num_partitions, max_segments)):
+        s = _sample_rows_and_groups_presorted(
+            key, pid, pk, valid, linf_cap, l0_cap,
+            num_partitions=num_partitions,
+            max_segments=int(max_segments) if max_segments else n,
+            value=value)
+    else:
+        s = _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap,
+                                    l1_cap, value=value, need_order=False)
     sval = s.sval
 
     # -- rows -> (pid, pk) group accumulators ------------------------------
@@ -395,15 +608,21 @@ def bound_and_aggregate_vector(key: jax.Array,
     return vector_sums, accs
 
 
-@functools.partial(jax.jit)
+@functools.partial(jax.jit,
+                   static_argnames=("pid_sorted", "max_segments",
+                                    "num_partitions"))
 def bound_row_mask(key: jax.Array, pid: jnp.ndarray, pk: jnp.ndarray,
                    valid: jnp.ndarray, linf_cap, l0_cap,
-                   l1_cap=None) -> jnp.ndarray:
+                   l1_cap=None, *, pid_sorted: bool = False,
+                   max_segments: Optional[int] = None,
+                   num_partitions: Optional[int] = None) -> jnp.ndarray:
     """Per-row keep mask (original row order) after Linf + L0 bounding.
 
     Identical sampling decisions to bound_and_aggregate for the same key —
     guaranteed structurally: all bounding kernels derive from the shared
-    _sample_rows_and_groups pipeline. This one returns which rows survive
+    _sample_rows_and_groups pipeline (pass the SAME pid_sorted /
+    max_segments / num_partitions statics as the aggregation kernel so the
+    two sort with identical keys). This one returns which rows survive
     instead of aggregates — the row-level view needed by consumers that
     histogram individual contributions (e.g. the batched quantile trees of
     ops/quantiles.py).
@@ -411,8 +630,19 @@ def bound_row_mask(key: jax.Array, pid: jnp.ndarray, pk: jnp.ndarray,
     n = pid.shape[0]
     if n == 0:
         return jnp.zeros((0,), dtype=bool)
-    s = _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap,
-                                l1_cap)
+    # Same trace-time dispatch as bound_and_aggregate (static flags +
+    # structural l1_cap test) so replayed sampling stays identical.
+    # dplint: disable=DPL003 — static/structural branch, resolved per compile
+    if (pid_sorted and l1_cap is None and num_partitions is not None
+            and presorted_fits(n, num_partitions, max_segments)):
+        s = _sample_rows_and_groups_presorted(
+            key, pid, pk, valid, linf_cap, l0_cap,
+            num_partitions=num_partitions,
+            max_segments=int(max_segments) if max_segments else n,
+            need_order=True)
+    else:
+        s = _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap,
+                                    l1_cap)
     keep_sorted_rows = s.keep_row & s.keep_group_row
     return jnp.zeros((n,), dtype=bool).at[s.order].set(keep_sorted_rows)
 

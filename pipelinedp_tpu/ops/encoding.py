@@ -168,8 +168,14 @@ def _factorize(column: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
             if present.all():
                 # Ids are already dense on [lo, hi]: identity mapping, no
                 # remap gather (2 s saved at the 100M-row benchmark shape).
-                return (shifted.astype(np.int32, copy=False),
-                        np.arange(lo, hi + 1, dtype=column.dtype))
+                # The ids array must be a FRESH buffer: results are
+                # computed lazily, so aliasing the caller's column would
+                # let a later caller-side mutation corrupt the encoded
+                # ids (shifted aliases `column` when lo == 0).
+                ids = (shifted.astype(np.int32, copy=True)
+                       if shifted is column else
+                       shifted.astype(np.int32, copy=False))
+                return ids, np.arange(lo, hi + 1, dtype=column.dtype)
             ids_map = np.cumsum(present, dtype=np.int32) - 1
             ids = ids_map[shifted]
             uniques = np.flatnonzero(present) + lo
@@ -206,6 +212,11 @@ def _pid_passthrough(pid_col: np.ndarray) -> Optional[np.ndarray]:
     factorization is pure overhead when the input ids are already integers
     — a shift-to-zero keeps them inside int32 (the kernel reserves
     INT32_MAX as its padding sentinel, hence the safety margin).
+
+    Read-only contract: when the input is already int32 with lo == 0 the
+    returned array ALIASES the caller's column (this is the hot path; a
+    defensive copy would cost ~0.2 s at the 100M-row shape). Engine call
+    sites treat encoded pid columns as immutable.
     """
     if not np.issubdtype(pid_col.dtype, np.integer) or len(pid_col) == 0:
         return None

@@ -61,14 +61,22 @@ DEFAULT_NUM_CHUNKS = 8
 # slab stays bounded.
 SLAB_BYTE_BUDGET = 192 * 1024 * 1024
 
+# When the host radix sort rides the slab pipeline (exact RLE entry counts
+# known at prep time), finer slabs buy overlap: each slab's sort runs while
+# the previous slab's transfer + kernels are in flight, so more slabs hide
+# more of the single-core sort. Still bounded below (2) and by the bucket
+# count; per-transfer fixed costs keep this from going per-row.
+PIPELINED_SLAB_BYTE_BUDGET = 48 * 1024 * 1024
+
 
 def _num_chunks(n_rows: int) -> int:
     # ~8 MB of packed bytes per chunk minimum, capped at the default.
     return int(min(DEFAULT_NUM_CHUNKS, max(2, n_rows // 1_000_000)))
 
 
-def _num_transfers(total_bytes: int, k: int) -> int:
-    want = -(-total_bytes // SLAB_BYTE_BUDGET)  # ceil
+def _num_transfers(total_bytes: int, k: int,
+                   budget: int = SLAB_BYTE_BUDGET) -> int:
+    want = -(-total_bytes // budget)  # ceil
     return int(max(2, min(k, want)))
 
 
@@ -172,7 +180,10 @@ def _chunk_step_rle(key, row, n_valid, n_uniq, accs, linf_cap, l0_cap,
     """Decode one wire-codec bucket, bound+aggregate it, add into accs.
 
     Buckets are pid-disjoint, so bounding each independently with the full
-    caps and summing accumulators is exact (see module docstring).
+    caps and summing accumulators is exact (see module docstring). In
+    PID_RLE mode the decoded rows are pid-sorted by construction, so the
+    kernel runs its cheaper presorted sampler (fmt.pid_sorted plumbs the
+    invariant; fmt.ucap bounds the distinct pids per bucket).
     """
     pid, pk, value, valid = wirecodec.decode_bucket(row, n_valid, n_uniq,
                                                     fmt)
@@ -193,7 +204,9 @@ def _chunk_step_rle(key, row, n_valid, n_uniq, accs, linf_cap, l0_cap,
         need_sum=need_flags[1],
         need_norm=need_flags[2],
         need_norm_sq=need_flags[3],
-        has_group_clip=has_group_clip)
+        has_group_clip=has_group_clip,
+        pid_sorted=fmt.pid_sorted,
+        max_segments=fmt.ucap if fmt.pid_sorted else None)
     return columnar.PartitionAccumulators(
         *(a + c for a, c in zip(accs, chunk_accs)))
 
@@ -237,9 +250,16 @@ def _chunk_step_rle_quantile(key, row, n_valid, n_uniq, accs, qhist,
         need_sum=need_flags[1],
         need_norm=need_flags[2],
         need_norm_sq=need_flags[3],
-        has_group_clip=has_group_clip)
-    row_keep = columnar.bound_row_mask(key, pid, pk, valid, linf_cap,
-                                       l0_cap, l1_cap=l1_cap)
+        has_group_clip=has_group_clip,
+        pid_sorted=fmt.pid_sorted,
+        max_segments=fmt.ucap if fmt.pid_sorted else None)
+    # Same pid_sorted statics as the aggregation kernel, so the replayed
+    # sampling decisions stay identical (shared packed-key sort).
+    row_keep = columnar.bound_row_mask(
+        key, pid, pk, valid, linf_cap, l0_cap, l1_cap=l1_cap,
+        pid_sorted=fmt.pid_sorted,
+        max_segments=fmt.ucap if fmt.pid_sorted else None,
+        num_partitions=num_partitions)
     chunk_hist = quantile_ops.leaf_histograms(pk, value, row_keep,
                                               num_partitions=num_partitions,
                                               num_leaves=num_leaves,
@@ -316,10 +336,12 @@ def stream_bound_and_aggregate(
 
     if transfer_encoding != "bytes":
         # Shared prologue with the mesh streaming path (pid-span
-        # validation, width/bit planning, value plan, native encoder).
-        enc, plan, vidx, pid_lo, bytes_pid, bits_pk = wirecodec.make_encoder(
-            pid, pk, value, num_partitions=num_partitions, k=k,
-            value_transfer_dtype=value_transfer_dtype)
+        # validation, width/bit planning, value plan, pid wire mode,
+        # native encoder).
+        with profiler.stage("dp/wire_prep"):
+            enc, info = wirecodec.make_encoder(
+                pid, pk, value, num_partitions=num_partitions, k=k,
+                value_transfer_dtype=value_transfer_dtype)
         qhist = (jnp.zeros((num_partitions, quantile_spec[0]),
                            dtype=jnp.float32)
                  if quantile_spec is not None else None)
@@ -344,25 +366,69 @@ def stream_bound_and_aggregate(
                 has_group_clip=has_group_clip), qhist
 
         if enc is not None:
-            # Pipelined encode: every slab shares ONE wire format (so the
-            # chunk kernel compiles once — the sort runs upfront to learn
-            # the global RLE entry max, ~5% of the encode), then slab s+1
-            # is emitted on the host CPU while slab s's device_put is
-            # still on the wire (device_put and the kernels are async).
+            # Pipelined encode. Every slab shares ONE wire format (one
+            # XLA compile for the chunk kernel). Three schedules, best
+            # first:
+            #   * PID_PLANES: no host sort at all — emit ships arrival-
+            #     order pid planes, the device sorts (it sorts anyway).
+            #   * PID_RLE with prep-time entry counts: the format is known
+            #     before any sorting, so the per-bucket radix sort runs
+            #     INSIDE the slab loop — slab s+1 sorts on the host CPU
+            #     while slab s's device_put + kernels are in flight. This
+            #     takes the single-core sort off the e2e critical path.
+            #   * PID_RLE without entry counts (huge pid span): upfront
+            #     sort to learn the RLE entry max, as before.
             with enc:
                 counts = enc.counts
-                with profiler.stage("dp/wire_sort"):
-                    n_uniq = enc.sort_range(0, k)
-                fmt = wirecodec.WireFormat(
-                    bytes_pid=bytes_pid, bits_pk=bits_pk,
-                    cap=wirecodec._round8(int(counts.max())),
-                    ucap=wirecodec.round_ucap(int(n_uniq.max())),
-                    value=plan)
-                n_t = n_transfers or _num_transfers(fmt.width * k, k)
+                cap = wirecodec._round8(int(counts.max()))
+                pipelined_sort = (info.pid_mode == wirecodec.PID_RLE
+                                  and enc.entry_counts is not None)
+                if info.pid_mode == wirecodec.PID_PLANES:
+                    fmt = wirecodec.WireFormat(
+                        bytes_pid=info.bytes_pid, bits_pk=info.bits_pk,
+                        cap=cap, ucap=8, value=info.plan,
+                        pid_mode=wirecodec.PID_PLANES,
+                        bits_pid=info.bits_pid)
+                    n_uniq = np.zeros(k, dtype=np.int64)
+                elif pipelined_sort:
+                    n_uniq = enc.entry_counts
+                    fmt = wirecodec.WireFormat(
+                        bytes_pid=info.bytes_pid, bits_pk=info.bits_pk,
+                        cap=cap,
+                        ucap=wirecodec.round_ucap(int(n_uniq.max())),
+                        value=info.plan)
+                else:
+                    # Distinct stage name: an upfront sort serializes
+                    # ahead of the pipeline (bench reports it as
+                    # non-overlapped host encode).
+                    with profiler.stage("dp/wire_sort_upfront"):
+                        n_uniq = enc.sort_range(0, k)
+                    fmt = wirecodec.WireFormat(
+                        bytes_pid=info.bytes_pid, bits_pk=info.bits_pk,
+                        cap=cap,
+                        ucap=wirecodec.round_ucap(int(n_uniq.max())),
+                        value=info.plan)
+                budget = (PIPELINED_SLAB_BYTE_BUDGET if pipelined_sort
+                          else SLAB_BYTE_BUDGET)
+                n_t = n_transfers or _num_transfers(fmt.width * k, k,
+                                                    budget)
                 slab_buckets = max(1, (k + n_t - 1) // n_t)
                 for s0 in range(0, k, slab_buckets):
                     s1 = min(s0 + slab_buckets, k)
                     with profiler.stage(f"dp/stream_slab_{s0}"):
+                        if pipelined_sort:
+                            with profiler.stage("dp/wire_sort"):
+                                sorted_uniq = enc.sort_range(s0, s1)
+                            if not np.array_equal(sorted_uniq,
+                                                  n_uniq[s0:s1]):
+                                # Analytic prep counts must equal the
+                                # post-sort RLE counts; a mismatch means
+                                # corrupted input (e.g. mutated between
+                                # prep and sort) and must not decode.
+                                raise RuntimeError(
+                                    "wirecodec: prep-time RLE entry "
+                                    "counts disagree with the sorted "
+                                    "buckets")
                         slab = enc.emit_range(s0, s1, fmt)
                         dslab = jax.device_put(slab)
                         for c in range(s0, s1):
@@ -373,8 +439,10 @@ def stream_bound_and_aggregate(
         else:
             with profiler.stage("dp/wire_encode"):
                 slab, counts, n_uniq, fmt = wirecodec.encode_buckets_numpy(
-                    pid, pk, value, pid_lo=pid_lo, k=k, bytes_pid=bytes_pid,
-                    bits_pk=bits_pk, plan=plan)
+                    pid, pk, value, pid_lo=info.pid_lo, k=k,
+                    bytes_pid=info.bytes_pid, bits_pk=info.bits_pk,
+                    plan=info.plan, pid_mode=info.pid_mode,
+                    bits_pid=info.bits_pid)
             n_t = n_transfers or _num_transfers(slab.nbytes, k)
             slab_buckets = max(1, (k + n_t - 1) // n_t)
             for s0 in range(0, k, slab_buckets):

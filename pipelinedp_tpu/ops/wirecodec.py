@@ -57,6 +57,15 @@ VALUE_PLANES = 1  # affine-integer bit-planes (lossless, host-verified)
 VALUE_F32 = 2  # raw little-endian float32
 VALUE_F16 = 3  # raw float16 (lossy ingest, existing opt-in)
 
+# Privacy-id wire modes. PID_RLE requires the host radix sort (rows arrive
+# on device pid-sorted per bucket — the load-bearing invariant the fused
+# kernel's presorted sampler exploits); PID_PLANES ships the shifted ids as
+# LSB-first bit-planes in arrival order and skips the host sort entirely —
+# chosen when the RLE gain is small (near-unique ids), where the planes are
+# BOTH fewer bytes and zero host sort (the device kernel sorts anyway).
+PID_RLE = 0
+PID_PLANES = 1
+
 _MAX_VALUE_BITS = 20  # beyond ~1M distinct levels the planes stop paying
 _RUN_SPLIT = 65535  # uint16 run-length limit; longer runs split
 
@@ -75,21 +84,36 @@ class WireFormat:
     """Static shape/layout info shared by encoder and decoder.
 
     All fields are jit-static: one compile serves every bucket of a call.
+    pid_mode PID_RLE lays out [uniq ids | uint16 runs | pk planes | value];
+    PID_PLANES lays out [pid planes | pk planes | value] (bits_pid planes,
+    arrival order, no sortedness guarantee).
     """
     bytes_pid: int
     bits_pk: int
     cap: int  # padded rows per bucket, multiple of 8
-    ucap: int  # padded RLE entries per bucket
+    ucap: int  # padded RLE entries per bucket (PID_RLE only)
     value: ValuePlan
+    pid_mode: int = PID_RLE
+    bits_pid: int = 0  # pid bit-planes per row (PID_PLANES only)
 
     @property
     def cap_bytes(self) -> int:
         return self.cap // 8
 
     @property
+    def pid_sorted(self) -> bool:
+        """Whether decoded rows are pid-sorted (the presorted-kernel
+        invariant): structural for PID_RLE, never for PID_PLANES."""
+        return self.pid_mode == PID_RLE
+
+    @property
     def _offsets(self) -> Tuple[int, int, int, int]:
-        o_cnt = self.ucap * self.bytes_pid
-        o_pk = o_cnt + self.ucap * 2
+        if self.pid_mode == PID_PLANES:
+            o_cnt = self.bits_pid * self.cap_bytes
+            o_pk = o_cnt
+        else:
+            o_cnt = self.ucap * self.bytes_pid
+            o_pk = o_cnt + self.ucap * 2
         o_val = o_pk + self.bits_pk * self.cap_bytes
         if self.value.mode == VALUE_PLANES:
             end = o_val + self.value.bits * self.cap_bytes
@@ -232,9 +256,17 @@ def encode_buckets_numpy(
     bytes_pid: int,
     bits_pk: int,
     plan: ValuePlan,
+    pid_mode: int = PID_RLE,
+    bits_pid: int = 0,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, WireFormat]:
     """Numpy reference encoder. Returns (slab [k, W] uint8, n_rows [k],
-    n_uniq [k], fmt). Bit-identical to the native packer's output."""
+    n_uniq [k], fmt). Bit-identical to the native packer's output.
+
+    pid_mode PID_PLANES ships the shifted pid column as bits_pid bit-planes
+    with rows grouped (stably) by the pid low byte — the same arrival order
+    the native prep scatter produces, so the two encoders stay
+    bit-identical in this mode too.
+    """
     n = len(pid)
     shifted = (np.asarray(pid) - pid_lo).astype(np.uint32, copy=False)
     bucket = ((shifted * _HASH_MULT) >> np.uint32(16)) % np.uint32(k)
@@ -246,6 +278,25 @@ def encode_buckets_numpy(
         vidx = np.rint(
             (np.asarray(value, dtype=np.float64) - float(plan.lo))
             / float(plan.scale)).astype(np.int64)
+
+    if pid_mode == PID_PLANES:
+        fmt = WireFormat(bytes_pid=bytes_pid, bits_pk=bits_pk, cap=cap,
+                         ucap=8, value=plan, pid_mode=PID_PLANES,
+                         bits_pid=bits_pid)
+        slab = np.zeros((k, fmt.width), dtype=np.uint8)
+        o_cnt, o_pk, o_val, _ = fmt._offsets
+        for c in range(k):
+            rows = np.flatnonzero(bucket == c)
+            # Match the native prep scatter order (radix pass 0): stable
+            # grouping by the pid low byte.
+            order = rows[np.argsort(shifted[rows] & np.uint32(0xFF),
+                                    kind="stable")]
+            row = slab[c]
+            pid_planes = row[:o_cnt].reshape(bits_pid, fmt.cap_bytes)
+            _pack_planes(pid_planes, shifted[order], bits_pid)
+            _emit_pk_and_value(row, fmt, plan, np.asarray(pk), value, vidx,
+                               order, o_pk, o_val)
+        return slab, counts, np.zeros(k, dtype=np.int64), fmt
 
     # Pass 1: per-bucket stable pid sort + RLE to size ucap exactly.
     orders, uniq_cols, cnt_cols = [], [], []
@@ -269,23 +320,30 @@ def encode_buckets_numpy(
         _pack_le(row[:len(u) * bytes_pid].reshape(-1, bytes_pid), u,
                  bytes_pid)
         _pack_le(row[o_cnt:o_cnt + len(cts) * 2].reshape(-1, 2), cts, 2)
-        pk_planes = row[o_pk:o_pk + bits_pk * fmt.cap_bytes].reshape(
-            bits_pk, fmt.cap_bytes)
-        _pack_planes(pk_planes, np.asarray(pk)[order], bits_pk)
-        if plan.mode == VALUE_PLANES:
-            val_planes = row[o_val:o_val + plan.bits * fmt.cap_bytes
-                             ].reshape(plan.bits, fmt.cap_bytes)
-            _pack_planes(val_planes, vidx[order], plan.bits)
-        elif plan.mode == VALUE_F32:
-            m = len(order)
-            row[o_val:o_val + m * 4] = (np.asarray(
-                value, dtype=np.float32)[order].view(np.uint8))
-        elif plan.mode == VALUE_F16:
-            m = len(order)
-            row[o_val:o_val + m * 2] = (np.asarray(
-                value, dtype=np.float32)[order].astype(
-                    np.float16).view(np.uint8))
+        _emit_pk_and_value(row, fmt, plan, np.asarray(pk), value, vidx,
+                           order, o_pk, o_val)
     return slab, counts, n_uniq, fmt
+
+
+def _emit_pk_and_value(row, fmt, plan, pk, value, vidx, order, o_pk,
+                       o_val) -> None:
+    """Shared pk-planes + value tail of both numpy bucket layouts."""
+    pk_planes = row[o_pk:o_pk + fmt.bits_pk * fmt.cap_bytes].reshape(
+        fmt.bits_pk, fmt.cap_bytes)
+    _pack_planes(pk_planes, pk[order], fmt.bits_pk)
+    if plan.mode == VALUE_PLANES:
+        val_planes = row[o_val:o_val + plan.bits * fmt.cap_bytes].reshape(
+            plan.bits, fmt.cap_bytes)
+        _pack_planes(val_planes, vidx[order], plan.bits)
+    elif plan.mode == VALUE_F32:
+        m = len(order)
+        row[o_val:o_val + m * 4] = (np.asarray(
+            value, dtype=np.float32)[order].view(np.uint8))
+    elif plan.mode == VALUE_F16:
+        m = len(order)
+        row[o_val:o_val + m * 2] = (np.asarray(
+            value, dtype=np.float32)[order].astype(
+                np.float16).view(np.uint8))
 
 
 def _round8(x: int) -> int:
@@ -344,25 +402,33 @@ def decode_bucket(
 ) -> Tuple[jnp.ndarray, jnp.ndarray, Optional[jnp.ndarray], jnp.ndarray]:
     """Decode one bucket row of the slab -> (pid, pk, value|None, valid).
 
-    pid is the shifted (pid - pid_lo) id; rows come back in the bucket's
-    pid-sorted order, which the kernel is insensitive to (it re-sorts with
-    its own random tiebreaks). Rows >= n_valid are garbage with valid=False.
+    pid is the shifted (pid - pid_lo) id. In PID_RLE mode rows come back in
+    the bucket's pid-sorted order — nondecreasing by construction (sorted
+    RLE entries expanded in sequence, padding repeating the last id), which
+    is the invariant the fused kernel's presorted sampler relies on. In
+    PID_PLANES mode rows are in arrival order (no sortedness guarantee).
+    Rows >= n_valid are garbage with valid=False.
     """
     o_cnt, o_pk, o_val, _ = fmt._offsets
     cap, ucap = fmt.cap, fmt.ucap
 
-    uniq = _unpack_le(row[:o_cnt].reshape(ucap, fmt.bytes_pid),
-                      fmt.bytes_pid)
-    cnts = _unpack_le(row[o_cnt:o_pk].reshape(ucap, 2), 2)
-    uvalid = jnp.arange(ucap, dtype=jnp.int32) < n_uniq
-    cnts = jnp.where(uvalid, cnts, 0)
-    starts = jnp.cumsum(cnts) - cnts
-    # Padded entries scatter out of range and are dropped.
-    starts = jnp.where(uvalid, starts, cap)
-    run_of_row = jnp.cumsum(
-        jnp.zeros((cap,), jnp.int32).at[starts].add(1, mode="drop")) - 1
-    run_of_row = jnp.clip(run_of_row, 0, ucap - 1)
-    pid = uniq[run_of_row]
+    if fmt.pid_mode == PID_PLANES:
+        pid = _unpack_planes(
+            row[:o_cnt].reshape(fmt.bits_pid, fmt.cap_bytes), fmt.bits_pid,
+            cap)
+    else:
+        uniq = _unpack_le(row[:o_cnt].reshape(ucap, fmt.bytes_pid),
+                          fmt.bytes_pid)
+        cnts = _unpack_le(row[o_cnt:o_pk].reshape(ucap, 2), 2)
+        uvalid = jnp.arange(ucap, dtype=jnp.int32) < n_uniq
+        cnts = jnp.where(uvalid, cnts, 0)
+        starts = jnp.cumsum(cnts) - cnts
+        # Padded entries scatter out of range and are dropped.
+        starts = jnp.where(uvalid, starts, cap)
+        run_of_row = jnp.cumsum(
+            jnp.zeros((cap,), jnp.int32).at[starts].add(1, mode="drop")) - 1
+        run_of_row = jnp.clip(run_of_row, 0, ucap - 1)
+        pid = uniq[run_of_row]
 
     pk = _unpack_planes(
         row[o_pk:o_val].reshape(fmt.bits_pk, fmt.cap_bytes), fmt.bits_pk,
@@ -418,17 +484,24 @@ class NativeRleEncoder:
 
     The split API exists for pipelining: `sort_range`+`emit_range` of slab
     s+1 runs on the host CPU while slab s's async device_put is still on
-    the wire (ops/streaming.py drives this). Use as a context manager or
-    call close(); create() returns None when the native library is
-    unavailable (callers fall back to encode_buckets_numpy).
+    the wire (ops/streaming.py drives this). When `entry_counts` is
+    available (prep counted RLE entries exactly without sorting), the wire
+    format can be fixed up front and the expensive per-bucket radix sort
+    itself joins the pipeline — sort slab s+1 while slab s is in flight.
+    Use as a context manager or call close(); create() returns None when
+    the native library is unavailable (callers fall back to
+    encode_buckets_numpy).
     """
 
-    def __init__(self, lib, handle, counts, k, plan):
+    def __init__(self, lib, handle, counts, k, plan, entry_counts=None):
         self._lib = lib
         self._handle = handle
         self.counts = counts
         self._k = k
         self._plan = plan
+        # Exact per-bucket RLE entry counts from prep (pre-sort), or None
+        # when the pid span exceeded the native count-table budget.
+        self.entry_counts = entry_counts
 
     @property
     def plan(self) -> ValuePlan:
@@ -440,14 +513,19 @@ class NativeRleEncoder:
     def create(cls, pid, pk, value, vidx, *, pid_lo: int, k: int,
                plan: ValuePlan,
                inline_vidx: bool = False,
-               out_status: Optional[dict] = None
+               out_status: Optional[dict] = None,
+               pid_span: int = -1
                ) -> Optional["NativeRleEncoder"]:
         """inline_vidx: for PLANES plans, let the C++ prep compute AND
         bit-verify the value index during its scatter pass (vidx must be
         None). On verification failure returns None and sets
         out_status["inline_failed"] = True — callers re-plan. The
         returned encoder's plan carries the true bit width (from the
-        observed max index)."""
+        observed max index).
+
+        pid_span: max(pid) - pid_lo; when >= 0 and within the native
+        count-table budget, prep also returns exact per-bucket RLE entry
+        counts (encoder.entry_counts) without sorting."""
         lib = _load_packer()
         if lib is None:
             return None
@@ -464,6 +542,7 @@ class NativeRleEncoder:
         vidx32 = (np.ascontiguousarray(vidx, dtype=np.int32)
                   if plan.mode == VALUE_PLANES and not use_inline else None)
         counts = np.zeros(k, dtype=np.int64)
+        entries = np.zeros(k, dtype=np.int64)
         stats = np.zeros(2, dtype=np.int64)
         handle = lib.pdp_rle_prep(
             pid32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
@@ -473,7 +552,8 @@ class NativeRleEncoder:
             vidx32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
             if vidx32 is not None else None,
             float(plan.lo), float(plan.scale),
-            n, int(pid_lo), k, int(plan.mode),
+            n, int(pid_lo), k, int(plan.mode), int(pid_span),
+            entries.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             stats.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
         if not handle:
@@ -483,7 +563,8 @@ class NativeRleEncoder:
         if use_inline:
             plan = dataclasses.replace(
                 plan, bits=max(1, int(stats[1]).bit_length()))
-        return cls(lib, handle, counts, k, plan)
+        entry_counts = None if entries[0] < 0 else entries
+        return cls(lib, handle, counts, k, plan, entry_counts)
 
     def sort_range(self, b0: int, b1: int) -> np.ndarray:
         """Sorts buckets [b0, b1) by pid; returns their RLE entry counts."""
@@ -497,11 +578,13 @@ class NativeRleEncoder:
         return n_uniq
 
     def emit_range(self, b0: int, b1: int, fmt: WireFormat) -> np.ndarray:
-        """Writes the flat [b1-b0, fmt.width] slab for sorted buckets."""
+        """Writes the flat [b1-b0, fmt.width] slab: sorted RLE rows in
+        PID_RLE mode, arrival-order pid bit-planes in PID_PLANES mode."""
         import ctypes
         out = np.empty((b1 - b0, fmt.width), dtype=np.uint8)
         rc = self._lib.pdp_rle_emit_range(
-            self._handle, b0, b1, fmt.bytes_pid, fmt.bits_pk,
+            self._handle, b0, b1, int(fmt.pid_mode), fmt.bytes_pid,
+            int(fmt.bits_pid), fmt.bits_pk,
             int(self._plan.bits), fmt.cap, fmt.ucap,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), fmt.width)
         if rc != 0:
@@ -569,6 +652,56 @@ def encode_buckets(pid, pk, value, *, pid_lo, k, bytes_pid, bits_pk, plan,
     return out
 
 
+# Largest (pid_span + 1) for which the numpy fallback counts exact RLE
+# entries before sorting (mirrors kMaxEntryCountSpan in row_packer.cc; the
+# extra 4*n guard keeps the span pass proportional to the data).
+_MAX_ENTRY_COUNT_SPAN = 1 << 26
+
+
+def rle_entry_counts_numpy(pid, pid_lo: int, k: int,
+                           pid_span: int) -> Optional[np.ndarray]:
+    """Exact per-bucket RLE entry counts WITHOUT sorting, or None when the
+    pid span is too large to count cheaply.
+
+    A pid hashes to exactly one bucket, so bucket b's post-sort entry
+    count is sum(ceil(rows_of_pid / 65535)) over the pids landing in b —
+    computable from a per-pid bincount. This is what lets the caller fix
+    the wire format before any sort and pipeline the sort per slab.
+    """
+    n = len(pid)
+    if pid_span < 0 or pid_span + 1 > min(_MAX_ENTRY_COUNT_SPAN,
+                                          max(4 * n, 1 << 22)):
+        return None
+    shifted = (np.asarray(pid) - pid_lo).astype(np.int64, copy=False)
+    per = np.bincount(shifted, minlength=pid_span + 1)
+    nz = np.flatnonzero(per)
+    bucket = ((nz.astype(np.uint32) * _HASH_MULT) >> np.uint32(16)) % \
+        np.uint32(k)
+    entries = -(-per[nz] // _RUN_SPLIT)
+    return np.bincount(bucket, weights=entries,
+                       minlength=k).astype(np.int64)
+
+
+def choose_pid_mode(n: int, pid_span: int, bytes_pid: int,
+                    entry_counts: Optional[np.ndarray]) -> Tuple[int, int]:
+    """(pid_mode, bits_pid) for this dataset.
+
+    PID_PLANES wins when the arrival-order bit-planes are strictly smaller
+    on the wire than the RLE entries — near-unique privacy ids — since it
+    also skips the host radix sort entirely (the device sorts anyway).
+    With repetitive ids (the headline movie-ratings shape: ~10 rows/user,
+    RLE ~0.3 bits/row vs 24 plane bits) RLE stays, and it additionally
+    hands the kernel the pid-sorted arrival order (presorted sampler).
+    Unknown entry counts (huge span) keep RLE with the upfront sort.
+    """
+    bits_pid = max(1, int(pid_span).bit_length())
+    if entry_counts is None:
+        return PID_RLE, bits_pid
+    plane_bits = n * bits_pid
+    rle_bits = int(entry_counts.sum()) * (8 * bytes_pid + 16)
+    return (PID_PLANES if plane_bits < rle_bits else PID_RLE), bits_pid
+
+
 def _sample_plan(value: Optional[np.ndarray],
                  value_f16: bool) -> ValuePlan:
     """Tentative plan from the 64k-sample gate only (one cheap pass plus
@@ -584,18 +717,37 @@ def _sample_plan(value: Optional[np.ndarray],
     return ValuePlan(VALUE_F32)
 
 
+@dataclasses.dataclass(frozen=True)
+class EncodeInfo:
+    """Everything the streaming drivers need to build wire formats and
+    schedule the encode pipeline (make_encoder's planning output)."""
+    plan: ValuePlan
+    vidx: Optional[np.ndarray]  # value index (numpy fallback PLANES only)
+    pid_lo: int
+    pid_span: int
+    bytes_pid: int
+    bits_pk: int
+    pid_mode: int  # PID_RLE or PID_PLANES
+    bits_pid: int  # pid plane count (PID_PLANES)
+    # Exact per-bucket RLE entry counts known BEFORE sorting, or None
+    # (then PID_RLE callers must learn ucap from an upfront sort).
+    entry_counts: Optional[np.ndarray]
+
+
 def make_encoder(pid: np.ndarray, pk, value, *, num_partitions: int, k: int,
-                 value_transfer_dtype=None):
+                 value_transfer_dtype=None
+                 ) -> Tuple[Optional[NativeRleEncoder], EncodeInfo]:
     """Shared encode prologue of the single-device and mesh streaming
     paths: pid-span validation, width/bit planning, value plan + index,
-    and the native encoder (None -> numpy fallback).
+    the pid wire-mode decision, and the native encoder (None -> numpy
+    fallback).
 
     With the native library, the full-array value verification happens
     INSIDE the C++ scatter pass (no separate host pass); without it, the
     chunked host verification of plan_and_index runs for the numpy
     fallback.
 
-    Returns (enc_or_None, plan, vidx, pid_lo, bytes_pid, bits_pk).
+    Returns (enc_or_None, EncodeInfo).
     """
     pid = np.asarray(pid)
     pid_lo = int(pid.min())
@@ -613,29 +765,43 @@ def make_encoder(pid: np.ndarray, pk, value, *, num_partitions: int, k: int,
     value_f16 = (value_transfer_dtype is not None
                  and np.dtype(value_transfer_dtype) == np.float16)
 
+    def info_for(plan, vidx, entry_counts):
+        pid_mode, bits_pid = choose_pid_mode(len(pid), pid_span, bytes_pid,
+                                             entry_counts)
+        return EncodeInfo(plan=plan, vidx=vidx, pid_lo=pid_lo,
+                          pid_span=pid_span, bytes_pid=bytes_pid,
+                          bits_pk=bits_pk, pid_mode=pid_mode,
+                          bits_pid=bits_pid, entry_counts=entry_counts)
+
+    def fallback_info():
+        plan, vidx = plan_and_index(value, value_f16)
+        entries = rle_entry_counts_numpy(pid, pid_lo, k, pid_span)
+        return info_for(plan, vidx, entries)
+
     if _load_packer() is None:
         # Numpy fallback: needs the fully verified plan and index on the
         # host (and must not pay the sample pass twice).
-        plan, vidx = plan_and_index(value, value_f16)
-        return None, plan, vidx, pid_lo, bytes_pid, bits_pk
+        return None, fallback_info()
 
     tentative = _sample_plan(value, value_f16)
     status: dict = {}
     enc = NativeRleEncoder.create(pid, pk, value, None, pid_lo=pid_lo, k=k,
                                   plan=tentative, inline_vidx=True,
-                                  out_status=status)
+                                  out_status=status, pid_span=pid_span)
     if enc is not None:
-        return enc, enc.plan, None, pid_lo, bytes_pid, bits_pk
+        return enc, info_for(enc.plan, None, enc.entry_counts)
     if status.get("inline_failed"):
         # The sample-chosen scale failed the full array: re-plan with the
         # full chunked host verification (which tries the other scales)
         # and retry — rare, and only costs the fallback pass.
         plan, vidx = plan_and_index(value, value_f16)
         enc = NativeRleEncoder.create(pid, pk, value, vidx, pid_lo=pid_lo,
-                                      k=k, plan=plan)
-        return enc, plan, vidx, pid_lo, bytes_pid, bits_pk
-    plan, vidx = plan_and_index(value, value_f16)
-    return None, plan, vidx, pid_lo, bytes_pid, bits_pk
+                                      k=k, plan=plan, pid_span=pid_span)
+        if enc is not None:
+            return enc, info_for(plan, vidx, enc.entry_counts)
+        entries = rle_entry_counts_numpy(pid, pid_lo, k, pid_span)
+        return None, info_for(plan, vidx, entries)
+    return None, fallback_info()
 
 
 def round_ucap(umax: int) -> int:

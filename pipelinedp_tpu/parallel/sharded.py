@@ -38,6 +38,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from pipelinedp_tpu.ops import columnar
 from pipelinedp_tpu.ops import quantiles as quantile_ops
 
+
+def shard_map(fn, *, mesh, in_specs, out_specs, check_vma=False):
+    """jax.shard_map with a fallback for older JAX releases, where it
+    lives in jax.experimental.shard_map and the replication-check flag is
+    named check_rep."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
 def _spec(mesh: Mesh) -> P:
     """Row arrays shard over every mesh axis (dcn included)."""
     return P(tuple(mesh.axis_names))
@@ -198,7 +211,7 @@ def _scalar_kernel(mesh: Mesh, padded_p: int, has_l1: bool = False,
 
     spec = _spec(mesh)
     part = _part_spec(mesh)
-    fn = jax.shard_map(
+    fn = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(),) + (spec,) * 4 + (P(),) * (8 if has_l1 else 7),
@@ -230,7 +243,7 @@ def _vector_kernel(mesh: Mesh, padded_p: int, norm_ord: int,
 
     spec = _spec(mesh)
     part = _part_spec(mesh)
-    fn = jax.shard_map(
+    fn = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(),) + (spec,) * 4 + (P(),) * (4 if has_l1 else 3),
@@ -261,7 +274,7 @@ def _quantile_kernel(mesh: Mesh, padded_p: int, num_leaves: int,
         return _reduce_scatter(hist, scatter)
 
     spec = _spec(mesh)
-    fn = jax.shard_map(
+    fn = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(),) + (spec,) * 4 + (P(),) * (5 if has_l1 else 4),
@@ -353,7 +366,7 @@ def _row_mask_kernel(mesh: Mesh, has_l1: bool = False):
                                        l1_cap=l1_args[0] if has_l1 else None)
 
     spec = _spec(mesh)
-    fn = jax.shard_map(local_step,
+    fn = shard_map(local_step,
                        mesh=mesh,
                        in_specs=(P(),) + (spec,) * 3 + (P(),) *
                        (3 if has_l1 else 2),
@@ -373,7 +386,7 @@ def _local_pk_sort_kernel(mesh: Mesh):
         return pk[order], value[order], mask[order]
 
     spec = _spec(mesh)
-    fn = jax.shard_map(local_step,
+    fn = shard_map(local_step,
                        mesh=mesh,
                        in_specs=(spec,) * 3,
                        out_specs=(spec,) * 3,
@@ -399,7 +412,7 @@ def _block_rows_cap_kernel(mesh: Mesh, block_p: int, n_blocks: int):
         return m
 
     spec = _spec(mesh)
-    fn = jax.shard_map(local_step,
+    fn = shard_map(local_step,
                        mesh=mesh,
                        in_specs=(spec, spec),
                        out_specs=P(),
@@ -434,7 +447,7 @@ def _block_hist_kernel(mesh: Mesh, block_p: int, num_leaves: int,
         return _reduce_scatter(hist, scatter)
 
     spec = _spec(mesh)
-    fn = jax.shard_map(local_step,
+    fn = shard_map(local_step,
                        mesh=mesh,
                        in_specs=(spec,) * 3 + (P(),) * 3,
                        out_specs=_part_spec(mesh),
@@ -581,12 +594,14 @@ def _codec_scalar_kernel(mesh: Mesh, padded_p: int, fmt, has_l1: bool,
             need_sum=need_flags[1],
             need_norm=need_flags[2],
             need_norm_sq=need_flags[3],
-            has_group_clip=has_group_clip)
+            has_group_clip=has_group_clip,
+            pid_sorted=fmt.pid_sorted,
+            max_segments=fmt.ucap if fmt.pid_sorted else None)
         return columnar.PartitionAccumulators(
             *(_reduce_scatter(a, scatter_axes) for a in accs))
 
     spec = _spec(mesh)
-    fn = jax.shard_map(
+    fn = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(), spec, spec, spec) + (P(),) * (8 if has_l1 else 7),
@@ -644,20 +659,49 @@ def stream_bound_and_aggregate(mesh: Mesh,
     n_c = n_chunks or streaming._num_chunks(max(n // n_dev, 1))
     k = n_c * n_dev
     # Shared encode prologue with ops/streaming.py (pid-span validation,
-    # width/bit planning, value plan, native encoder).
-    enc, plan, vidx, pid_lo, bytes_pid, bits_pk = wirecodec.make_encoder(
+    # width/bit planning, value plan, pid wire mode, native encoder).
+    enc, info = wirecodec.make_encoder(
         pid, pk, value, num_partitions=num_partitions, k=k,
         value_transfer_dtype=value_transfer_dtype)
     if enc is not None:
         with enc:
             counts = enc.counts
-            n_uniq = enc.sort_range(0, k)
-            fmt = wirecodec.WireFormat(
-                bytes_pid=bytes_pid, bits_pk=bits_pk,
-                cap=wirecodec._round8(int(counts.max())),
-                ucap=wirecodec.round_ucap(int(n_uniq.max())), value=plan)
-            def emit(c):
-                return enc.emit_range(c * n_dev, (c + 1) * n_dev, fmt)
+            cap = wirecodec._round8(int(counts.max()))
+            if info.pid_mode == wirecodec.PID_PLANES:
+                # Arrival-order pid planes: no host sort at all.
+                fmt = wirecodec.WireFormat(
+                    bytes_pid=info.bytes_pid, bits_pk=info.bits_pk,
+                    cap=cap, ucap=8, value=info.plan,
+                    pid_mode=wirecodec.PID_PLANES, bits_pid=info.bits_pid)
+                n_uniq = np.zeros(k, dtype=np.int64)
+
+                def emit(c):
+                    return enc.emit_range(c * n_dev, (c + 1) * n_dev, fmt)
+            elif enc.entry_counts is not None:
+                # Entry counts known at prep time: the per-bucket radix
+                # sort joins the chunk pipeline (sort chunk c while chunk
+                # c-1's sharded device_put + kernels are in flight).
+                n_uniq = enc.entry_counts
+                fmt = wirecodec.WireFormat(
+                    bytes_pid=info.bytes_pid, bits_pk=info.bits_pk,
+                    cap=cap,
+                    ucap=wirecodec.round_ucap(int(n_uniq.max())),
+                    value=info.plan)
+
+                def emit(c):
+                    b0, b1 = c * n_dev, (c + 1) * n_dev
+                    enc.sort_range(b0, b1)
+                    return enc.emit_range(b0, b1, fmt)
+            else:
+                n_uniq = enc.sort_range(0, k)
+                fmt = wirecodec.WireFormat(
+                    bytes_pid=info.bytes_pid, bits_pk=info.bits_pk,
+                    cap=cap,
+                    ucap=wirecodec.round_ucap(int(n_uniq.max())),
+                    value=info.plan)
+
+                def emit(c):
+                    return enc.emit_range(c * n_dev, (c + 1) * n_dev, fmt)
 
             return _run_codec_chunks(mesh, key, emit, counts, n_uniq, fmt,
                                      n_c, n_dev, padded_p, linf_cap, l0_cap,
@@ -665,8 +709,9 @@ def stream_bound_and_aggregate(mesh: Mesh,
                                      group_clip_lo, group_clip_hi, l1_cap,
                                      tuple(need_flags), has_group_clip)
     slab, counts, n_uniq, fmt = wirecodec.encode_buckets_numpy(
-        pid, pk, value, pid_lo=pid_lo, k=k, bytes_pid=bytes_pid,
-        bits_pk=bits_pk, plan=plan)
+        pid, pk, value, pid_lo=info.pid_lo, k=k, bytes_pid=info.bytes_pid,
+        bits_pk=info.bits_pk, plan=info.plan, pid_mode=info.pid_mode,
+        bits_pid=info.bits_pid)
     return _run_codec_chunks(mesh, key,
                              lambda c: slab[c * n_dev:(c + 1) * n_dev],
                              counts, n_uniq, fmt, n_c,

@@ -21,9 +21,15 @@ permanently.
 from __future__ import annotations
 
 import contextlib
-from typing import Iterator, Optional
+import threading
+import time
+from typing import Dict, Iterator, Optional
 
 import jax
+
+# Active wall-clock stage collectors (see collect_stage_times). Thread-local
+# so concurrent engines don't interleave their phase budgets.
+_collect = threading.local()
 
 
 @contextlib.contextmanager
@@ -41,9 +47,42 @@ def profile(logdir: str,
 @contextlib.contextmanager
 def stage(name: str) -> Iterator[None]:
     """Names the enclosed host block (and its dispatched device work) in
-    the trace; free when no trace is active."""
+    the trace; free when no trace is active. When a collect_stage_times()
+    block is active, also accumulates the stage's host wall time."""
+    sinks = getattr(_collect, "sinks", None)
+    if sinks:
+        t0 = time.perf_counter()
+        try:
+            with jax.profiler.TraceAnnotation(name):
+                yield
+        finally:
+            dt = time.perf_counter() - t0
+            for sink in sinks:
+                sink[name] = sink.get(name, 0.0) + dt
+        return
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+@contextlib.contextmanager
+def collect_stage_times() -> Iterator[Dict[str, float]]:
+    """Collects per-stage host wall seconds for the enclosed block.
+
+    Yields a dict that fills as stages complete: {stage_name: seconds},
+    summed over re-entries. Note these are HOST wall times — a stage that
+    only dispatches async device work (device_put, jitted kernels) is
+    cheap here even when the device is busy long after; that asymmetry is
+    exactly what the bench's overlap report keys off.
+    """
+    sink: Dict[str, float] = {}
+    sinks = getattr(_collect, "sinks", None)
+    if sinks is None:
+        sinks = _collect.sinks = []
+    sinks.append(sink)
+    try:
+        yield sink
+    finally:
+        sinks.remove(sink)
 
 
 def annotate_function(fn, name: Optional[str] = None):

@@ -221,3 +221,180 @@ class TestPLDLibrary:
         b = one.self_compose(3)
         assert a.get_epsilon_for_delta(1e-9) == pytest.approx(
             b.get_epsilon_for_delta(1e-9), rel=1e-6)
+
+
+def _analytic_gaussian_delta(eps: float, sigma: float) -> float:
+    """Exact delta(eps) of the Gaussian mechanism (sensitivity 1).
+
+    Balle & Wang 2018, "Improving the Gaussian Mechanism for Differential
+    Privacy", Theorem 8:
+        delta(eps) = Phi(1/(2 sigma) - eps sigma)
+                     - e^eps * Phi(-1/(2 sigma) - eps sigma).
+    This closed form is the mathematical ground truth the reference's
+    dp_accounting PLD converges to for the Gaussian mechanism (its
+    discretized estimates approach this curve as the interval -> 0), so it
+    serves as the golden oracle here — the container does not vendor
+    dp_accounting (see pld.py module docstring).
+    """
+    from scipy import stats
+    a = 1.0 / (2.0 * sigma)
+    b = eps * sigma
+    return float(stats.norm.cdf(a - b) - math.exp(eps)
+                 * stats.norm.cdf(-a - b))
+
+
+def _analytic_gaussian_epsilon(delta: float, sigma: float) -> float:
+    """Inverse of _analytic_gaussian_delta by bisection (exact oracle)."""
+    lo, hi = 0.0, 1.0
+    while _analytic_gaussian_delta(hi, sigma) > delta:
+        hi *= 2.0
+        assert hi < 1e6
+    for _ in range(200):
+        mid = 0.5 * (lo + hi)
+        if _analytic_gaussian_delta(mid, sigma) > delta:
+            lo = mid
+        else:
+            hi = mid
+    return hi
+
+
+def _laplace_delta_oracle(eps: float, b: float) -> float:
+    """Exact delta(eps) of the Laplace mechanism, scale b, sensitivity 1.
+
+    Derived from the closed-form hockey-stick divergence between Lap(0, b)
+    and Lap(1, b) (e.g. dp_accounting's LaplacePrivacyLoss; also
+    Koskela et al. 2020 eq. (12)):
+        delta(eps) = 0 for eps >= 1/b, else
+        delta(eps) = 1 - exp((eps - 1/b) / 2) * ... computed here by
+    numerically integrating (1 - e^(eps - l)) dP(l) with the exact CDF
+    P(L <= l) = exp((l b - 1)/(2 b)) / 2 on l in (eps, 1/b), plus the
+    atom of mass 1/2 at l = 1/b.
+    """
+    from scipy import integrate
+    max_loss = 1.0 / b
+    if eps >= max_loss:
+        return 0.0
+    # Continuous part density on (-1/b, 1/b): d/dl [exp((l - 1/b)/2)/2].
+    def integrand(loss):
+        dens = 0.25 * math.exp((loss - max_loss) / 2.0)
+        return (1.0 - math.exp(eps - loss)) * dens
+    cont, _ = integrate.quad(integrand, eps, max_loss, limit=200)
+    atom = 0.5 * (1.0 - math.exp(eps - max_loss))
+    return cont + atom
+
+
+class TestPLDGoldenValues:
+    """Golden-value pins for the self-rolled PLD accountant.
+
+    Every point checks BOTH directions against the exact analytic oracle:
+      * soundness — the pessimistic discretization must never claim less
+        epsilon than the true curve (an under-estimate would be a privacy
+        accounting bug);
+      * tightness — it must stay within a small factor of the truth
+        (otherwise it silently wastes budget).
+    Gaussian k-fold composition is exactly a single Gaussian with
+    sigma / sqrt(k), so the oracle covers all composition counts.
+    """
+
+    # (sigma, n_compositions, delta) — 24 points spanning high/low noise,
+    # deep composition, and two delta regimes.
+    GAUSSIAN_POINTS = [
+        (0.5, 1, 1e-6), (0.5, 4, 1e-6), (0.5, 16, 1e-6),
+        (1.0, 1, 1e-6), (1.0, 4, 1e-6), (1.0, 16, 1e-6), (1.0, 64, 1e-6),
+        (2.0, 1, 1e-6), (2.0, 4, 1e-6), (2.0, 16, 1e-6), (2.0, 64, 1e-6),
+        (5.0, 1, 1e-6), (5.0, 16, 1e-6), (5.0, 64, 1e-6),
+        (0.5, 1, 1e-5), (1.0, 1, 1e-5), (1.0, 16, 1e-5), (2.0, 4, 1e-5),
+        (2.0, 64, 1e-5), (5.0, 64, 1e-5),
+    ]
+
+    def test_gaussian_composition_table(self):
+        from pipelinedp_tpu import pld
+        interval = 1e-3
+        for sigma, k, delta in self.GAUSSIAN_POINTS:
+            dist = pld.from_gaussian_mechanism(
+                sigma, value_discretization_interval=interval)
+            if k > 1:
+                dist = dist.self_compose(k)
+            est = dist.get_epsilon_for_delta(delta)
+            true_eps = _analytic_gaussian_epsilon(
+                delta, sigma / math.sqrt(k))
+            # Soundness: pessimistic estimate upper-bounds the truth.
+            # (self_compose uses log2(k) convolutions, each of which can
+            # only round losses UP; allow float round-off only.)
+            assert est >= true_eps - 1e-6, (sigma, k, delta, est, true_eps)
+            # Tightness: within 2% + a few grid steps of the truth.
+            slack = 0.02 * true_eps + 20 * interval
+            assert est <= true_eps + slack, (sigma, k, delta, est, true_eps)
+
+    # (scale b, delta) for single-shot Laplace — exact oracle by
+    # integration of the closed-form loss CDF.
+    LAPLACE_POINTS = [(0.5, 1e-6), (1.0, 1e-6), (2.0, 1e-6), (4.0, 1e-6),
+                      (1.0, 1e-3), (2.0, 1e-3)]
+
+    def test_laplace_single_mechanism_table(self):
+        from pipelinedp_tpu import pld
+        interval = 1e-4
+        for b, delta in self.LAPLACE_POINTS:
+            dist = pld.from_laplace_mechanism(
+                b, value_discretization_interval=interval)
+            est = dist.get_epsilon_for_delta(delta)
+            # Invert the oracle by bisection.
+            lo, hi = 0.0, 1.0 / b
+            if _laplace_delta_oracle(0.0, b) <= delta:
+                true_eps = 0.0
+            else:
+                for _ in range(200):
+                    mid = 0.5 * (lo + hi)
+                    if _laplace_delta_oracle(mid, b) > delta:
+                        lo = mid
+                    else:
+                        hi = mid
+                true_eps = hi
+            assert est >= true_eps - 1e-6, (b, delta, est, true_eps)
+            assert est <= true_eps + 0.02 * true_eps + 10 * interval, (
+                b, delta, est, true_eps)
+
+    def test_laplace_composition_bounds(self):
+        # k-fold Laplace: the pessimistic estimate must stay within the
+        # [single-mechanism, basic-composition] envelope (plus grid
+        # pessimism) for every k.
+        from pipelinedp_tpu import pld
+        interval = 1e-4
+        b = 2.0
+        one = pld.from_laplace_mechanism(
+            b, value_discretization_interval=interval)
+        eps1 = one.get_epsilon_for_delta(1e-9)
+        prev = 0.0
+        for k in (2, 4, 8, 32):
+            est = one.self_compose(k).get_epsilon_for_delta(1e-9)
+            assert est > prev  # strictly grows with k
+            assert est <= k * eps1 + k * interval  # never beats basic comp
+            assert est >= eps1  # never below a single mechanism
+            prev = est
+
+    def test_gaussian_upper_bound_property_random_points(self):
+        # Property test: across a sweep of (sigma, k, delta) the estimate
+        # NEVER under-runs the analytic curve (soundness is the invariant
+        # privacy depends on; tightness is only economy).
+        from pipelinedp_tpu import pld
+        import itertools
+        interval = 2e-3
+        for sigma, k in itertools.product((0.7, 1.3, 3.1), (1, 3, 10, 30)):
+            dist = pld.from_gaussian_mechanism(
+                sigma, value_discretization_interval=interval)
+            if k > 1:
+                dist = dist.self_compose(k)
+            for delta in (1e-7, 1e-5, 1e-3):
+                est = dist.get_epsilon_for_delta(delta)
+                true_eps = _analytic_gaussian_epsilon(
+                    delta, sigma / math.sqrt(k))
+                assert est >= true_eps - 1e-6, (sigma, k, delta)
+
+    def test_generic_pld_roundtrip(self):
+        # from_privacy_parameters pins (eps, delta) -> its own epsilon.
+        from pipelinedp_tpu import pld
+        for eps, delta in ((0.1, 1e-6), (1.0, 1e-6), (3.0, 1e-4)):
+            dist = pld.from_privacy_parameters(
+                eps, delta, value_discretization_interval=1e-4)
+            est = dist.get_epsilon_for_delta(delta)
+            assert est == pytest.approx(eps, abs=2e-3)

@@ -1244,6 +1244,46 @@ class TestPrivateContributionBounds:
             host.max_partitions_contributed
         assert 1 <= got.max_partitions_contributed <= 10
 
+    def test_encoded_columns_counts_dataless_public_partitions(self):
+        # Round-5 advisor regression: with EncodedColumns, public
+        # partitions that have NO data (absent from pk_keys) must still
+        # count toward number_of_partitions — the exponential-mechanism
+        # scoring has to match DPEngine, which sees the full user list.
+        from pipelinedp_tpu import dp_computations
+        # 40 users, each contributing to the same 3 data partitions; 27
+        # more public partitions carry no data at all. With the count
+        # taken from the full user list (30) the noise term dominates and
+        # the near-deterministic mechanism picks bound 1; counting only
+        # the data vocabulary (3) both caps the candidate range at 3 and
+        # flips the winner to 3 — exactly the old bug.
+        rows = [(u, f"pk{i}", 1.0) for u in range(40) for i in range(3)]
+        pk_keys = [f"pk{i}" for i in range(3)]
+        id_of = {k: i for i, k in enumerate(pk_keys)}
+        data = pdp.EncodedColumns(
+            pid=np.array([r[0] for r in rows], dtype=np.int32),
+            pk=np.array([id_of[r[1]] for r in rows], dtype=np.int32),
+            num_partitions=3,
+            value=np.array([r[2] for r in rows], dtype=np.float32),
+            pk_keys=pk_keys)
+        partitions = pk_keys + [f"empty{i}" for i in range(27)]
+        params = self._params(calc_eps=1000.0)
+
+        dp_computations.ExponentialMechanism.seed_rng(13)
+        got = pdp.JaxDPEngine(
+            pdp.NaiveBudgetAccountant(1.0, 1e-6)
+        ).calculate_private_contribution_bounds(
+            data, params, partitions=partitions)
+
+        dp_computations.ExponentialMechanism.seed_rng(13)
+        host_engine = pdp.DPEngine(pdp.NaiveBudgetAccountant(1.0, 1e-6),
+                                   pdp.LocalBackend())
+        host = list(host_engine.calculate_private_contribution_bounds(
+            rows, params, self._extractors(),
+            partitions=partitions))[0]
+        dp_computations.ExponentialMechanism.seed_rng(None)
+        assert got.max_partitions_contributed == \
+            host.max_partitions_contributed == 1
+
     def test_partition_filtering(self):
         # Rows outside `partitions` must not influence the histogram:
         # an engine fed junk rows in other partitions picks the same bound.

@@ -343,3 +343,154 @@ class TestNarrowValueDtype:
         np.testing.assert_allclose(np.asarray(accs16.sum),
                                    np.asarray(accs32.sum))
         assert np.asarray(accs16.count).dtype == np.float32
+
+
+class TestPresortedKernel:
+    """The packed-3-key presorted sampler (pid_sorted=True) must be a
+    drop-in for the general 4-key sort: same aggregates whenever the
+    decisions are forced (caps don't bind, or totals are permutation-
+    invariant), uniform sampling when they are not, and exact suffix
+    padding handling — the contract the wire-codec decode relies on."""
+
+    def _run(self, pid, pk, value, P, linf, l0, *, pid_sorted, seed=0,
+             max_segments=None, valid=None, **kw):
+        import jax.numpy as jnp
+        n = len(pid)
+        return columnar.bound_and_aggregate(
+            jax.random.PRNGKey(seed),
+            jnp.asarray(np.asarray(pid, np.int32)),
+            jnp.asarray(np.asarray(pk, np.int32)),
+            jnp.asarray(np.asarray(value, np.float32)),
+            jnp.asarray(np.ones(n, bool) if valid is None else valid),
+            num_partitions=P, linf_cap=linf, l0_cap=l0,
+            row_clip_lo=-np.inf, row_clip_hi=np.inf, middle=0.0,
+            group_clip_lo=-np.inf, group_clip_hi=np.inf,
+            pid_sorted=pid_sorted, max_segments=max_segments, **kw)
+
+    def _data(self, n=30_000, P=64, U=900, seed=0):
+        rng = np.random.default_rng(seed)
+        pid = np.sort(rng.integers(0, U, n)).astype(np.int32)
+        pk = rng.integers(0, P, n).astype(np.int32)
+        value = rng.uniform(-1, 4, n).astype(np.float32)
+        return pid, pk, value
+
+    def test_matches_general_when_caps_do_not_bind(self):
+        pid, pk, value = self._data()
+        a = self._run(pid, pk, value, 64, len(pid), 64, pid_sorted=False)
+        b = self._run(pid, pk, value, 64, len(pid), 64, pid_sorted=True,
+                      max_segments=900)
+        for x, y in zip(a, b):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=1e-5)
+
+    def test_binding_cap_totals_are_permutation_invariant(self):
+        # Which rows/groups survive is a different draw, but the TOTALS
+        # (min(c, linf) per group, min(m, l0) groups per pid) are not —
+        # both samplers must land on exactly the same sums.
+        pid, pk, value = self._data()
+        ta = np.asarray(
+            self._run(pid, pk, value, 64, 2, 3, pid_sorted=False).count)
+        tb = np.asarray(
+            self._run(pid, pk, value, 64, 2, 3, pid_sorted=True,
+                      max_segments=900).count)
+        assert ta.sum() == tb.sum() > 0
+
+    def test_l0_sampling_is_uniform(self):
+        keeps = np.zeros(5)
+        for seed in range(200):
+            accs = self._run([1] * 5, list(range(5)), [1.0] * 5, 5, 1, 2,
+                             pid_sorted=True, max_segments=1, seed=seed)
+            keeps += np.asarray(accs.count)
+        np.testing.assert_allclose(keeps / 200, [0.4] * 5, atol=0.12)
+
+    def test_linf_sampling_is_uniform_over_rows(self):
+        # 1 user, 1 partition, 10 rows with distinct values, keep 3: the
+        # kept count is always exactly 3, and across seeds the mean kept
+        # sum matches a uniform 3-subset of 0..9 (3 * 4.5 = 13.5).
+        vals = np.arange(10, dtype=np.float32)
+        sums = []
+        for seed in range(300):
+            accs = self._run([7] * 10, [0] * 10, vals, 1, 3, 1,
+                             pid_sorted=True, max_segments=1, seed=seed)
+            assert float(np.asarray(accs.count)[0]) == 3
+            sums.append(float(np.asarray(accs.sum)[0]))
+        assert abs(np.mean(sums) - 13.5) < 0.8
+
+    def test_padding_suffix_ignored(self):
+        import jax.numpy as jnp
+        pid, pk, value = self._data(n=5_000)
+        npad = 128
+        pid_p = np.concatenate([pid, np.zeros(npad, np.int32)])
+        pk_p = np.concatenate([pk, np.full(npad, 63, np.int32)])
+        val_p = np.concatenate([value, np.full(npad, 99.0, np.float32)])
+        valid = np.concatenate(
+            [np.ones(len(pid), bool), np.zeros(npad, bool)])
+        a = self._run(pid, pk, value, 64, len(pid), 64, pid_sorted=True,
+                      max_segments=900)
+        b = self._run(pid_p, pk_p, val_p, 64, len(pid), 64,
+                      pid_sorted=True, max_segments=900,
+                      valid=jnp.asarray(valid))
+        for x, y in zip(a, b):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=1e-5)
+
+    def test_row_mask_parity_with_aggregate(self):
+        import jax.numpy as jnp
+        pid, pk, value = self._data()
+        key = jax.random.PRNGKey(11)
+        mask = np.asarray(columnar.bound_row_mask(
+            key, jnp.asarray(pid), jnp.asarray(pk),
+            jnp.ones(len(pid), bool), 2, 3, pid_sorted=True,
+            max_segments=900, num_partitions=64))
+        accs = columnar.bound_and_aggregate(
+            key, jnp.asarray(pid), jnp.asarray(pk), jnp.asarray(value),
+            jnp.ones(len(pid), bool), num_partitions=64, linf_cap=2,
+            l0_cap=3, row_clip_lo=-np.inf, row_clip_hi=np.inf, middle=0.0,
+            group_clip_lo=-np.inf, group_clip_hi=np.inf, pid_sorted=True,
+            max_segments=900, need_norm=False, need_norm_sq=False,
+            has_group_clip=False)
+        np.testing.assert_allclose(
+            np.asarray(accs.count), np.bincount(pk[mask], minlength=64))
+        np.testing.assert_allclose(
+            np.asarray(accs.sum),
+            np.bincount(pk[mask], weights=value[mask], minlength=64),
+            rtol=1e-5)
+
+    def test_group_clip_path_matches_general(self):
+        pid, pk, value = self._data()
+        a = self._run(pid, pk, value, 64, len(pid), 64, pid_sorted=False,
+                      has_group_clip=True)
+        b = self._run(pid, pk, value, 64, len(pid), 64, pid_sorted=True,
+                      max_segments=900, has_group_clip=True)
+        for x, y in zip(a, b):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=1e-5)
+
+    def test_infeasible_bits_fall_back_to_general(self):
+        # A partition vocabulary too wide for the packed keys must not
+        # break pid_sorted=True calls — the general sampler takes over.
+        assert not columnar.presorted_fits(10**9, 1 << 31, 10**9)
+        pid, pk, value = self._data(n=2_000, P=64)
+        accs = self._run(pid, pk, value, 64, len(pid), 64,
+                         pid_sorted=True, max_segments=1 << 40)
+        np.testing.assert_allclose(np.asarray(accs.count),
+                                   np.bincount(pk, minlength=64))
+
+    def test_key_packing_roundtrip(self):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(0)
+        seg = rng.integers(0, 1 << 21, 500).astype(np.uint32)
+        gh = rng.integers(0, 1 << 32, 500, dtype=np.uint64).astype(
+            np.uint32)
+        pk = rng.integers(0, 1 << 20, 500).astype(np.uint32)
+        rnd = rng.integers(0, 1 << 23, 500).astype(np.uint32)
+        keys = columnar._pack_key_bits([
+            (jnp.asarray(seg), 21), (jnp.asarray(gh), 32),
+            (jnp.asarray(pk), 20), (jnp.asarray(rnd), 23)])
+        assert len(keys) == 3
+        np.testing.assert_array_equal(
+            np.asarray(columnar._extract_key_bits(keys, 0, 21)), seg)
+        np.testing.assert_array_equal(
+            np.asarray(columnar._extract_key_bits(keys, 53, 20)), pk)
+        np.testing.assert_array_equal(
+            np.asarray(columnar._extract_key_bits(keys, 73, 23)), rnd)

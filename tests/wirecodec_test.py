@@ -271,8 +271,9 @@ class TestInlineVerification:
         pk = rng.integers(0, 64, n, dtype=np.int32)
         value = rng.integers(1, 6, n).astype(np.float32)
         value[150_000:] = rng.uniform(0, 5, 50_000).astype(np.float32)
-        enc, plan, vidx, pid_lo, bytes_pid, bits_pk = wirecodec.make_encoder(
+        enc, info = wirecodec.make_encoder(
             pid, pk, value, num_partitions=64, k=4)
+        plan, bytes_pid, bits_pk = info.plan, info.bytes_pid, info.bits_pk
         # The 64k sample is integral, the tail is not: the plan must end
         # raw (either via inline-verify failure or host verification).
         assert plan.mode == wirecodec.VALUE_F32
@@ -304,8 +305,9 @@ class TestInlineVerification:
         pk = rng.integers(0, 32, n, dtype=np.int32)
         value = rng.integers(1, 6, n).astype(np.float32)
         value[90_000:] = rng.integers(100, 901, 10_000).astype(np.float32)
-        enc, plan, vidx, pid_lo, bytes_pid, bits_pk = wirecodec.make_encoder(
+        enc, info = wirecodec.make_encoder(
             pid, pk, value, num_partitions=32, k=4)
+        plan, bytes_pid, bits_pk = info.plan, info.bytes_pid, info.bits_pk
         if enc is None:
             pytest.skip("native encoder unavailable")
         assert plan.mode == wirecodec.VALUE_PLANES
@@ -379,8 +381,10 @@ class TestCodecWidthEdges:
                + (1 << 27))  # nonzero pid_lo, 4-byte span
         pk = rng.integers(0, 500, n, dtype=np.int32)
         value = (rng.integers(-6, 7, n) * 0.5).astype(np.float32)
-        enc, plan, vidx, pid_lo, bytes_pid, bits_pk = wirecodec.make_encoder(
+        enc, info = wirecodec.make_encoder(
             pid, pk, value, num_partitions=500, k=4)
+        plan, pid_lo = info.plan, info.pid_lo
+        bytes_pid, bits_pk = info.bytes_pid, info.bits_pk
         assert enc is not None and plan.mode == wirecodec.VALUE_PLANES
         with enc:
             nu = enc.sort_range(0, 4)
@@ -398,3 +402,240 @@ class TestCodecWidthEdges:
         assert fmt.cap == fmt_r.cap and plan == full_plan
         assert fmt.ucap == fmt_r.ucap  # _round8 of equal maxima
         np.testing.assert_array_equal(slab_n, slab_r)
+
+
+class TestPidPlanesMode:
+    """The unsorted pid bit-plane wire mode: chosen automatically when
+    near-unique privacy ids make RLE a net loss, skips the host radix sort
+    entirely, and must stay exact (the device kernel sorts anyway)."""
+
+    def test_unique_pids_choose_planes(self):
+        n = 50_000
+        rng = np.random.default_rng(0)
+        pid = rng.permutation(n).astype(np.int32)
+        pk = rng.integers(0, 100, n).astype(np.int32)
+        enc, info = wirecodec.make_encoder(pid, pk, None,
+                                           num_partitions=100, k=4)
+        assert info.pid_mode == wirecodec.PID_PLANES
+        if enc is not None:
+            enc.close()
+
+    def test_repetitive_pids_choose_rle(self):
+        n = 50_000
+        rng = np.random.default_rng(0)
+        pid = rng.integers(0, n // 20, n).astype(np.int32)  # ~20 rows/user
+        pk = rng.integers(0, 100, n).astype(np.int32)
+        enc, info = wirecodec.make_encoder(pid, pk, None,
+                                           num_partitions=100, k=4)
+        assert info.pid_mode == wirecodec.PID_RLE
+        if enc is not None:
+            enc.close()
+
+    def test_planes_native_matches_numpy_bit_identically(self):
+        from pipelinedp_tpu.native import loader
+        if loader.load_row_packer() is None:
+            pytest.skip("native unavailable")
+        n = 40_000
+        rng = np.random.default_rng(3)
+        pid = rng.permutation(n).astype(np.int32) + 5
+        pk = rng.integers(0, 700, n).astype(np.int32)
+        value = rng.uniform(-2, 2, n).astype(np.float32)
+        enc, info = wirecodec.make_encoder(pid, pk, value,
+                                           num_partitions=700, k=4)
+        assert enc is not None and info.pid_mode == wirecodec.PID_PLANES
+        with enc:
+            fmt = wirecodec.WireFormat(
+                bytes_pid=info.bytes_pid, bits_pk=info.bits_pk,
+                cap=wirecodec._round8(int(enc.counts.max())), ucap=8,
+                value=info.plan, pid_mode=wirecodec.PID_PLANES,
+                bits_pid=info.bits_pid)
+            slab = enc.emit_range(0, 4, fmt)  # no sort_range call at all
+            counts = enc.counts
+        ref_slab, ref_counts, _, ref_fmt = wirecodec.encode_buckets_numpy(
+            pid, pk, value, pid_lo=info.pid_lo, k=4,
+            bytes_pid=info.bytes_pid, bits_pk=info.bits_pk, plan=info.plan,
+            pid_mode=wirecodec.PID_PLANES, bits_pid=info.bits_pid)
+        assert ref_fmt == fmt
+        np.testing.assert_array_equal(ref_counts, counts)
+        np.testing.assert_array_equal(ref_slab, slab)
+
+    def test_planes_streamed_matches_groupby(self):
+        import jax
+        n = 60_000
+        rng = np.random.default_rng(5)
+        pid = rng.permutation(n).astype(np.int64)  # unique -> planes
+        pk = rng.integers(0, 150, n).astype(np.int32)
+        value = rng.uniform(0, 5, n).astype(np.float32)
+        accs = streaming.stream_bound_and_aggregate(
+            jax.random.PRNGKey(0), pid, pk, value, num_partitions=150,
+            linf_cap=n, l0_cap=150, row_clip_lo=-np.inf,
+            row_clip_hi=np.inf, middle=0.0, group_clip_lo=-np.inf,
+            group_clip_hi=np.inf, n_chunks=3, has_group_clip=False)
+        np.testing.assert_allclose(np.asarray(accs.count),
+                                   np.bincount(pk, minlength=150))
+        truth = np.zeros(150)
+        np.add.at(truth, pk, value)
+        np.testing.assert_allclose(np.asarray(accs.sum), truth, rtol=1e-4)
+
+
+class TestSortednessInvariant:
+    """The pid-sorted wire order is load-bearing end to end: decode must
+    produce nondecreasing pids (including the padding suffix), and the
+    prep-time analytic RLE entry counts must equal the post-sort truth —
+    the invariant that lets the radix sort join the transfer pipeline."""
+
+    def test_decoded_rows_nondecreasing_with_padding(self):
+        n = 30_000
+        rng = np.random.default_rng(2)
+        pid = rng.integers(50, 2_000, n).astype(np.int32)
+        pk = rng.integers(0, 64, n).astype(np.int32)
+        plan = wirecodec.plan_value_encoding(None)
+        slab, n_rows, n_uniq, fmt = wirecodec.encode_buckets_numpy(
+            pid, pk, None, pid_lo=50, k=4, bytes_pid=2, bits_pk=6,
+            plan=plan)
+        for c in range(4):
+            p, _, _, valid = wirecodec.decode_bucket(
+                jnp.asarray(slab[c]), int(n_rows[c]), int(n_uniq[c]), fmt)
+            p = np.asarray(p)
+            # Nondecreasing over the FULL padded row range, not just the
+            # valid prefix — the presorted kernel sorts padding via its
+            # all-ones keys but the decode contract is stronger.
+            assert np.all(np.diff(p) >= 0)
+            assert np.asarray(valid).sum() == n_rows[c]
+
+    def test_entry_counts_numpy_matches_sorted_truth(self):
+        n = 25_000
+        rng = np.random.default_rng(4)
+        pid = rng.integers(0, 3_000, n).astype(np.int64)
+        span = int(pid.max() - pid.min())
+        entries = wirecodec.rle_entry_counts_numpy(pid, int(pid.min()), 8,
+                                                   span)
+        assert entries is not None
+        _, _, n_uniq, _ = wirecodec.encode_buckets_numpy(
+            pid, np.zeros(n, np.int32), None, pid_lo=int(pid.min()), k=8,
+            bytes_pid=2, bits_pk=1, plan=wirecodec.plan_value_encoding(None))
+        np.testing.assert_array_equal(entries, n_uniq)
+
+    def test_entry_counts_account_for_run_splits(self):
+        # 70k rows of ONE pid: RLE must split at 65535 -> 2 entries.
+        pid = np.zeros(70_000, dtype=np.int64)
+        entries = wirecodec.rle_entry_counts_numpy(pid, 0, 2, 0)
+        assert entries is not None and int(entries.sum()) == 2
+
+    def test_native_entry_counts_match_sort(self):
+        from pipelinedp_tpu.native import loader
+        if loader.load_row_packer() is None:
+            pytest.skip("native unavailable")
+        n = 80_000
+        rng = np.random.default_rng(6)
+        pid = rng.integers(10, 4_000, n).astype(np.int32)
+        pk = rng.integers(0, 32, n).astype(np.int32)
+        enc, info = wirecodec.make_encoder(pid, pk, None,
+                                           num_partitions=32, k=6)
+        assert enc is not None and enc.entry_counts is not None
+        with enc:
+            np.testing.assert_array_equal(enc.sort_range(0, 6),
+                                          enc.entry_counts)
+
+    def test_huge_span_disables_entry_counts(self):
+        pid = np.array([0, 1 << 30], dtype=np.int64)
+        assert wirecodec.rle_entry_counts_numpy(pid, 0, 2, 1 << 30) is None
+
+
+class TestAdversarialStreamedInputs:
+    """Hostile inputs through the full streamed path."""
+
+    def _stream(self, pid, pk, value, P, **kw):
+        import jax
+        args = dict(num_partitions=P, linf_cap=len(pid), l0_cap=P,
+                    row_clip_lo=-np.inf, row_clip_hi=np.inf, middle=0.0,
+                    group_clip_lo=-np.inf, group_clip_hi=np.inf,
+                    n_chunks=3, has_group_clip=False)
+        args.update(kw)
+        return streaming.stream_bound_and_aggregate(
+            jax.random.PRNGKey(0), pid, pk, value, **args)
+
+    def test_nan_inf_values_roundtrip(self):
+        n = 10_000
+        rng = np.random.default_rng(0)
+        pid = rng.integers(0, 500, n).astype(np.int32)
+        pk = rng.integers(1, 8, n).astype(np.int32)  # partition 0 clean
+        value = rng.uniform(0, 1, n).astype(np.float32)
+        value[::7] = np.nan
+        value[1::7] = np.inf
+        value[2::7] = -np.inf
+        pk[:100] = 0
+        value[:100] = 1.0  # partition 0 gets only finite values
+        accs = self._stream(pid, pk, value, 8)
+        # Counts never touch the value column: exact despite NaN/Inf.
+        np.testing.assert_allclose(np.asarray(accs.count),
+                                   np.bincount(pk, minlength=8))
+        # The clean partition's sum is exact; poisoned partitions
+        # propagate their NaN/Inf honestly instead of corrupting others.
+        assert float(np.asarray(accs.sum)[0]) == 100.0
+
+    def test_empty_and_singleton_partitions(self):
+        # Public partitions 0..9; data only in partitions {3} (many rows)
+        # and {7} (exactly one row). Streamed == single-shot == truth.
+        import pipelinedp_tpu as pdp
+        pid = np.concatenate([np.arange(200), [999]]).astype(np.int64)
+        pk = np.concatenate([np.full(200, 3), [7]]).astype(np.int32)
+        value = np.concatenate([np.ones(200), [2.5]]).astype(np.float32)
+
+        def run(chunks):
+            accountant = pdp.NaiveBudgetAccountant(1e9, 1 - 1e-9)
+            engine = pdp.JaxDPEngine(accountant, seed=5,
+                                     stream_chunks=chunks,
+                                     secure_host_noise=False)
+            params = pdp.AggregateParams(
+                metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+                max_partitions_contributed=10,
+                max_contributions_per_partition=10,
+                min_value=0.0, max_value=5.0)
+            result = engine.aggregate(
+                pdp.ColumnarData(pid=pid, pk=pk, value=value), params,
+                public_partitions=list(range(10)))
+            accountant.compute_budgets()
+            return result.to_columns()
+
+        single, streamed = run(1), run(3)
+        np.testing.assert_allclose(streamed["count"],
+                                   np.bincount(pk, minlength=10), atol=0.01)
+        np.testing.assert_allclose(single["count"], streamed["count"],
+                                   atol=0.01)
+        assert streamed["sum"][7] == pytest.approx(2.5, abs=0.01)
+        assert streamed["count"][0] == pytest.approx(0.0, abs=0.01)
+
+    def test_duplicate_public_partition_keys_collapse(self):
+        # A public partition list with duplicate keys must not double the
+        # output vocabulary (vocab collision hygiene).
+        import pipelinedp_tpu as pdp
+        pid = np.arange(50, dtype=np.int64)
+        pk = np.zeros(50, dtype=np.int32)
+        value = np.ones(50, dtype=np.float32)
+        accountant = pdp.NaiveBudgetAccountant(1e9, 1 - 1e-9)
+        engine = pdp.JaxDPEngine(accountant, seed=1,
+                                 secure_host_noise=False)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            min_value=0.0, max_value=1.0)
+        result = engine.aggregate(
+            pdp.ColumnarData(pid=pid, pk=pk, value=value), params,
+            public_partitions=[0, 1, 1, 0, 2])
+        accountant.compute_budgets()
+        cols = result.to_columns()
+        assert len(cols["partition_id"]) == 3
+        assert cols["count"][0] == pytest.approx(50.0, abs=0.01)
+
+    def test_all_rows_one_pid_rle_run_split_streamed(self):
+        # One privacy id with 70k rows forces uint16 run splitting inside
+        # a single bucket; exactness must survive.
+        n = 70_000
+        pid = np.full(n, 42, dtype=np.int64)
+        pk = (np.arange(n) % 5).astype(np.int32)
+        value = np.ones(n, dtype=np.float32)
+        accs = self._stream(pid, pk, value, 5, n_chunks=2)
+        np.testing.assert_allclose(np.asarray(accs.count),
+                                   np.bincount(pk, minlength=5))
