@@ -124,10 +124,51 @@ def _coarse_phases(stages: dict, e2e_s: float) -> dict:
         "selection_sync_s": round(stages.get("dp/partition_selection",
                                              0.0), 3),
         "noise_s": round(stages.get("dp/noise", 0.0), 3),
+        # Fused epilogue (ops/finalize.py): the whole post-aggregation
+        # path in one dispatch; finalize_transfer is the single batched
+        # device->host sync that replaced the per-metric np.asarray tail.
+        "finalize_s": round(stages.get("dp/finalize", 0.0), 3),
+        "finalize_transfer_s": round(stages.get("dp/finalize_transfer",
+                                                0.0), 3),
     }
     phases["host_encode_overlapped"] = bool(
         sort_upfront == 0.0 and slab_host > 0.0)
     return phases
+
+
+def bench_e2e_steady(pid, pk, value, n_calls=4, secure_host_noise=True):
+    """Warm-cache steady state: n_calls repeated `aggregate` calls of the
+    same query shape, each through a FRESH engine/accountant (executables
+    are cached process-wide). Separates compile amortization from kernel
+    gains: the first call pays every trace, steady-state calls must pay
+    zero (per-call epilogue trace counts are reported to prove it).
+    """
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu.ops import finalize
+
+    times, traces = [], []
+    for i in range(n_calls):
+        traces_before = finalize.trace_count()
+        t0 = time.perf_counter()
+        data = pdp.ColumnarData(pid=pid, pk=pk, value=value)
+        accountant = pdp.NaiveBudgetAccountant(EPS, DELTA)
+        engine = pdp.JaxDPEngine(accountant, seed=i,
+                                 secure_host_noise=secure_host_noise)
+        result = engine.aggregate(data, _params())
+        accountant.compute_budgets()
+        cols = result.to_columns()
+        assert int(np.asarray(cols["keep_mask"]).sum()) > 0
+        times.append(time.perf_counter() - t0)
+        traces.append(finalize.trace_count() - traces_before)
+    cache = finalize.default_cache()
+    return {
+        "first_call_partitions_per_sec": round(N_PARTITIONS / times[0], 1),
+        "steady_state_partitions_per_sec": round(
+            N_PARTITIONS / min(times[1:]), 1),
+        "per_call_epilogue_traces": traces,
+        "epilogue_cache_hits": cache.hits,
+        "epilogue_cache_misses": cache.misses,
+    }
 
 
 def bench_kernel(pid, pk, value) -> float:
@@ -278,8 +319,18 @@ def bench_cpu_baseline() -> float:
 
 def main():
     cpu_pps = bench_cpu_baseline()
+    steady = {}
     try:
         pid, pk, value = _host_columns()
+        # Steady-state rows run FIRST (cold process caches) so the
+        # first-call column genuinely includes every compile; the headline
+        # e2e below then starts warm, as before (warmup + min-of-3).
+        steady["e2e_steady"] = bench_e2e_steady(pid, pk, value)
+        steady["e2e_device_noise_steady"] = bench_e2e_steady(
+            pid, pk, value, n_calls=3, secure_host_noise=False)
+    except Exception as e:  # noqa: BLE001
+        steady["e2e_steady_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
         e2e_pps, e2e_phases = bench_e2e(pid, pk, value)
         kernel_pps = bench_kernel(pid, pk, value)
     except Exception as e:  # noqa: BLE001 — report the failure, don't crash
@@ -289,9 +340,10 @@ def main():
             "unit": "partitions/sec",
             "vs_baseline": 0.0,
             "error": f"{type(e).__name__}: {e}"[:300],
+            **steady,
         }))
         sys.exit(0)
-    extra = {}
+    extra = dict(steady)
     try:
         # De-confounding row (round-5 advisor): the same shape with
         # uniform CONTINUOUS values, which defeat the affine-integer plane

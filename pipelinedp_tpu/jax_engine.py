@@ -21,6 +21,7 @@ time (after compute_budgets), so recompilation never depends on budgets.
 from __future__ import annotations
 
 import enum
+import functools
 import math
 from typing import Any, List, Optional, Sequence, Tuple
 
@@ -39,6 +40,7 @@ from pipelinedp_tpu.aggregate_params import (
 from pipelinedp_tpu import dp_engine as dp_engine_lib
 from pipelinedp_tpu.data_extractors import DataExtractors
 from pipelinedp_tpu.ops import columnar, encoding, noise as noise_ops
+from pipelinedp_tpu.ops import finalize as finalize_ops
 from pipelinedp_tpu.ops import streaming
 from pipelinedp_tpu.ops import quantiles as quantile_ops
 from pipelinedp_tpu.ops import selection as selection_ops
@@ -146,23 +148,23 @@ class LazyJaxResult(_LazyColumns):
     def __iter__(self):
         cols = self.to_columns()
         keep = np.asarray(cols["keep_mask"])
-        ids = np.asarray(cols["partition_id"])
+        kept_idx = np.flatnonzero(keep)
+        # One batched vocabulary decode + one tolist per column instead of
+        # a per-row decode/float() host loop.
+        keys = self._pk_vocab.decode_all(
+            np.asarray(cols["partition_id"])[kept_idx])
         metric_names = [
             name for name in cols
             if name not in ("partition_id", "keep_mask")
         ]
-        metric_arrays = [np.asarray(cols[name]) for name in metric_names]
+        kept_columns = []
+        for name in metric_names:
+            arr = np.asarray(cols[name])[kept_idx]
+            kept_columns.append(arr.tolist() if arr.ndim == 1 else list(arr))
         tuple_type = combiners_lib._get_or_create_named_tuple(
             "MetricsTuple", tuple(metric_names))
-
-        def element(arr, i):
-            return float(arr[i]) if arr.ndim == 1 else arr[i]
-
-        for i in range(len(ids)):
-            if keep[i]:
-                yield (self._pk_vocab.decode(int(ids[i])),
-                       tuple_type(*(element(arr, i)
-                                    for arr in metric_arrays)))
+        for key, *metrics in zip(keys, *kept_columns):
+            yield (key, tuple_type(*metrics))
 
 
 class _LazySelectedPartitions(_LazyColumns):
@@ -186,9 +188,13 @@ class _LazyNoisedValues(_LazyColumns):
         self._pk_col = pk_col
 
     def __iter__(self):
-        values = self.to_columns()["value"]
-        for pk, value in zip(self._pk_col, values):
-            yield (pk.item() if hasattr(pk, "item") else pk, float(value))
+        # Materialize both columns once (batched tolist gives native
+        # Python scalars) instead of one .item()/float() per row.
+        values = np.asarray(self.to_columns()["value"]).tolist()
+        pk_col = self._pk_col
+        if isinstance(pk_col, np.ndarray):
+            pk_col = pk_col.tolist()
+        yield from zip(pk_col, values)
 
 
 class _LazyCustomResult(_LazyColumns):
@@ -245,12 +251,24 @@ class JaxDPEngine:
                  mesh=None,
                  stream_chunks: Optional[int] = None,
                  value_transfer_dtype=None,
-                 transfer_encoding: str = "auto"):
+                 transfer_encoding: str = "auto",
+                 fused_epilogue: bool = True,
+                 epilogue_cache: Optional[finalize_ops.EpilogueCache] = None):
         self._budget_accountant = budget_accountant
         self._report_generators = []
         self._key_stream = KeyStream(jax.random.PRNGKey(seed))
         self._secure_host_noise = secure_host_noise
         self._mesh = mesh
+        # The fused finalization epilogue (ops/finalize.py): one compiled
+        # executable (device noise) or one batched host pass (secure host
+        # noise) instead of a per-combiner op/sync loop. False restores
+        # the legacy loop — kept as the parity oracle for tests.
+        self._fused_epilogue = fused_epilogue
+        # Executable cache shared across engines by default, so repeated
+        # queries with the same shape hit warm epilogues with zero
+        # retraces even from fresh engine instances.
+        self._epilogue_cache = (epilogue_cache if epilogue_cache is not None
+                                else finalize_ops.default_cache())
         # Streaming execution: large single-device inputs are hash-sharded
         # by privacy id into pid-disjoint chunks so the host->device
         # transfer overlaps the kernel (ops/streaming.py). stream_chunks=1
@@ -1068,6 +1086,72 @@ class JaxDPEngine:
                 valid_rows if self._mesh is not None else None,
                 precomputed_hist=streamed_qhist)
 
+        if self._fused_epilogue:
+            return self._fused_finalize(compound, params, selection_spec,
+                                        k_select, k_noise, accs, vector_sums,
+                                        quantile_cols, num_partitions,
+                                        is_public)
+        return self._legacy_finalize(compound, params, selection_spec,
+                                     k_select, k_noise, accs, vector_sums,
+                                     quantile_cols, num_partitions, num_out,
+                                     partition_exists, is_public)
+
+    def _fused_finalize(self, compound, params, selection_spec, k_select,
+                        k_noise, accs, vector_sums, quantile_cols,
+                        num_partitions, is_public) -> dict:
+        """The fused epilogue: plan construction + one dispatch + one
+        batched device→host transfer (ops/finalize.py)."""
+        max_rows_per_pid = 1
+        if (selection_spec is not None
+                and params.contribution_bounds_already_enforced):
+            max_rows_per_pid = (params.max_contributions
+                                or params.max_contributions_per_partition)
+        plan, scalars = finalize_ops.build_plan(
+            compound.combiners,
+            params,
+            selection_spec,
+            is_public=is_public,
+            num_partitions=num_partitions,
+            max_rows_per_pid=max_rows_per_pid)
+        with profiler.stage("dp/finalize"):
+            if self._secure_host_noise:
+                # One batched device→host transfer of every device-resident
+                # input; selection, noise and metric math then run in
+                # float64 numpy with noise_core's full granularity
+                # snapping.
+                with profiler.stage("dp/finalize_transfer"):
+                    host_accs, host_vec = jax.device_get(
+                        (accs, vector_sums))
+                metric_cols, keep = finalize_ops.host_epilogue(
+                    plan, scalars, host_accs, host_vec)
+            else:
+                operands = finalize_ops.device_operands(
+                    plan, scalars, accs, vector_sums, k_select, k_noise)
+                if self._mesh is not None:
+                    from pipelinedp_tpu.parallel import sharded
+                    builder = functools.partial(
+                        sharded.build_finalize_epilogue, self._mesh)
+                else:
+                    builder = None
+                epilogue = self._epilogue_cache.get(plan,
+                                                    self._mesh,
+                                                    operands,
+                                                    builder=builder)
+                device_cols, device_keep = epilogue(operands)
+                with profiler.stage("dp/finalize_transfer"):
+                    metric_cols, keep = jax.device_get(
+                        (device_cols, device_keep))
+        return finalize_ops.materialize(plan, scalars, metric_cols, keep,
+                                        quantile_cols=quantile_cols)
+
+    def _legacy_finalize(self, compound, params, selection_spec, k_select,
+                         k_noise, accs, vector_sums, quantile_cols,
+                         num_partitions, num_out, partition_exists,
+                         is_public) -> dict:
+        """The unfused per-combiner epilogue loop (fused_epilogue=False):
+        one device op + blocking sync per metric. Kept as the parity
+        oracle — tests/finalize_test.py pins the fused epilogue
+        bit-identical to this path for seeded device-noise runs."""
         # Partition selection. The selection strategy's L0 sensitivity is
         # the *declared* cross-partition bound: max_partitions_contributed,
         # or max_contributions in L1 mode (the per-privacy-id total sample
@@ -1159,8 +1243,10 @@ class JaxDPEngine:
                 return keep & np.asarray(exists), noised
             sel_params = selection_ops.selection_params_from_strategy(
                 strategy)
-            return selection_ops.select_partitions(key, counts, sel_params,
-                                                   exists)
+            # Compiled entry: selection bits must not depend on whether the
+            # kernel runs standalone or inlined in the fused epilogue.
+            return selection_ops.select_partitions_jit(key, counts,
+                                                       sel_params, exists)
 
     # -- noise dispatch: device kernels or float64 host finalization --------
 
@@ -1170,20 +1256,24 @@ class JaxDPEngine:
                 return noise_core.add_noise_array(np.asarray(values),
                                                   bool(is_gaussian),
                                                   float(scale_or_std))
-            return noise_ops.add_noise(key, values, is_gaussian,
-                                       scale_or_std, granularity)
+            return noise_ops.add_noise_compiled(key, jnp.asarray(values),
+                                                is_gaussian, scale_or_std,
+                                                granularity)
 
     def _add_laplace(self, key, values, scale, granularity):
         if self._secure_host_noise:
             return noise_core.add_laplace_noise_array(np.asarray(values),
                                                       float(scale))
-        return noise_ops.add_laplace_noise(key, values, scale, granularity)
+        return noise_ops.add_laplace_noise_compiled(key, jnp.asarray(values),
+                                                    scale, granularity)
 
     def _add_gaussian(self, key, values, stddev, granularity):
         if self._secure_host_noise:
             return noise_core.add_gaussian_noise_array(np.asarray(values),
                                                        float(stddev))
-        return noise_ops.add_gaussian_noise(key, values, stddev, granularity)
+        return noise_ops.add_gaussian_noise_compiled(key,
+                                                     jnp.asarray(values),
+                                                     stddev, granularity)
 
     @staticmethod
     def _noise_stddev_column(columns: dict, name: str, is_gaussian,
@@ -1433,7 +1523,12 @@ class JaxDPEngine:
         sq_linf = linf * abs(sq_middle - sq_lo)
         dp_mean_sq = noise_arr(k3, accs.norm_sq_sum, b_sq,
                                sq_linf) / count_clamped
-        dp_var = dp_mean_sq - dp_mean_normalized**2
+        if self._secure_host_noise:
+            dp_var = dp_mean_sq - dp_mean_normalized**2
+        else:
+            # Compiled: identical FMA contraction to the fused epilogue.
+            dp_var = finalize_ops.variance_from_moments(dp_mean_sq,
+                                                        dp_mean_normalized)
         # Parity with compute_dp_var: the middle is added only for a proper
         # range (when min == max the normalized mean is reported as-is).
         dp_mean = dp_mean_normalized + (

@@ -1,0 +1,714 @@
+"""Fused DP finalization epilogue: plan construction + one dispatch.
+
+Everything that happens after the fused bound-and-aggregate kernel —
+private partition selection, every combiner's noise draw, mean/variance
+arithmetic, post-aggregation thresholding, keep-mask application and the
+mesh-padding trim — used to run as a host-side Python loop over combiners,
+one tiny device op per metric interleaved with blocking ``np.asarray``
+syncs (jax_engine._compute_combiner_metrics). This module collapses that
+epilogue into:
+
+  * a static :class:`FinalizePlan`, derived from the compound combiner
+    list: which accumulator columns feed which metrics, the noise mode per
+    metric, the selection strategy kind, thresholding, the public-partition
+    mask and the output-stddev flags. The plan is hashable and contains no
+    budget-dependent values, so it doubles as the jit cache key;
+  * per-execution :class:`FinalizeScalars`: noise scales / granularities /
+    selection constants read off the *resolved* mechanism specs. They enter
+    the compiled epilogue as dynamic operands, so the lazy-budget contract
+    survives jit — recompilation never depends on budgets;
+  * one compiled epilogue (:func:`epilogue_body` under ``jax.jit``) for the
+    device-noise path, with all per-combiner draws batched into stacked
+    ``[n_metrics, num_out]`` noise kernels (ops/noise.add_noise_batched):
+    the per-metric keys reproduce the legacy
+    ``split(fold_in(k_noise, i), 3)`` derivation bit-for-bit, so seeded
+    device-noise runs are unchanged across the fusion (pinned by
+    tests/finalize_test.py);
+  * a float64 host twin (:func:`host_epilogue`) for the secure-host-noise
+    path that keeps noise_core's full granularity snapping but consumes the
+    accumulators from ONE batched device→host transfer instead of one
+    blocking sync per metric, drawing host noise in the exact legacy order
+    (so the seeded fallback RNG sequence is also unchanged);
+  * an engine-level :class:`EpilogueCache` keyed on
+    ``(plan, shapes, dtypes, mesh)``: a second ``aggregate`` call with the
+    same query shape reuses the compiled executable with zero retraces
+    (counted via profiler.count_event — see :func:`trace_count`).
+
+Noise stddev outputs ride the plan as *scalars* and are expanded to
+columns only at :func:`materialize` time (one ``np.full`` per released
+dict instead of one per combiner per call).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pipelinedp_tpu import combiners as combiners_lib
+from pipelinedp_tpu import dp_computations
+from pipelinedp_tpu import noise_core
+from pipelinedp_tpu import partition_selection as ps_lib
+from pipelinedp_tpu import profiler
+from pipelinedp_tpu.aggregate_params import NoiseKind
+from pipelinedp_tpu.ops import noise as noise_ops
+from pipelinedp_tpu.ops import selection as selection_ops
+
+# Selection sentinels (plan.selection_kind). Non-negative values are
+# ops/selection strategy kinds (TRUNCATED_GEOMETRIC / *_THRESHOLDING).
+SEL_PUBLIC = -1  # keep the first num_partitions rows (public partitions)
+SEL_EXISTS = -2  # keep partitions with data (post-agg thresholding prunes)
+
+# Noise slot modes. 'select' is the branchless two-draw kernel
+# (ops/noise.add_noise: laplace + gaussian drawn, one selected — the
+# additive-mechanism path); 'laplace'/'gaussian' are the single-draw
+# kernels (variance / vector sums, where the kind is static in the
+# params); 'none' passes the accumulator through un-noised (zero
+# sensitivity).
+MODE_SELECT = "select"
+MODE_LAPLACE = "laplace"
+MODE_GAUSSIAN = "gaussian"
+MODE_NONE = "none"
+
+_TRACE_EVENT = "dp/finalize_traces"
+_CACHE_HIT_EVENT = "dp/finalize_cache_hits"
+_CACHE_MISS_EVENT = "dp/finalize_cache_misses"
+
+
+@dataclasses.dataclass(frozen=True)
+class NoiseSlot:
+    """One noise draw of the epilogue.
+
+    The key derivation replays the legacy per-combiner loop exactly:
+    ``sub_key = fold_in(k_noise, comb_idx)`` then
+    ``split(sub_key, 3)[split_idx]`` — so fused device noise is
+    bit-identical to the unfused path for the same engine seed.
+    """
+    comb_idx: int  # index into compound.combiners
+    split_idx: int  # which of split(sub_key, 3) keys the draw consumes
+    source: str  # accumulator column ('count', 'norm_sum', ...) or 'vector'
+    mode: str  # MODE_SELECT / MODE_LAPLACE / MODE_GAUSSIAN / MODE_NONE
+
+
+@dataclasses.dataclass(frozen=True)
+class FinalizePlan:
+    """Static description of the whole post-aggregation path.
+
+    Hashable (all-tuple payloads) and free of budget-dependent values:
+    (eps, delta)-derived scales live in FinalizeScalars and enter the
+    compiled epilogue as runtime operands.
+    """
+    ops: Tuple[tuple, ...]  # per-combiner op descriptors, in combiner order
+    slots: Tuple[NoiseSlot, ...]
+    out_columns: Tuple[tuple, ...]  # ordered ('col'|'qcol'|'stddev', name, i)
+    selection_kind: int  # SEL_PUBLIC / SEL_EXISTS / ops.selection kind
+    thresh_kind: int  # selection kind of post-agg thresholding, or -1
+    thresh_comb_idx: int  # combiner index of the thresholding combiner
+    num_partitions: int  # trim target (mesh padding is dropped here)
+    has_vector: bool
+
+
+@dataclasses.dataclass
+class FinalizeScalars:
+    """Per-execution dynamic values, read off resolved mechanism specs."""
+    slot_isg: Tuple[bool, ...] = ()
+    slot_scale: Tuple[float, ...] = ()
+    slot_gran: Tuple[float, ...] = ()
+    sel_strategy: Optional[ps_lib.PartitionSelection] = None
+    sel_params: Optional[selection_ops.SelectionParams] = None
+    thresh_strategy: Optional[ps_lib.PartitionSelection] = None
+    thresh_params: Optional[selection_ops.SelectionParams] = None
+    max_rows_per_pid: float = 1.0
+    mean_middle: float = 0.0
+    var_shift: float = 0.0
+    stddevs: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+def _mechanism_noise_params(spec, sensitivities):
+    """(is_gaussian, scale_or_std, granularity) for a resolved spec."""
+    mech = dp_computations.create_additive_mechanism(spec, sensitivities)
+    if mech.noise_kind == NoiseKind.GAUSSIAN:
+        return True, mech.std, noise_core.gaussian_granularity(mech.std)
+    return False, mech.noise_parameter, noise_core.laplace_granularity(
+        mech.noise_parameter)
+
+
+def _released_stddev(is_gaussian: bool, scale_or_std: float) -> float:
+    """Stddev of the released additive noise (Laplace: b*sqrt(2))."""
+    return (float(scale_or_std)
+            if is_gaussian else float(scale_or_std) * math.sqrt(2.0))
+
+
+def build_plan(combiners: Sequence[combiners_lib.Combiner],
+               params,
+               selection_spec,
+               *,
+               is_public: bool,
+               num_partitions: int,
+               max_rows_per_pid: int = 1
+               ) -> Tuple[FinalizePlan, FinalizeScalars]:
+    """Derives (plan, scalars) from a compound combiner list.
+
+    Must run after BudgetAccountant.compute_budgets() — the scalars read
+    eps/delta off the resolved specs (the lazy-budget contract: reading an
+    unresolved spec raises). The plan itself is structural and would be
+    identical across budgets.
+    """
+    ops: list = []
+    slots: list = []
+    out_columns: list = []
+    scalars = FinalizeScalars()
+    slot_isg: list = []
+    slot_scale: list = []
+    slot_gran: list = []
+    stddevs: Dict[str, float] = {}
+    thresh_kind = -1
+    thresh_comb_idx = -1
+    has_vector = False
+
+    def add_slot(comb_idx, split_idx, source, mode, is_g, scale, gran) -> int:
+        slots.append(NoiseSlot(comb_idx, split_idx, source, mode))
+        slot_isg.append(bool(is_g))
+        slot_scale.append(float(scale))
+        slot_gran.append(float(gran))
+        return len(slots) - 1
+
+    for i, combiner in enumerate(combiners):
+        if isinstance(combiner, combiners_lib.CountCombiner):
+            is_g, scale, gran = _mechanism_noise_params(
+                combiner.mechanism_spec(), combiner.sensitivities())
+            slot = add_slot(i, 0, "count", MODE_SELECT, is_g, scale, gran)
+            ops.append(("count", slot))
+            out_columns.append(("col", "count", None))
+            if params.output_noise_stddev:
+                stddevs["count_noise_stddev"] = _released_stddev(is_g, scale)
+                out_columns.append(("stddev", "count_noise_stddev", None))
+        elif isinstance(combiner, combiners_lib.SumCombiner):
+            is_g, scale, gran = _mechanism_noise_params(
+                combiner.mechanism_spec(), combiner.sensitivities())
+            slot = add_slot(i, 0, "sum", MODE_SELECT, is_g, scale, gran)
+            ops.append(("sum", slot))
+            out_columns.append(("col", "sum", None))
+            if params.output_noise_stddev:
+                stddevs["sum_noise_stddev"] = _released_stddev(is_g, scale)
+                out_columns.append(("stddev", "sum_noise_stddev", None))
+        elif isinstance(combiner, combiners_lib.PrivacyIdCountCombiner):
+            is_g, scale, gran = _mechanism_noise_params(
+                combiner.mechanism_spec(), combiner.sensitivities())
+            slot = add_slot(i, 0, "pid_count", MODE_SELECT, is_g, scale,
+                            gran)
+            ops.append(("privacy_id_count", slot))
+            out_columns.append(("col", "privacy_id_count", None))
+            if params.output_noise_stddev:
+                stddevs["privacy_id_count_noise_stddev"] = _released_stddev(
+                    is_g, scale)
+                out_columns.append(
+                    ("stddev", "privacy_id_count_noise_stddev", None))
+        elif isinstance(combiner,
+                        combiners_lib.PostAggregationThresholdingCombiner):
+            thresh = dp_computations.create_thresholding_mechanism(
+                combiner.mechanism_spec(), combiner.sensitivities(),
+                params.pre_threshold)
+            scalars.thresh_strategy = thresh.strategy
+            scalars.thresh_params = (
+                selection_ops.selection_params_from_strategy(thresh.strategy))
+            thresh_kind = scalars.thresh_params.kind
+            thresh_comb_idx = i
+            ops.append(("post_thresh",))
+            out_columns.append(("col", "privacy_id_count", None))
+            if params.output_noise_stddev:
+                stddevs["privacy_id_count_noise_stddev"] = float(
+                    thresh.strategy.noise_stddev)
+                out_columns.append(
+                    ("stddev", "privacy_id_count_noise_stddev", None))
+        elif isinstance(combiner, combiners_lib.MeanCombiner):
+            count_spec, sum_spec = combiner.mechanism_spec()
+            cg, cs, cgr = _mechanism_noise_params(
+                count_spec, combiner._count_sensitivities)
+            sg, ss, sgr = _mechanism_noise_params(
+                sum_spec, combiner._sum_sensitivities)
+            c_slot = add_slot(i, 0, "count", MODE_SELECT, cg, cs, cgr)
+            s_slot = add_slot(i, 1, "norm_sum", MODE_SELECT, sg, ss, sgr)
+            scalars.mean_middle = dp_computations.compute_middle(
+                params.min_value, params.max_value)
+            names = combiner.metrics_names()
+            ops.append(("mean", c_slot, s_slot, "count" in names,
+                        "sum" in names))
+            out_columns.append(("col", "mean", None))
+            if "count" in names:
+                out_columns.append(("col", "count", None))
+            if "sum" in names:
+                out_columns.append(("col", "sum", None))
+        elif isinstance(combiner, combiners_lib.VarianceCombiner):
+            p = combiner._params
+            b_count, b_sum, b_sq = dp_computations.equally_split_budget(
+                p.eps, p.delta, 3)
+            l0 = params.max_partitions_contributed
+            linf = params.max_contributions_per_partition
+            middle = dp_computations.compute_middle(params.min_value,
+                                                    params.max_value)
+            sq_lo, sq_hi = dp_computations.compute_squares_interval(
+                params.min_value, params.max_value)
+            sq_middle = dp_computations.compute_middle(sq_lo, sq_hi)
+            is_gaussian = params.noise_kind == NoiseKind.GAUSSIAN
+
+            def var_slot(split_idx, source, eps_delta, linf_sens):
+                if linf_sens == 0:
+                    return add_slot(i, split_idx, source, MODE_NONE,
+                                    is_gaussian, 0.0, 0.0)
+                if is_gaussian:
+                    sigma = noise_core.analytic_gaussian_sigma(
+                        eps_delta[0], eps_delta[1],
+                        dp_computations.compute_l2_sensitivity(l0, linf_sens))
+                    return add_slot(i, split_idx, source, MODE_GAUSSIAN,
+                                    True, sigma,
+                                    noise_core.gaussian_granularity(sigma))
+                scale = noise_core.laplace_diversity(
+                    eps_delta[0],
+                    dp_computations.compute_l1_sensitivity(l0, linf_sens))
+                return add_slot(i, split_idx, source, MODE_LAPLACE, False,
+                                scale, noise_core.laplace_granularity(scale))
+
+            c_slot = var_slot(0, "count", b_count, linf)
+            s_slot = var_slot(1, "norm_sum", b_sum,
+                              linf * abs(middle - params.min_value))
+            q_slot = var_slot(2, "norm_sq_sum", b_sq,
+                              linf * abs(sq_middle - sq_lo))
+            scalars.var_shift = (middle if params.min_value !=
+                                 params.max_value else 0.0)
+            names = combiner.metrics_names()
+            ops.append(("variance", c_slot, s_slot, q_slot, "mean" in names,
+                        "count" in names, "sum" in names))
+            out_columns.append(("col", "variance", None))
+            if "mean" in names:
+                out_columns.append(("col", "mean", None))
+            if "count" in names:
+                out_columns.append(("col", "count", None))
+            if "sum" in names:
+                out_columns.append(("col", "sum", None))
+        elif isinstance(combiner, combiners_lib.QuantileCombiner):
+            # Quantile columns are finished before the epilogue (the
+            # histogram/tree walk pipeline, ops/quantiles.py); the plan
+            # just routes them into the released dict, in order.
+            ops.append(("quantile",))
+            for j, name in enumerate(combiner.metrics_names()):
+                out_columns.append(("qcol", name, j))
+        elif isinstance(combiner, combiners_lib.VectorSumCombiner):
+            noise_params = combiner._params.additive_vector_noise_params
+            if noise_params.noise_kind == NoiseKind.LAPLACE:
+                l1 = (noise_params.l0_sensitivity *
+                      noise_params.linf_sensitivity)
+                scale = l1 / noise_params.eps_per_coordinate
+                slot = add_slot(i, 0, "vector", MODE_LAPLACE, False, scale,
+                                noise_core.laplace_granularity(scale))
+                std = _released_stddev(False, scale)
+            else:
+                l2 = (math.sqrt(noise_params.l0_sensitivity) *
+                      noise_params.linf_sensitivity)
+                sigma = noise_core.analytic_gaussian_sigma(
+                    noise_params.eps_per_coordinate,
+                    noise_params.delta_per_coordinate, l2)
+                slot = add_slot(i, 0, "vector", MODE_GAUSSIAN, True, sigma,
+                                noise_core.gaussian_granularity(sigma))
+                std = _released_stddev(True, sigma)
+            has_vector = True
+            ops.append(("vector_sum", slot))
+            out_columns.append(("col", "vector_sum", None))
+            if params.output_noise_stddev:
+                stddevs["vector_sum_noise_stddev"] = std
+                out_columns.append(
+                    ("stddev", "vector_sum_noise_stddev", None))
+        else:
+            raise NotImplementedError(
+                f"Combiner {type(combiner).__name__} is not supported on "
+                f"the columnar engine.")
+
+    if is_public:
+        selection_kind = SEL_PUBLIC
+    elif selection_spec is not None:
+        declared_l0 = (params.max_partitions_contributed
+                       or params.max_contributions or 1)
+        strategy = ps_lib.create_partition_selection_strategy(
+            params.partition_selection_strategy, selection_spec.eps,
+            selection_spec.delta, declared_l0, params.pre_threshold)
+        scalars.sel_strategy = strategy
+        scalars.sel_params = selection_ops.selection_params_from_strategy(
+            strategy)
+        selection_kind = scalars.sel_params.kind
+        scalars.max_rows_per_pid = float(max_rows_per_pid)
+    else:
+        selection_kind = SEL_EXISTS
+
+    scalars.slot_isg = tuple(slot_isg)
+    scalars.slot_scale = tuple(slot_scale)
+    scalars.slot_gran = tuple(slot_gran)
+    scalars.stddevs = stddevs
+    plan = FinalizePlan(ops=tuple(ops),
+                        slots=tuple(slots),
+                        out_columns=tuple(out_columns),
+                        selection_kind=selection_kind,
+                        thresh_kind=thresh_kind,
+                        thresh_comb_idx=thresh_comb_idx,
+                        num_partitions=int(num_partitions),
+                        has_vector=has_vector)
+    return plan, scalars
+
+
+# -- operand packing ---------------------------------------------------------
+
+
+def device_operands(plan: FinalizePlan, scalars: FinalizeScalars, accs,
+                    vector_sums, k_select, k_noise) -> dict:
+    """The dynamic operand pytree for the compiled epilogue.
+
+    Keys present depend only on the (static) plan, so the pytree structure
+    is stable per plan and never forces a retrace. All scale-like values
+    ship as float32 — the dtype the legacy eager path's weak-typed Python
+    floats resolved to inside the kernels, keeping the fusion bit-exact.
+    """
+    op = {
+        "accs": accs,
+        "k_noise": k_noise,
+        "slot_isg": np.asarray(scalars.slot_isg, dtype=bool),
+        "slot_scale": np.asarray(scalars.slot_scale, dtype=np.float32),
+        "slot_gran": np.asarray(scalars.slot_gran, dtype=np.float32),
+    }
+    if plan.has_vector:
+        op["vector_sums"] = vector_sums
+    if plan.selection_kind >= 0:
+        op["k_select"] = k_select
+        op["sel_floats"] = selection_ops.pack_operands(scalars.sel_params)
+        op["max_rows_per_pid"] = np.float32(scalars.max_rows_per_pid)
+    if plan.thresh_kind >= 0:
+        op["thresh_floats"] = selection_ops.pack_operands(
+            scalars.thresh_params)
+    if any(entry[0] == "mean" for entry in plan.ops):
+        op["mean_middle"] = np.float32(scalars.mean_middle)
+    if any(entry[0] == "variance" for entry in plan.ops):
+        op["var_shift"] = np.float32(scalars.var_shift)
+    return op
+
+
+def _slot_key(k_noise, slot: NoiseSlot):
+    sub_key = jax.random.fold_in(k_noise, slot.comb_idx)
+    return jax.random.split(sub_key, 3)[slot.split_idx]
+
+
+@jax.jit
+def variance_from_moments(dp_mean_sq, dp_mean_normalized):
+    """DP variance from the two noised normalized moments.
+
+    Compiled so the mul-into-sub pair FMA-contracts identically whether
+    called standalone (the legacy per-combiner loop) or inlined in the
+    fused epilogue's jit — eager op-by-op execution rounds the square
+    separately and can differ in the last ulp (see
+    ops/noise.add_noise_compiled).
+    """
+    return dp_mean_sq - dp_mean_normalized**2
+
+
+# -- the fused device epilogue ----------------------------------------------
+
+
+def epilogue_body(plan: FinalizePlan, op: dict):
+    """Traced body of the fused epilogue: selection, batched noise,
+    combiner arithmetic and post-aggregation thresholding in one
+    executable. Returns (metric_columns, keep_mask) over the full
+    (possibly mesh-padded) partition axis; materialize() trims and masks.
+    """
+    profiler.count_event(_TRACE_EVENT)
+    accs = op["accs"]
+    num_out = accs.pid_count.shape[0]
+    partition_exists = accs.pid_count > 0
+
+    if plan.selection_kind == SEL_PUBLIC:
+        keep = jnp.arange(num_out) < plan.num_partitions
+    elif plan.selection_kind == SEL_EXISTS:
+        keep = partition_exists
+    else:
+        pid_counts_est = jnp.ceil(accs.pid_count / op["max_rows_per_pid"])
+        sel_params = selection_ops.unpack_operands(plan.selection_kind,
+                                                   op["sel_floats"])
+        keep, _ = selection_ops.select_partitions(op["k_select"],
+                                                  pid_counts_est, sel_params,
+                                                  partition_exists)
+
+    def source_of(slot: NoiseSlot):
+        if slot.source == "vector":
+            return op["vector_sums"]
+        return getattr(accs, slot.source)
+
+    # Batched noise: all scalar-column draws of one mode stack into a
+    # single [n_metrics, num_out] kernel; vector sums (different shape)
+    # draw individually. 'none' slots pass through un-noised.
+    noised: Dict[int, jnp.ndarray] = {}
+    groups: Dict[str, list] = {
+        MODE_SELECT: [],
+        MODE_LAPLACE: [],
+        MODE_GAUSSIAN: []
+    }
+    for idx, slot in enumerate(plan.slots):
+        if slot.mode == MODE_NONE:
+            noised[idx] = source_of(slot)
+        elif slot.source == "vector":
+            vec_key = _slot_key(op["k_noise"], slot)
+            if slot.mode == MODE_LAPLACE:
+                noised[idx] = noise_ops.add_laplace_noise(
+                    vec_key, op["vector_sums"], op["slot_scale"][idx],
+                    op["slot_gran"][idx])
+            else:
+                noised[idx] = noise_ops.add_gaussian_noise(
+                    vec_key, op["vector_sums"], op["slot_scale"][idx],
+                    op["slot_gran"][idx])
+        else:
+            groups[slot.mode].append(idx)
+    for mode, idxs in groups.items():
+        if not idxs:
+            continue
+        keys = jnp.stack([_slot_key(op["k_noise"], plan.slots[i])
+                          for i in idxs])
+        values = jnp.stack([source_of(plan.slots[i]) for i in idxs])
+        scales = jnp.stack([op["slot_scale"][i] for i in idxs])
+        grans = jnp.stack([op["slot_gran"][i] for i in idxs])
+        if mode == MODE_SELECT:
+            is_g = jnp.stack([op["slot_isg"][i] for i in idxs])
+            outs = noise_ops.add_noise_batched(keys, values, is_g, scales,
+                                               grans)
+        elif mode == MODE_LAPLACE:
+            outs = noise_ops.add_laplace_noise_batched(keys, values, scales,
+                                                       grans)
+        else:
+            outs = noise_ops.add_gaussian_noise_batched(keys, values, scales,
+                                                        grans)
+        for j, i in enumerate(idxs):
+            noised[i] = outs[j]
+
+    columns: Dict[str, jnp.ndarray] = {}
+    for entry in plan.ops:
+        tag = entry[0]
+        if tag in ("count", "sum", "privacy_id_count", "vector_sum"):
+            columns[tag] = noised[entry[1]]
+        elif tag == "mean":
+            _, c_slot, s_slot, emit_count, emit_sum = entry
+            dp_count = noised[c_slot]
+            dp_mean = op["mean_middle"] + noised[s_slot] / jnp.maximum(
+                1.0, dp_count)
+            columns["mean"] = dp_mean
+            if emit_count:
+                columns["count"] = dp_count
+            if emit_sum:
+                columns["sum"] = dp_mean * dp_count
+        elif tag == "variance":
+            _, c_slot, s_slot, q_slot, emit_mean, emit_count, emit_sum = entry
+            dp_count = noised[c_slot]
+            count_clamped = jnp.maximum(1.0, dp_count)
+            dp_mean_normalized = noised[s_slot] / count_clamped
+            dp_mean_sq = noised[q_slot] / count_clamped
+            columns["variance"] = variance_from_moments(
+                dp_mean_sq, dp_mean_normalized)
+            dp_mean = dp_mean_normalized + op["var_shift"]
+            if emit_mean:
+                columns["mean"] = dp_mean
+            if emit_count:
+                columns["count"] = dp_count
+            if emit_sum:
+                columns["sum"] = dp_mean * dp_count
+        elif tag == "post_thresh":
+            thresh_params = selection_ops.unpack_operands(
+                plan.thresh_kind, op["thresh_floats"])
+            thresh_key = jax.random.fold_in(op["k_noise"],
+                                            plan.thresh_comb_idx)
+            thresh_keep, thresh_noised = selection_ops.select_partitions(
+                thresh_key, accs.pid_count, thresh_params, partition_exists)
+            keep = keep & thresh_keep
+            columns["privacy_id_count"] = thresh_noised
+        # 'quantile' entries route finished host columns in materialize().
+    return columns, keep
+
+
+# -- the float64 host epilogue ----------------------------------------------
+
+
+def host_epilogue(plan: FinalizePlan, scalars: FinalizeScalars, accs,
+                  vector_sums):
+    """Secure-host-noise twin: float64 finalization over numpy
+    accumulators that arrived in ONE batched device→host transfer.
+
+    The draw order (selection uniforms, then per-combiner noise in
+    combiner order) replays the legacy loop exactly, so a seeded fallback
+    RNG produces the identical release.
+    """
+    pid_count = np.asarray(accs.pid_count)
+    partition_exists = pid_count > 0
+
+    if plan.selection_kind == SEL_PUBLIC:
+        keep = np.arange(len(pid_count)) < plan.num_partitions
+    elif plan.selection_kind == SEL_EXISTS:
+        keep = partition_exists
+    else:
+        # float32 division + ceil to match the legacy device-computed
+        # estimate bit-for-bit before the host selection draw.
+        pid_counts_est = np.ceil(
+            pid_count.astype(np.float32) /
+            np.float32(scalars.max_rows_per_pid))
+        sel_keep, _ = scalars.sel_strategy.select_vec(pid_counts_est)
+        keep = sel_keep & partition_exists
+
+    def source_of(slot: NoiseSlot):
+        if slot.source == "vector":
+            return np.asarray(vector_sums)
+        return np.asarray(getattr(accs, slot.source))
+
+    def draw(slot_idx: int):
+        slot = plan.slots[slot_idx]
+        values = source_of(slot)
+        if slot.mode == MODE_NONE:
+            return values
+        if slot.mode == MODE_SELECT:
+            return noise_core.add_noise_array(values,
+                                              scalars.slot_isg[slot_idx],
+                                              scalars.slot_scale[slot_idx])
+        if slot.mode == MODE_LAPLACE:
+            return noise_core.add_laplace_noise_array(
+                values, scalars.slot_scale[slot_idx])
+        return noise_core.add_gaussian_noise_array(
+            values, scalars.slot_scale[slot_idx])
+
+    columns: Dict[str, np.ndarray] = {}
+    for entry in plan.ops:
+        tag = entry[0]
+        if tag in ("count", "sum", "privacy_id_count", "vector_sum"):
+            columns[tag] = draw(entry[1])
+        elif tag == "mean":
+            _, c_slot, s_slot, emit_count, emit_sum = entry
+            dp_count = draw(c_slot)
+            dp_norm_sum = draw(s_slot)
+            dp_mean = scalars.mean_middle + dp_norm_sum / np.maximum(
+                1.0, dp_count)
+            columns["mean"] = dp_mean
+            if emit_count:
+                columns["count"] = dp_count
+            if emit_sum:
+                columns["sum"] = dp_mean * dp_count
+        elif tag == "variance":
+            _, c_slot, s_slot, q_slot, emit_mean, emit_count, emit_sum = entry
+            dp_count = draw(c_slot)
+            count_clamped = np.maximum(1.0, dp_count)
+            dp_mean_normalized = draw(s_slot) / count_clamped
+            dp_mean_sq = draw(q_slot) / count_clamped
+            columns["variance"] = dp_mean_sq - dp_mean_normalized**2
+            dp_mean = dp_mean_normalized + scalars.var_shift
+            if emit_mean:
+                columns["mean"] = dp_mean
+            if emit_count:
+                columns["count"] = dp_count
+            if emit_sum:
+                columns["sum"] = dp_mean * dp_count
+        elif tag == "post_thresh":
+            thresh_keep, thresh_noised = scalars.thresh_strategy.select_vec(
+                pid_count)
+            keep = keep & (thresh_keep & partition_exists)
+            columns["privacy_id_count"] = thresh_noised
+    return columns, keep
+
+
+# -- materialization ---------------------------------------------------------
+
+
+def materialize(plan: FinalizePlan, scalars: FinalizeScalars,
+                metric_cols: Dict[str, Any], keep_mask,
+                quantile_cols=None) -> dict:
+    """Final released dict: trim mesh padding to num_partitions, expand
+    stddev scalars to columns, splice quantile columns, NaN-mask non-kept
+    partitions — preserving the legacy column insertion order (the
+    MetricsTuple field order consumers iterate)."""
+    n = plan.num_partitions
+    keep = np.asarray(keep_mask)[:n]
+    out: dict = {}
+    for kind, name, payload in plan.out_columns:
+        if kind == "col":
+            arr = np.asarray(metric_cols[name])[:n]
+        elif kind == "qcol":
+            arr = np.asarray(quantile_cols[:, payload])[:n]
+        else:  # 'stddev': plan-scalar expanded only here
+            arr = np.full(n, scalars.stddevs[name], dtype=np.float64)
+        mask = keep if arr.ndim == 1 else keep[:, None]
+        out[name] = np.where(mask, arr, np.nan)
+    out["partition_id"] = np.arange(n, dtype=np.int32)
+    out["keep_mask"] = keep
+    return out
+
+
+# -- the executable cache ----------------------------------------------------
+
+
+def _abstract_signature(operands) -> tuple:
+    leaves, treedef = jax.tree_util.tree_flatten(operands)
+    return (treedef,
+            tuple((tuple(np.shape(leaf)), str(np.asarray(leaf).dtype)
+                   if not hasattr(leaf, "dtype") else str(leaf.dtype))
+                  for leaf in leaves))
+
+
+def _jit_entry(plan: FinalizePlan, op: dict):
+    return epilogue_body(plan, op)
+
+
+class EpilogueCache:
+    """Engine-level executable cache for the fused epilogue.
+
+    Keyed on (plan, operand shapes/dtypes, mesh): a second aggregate call
+    with an identical query shape reuses the compiled executable with zero
+    retraces (jit's own cache handles shapes/dtypes; this layer keeps one
+    jitted callable per (plan, mesh) so the callable identity — and with
+    it the jit cache — survives across engines). Hit/miss counts are
+    exposed for the bench and mirrored into profiler event counters.
+    """
+
+    def __init__(self):
+        self._executables: Dict[tuple, Any] = {}
+        self._seen_signatures = set()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, plan: FinalizePlan, mesh, operands, builder=None):
+        """The compiled epilogue for (plan, mesh); counts whether this
+        exact operand signature was seen before. builder(plan) supplies a
+        mesh-aware jit (parallel/sharded.build_finalize_epilogue)."""
+        signature = (plan, mesh, _abstract_signature(operands))
+        if signature in self._seen_signatures:
+            self.hits += 1
+            profiler.count_event(_CACHE_HIT_EVENT)
+        else:
+            self.misses += 1
+            self._seen_signatures.add(signature)
+            profiler.count_event(_CACHE_MISS_EVENT)
+        key = (plan, mesh)
+        fn = self._executables.get(key)
+        if fn is None:
+            if builder is not None:
+                fn = builder(plan)
+            else:
+                fn = jax.jit(functools.partial(_jit_entry, plan))
+            self._executables[key] = fn
+        return fn
+
+
+_DEFAULT_CACHE = EpilogueCache()
+
+
+def default_cache() -> EpilogueCache:
+    """The process-wide cache engines share by default (so repeated
+    queries from fresh engine instances still hit warm executables)."""
+    return _DEFAULT_CACHE
+
+
+def trace_count() -> int:
+    """How many times the fused epilogue has been traced (compiled) in
+    this process. Steady-state serving must not move this counter."""
+    return profiler.event_count(_TRACE_EVENT)

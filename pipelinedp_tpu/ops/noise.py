@@ -82,3 +82,67 @@ def add_noise(key: jax.Array, values: jnp.ndarray, is_gaussian,
                               dtype=values.dtype)
     noise = jnp.where(is_gaussian, gauss, lap) * scale_or_std
     return snap(values + noise, g)
+
+
+# Compiled top-level entries. XLA's CPU/TPU backends may contract a
+# multiply feeding an add into one FMA (single rounding) when a kernel is
+# compiled as one computation, so op-by-op eager execution of the same
+# formula can differ from the jitted form in the last ulp — which the snap
+# then amplifies to a whole granularity step. Every engine call site uses
+# these compiled entries so released noise is identical whether a kernel
+# runs standalone (the per-combiner legacy loop) or inlined in the fused
+# finalization epilogue (ops/finalize.py, which compiles the same
+# formulas in one executable — pinned by tests/finalize_test.py).
+add_noise_compiled = jax.jit(add_noise)
+add_laplace_noise_compiled = jax.jit(add_laplace_noise)
+add_gaussian_noise_compiled = jax.jit(add_gaussian_noise)
+
+
+# -- stacked per-metric batching (the fused epilogue, ops/finalize.py) -------
+#
+# One noise kernel over a stacked [n_metrics, num_out] array replaces one
+# dispatch per metric. The raw draws vmap over the per-metric keys — the
+# counter-based PRNG makes that bit-identical to the per-key calls (each
+# row's bits depend only on its own key and row shape) — while the
+# scale/snap arithmetic runs once on the stacked array with the per-row
+# scales broadcast, which is elementwise-identical to the scalar kernels.
+# So fusing the epilogue does not change seeded device-noise runs (pinned
+# by tests/finalize_test.py).
+
+
+def _batched_laplace(keys, values: jnp.ndarray) -> jnp.ndarray:
+    return jax.vmap(
+        lambda k: jax.random.laplace(k, values.shape[1:],
+                                     dtype=values.dtype))(keys)
+
+
+def _batched_normal(keys, values: jnp.ndarray) -> jnp.ndarray:
+    return jax.vmap(
+        lambda k: jax.random.normal(k, values.shape[1:],
+                                    dtype=values.dtype))(keys)
+
+
+def add_noise_batched(keys, values: jnp.ndarray, is_gaussian, scales,
+                      granularities) -> jnp.ndarray:
+    """Stacked twin of add_noise: row i of ``values`` [n, m] is noised with
+    ``keys[i]``/``scales[i]``, exactly as n separate add_noise calls."""
+    g = effective_granularity(scales, granularities, values.dtype)[:, None]
+    lap = _batched_laplace(keys, values)
+    gauss = _batched_normal(
+        jax.vmap(lambda k: jax.random.fold_in(k, 1))(keys), values)
+    noise = jnp.where(is_gaussian[:, None], gauss, lap) * scales[:, None]
+    return snap(values + noise, g)
+
+
+def add_laplace_noise_batched(keys, values: jnp.ndarray, scales,
+                              granularities) -> jnp.ndarray:
+    g = effective_granularity(scales, granularities, values.dtype)[:, None]
+    noise = _batched_laplace(keys, values) * scales[:, None]
+    return snap(values + noise, g)
+
+
+def add_gaussian_noise_batched(keys, values: jnp.ndarray, stddevs,
+                               granularities) -> jnp.ndarray:
+    g = effective_granularity(stddevs, granularities, values.dtype)[:, None]
+    noise = _batched_normal(keys, values) * stddevs[:, None]
+    return snap(values + noise, g)

@@ -11,6 +11,7 @@ recompiles across budgets.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional
 
 import jax
@@ -84,6 +85,35 @@ def create_selection_params(strategy: PartitionSelectionStrategy, eps: float,
     return selection_params_from_strategy(host)
 
 
+def pack_operands(params: SelectionParams) -> np.ndarray:
+    """The strategy's dynamic scalars as one float32 operand vector.
+
+    The static ``kind`` travels separately (e.g. in a FinalizePlan) so a
+    compiled kernel keyed on it never recompiles across budgets — the
+    (eps, delta)-derived constants here stay runtime operands.
+    """
+    return np.asarray([
+        params.eps_p, params.delta_p, params.n1, params.pi_n1, params.pi_inf,
+        params.noise_scale, params.threshold_shifted,
+        params.pre_threshold_shift
+    ],
+                      dtype=np.float32)
+
+
+def unpack_operands(kind: int, floats) -> SelectionParams:
+    """Rebuilds SelectionParams from pack_operands output (floats may be
+    traced inside jit; kind must be a static Python int)."""
+    return SelectionParams(kind=kind,
+                           eps_p=floats[0],
+                           delta_p=floats[1],
+                           n1=floats[2],
+                           pi_n1=floats[3],
+                           pi_inf=floats[4],
+                           noise_scale=floats[5],
+                           threshold_shifted=floats[6],
+                           pre_threshold_shift=floats[7])
+
+
 def truncated_geometric_keep_prob(pid_counts: jnp.ndarray, eps_p, delta_p, n1,
                                   pi_n1, pi_inf) -> jnp.ndarray:
     """pi(n) via the two closed-form segments (floats in, probs out)."""
@@ -123,6 +153,29 @@ def select_partitions(key: jax.Array, pid_counts: jnp.ndarray,
     noised = n + noise
     keep = positive & (noised >= params.threshold_shifted)
     return keep, noised + params.pre_threshold_shift
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _select_partitions_compiled(key, pid_counts, kind, floats, valid):
+    return select_partitions(key, pid_counts, unpack_operands(kind, floats),
+                             valid)
+
+
+def select_partitions_jit(key: jax.Array, pid_counts: jnp.ndarray,
+                          params: SelectionParams, valid: jnp.ndarray):
+    """Compiled top-level entry for select_partitions.
+
+    XLA may FMA-contract the noise multiply into the threshold addition
+    when the kernel compiles as one computation, flipping keep decisions
+    at the boundary relative to op-by-op eager execution. Engine call
+    sites use this entry so selection bits match the fused finalization
+    epilogue (ops/finalize.py), which inlines the same formula in its own
+    jit. The strategy kind is the static key; the (eps, delta)-derived
+    floats stay runtime operands (no recompiles across budgets).
+    """
+    return _select_partitions_compiled(key, jnp.asarray(pid_counts),
+                                       params.kind, pack_operands(params),
+                                       valid)
 
 
 def probability_of_keep_np(strategy: ps_lib.PartitionSelection,

@@ -777,3 +777,36 @@ def bound_and_aggregate_vector(mesh: Mesh,
     if l1_cap is not None:
         args += (l1_cap,)
     return kernel(*args)
+
+
+def build_finalize_epilogue(mesh: Mesh, plan):
+    """Mesh variant of the fused finalization epilogue (ops/finalize.py).
+
+    The accumulators arrive sharded over the partition dimension (the
+    reduce-scatter layout, _part_spec); the whole epilogue — selection,
+    batched noise, metric math, thresholding — compiles as one executable
+    under XLA's SPMD partitioner, with explicit sharding constraints
+    pinning every released column to the partition layout so no
+    all-gather sneaks onto the serving path before the single batched
+    device→host transfer.
+
+    Deliberately NOT a per-device-key shard_map: the PRNG draws must stay
+    *globally* keyed so mesh and single-device runs of the same seed
+    release identical noise (the bit-parity contract pinned by
+    tests/finalize_test.py). Elementwise ops over [padded_p] arrays
+    partition perfectly under SPMD anyway — shard_map would buy nothing
+    but a different (per-shard) noise stream.
+    """
+    from pipelinedp_tpu.ops import finalize as finalize_ops
+
+    part = NamedSharding(mesh, _part_spec(mesh))
+
+    def body(op):
+        columns, keep = finalize_ops.epilogue_body(plan, op)
+        columns = {
+            name: jax.lax.with_sharding_constraint(col, part)
+            for name, col in columns.items()
+        }
+        return columns, jax.lax.with_sharding_constraint(keep, part)
+
+    return jax.jit(body)

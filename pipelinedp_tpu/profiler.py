@@ -31,6 +31,31 @@ import jax
 # so concurrent engines don't interleave their phase budgets.
 _collect = threading.local()
 
+# Global named counters: compile/trace/cache telemetry (ops/finalize uses
+# them to count epilogue retraces and executable-cache hits). Unlike stage
+# times these are process-global — a retrace is a property of the jit
+# caches, which are shared across engines and threads.
+_counter_lock = threading.Lock()
+_counters: Dict[str, int] = {}
+
+
+def count_event(name: str, n: int = 1) -> None:
+    """Increments a named global counter (e.g. one per jit trace)."""
+    with _counter_lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def event_count(name: str) -> int:
+    """Current value of a named counter (0 if never incremented)."""
+    with _counter_lock:
+        return _counters.get(name, 0)
+
+
+def event_counts() -> Dict[str, int]:
+    """Snapshot of all named counters."""
+    with _counter_lock:
+        return dict(_counters)
+
 
 @contextlib.contextmanager
 def profile(logdir: str,

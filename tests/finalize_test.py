@@ -1,0 +1,319 @@
+"""Fused finalization epilogue tests (ops/finalize.py).
+
+Two contracts:
+  * Parity — the fused epilogue must be bit-identical to the legacy
+    per-combiner loop for seeded device-noise runs (every metric kind,
+    selection mode, public/private, mesh and single-device), and
+    equivalent on the secure-host-noise path (bit-identical under the
+    seeded fallback RNG since the draw order is preserved; distributional
+    when the native secure sampler is installed).
+  * Executable cache — a second aggregate with identical shapes performs
+    ZERO new jit traces (finalize.trace_count is the hook); a shape or
+    plan change misses cleanly (one new trace, one cache miss).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu.ops import finalize
+from pipelinedp_tpu.parallel import sharded
+
+M = pdp.Metrics
+S = pdp.PartitionSelectionStrategy
+
+ADDITIVE = {M.COUNT, M.PRIVACY_ID_COUNT, M.SUM, M.VECTOR_SUM}
+
+
+@pytest.fixture(params=["single_device", "mesh8"], scope="module")
+def engine_mesh(request):
+    """Same assertions run on one device and on an 8-device mesh."""
+    if request.param == "single_device":
+        return None
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return sharded.make_mesh(8)
+
+
+def extractors():
+    return pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                              partition_extractor=lambda r: r[1],
+                              value_extractor=lambda r: r[2])
+
+
+def run_engine(fused,
+               metrics,
+               *,
+               secure=False,
+               mesh=None,
+               seed=3,
+               public=None,
+               post_thresh=False,
+               noise_kind=pdp.NoiseKind.LAPLACE,
+               strategy=None,
+               vector=False,
+               n=800,
+               nparts=11,
+               host_seed=17):
+    if vector:
+        data = [(u, f"p{u % nparts}", np.array([1.0, 2.0, 3.0]) * (u % 3))
+                for u in range(n)]
+    else:
+        data = [(u, f"p{u % nparts}", float(u % 5)) for u in range(n)]
+    pdp.noise_core.seed_fallback_rng(host_seed)
+    pdp.partition_selection.seed_rng(host_seed)
+    accountant = pdp.NaiveBudgetAccountant(2.0, 1e-6)
+    engine = pdp.JaxDPEngine(accountant,
+                             seed=seed,
+                             secure_host_noise=secure,
+                             mesh=mesh,
+                             fused_epilogue=fused)
+    kwargs = dict(metrics=metrics,
+                  noise_kind=noise_kind,
+                  max_partitions_contributed=3,
+                  max_contributions_per_partition=2,
+                  post_aggregation_thresholding=post_thresh,
+                  output_noise_stddev=all(m in ADDITIVE for m in metrics))
+    if strategy is not None:
+        kwargs["partition_selection_strategy"] = strategy
+    if vector:
+        kwargs.update(vector_size=3,
+                      vector_max_norm=5.0,
+                      vector_norm_kind=pdp.NormKind.Linf)
+    else:
+        kwargs.update(min_value=0.0, max_value=5.0)
+    result = engine.aggregate(data, pdp.AggregateParams(**kwargs),
+                              extractors(), public_partitions=public)
+    accountant.compute_budgets()
+    return result
+
+
+def assert_columns_identical(a: dict, b: dict):
+    assert list(a) == list(b)  # same columns, same insertion order
+    for name in a:
+        np.testing.assert_array_equal(np.asarray(a[name]),
+                                      np.asarray(b[name]),
+                                      err_msg=name)
+
+
+PARITY_CONFIGS = {
+    "count_sum_private": dict(metrics=[M.COUNT, M.SUM]),
+    "count_sum_public": dict(metrics=[M.COUNT, M.SUM],
+                             public=[f"p{i}" for i in range(14)]),
+    "mean_count_sum": dict(metrics=[M.MEAN, M.COUNT, M.SUM]),
+    "variance_all": dict(metrics=[M.VARIANCE, M.MEAN, M.COUNT, M.SUM]),
+    "variance_gaussian": dict(metrics=[M.VARIANCE],
+                              noise_kind=pdp.NoiseKind.GAUSSIAN),
+    "privacy_id_count": dict(metrics=[M.PRIVACY_ID_COUNT]),
+    "post_agg_thresholding": dict(metrics=[M.COUNT, M.PRIVACY_ID_COUNT],
+                                  post_thresh=True),
+    "gaussian_count_sum": dict(metrics=[M.COUNT, M.SUM],
+                               noise_kind=pdp.NoiseKind.GAUSSIAN),
+    "laplace_thresholding_selection": dict(metrics=[M.COUNT],
+                                           strategy=S.LAPLACE_THRESHOLDING),
+    "gaussian_thresholding_selection": dict(
+        metrics=[M.COUNT],
+        noise_kind=pdp.NoiseKind.GAUSSIAN,
+        strategy=S.GAUSSIAN_THRESHOLDING),
+    "percentile_mix": dict(metrics=[M.COUNT, M.PERCENTILE(50),
+                                    M.PERCENTILE(90)]),
+}
+
+
+class TestDeviceNoiseParity:
+    """Fused epilogue == legacy per-combiner loop, bit for bit, for seeded
+    device-noise runs (secure_host_noise=False)."""
+
+    @pytest.mark.parametrize("config", sorted(PARITY_CONFIGS))
+    def test_bit_identical(self, engine_mesh, config):
+        kwargs = PARITY_CONFIGS[config]
+        fused = run_engine(True, mesh=engine_mesh, **kwargs).to_columns()
+        legacy = run_engine(False, mesh=engine_mesh, **kwargs).to_columns()
+        assert_columns_identical(fused, legacy)
+
+    def test_vector_sum_bit_identical(self, engine_mesh):
+        fused = run_engine(True, [M.VECTOR_SUM], vector=True,
+                           mesh=engine_mesh).to_columns()
+        legacy = run_engine(False, [M.VECTOR_SUM], vector=True,
+                            mesh=engine_mesh).to_columns()
+        assert_columns_identical(fused, legacy)
+
+    def test_mesh_matches_single_device(self):
+        """The mesh epilogue draws globally-keyed noise: when the partition
+        count shards evenly (no mesh padding, which would change the draw
+        shapes), the same seed releases the same values as the
+        single-device epilogue."""
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        mesh = sharded.make_mesh(8)
+        on_mesh = run_engine(True, [M.COUNT, M.SUM], nparts=40,
+                             mesh=mesh).to_columns()
+        single = run_engine(True, [M.COUNT, M.SUM],
+                            nparts=40).to_columns()
+        assert_columns_identical(on_mesh, single)
+
+    def test_iterator_matches_columns(self):
+        result = run_engine(True, [M.COUNT, M.SUM])
+        cols = result.to_columns()
+        rows = list(result)
+        keep = np.asarray(cols["keep_mask"])
+        kept_idx = np.flatnonzero(keep)
+        assert len(rows) == len(kept_idx)
+        for (_, metrics), i in zip(rows, kept_idx):
+            assert metrics.count == pytest.approx(
+                float(np.asarray(cols["count"])[i]))
+            assert metrics.sum == pytest.approx(
+                float(np.asarray(cols["sum"])[i]))
+
+
+class TestHostNoiseParity:
+    """Secure-host-noise path: the fused epilogue preserves the exact
+    host-RNG draw order, so the seeded fallback RNG gives bit-identical
+    releases; with the native (unseedable) sampler only distributional
+    equivalence is checkable."""
+
+    HOST_CONFIGS = ["count_sum_private", "count_sum_public", "mean_count_sum",
+                    "variance_all", "post_agg_thresholding"]
+
+    @pytest.mark.parametrize("config", HOST_CONFIGS)
+    def test_seeded_fallback_identical(self, engine_mesh, config):
+        if pdp.noise_core.using_native_sampling():
+            pytest.skip("native secure sampler is not seedable")
+        kwargs = PARITY_CONFIGS[config]
+        fused = run_engine(True, secure=True, mesh=engine_mesh,
+                           **kwargs).to_columns()
+        legacy = run_engine(False, secure=True, mesh=engine_mesh,
+                            **kwargs).to_columns()
+        assert_columns_identical(fused, legacy)
+
+    def test_noise_std_distribution(self):
+        """Released COUNT noise std matches the calibrated Laplace std on
+        the fused host path (the distributional contract that holds even
+        with the native sampler)."""
+        data = [(u, "a", 1.0) for u in range(1000)]
+        params = pdp.AggregateParams(metrics=[M.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1)
+        samples = []
+        for seed in range(300):
+            accountant = pdp.NaiveBudgetAccountant(1.0, 1e-15)
+            engine = pdp.JaxDPEngine(accountant, seed=seed,
+                                     fused_epilogue=True)
+            result = engine.aggregate(data, params, extractors(),
+                                      public_partitions=["a"])
+            accountant.compute_budgets()
+            samples.append(dict(result)["a"].count - 1000.0)
+        expected_std = np.sqrt(2.0)  # b = 1/eps, eps = 1
+        assert np.std(samples) == pytest.approx(expected_std, rel=0.2)
+
+
+class TestExecutableCache:
+    """Second identical aggregate call: zero new jit traces. Shape or plan
+    change: exactly one clean miss."""
+
+    @staticmethod
+    def _aggregate(n=500, nparts=7, metrics=(M.COUNT, M.SUM), seed=0,
+                   cache=None):
+        data = [(u, f"p{u % nparts}", float(u % 5)) for u in range(n)]
+        accountant = pdp.NaiveBudgetAccountant(2.0, 1e-6)
+        engine = pdp.JaxDPEngine(accountant, seed=seed,
+                                 secure_host_noise=False,
+                                 epilogue_cache=cache)
+        params = pdp.AggregateParams(metrics=list(metrics),
+                                     max_partitions_contributed=3,
+                                     max_contributions_per_partition=2,
+                                     min_value=0.0, max_value=5.0)
+        result = engine.aggregate(data, params, extractors())
+        accountant.compute_budgets()
+        return result.to_columns()
+
+    def test_second_call_zero_retraces(self):
+        cache = finalize.EpilogueCache()
+        self._aggregate(seed=0, cache=cache)
+        traces_before = finalize.trace_count()
+        hits_before = cache.hits
+        self._aggregate(seed=1, cache=cache)
+        assert finalize.trace_count() == traces_before
+        assert cache.hits == hits_before + 1
+
+    def test_shared_default_cache_across_engines(self):
+        # Fresh engines share the default cache: a repeated query shape
+        # stays warm without threading a cache object through callers.
+        self._aggregate(n=303, nparts=9, seed=0)
+        traces_before = finalize.trace_count()
+        self._aggregate(n=303, nparts=9, seed=1)
+        assert finalize.trace_count() == traces_before
+
+    def test_shape_change_misses_cleanly(self):
+        cache = finalize.EpilogueCache()
+        self._aggregate(nparts=7, seed=0, cache=cache)
+        traces_before = finalize.trace_count()
+        misses_before = cache.misses
+        self._aggregate(nparts=13, seed=0, cache=cache)
+        assert finalize.trace_count() == traces_before + 1
+        assert cache.misses == misses_before + 1
+
+    def test_plan_change_misses_cleanly(self):
+        cache = finalize.EpilogueCache()
+        self._aggregate(metrics=(M.COUNT, M.SUM), seed=0, cache=cache)
+        traces_before = finalize.trace_count()
+        misses_before = cache.misses
+        self._aggregate(metrics=(M.COUNT,), seed=0, cache=cache)
+        assert finalize.trace_count() == traces_before + 1
+        assert cache.misses == misses_before + 1
+
+    def test_host_noise_path_never_traces(self):
+        traces_before = finalize.trace_count()
+        data = [(u, f"p{u % 7}", 1.0) for u in range(300)]
+        accountant = pdp.NaiveBudgetAccountant(2.0, 1e-6)
+        engine = pdp.JaxDPEngine(accountant)  # secure_host_noise default
+        params = pdp.AggregateParams(metrics=[M.COUNT],
+                                     max_partitions_contributed=3,
+                                     max_contributions_per_partition=2)
+        result = engine.aggregate(data, params, extractors())
+        accountant.compute_budgets()
+        result.to_columns()
+        assert finalize.trace_count() == traces_before
+
+
+class TestStddevScalars:
+    """output_noise_stddev rides the plan as a scalar and expands to a
+    column only at materialization — values and masking must match the
+    legacy per-combiner np.full columns."""
+
+    def test_stddev_columns_constant_and_masked(self):
+        cols = run_engine(True, [M.COUNT, M.SUM]).to_columns()
+        keep = cols["keep_mask"]
+        for name in ("count_noise_stddev", "sum_noise_stddev"):
+            col = np.asarray(cols[name])
+            assert col.dtype == np.float64
+            kept_vals = col[keep]
+            assert len(np.unique(kept_vals)) == 1 and kept_vals[0] > 0
+            assert np.isnan(col[~keep]).all()
+
+
+class TestBatchedIterators:
+    """The output iterators materialize columns once (batched decode /
+    tolist) instead of per-row host calls."""
+
+    def test_add_dp_noise_pairs_iterate(self):
+        accountant = pdp.NaiveBudgetAccountant(1e6, 1e-9)
+        engine = pdp.JaxDPEngine(accountant)
+        pairs = [("a", 10.0), ("b", 20.0), ("c", 30.0)]
+        params = pdp.AddDPNoiseParams(noise_kind=pdp.NoiseKind.LAPLACE,
+                                      l0_sensitivity=1,
+                                      linf_sensitivity=1.0)
+        result = engine.add_dp_noise(pairs, params)
+        accountant.compute_budgets()
+        out = list(result)
+        assert [pk for pk, _ in out] == ["a", "b", "c"]
+        for (_, noised), (_, raw) in zip(out, pairs):
+            assert isinstance(noised, float)
+            assert noised == pytest.approx(raw, abs=0.1)
+
+    def test_result_iterator_vector_rows(self):
+        result = run_engine(True, [M.VECTOR_SUM], vector=True,
+                            public=[f"p{i}" for i in range(11)])
+        for _, metrics in result:
+            assert np.asarray(metrics.vector_sum).shape == (3,)
