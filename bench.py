@@ -317,6 +317,17 @@ def bench_cpu_baseline() -> float:
     return CPU_PARTITIONS / elapsed
 
 
+def _resilience_counters():
+    """Runtime resilience counters (retries, degradations, resumes,
+    checkpoint_bytes, native_fallbacks — pipelinedp_tpu/runtime/). All
+    keys always present; a clean run reports zeros, and a run that had to
+    retry/degrade/resume shows it here instead of hiding it in the
+    timings."""
+    from pipelinedp_tpu import runtime
+
+    return runtime.resilience_counters()
+
+
 def main():
     cpu_pps = bench_cpu_baseline()
     steady = {}
@@ -340,6 +351,7 @@ def main():
             "unit": "partitions/sec",
             "vs_baseline": 0.0,
             "error": f"{type(e).__name__}: {e}"[:300],
+            "resilience": _resilience_counters(),
             **steady,
         }))
         sys.exit(0)
@@ -381,6 +393,7 @@ def main():
         "kernel_vs_baseline": round(kernel_pps / cpu_pps, 2),
         "cpu_baseline_partitions_per_sec": round(cpu_pps, 1),
         "e2e_phases": e2e_phases,
+        "resilience": _resilience_counters(),
         **extra,
     }))
 

@@ -30,6 +30,28 @@ from pipelinedp_tpu.aggregate_params import MechanismType
 Budget = collections.namedtuple("Budget", ["epsilon", "delta"])
 
 
+class BudgetAccountantError(Exception):
+    """Budget-accounting contract violation: compute_budgets called twice,
+    request_budget after finalization, or a committed mechanism spend
+    about to be replayed. Typed (instead of the historical bare
+    ``Exception``) so recovery/retry layers can distinguish an accounting
+    replay — which must abort, per the at-most-once rule in
+    RESILIENCE.md — from transient execution failures."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SpendRecord:
+    """One mechanism's committed budget spend (see
+    BudgetAccountant.spend_journal). Exactly one record per registered
+    mechanism, written when compute_budgets resolves it."""
+    index: int
+    mechanism_type: MechanismType
+    eps: Optional[float]
+    delta: Optional[float]
+    noise_standard_deviation: Optional[float]
+    count: int
+
+
 @dataclasses.dataclass
 class MechanismSpec:
     """A lazily-resolved mechanism budget.
@@ -70,10 +92,22 @@ class MechanismSpec:
     def set_eps_delta(self, eps: float, delta: Optional[float]) -> None:
         if eps is None:
             raise AssertionError("eps must not be None.")
+        if self._eps is not None:
+            # At-most-once spend: a resolved spec is a committed budget
+            # spend — re-resolving it (e.g. a replayed compute_budgets in
+            # a retried run) would silently change what the released
+            # noise was calibrated against.
+            raise BudgetAccountantError(
+                "Mechanism (eps, delta) is already committed; replaying a "
+                "committed budget spend is not allowed.")
         self._eps = eps
         self._delta = delta
 
     def set_noise_standard_deviation(self, stddev: float) -> None:
+        if self._noise_standard_deviation is not None:
+            raise BudgetAccountantError(
+                "Mechanism noise standard deviation is already committed; "
+                "replaying a committed budget spend is not allowed.")
         self._noise_standard_deviation = stddev
 
     def use_delta(self) -> bool:
@@ -147,6 +181,26 @@ class BudgetAccountant(abc.ABC):
         self._expected_num_aggregations = num_aggregations
         self._expected_aggregation_weights = aggregation_weights
         self._actual_aggregation_weights: List[float] = []
+        self._spend_journal: List[SpendRecord] = []
+
+    @property
+    def spend_journal(self) -> tuple:
+        """One SpendRecord per registered mechanism, written exactly once
+        when compute_budgets resolves it — the auditable record that each
+        epsilon/delta spend was committed once and only once."""
+        return tuple(self._spend_journal)
+
+    def _commit_spend(self, index: int,
+                      mechanism: "MechanismSpecInternal") -> None:
+        spec = mechanism.mechanism_spec
+        self._spend_journal.append(
+            SpendRecord(index=index,
+                        mechanism_type=spec.mechanism_type,
+                        eps=spec._eps,
+                        delta=spec._delta,
+                        noise_standard_deviation=spec.
+                        _noise_standard_deviation,
+                        count=spec.count))
 
     @property
     def total_epsilon(self) -> float:
@@ -234,7 +288,8 @@ class BudgetAccountant(abc.ABC):
 
     def _finalize(self):
         if self._finalized:
-            raise Exception("compute_budgets can not be called twice.")
+            raise BudgetAccountantError(
+                "compute_budgets can not be called twice.")
         self._finalized = True
 
     def _pre_compute_checks(self) -> bool:
@@ -245,13 +300,13 @@ class BudgetAccountant(abc.ABC):
             logging.warning("No budgets were requested.")
             return False
         if self._scopes_stack:
-            raise Exception(
+            raise BudgetAccountantError(
                 "Cannot call compute_budgets from within a budget scope.")
         return True
 
     def _check_not_finalized(self):
         if self._finalized:
-            raise Exception(
+            raise BudgetAccountantError(
                 "request_budget() is called after compute_budgets(). Please "
                 "ensure that compute_budgets() is called after DP "
                 "aggregations.")
@@ -305,13 +360,14 @@ class NaiveBudgetAccountant(BudgetAccountant):
         total_w_delta = sum(m.weight * m.mechanism_spec.count
                             for m in self._mechanisms
                             if m.mechanism_spec.use_delta())
-        for m in self._mechanisms:
+        for i, m in enumerate(self._mechanisms):
             eps = (self._total_epsilon * m.weight /
                    total_w_eps) if total_w_eps else 0.0
             delta = 0.0
             if m.mechanism_spec.use_delta() and total_w_delta:
                 delta = self._total_delta * m.weight / total_w_delta
             m.mechanism_spec.set_eps_delta(eps, delta)
+            self._commit_spend(i, m)
 
 
 class PLDBudgetAccountant(BudgetAccountant):
@@ -368,13 +424,14 @@ class PLDBudgetAccountant(BudgetAccountant):
         else:
             minimum_noise_std = self._find_minimum_noise_std()
         self.minimum_noise_std = minimum_noise_std
-        for m in self._mechanisms:
+        for i, m in enumerate(self._mechanisms):
             noise_std = m.sensitivity * minimum_noise_std / m.weight
             m.mechanism_spec.set_noise_standard_deviation(noise_std)
             if m.mechanism_spec.mechanism_type == MechanismType.GENERIC:
                 eps0 = math.sqrt(2) / noise_std
                 delta0 = eps0 / self._total_epsilon * self._total_delta
                 m.mechanism_spec.set_eps_delta(eps0, delta0)
+            self._commit_spend(i, m)
 
     def _find_minimum_noise_std(self) -> float:
         threshold = 1e-4

@@ -98,6 +98,20 @@ class KeyStream:
         self._counter += 1
         return jax.random.fold_in(self._root_key, self._counter)
 
+    @property
+    def counter(self) -> int:
+        """How many keys have been handed out. Checkpoints and release
+        tokens record this position so a resumed run under a different
+        key schedule is refused (runtime/checkpoint.py)."""
+        return self._counter
+
+    def fingerprint(self) -> str:
+        """Digest of the root key. (root fingerprint, counter) names the
+        KeyStream state exactly — it is the release-token identity of
+        runtime/journal.py, derived without consuming any key."""
+        from pipelinedp_tpu.runtime import checkpoint as checkpoint_lib
+        return checkpoint_lib.key_fingerprint(self._root_key)
+
     @staticmethod
     def derive(key, tag):
         """A substream of ``key`` under ``tag`` (see KeyTag)."""
@@ -253,7 +267,11 @@ class JaxDPEngine:
                  value_transfer_dtype=None,
                  transfer_encoding: str = "auto",
                  fused_epilogue: bool = True,
-                 epilogue_cache: Optional[finalize_ops.EpilogueCache] = None):
+                 epilogue_cache: Optional[finalize_ops.EpilogueCache] = None,
+                 checkpoint_policy=None,
+                 retry_policy=None,
+                 release_journal=None,
+                 fault_injector=None):
         self._budget_accountant = budget_accountant
         self._report_generators = []
         self._key_stream = KeyStream(jax.random.PRNGKey(seed))
@@ -280,6 +298,24 @@ class JaxDPEngine:
         # "auto": the lossless RLE/bit-plane wire codec (ops/wirecodec.py);
         # "bytes": the legacy fixed-width byte packing. Both exact.
         self._transfer_encoding = transfer_encoding
+        # Resilience knobs (pipelinedp_tpu/runtime/, RESILIENCE.md):
+        #   checkpoint_policy: runtime.CheckpointPolicy — snapshot the
+        #     streamed slab loop after each slab and auto-resume from the
+        #     policy's store; a resumed run is bit-identical to an
+        #     uninterrupted seeded run.
+        #   retry_policy: runtime.RetryPolicy — bounded backoff for
+        #     transient transfer/kernel failures; RESOURCE_EXHAUSTED
+        #     halves the slab budget and re-issues (same per-chunk keys,
+        #     so released values are unchanged).
+        #   release_journal: runtime.ReleaseJournal — at-most-once noise
+        #     release: a run that would re-draw already-released noise
+        #     raises DoubleReleaseError instead.
+        #   fault_injector: runtime.FaultInjector — deterministic fault
+        #     scripting for tests (never set in production).
+        self._checkpoint_policy = checkpoint_policy
+        self._retry_policy = retry_policy
+        self._release_journal = release_journal
+        self._fault_injector = fault_injector
 
     def _next_key(self):
         return self._key_stream.next_key()
@@ -376,9 +412,11 @@ class JaxDPEngine:
                         f"{params.partition_selection_strategy.value} "
                         f"method with (eps={spec.eps}, delta={spec.delta})")
             key = self._next_key()
+            key_counter = self._key_stream.counter
             engine = self
 
             def compute():
+                engine._commit_release(key_counter, kind="selection_release")
                 k_kernel, k_select = jax.random.split(key)
                 counts = columnar.count_distinct_pids_per_partition(
                     jnp.asarray(pid), jnp.asarray(pk),
@@ -439,9 +477,11 @@ class JaxDPEngine:
                      f"noise with parameter "
                      f"{dp_computations.create_additive_mechanism(spec, sensitivities).noise_parameter}"))
         key = self._next_key()
+        key_counter = self._key_stream.counter
         engine = self
 
         def compute():
+            engine._commit_release(key_counter)
             is_g, scale, gran = _mechanism_noise_params(spec, sensitivities)
             # numpy in: the secure host path keeps float64 end to end; the
             # device path converts on entry.
@@ -659,6 +699,7 @@ class JaxDPEngine:
             self._add_report_stage(stage)
 
         kernel_key = self._next_key()
+        key_counter = self._key_stream.counter
         engine = self
 
         def compute():
@@ -667,7 +708,8 @@ class JaxDPEngine:
                                        kernel_key, pid, pk, value,
                                        num_partitions, linf_cap, l0_cap,
                                        public_partitions is not None,
-                                       is_vector, l1_cap=l1_cap)
+                                       is_vector, l1_cap=l1_cap,
+                                       key_counter=key_counter)
 
         return LazyJaxResult(compute, pk_vocab)
 
@@ -806,9 +848,11 @@ class JaxDPEngine:
         for stage in compound.explain_computation():
             self._add_report_stage(stage)
         key = self._next_key()
+        key_counter = self._key_stream.counter
         engine = self
 
         def compute():
+            engine._commit_release(key_counter)
             k_kernel, _ = jax.random.split(key)
             n_rows = len(pid)
             no_bounding = (params.contribution_bounds_already_enforced or
@@ -896,7 +940,8 @@ class JaxDPEngine:
 
     def _execute(self, compound, params: AggregateParams, selection_spec,
                  key, pid, pk, value, num_partitions, linf_cap, l0_cap,
-                 is_public: bool, is_vector: bool, l1_cap=None) -> dict:
+                 is_public: bool, is_vector: bool, l1_cap=None,
+                 key_counter: int = -1) -> dict:
         k_kernel, k_select, k_noise = jax.random.split(key, 3)
         n_rows = len(pid)
         has_quantile = any(
@@ -964,7 +1009,8 @@ class JaxDPEngine:
                     n_chunks=self._stream_chunks,
                     value_transfer_dtype=self._value_transfer_dtype,
                     need_flags=need_flags,
-                    has_group_clip=has_group_clip)
+                    has_group_clip=has_group_clip,
+                    resilience=self._stream_resilience(key_counter))
             else:
                 # Stage (hash-shard + device_put) once; both the aggregate
                 # and the quantile-histogram kernels reuse the staged
@@ -1036,7 +1082,8 @@ class JaxDPEngine:
                 need_flags=need_flags,
                 has_group_clip=has_group_clip,
                 transfer_encoding=self._transfer_encoding,
-                quantile_spec=quantile_spec)
+                quantile_spec=quantile_spec,
+                resilience=self._stream_resilience(key_counter))
             if has_quantile:
                 accs, streamed_qhist = accs
         else:
@@ -1057,6 +1104,14 @@ class JaxDPEngine:
                 need_norm=need_flags[2],
                 need_norm_sq=need_flags[3],
                 has_group_clip=has_group_clip)
+
+        # At-most-once release: the token commits BEFORE any noise is
+        # drawn (the quantile noise below and the finalize epilogue), so
+        # a resumed or retried run that already released under this
+        # KeyStream state refuses instead of re-drawing — and a crash
+        # between commit and publication errs on the side of zero
+        # releases, never two (RESILIENCE.md).
+        self._commit_release(key_counter)
 
         # On a mesh the accumulators are padded so the partition dimension
         # shards evenly; all downstream math runs on the padded arrays and
@@ -1211,6 +1266,33 @@ class JaxDPEngine:
         columns["partition_id"] = np.arange(num_partitions, dtype=np.int32)
         columns["keep_mask"] = keep_np
         return columns
+
+    def _commit_release(self, key_counter: int,
+                        kind: str = "noise_release") -> None:
+        """At-most-once gate for every release-producing entry point:
+        commits (root fingerprint, KeyStream counter) to the engine's
+        ReleaseJournal before any randomness is drawn; no-op without a
+        journal (the reference's semantics — re-release is the caller's
+        accounting decision)."""
+        if self._release_journal is not None:
+            self._release_journal.commit(
+                finalize_ops.release_token(self._key_stream.fingerprint(),
+                                           key_counter), kind=kind)
+
+    def _stream_resilience(self, key_counter: int):
+        """The runtime.StreamResilience bundle for a streamed execution,
+        or None when no resilience knob is set (fail-fast, zero
+        overhead — the historical behavior)."""
+        if (self._checkpoint_policy is None and self._retry_policy is None
+                and self._fault_injector is None):
+            return None
+        from pipelinedp_tpu import runtime as runtime_lib
+        return runtime_lib.StreamResilience(
+            retry_policy=(self._retry_policy if self._retry_policy is not None
+                          else runtime_lib.RetryPolicy()),
+            fault_injector=self._fault_injector,
+            checkpoint_policy=self._checkpoint_policy,
+            key_counter=key_counter)
 
     def _can_stream(self, has_quantile: bool, num_partitions: int) -> bool:
         """PERCENTILE can ride the stream when the dense [partitions,

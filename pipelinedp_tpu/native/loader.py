@@ -32,6 +32,26 @@ _EXT = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
 _lock = threading.Lock()
 _libs: dict = {}  # stem -> CDLL | None (None = load failed)
 
+# The error types a native build/load can surface; callers that treat the
+# native path as an optimization catch exactly these (ops/streaming.py) —
+# never a bare Exception, which would also swallow NativeRequiredError.
+LOADER_ERRORS = (OSError, subprocess.SubprocessError, AttributeError)
+
+# When set to a truthy value ("1"/"true"/"yes"), a failed native
+# build/load raises NativeRequiredError instead of silently installing
+# the numpy fallback — CI and prod set it so a toolchain regression is a
+# hard error, not a quiet 10x slowdown.
+REQUIRE_NATIVE_ENV = "PIPELINEDP_TPU_REQUIRE_NATIVE"
+
+
+class NativeRequiredError(RuntimeError):
+    """Native library unavailable while REQUIRE_NATIVE_ENV demands it."""
+
+
+def _native_required() -> bool:
+    return os.environ.get(REQUIRE_NATIVE_ENV,
+                          "").strip().lower() in ("1", "true", "yes")
+
 
 def _build(stem: str) -> bool:
     src = os.path.join(_DIR, f"{stem}.cc")
@@ -51,10 +71,20 @@ def _build(stem: str) -> bool:
 
 def _load_lib(stem: str, abi_symbol: str,
               abi_version: int = 1) -> Optional[ctypes.CDLL]:
-    """Builds (if stale/missing) and loads native/<stem>.cc; caches."""
+    """Builds (if stale/missing) and loads native/<stem>.cc; caches.
+
+    Under REQUIRE_NATIVE_ENV a failure raises NativeRequiredError instead
+    of returning None (checked on cache hits too, so a permissive early
+    call can't mask a later strict one).
+    """
     with _lock:
         if stem in _libs:
-            return _libs[stem]
+            lib = _libs[stem]
+            if lib is None and _native_required():
+                raise NativeRequiredError(
+                    f"native library '{stem}' failed to build/load and "
+                    f"{REQUIRE_NATIVE_ENV} is set")
+            return lib
         src = os.path.join(_DIR, f"{stem}.cc")
         so = os.path.join(_DIR, f"_{stem}{_EXT}")
         if not os.path.exists(so) or (os.path.exists(src) and
@@ -62,6 +92,10 @@ def _load_lib(stem: str, abi_symbol: str,
                                       os.path.getmtime(src)):
             if not _build(stem):
                 _libs[stem] = None
+                if _native_required():
+                    raise NativeRequiredError(
+                        f"native library '{stem}' failed to build and "
+                        f"{REQUIRE_NATIVE_ENV} is set")
                 return None
         lib = _try_load(so, abi_symbol, abi_version)
         if lib is None and os.path.exists(src):
@@ -74,6 +108,10 @@ def _load_lib(stem: str, abi_symbol: str,
             if _build(stem):
                 lib = _try_load(so, abi_symbol, abi_version)
         _libs[stem] = lib
+        if lib is None and _native_required():
+            raise NativeRequiredError(
+                f"native library '{stem}' failed to load and "
+                f"{REQUIRE_NATIVE_ENV} is set")
         return lib
 
 

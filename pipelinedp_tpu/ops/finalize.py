@@ -712,3 +712,23 @@ def trace_count() -> int:
     """How many times the fused epilogue has been traced (compiled) in
     this process. Steady-state serving must not move this counter."""
     return profiler.event_count(_TRACE_EVENT)
+
+
+# -- at-most-once release ----------------------------------------------------
+
+
+def release_token(key_stream_fingerprint: str,
+                  key_counter: int = -1) -> tuple:
+    """The identity of one noise release, tied to the KeyStream state.
+
+    Two computations release "the same noise" exactly when they draw from
+    the same key material — i.e. the same engine root key at the same
+    KeyStream position, which is exactly (root fingerprint, counter)
+    (jax_engine.KeyStream.fingerprint / .counter; every epilogue noise
+    key derives from that pair). The engine commits this token to its
+    ReleaseJournal (runtime/journal.py) immediately *before*
+    finalization: a resumed or retried run that would re-draw
+    already-released noise raises DoubleReleaseError instead of silently
+    spending the same budget twice (see RESILIENCE.md).
+    """
+    return ("noise_release", str(key_stream_fingerprint), int(key_counter))

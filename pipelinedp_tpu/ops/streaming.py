@@ -29,6 +29,7 @@ used across *time* on one device.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import logging
 from typing import Optional, Tuple
@@ -290,6 +291,8 @@ def stream_bound_and_aggregate(
     n_transfers: Optional[int] = None,
     transfer_encoding: str = "auto",
     quantile_spec: Optional[Tuple[int, float, float]] = None,
+    resilience=None,
+    resume_from=None,
 ) -> columnar.PartitionAccumulators:
     """Chunked, transfer-overlapped twin of columnar.bound_and_aggregate.
 
@@ -310,11 +313,25 @@ def stream_bound_and_aggregate(
       the [num_partitions, num_leaves] quantile-tree leaf histogram across
       chunks (PERCENTILE metrics on the streamed path; wire-codec
       encoding only). When set the return value is (accs, hist).
+    resilience: optional runtime.StreamResilience — retry/degradation
+      policy, fault injection and checkpointing for the slab loop (see
+      pipelinedp_tpu/runtime/ and RESILIENCE.md). None = fail-fast, the
+      historical behavior.
+    resume_from: optional runtime.StreamCheckpoint to resume the slab
+      loop from (fingerprint-validated; overrides any checkpoint found in
+      resilience.checkpoint_policy.store). A resumed run is bit-identical
+      to an uninterrupted one — per-chunk keys are fold_in(key, c) and
+      accumulators are mergeable.
 
     Returns per-partition accumulators on device, identical in distribution
     to the single-shot kernel.
     """
     n = len(pid)
+    if resume_from is not None:
+        if resilience is None:
+            from pipelinedp_tpu import runtime as runtime_lib
+            resilience = runtime_lib.StreamResilience()
+        resilience = dataclasses.replace(resilience, resume_from=resume_from)
     if quantile_spec is not None and transfer_encoding == "bytes":
         raise ValueError(
             "quantile_spec requires the wire-codec transfer encoding")
@@ -329,11 +346,6 @@ def stream_bound_and_aggregate(
     k = n_chunks or _num_chunks(n)
     pid = np.asarray(pid)
 
-    # Five distinct buffers: the accumulators are donated into each chunk
-    # step, and a donated buffer must not be aliased.
-    accs = columnar.PartitionAccumulators(
-        *(jnp.zeros((num_partitions,), dtype=jnp.float32) for _ in range(5)))
-
     if transfer_encoding != "bytes":
         # Shared prologue with the mesh streaming path (pid-span
         # validation, width/bit planning, value plan, pid wire mode,
@@ -342,11 +354,11 @@ def stream_bound_and_aggregate(
             enc, info = wirecodec.make_encoder(
                 pid, pk, value, num_partitions=num_partitions, k=k,
                 value_transfer_dtype=value_transfer_dtype)
-        qhist = (jnp.zeros((num_partitions, quantile_spec[0]),
-                           dtype=jnp.float32)
-                 if quantile_spec is not None else None)
 
-        def run_chunk(accs, qhist, c, bucket_row, n_valid, n_uniq_c, fmt):
+        # `fmt` is late-bound from the enclosing scope: both encode
+        # branches below assign it before the slab loop makes the first
+        # call.
+        def step_chunk(c, bucket_row, accs, qhist, n_valid, n_uniq_c):
             if quantile_spec is not None:
                 return _chunk_step_rle_quantile(
                     jax.random.fold_in(key, c), bucket_row, n_valid,
@@ -412,30 +424,26 @@ def stream_bound_and_aggregate(
                           else SLAB_BYTE_BUDGET)
                 n_t = n_transfers or _num_transfers(fmt.width * k, k,
                                                     budget)
-                slab_buckets = max(1, (k + n_t - 1) // n_t)
-                for s0 in range(0, k, slab_buckets):
-                    s1 = min(s0 + slab_buckets, k)
-                    with profiler.stage(f"dp/stream_slab_{s0}"):
-                        if pipelined_sort:
-                            with profiler.stage("dp/wire_sort"):
-                                sorted_uniq = enc.sort_range(s0, s1)
-                            if not np.array_equal(sorted_uniq,
-                                                  n_uniq[s0:s1]):
-                                # Analytic prep counts must equal the
-                                # post-sort RLE counts; a mismatch means
-                                # corrupted input (e.g. mutated between
-                                # prep and sort) and must not decode.
-                                raise RuntimeError(
-                                    "wirecodec: prep-time RLE entry "
-                                    "counts disagree with the sorted "
-                                    "buckets")
-                        slab = enc.emit_range(s0, s1, fmt)
-                        dslab = jax.device_put(slab)
-                        for c in range(s0, s1):
-                            accs, qhist = run_chunk(accs, qhist, c,
-                                                    dslab[c - s0],
-                                                    int(counts[c]),
-                                                    int(n_uniq[c]), fmt)
+
+                def prepare_slab(s0, s1):
+                    if pipelined_sort:
+                        with profiler.stage("dp/wire_sort"):
+                            sorted_uniq = enc.sort_range(s0, s1)
+                        if not np.array_equal(sorted_uniq, n_uniq[s0:s1]):
+                            # Analytic prep counts must equal the
+                            # post-sort RLE counts; a mismatch means
+                            # corrupted input (e.g. mutated between
+                            # prep and sort) and must not decode.
+                            raise RuntimeError(
+                                "wirecodec: prep-time RLE entry "
+                                "counts disagree with the sorted "
+                                "buckets")
+                    return enc.emit_range(s0, s1, fmt)
+
+                accs, qhist = _run_slab_loop(
+                    key, k, counts, n_uniq, fmt, prepare_slab, step_chunk,
+                    n_t, num_partitions, quantile_spec, resilience,
+                    lambda: _input_digest(pid, pk, value))
         else:
             with profiler.stage("dp/wire_encode"):
                 slab, counts, n_uniq, fmt = wirecodec.encode_buckets_numpy(
@@ -444,16 +452,11 @@ def stream_bound_and_aggregate(
                     plan=info.plan, pid_mode=info.pid_mode,
                     bits_pid=info.bits_pid)
             n_t = n_transfers or _num_transfers(slab.nbytes, k)
-            slab_buckets = max(1, (k + n_t - 1) // n_t)
-            for s0 in range(0, k, slab_buckets):
-                s1 = min(s0 + slab_buckets, k)
-                with profiler.stage(f"dp/stream_slab_{s0}"):
-                    dslab = jax.device_put(slab[s0:s1])
-                    for c in range(s0, s1):
-                        accs, qhist = run_chunk(accs, qhist, c,
-                                                dslab[c - s0],
-                                                int(counts[c]),
-                                                int(n_uniq[c]), fmt)
+            accs, qhist = _run_slab_loop(
+                key, k, counts, n_uniq, fmt,
+                lambda s0, s1: slab[s0:s1], step_chunk,
+                n_t, num_partitions, quantile_spec, resilience,
+                lambda: _input_digest(pid, pk, value))
         if quantile_spec is not None:
             return accs, qhist
         return accs
@@ -484,24 +487,211 @@ def stream_bound_and_aggregate(
     # the pipeline if every bucket shipped separately, and the slab after
     # this one still overlaps the current slab's kernels (async dispatch).
     n_t = n_transfers or _num_transfers(buckets.nbytes, k)
-    slab_buckets = max(1, (k + n_t - 1) // n_t)
-    for s0 in range(0, k, slab_buckets):
-        s1 = min(s0 + slab_buckets, k)
-        with profiler.stage(f"dp/stream_slab_{s0}"):
-            dslab = jax.device_put(buckets[s0:s1])
-            for c in range(s0, s1):
-                accs = _chunk_step(jax.random.fold_in(key, c), dslab[c - s0],
-                                   int(counts[c]), accs,
-                                   linf_cap, l0_cap, row_clip_lo,
-                                   row_clip_hi, middle, group_clip_lo,
-                                   group_clip_hi, l1_cap,
-                                   num_partitions=num_partitions,
-                                   bytes_pid=bytes_pid,
-                                   bytes_pk=bytes_pk,
-                                   value_f16=value_f16,
-                                   need_flags=tuple(need_flags),
-                                   has_group_clip=has_group_clip)
+
+    def step_chunk_bytes(c, bucket_row, accs, qhist, n_valid, _n_uniq_c):
+        return _chunk_step(jax.random.fold_in(key, c), bucket_row,
+                           n_valid, accs,
+                           linf_cap, l0_cap, row_clip_lo,
+                           row_clip_hi, middle, group_clip_lo,
+                           group_clip_hi, l1_cap,
+                           num_partitions=num_partitions,
+                           bytes_pid=bytes_pid,
+                           bytes_pk=bytes_pk,
+                           value_f16=value_f16,
+                           need_flags=tuple(need_flags),
+                           has_group_clip=has_group_clip), qhist
+
+    accs, _ = _run_slab_loop(
+        key, k, counts, None,
+        ("bytes", bytes_pid, bytes_pk, value_f16, width),
+        lambda s0, s1: buckets[s0:s1], step_chunk_bytes,
+        n_t, num_partitions, None, resilience,
+        lambda: _input_digest(pid, pk, value))
     return accs
+
+
+def _input_digest(pid, pk, value) -> str:
+    from pipelinedp_tpu.runtime import checkpoint as checkpoint_lib
+
+    return checkpoint_lib.array_digest(pid, pk, value)
+
+
+def _run_slab_loop(key, k, counts, n_uniq, fmt_desc, prepare_slab,
+                   step_chunk, n_transfers, num_partitions, quantile_spec,
+                   resilience, data_digest_fn=None):
+    """The resilient slab loop shared by every streaming encode path.
+
+    Iterates chunks [0, k) in slab windows: ``prepare_slab(s0, s1)``
+    produces the host slab (sort+emit for the native codec, an array
+    slice otherwise), one async ``device_put`` ships it, and
+    ``step_chunk(c, row, accs, qhist, n_valid, n_uniq_c)`` folds each
+    chunk into the running accumulators with its ``fold_in(key, c)`` key.
+
+    With a ``runtime.StreamResilience`` attached the loop additionally:
+
+      * resumes from a fingerprint-validated ``StreamCheckpoint``
+        (explicit ``resume_from`` or the policy store) — bit-identical to
+        an uninterrupted run because the chunk key schedule and the host
+        encode are pure functions of ``(input, key)``;
+      * snapshots ``(accs, qhist, next_chunk)`` to the checkpoint store
+        after every ``every_slabs`` completed windows;
+      * classifies failures (runtime/retry.py): ``RESOURCE_EXHAUSTED``
+        halves the slab window and re-issues from the failed chunk (the
+        chunk keys don't depend on the slab grouping, so released values
+        are unchanged); transient faults re-issue after bounded
+        exponential backoff; anything else — including HostCrash —
+        propagates.
+
+    A failure raised *inside* a chunk step may have consumed the donated
+    accumulator buffers, so those retries restore state from the last
+    checkpoint (and re-raise when no checkpoint exists — resuming from
+    possibly-poisoned buffers would risk double-counting a chunk).
+
+    Returns (accs, qhist); qhist is None when quantile_spec is None.
+    """
+    from pipelinedp_tpu import runtime as runtime_lib
+    from pipelinedp_tpu.runtime import checkpoint as checkpoint_lib
+    from pipelinedp_tpu.runtime import retry as retry_lib
+
+    # Five distinct buffers: the accumulators are donated into each chunk
+    # step, and a donated buffer must not be aliased.
+    accs = columnar.PartitionAccumulators(
+        *(jnp.zeros((num_partitions,), dtype=jnp.float32) for _ in range(5)))
+    qhist = (jnp.zeros((num_partitions, quantile_spec[0]),
+                       dtype=jnp.float32)
+             if quantile_spec is not None else None)
+    policy = injector = cp_policy = None
+    key_fp = wire_fp = None
+    cursor = 0
+    if resilience is not None:
+        policy = resilience.retry_policy
+        injector = resilience.fault_injector
+        cp_policy = resilience.checkpoint_policy
+        if cp_policy is not None or resilience.resume_from is not None:
+            key_fp = checkpoint_lib.key_fingerprint(key)
+            wire_fp = checkpoint_lib.wire_fingerprint(
+                k, repr(fmt_desc), counts, n_uniq,
+                data_digest=data_digest_fn() if data_digest_fn else "")
+            cp = resilience.resume_from
+            if cp is None and cp_policy is not None:
+                cp = cp_policy.store.load(cp_policy.run_id)
+            if cp is not None:
+                cp.validate(key_fp=key_fp, wire_fp=wire_fp, n_chunks=k,
+                            key_counter=resilience.key_counter)
+                accs, qhist, cursor = _restore_checkpoint(
+                    cp, expects_qhist=quantile_spec is not None)
+                profiler.count_event(runtime_lib.EVENT_RESUMES)
+
+    def save_checkpoint(next_chunk, accs, qhist):
+        host_accs, host_q = jax.device_get((tuple(accs), qhist))
+        cp = checkpoint_lib.StreamCheckpoint(
+            run_id=cp_policy.run_id, next_chunk=next_chunk, n_chunks=k,
+            accs=tuple(np.asarray(a) for a in host_accs),
+            qhist=None if host_q is None else np.asarray(host_q),
+            key_fingerprint=key_fp, wire_fingerprint=wire_fp,
+            key_counter=resilience.key_counter)
+        cp_policy.store.save(cp)
+        profiler.count_event(runtime_lib.EVENT_CHECKPOINT_BYTES,
+                             cp.nbytes())
+
+    slab_buckets = max(1, (k + n_transfers - 1) // n_transfers)
+    ordinal = 0  # slab-window starts incl. re-issues (fault script index)
+    failures = 0  # consecutive failed attempts of the current window
+    since_checkpoint = 0
+    while cursor < k:
+        s1 = min(cursor + slab_buckets, k)
+        window = ordinal
+        ordinal += 1
+        in_dispatch = False
+        try:
+            with profiler.stage(f"dp/stream_slab_{cursor}"):
+                slab = prepare_slab(cursor, s1)
+                if injector is not None:
+                    injector.check("transfer", window)
+                dslab = jax.device_put(slab)
+                if injector is not None:
+                    injector.check("kernel", window)
+                s0 = cursor
+                for c in range(s0, s1):
+                    in_dispatch = True
+                    accs, qhist = step_chunk(c, dslab[c - s0], accs, qhist,
+                                             int(counts[c]),
+                                             int(n_uniq[c])
+                                             if n_uniq is not None else 0)
+                    in_dispatch = False
+                    cursor = c + 1
+        except Exception as exc:
+            failure_kind = retry_lib.classify(exc)
+            if policy is None or failure_kind == retry_lib.FATAL:
+                raise
+            if in_dispatch:
+                # The failing chunk step may have consumed its donated
+                # accumulator buffers; only a checkpoint restores a
+                # trustworthy state.
+                cp = (cp_policy.store.load(cp_policy.run_id)
+                      if cp_policy is not None else None)
+                if cp is None:
+                    raise
+                cp.validate(key_fp=key_fp, wire_fp=wire_fp, n_chunks=k,
+                            key_counter=resilience.key_counter)
+                accs, qhist, cursor = _restore_checkpoint(
+                    cp, expects_qhist=quantile_spec is not None)
+                profiler.count_event(runtime_lib.EVENT_RESUMES)
+            if failure_kind == retry_lib.OOM:
+                smaller = policy.degrade_slab_buckets(slab_buckets)
+                if smaller < slab_buckets:
+                    # Re-issue from the failed chunk with a halved slab
+                    # byte budget; the per-chunk key schedule is
+                    # untouched, so results are unchanged.
+                    slab_buckets = smaller
+                    profiler.count_event(runtime_lib.EVENT_DEGRADATIONS)
+                    continue
+            failures += 1
+            if failures > policy.max_retries:
+                raise
+            profiler.count_event(runtime_lib.EVENT_RETRIES)
+            policy.sleep(policy.backoff_s(failures - 1))
+            continue
+        failures = 0
+        since_checkpoint += 1
+        if (cp_policy is not None and cursor < k
+                and since_checkpoint >= cp_policy.every_slabs):
+            save_checkpoint(cursor, accs, qhist)
+            since_checkpoint = 0
+    if cp_policy is not None and cp_policy.delete_on_success:
+        cp_policy.store.delete(cp_policy.run_id)
+    return accs, qhist
+
+
+def _restore_checkpoint(cp, expects_qhist: bool = False):
+    """(accs, qhist, cursor) device state from a validated checkpoint.
+    Fresh host copies, so restored buffers never alias store state even
+    after the chunk steps donate them."""
+    from pipelinedp_tpu.runtime import checkpoint as checkpoint_lib
+
+    if expects_qhist and cp.qhist is None:
+        raise checkpoint_lib.CheckpointMismatchError(
+            "checkpoint has no quantile histogram but this run streams "
+            "PERCENTILE metrics")
+    accs = columnar.PartitionAccumulators(
+        *(jnp.asarray(np.array(a)) for a in cp.accs))
+    qhist = None if cp.qhist is None else jnp.asarray(np.array(cp.qhist))
+    return accs, qhist, int(cp.next_chunk)
+
+
+# Log the native-packer fallback once per process, not once per call
+# (count_event("runtime/native_fallback") keeps the per-call tally).
+_native_fallback_logged = False
+
+
+def _count_native_fallback(reason: str) -> None:
+    global _native_fallback_logged
+    profiler.count_event("runtime/native_fallback")
+    if not _native_fallback_logged:
+        _native_fallback_logged = True
+        logging.info(
+            "pipelinedp_tpu streaming: native row packer unavailable (%s); "
+            "using the numpy fallback", reason)
 
 
 def _pack_native(pid, pk, value, pid_lo, k, bytes_pid, bytes_pk, value_f16,
@@ -512,12 +702,17 @@ def _pack_native(pid, pk, value, pid_lo, k, bytes_pid, bytes_pk, value_f16,
     unavailable or the dtypes don't qualify (the numpy fallback handles
     everything).
     """
+    from pipelinedp_tpu.native import loader
     try:
-        from pipelinedp_tpu.native import loader
         lib = loader.load_row_packer()
-    except Exception:  # noqa: BLE001 — packer is an optimization only
+    except loader.LOADER_ERRORS as e:
+        # Only loader/codec failures fall back (the packer is an
+        # optimization); anything else — including NativeRequiredError
+        # under PIPELINEDP_TPU_REQUIRE_NATIVE=1 — propagates.
+        _count_native_fallback(f"{type(e).__name__}: {e}")
         return None
     if lib is None:
+        _count_native_fallback("build/load failed; see native loader logs")
         return None
     import ctypes
 

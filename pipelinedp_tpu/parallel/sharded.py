@@ -628,7 +628,9 @@ def stream_bound_and_aggregate(mesh: Mesh,
                                n_chunks: Optional[int] = None,
                                value_transfer_dtype=None,
                                need_flags=(True, True, True, True),
-                               has_group_clip: bool = True
+                               has_group_clip: bool = True,
+                               resilience=None,
+                               resume_from=None
                                ) -> columnar.PartitionAccumulators:
     """Chunked, transfer-overlapped multi-chip bound-and-aggregate.
 
@@ -640,9 +642,22 @@ def stream_bound_and_aggregate(mesh: Mesh,
     (pid-disjoint buckets bound independently, accumulators add).
     Returns globally-sharded [padded_p] accumulators like
     bound_and_aggregate.
+
+    resilience / resume_from: the runtime resilience bundle and explicit
+    checkpoint hook, as on the single-device path (RESILIENCE.md). The
+    mesh checkpoints per chunk; OOM degradation does not apply here (the
+    chunk granularity is fixed by the mesh shape), so RESOURCE_EXHAUSTED
+    re-issues the chunk like a transient fault.
     """
+    import dataclasses
+
     from pipelinedp_tpu.ops import streaming, wirecodec
 
+    if resume_from is not None:
+        if resilience is None:
+            from pipelinedp_tpu import runtime as runtime_lib
+            resilience = runtime_lib.StreamResilience()
+        resilience = dataclasses.replace(resilience, resume_from=resume_from)
     n = len(pid)
     n_dev = mesh.devices.size
     padded_p = padded_num_partitions(mesh, num_partitions)
@@ -690,7 +705,14 @@ def stream_bound_and_aggregate(mesh: Mesh,
 
                 def emit(c):
                     b0, b1 = c * n_dev, (c + 1) * n_dev
-                    enc.sort_range(b0, b1)
+                    sorted_uniq = enc.sort_range(b0, b1)
+                    if not np.array_equal(sorted_uniq, n_uniq[b0:b1]):
+                        # Same corrupted-input guard as the single-device
+                        # slab loop (ops/streaming.py): analytic prep
+                        # counts must equal the post-sort RLE counts.
+                        raise RuntimeError(
+                            "wirecodec: prep-time RLE entry counts "
+                            "disagree with the sorted buckets")
                     return enc.emit_range(b0, b1, fmt)
             else:
                 n_uniq = enc.sort_range(0, k)
@@ -707,7 +729,10 @@ def stream_bound_and_aggregate(mesh: Mesh,
                                      n_c, n_dev, padded_p, linf_cap, l0_cap,
                                      row_clip_lo, row_clip_hi, middle,
                                      group_clip_lo, group_clip_hi, l1_cap,
-                                     tuple(need_flags), has_group_clip)
+                                     tuple(need_flags), has_group_clip,
+                                     resilience,
+                                     lambda: streaming._input_digest(
+                                         pid, pk, value))
     slab, counts, n_uniq, fmt = wirecodec.encode_buckets_numpy(
         pid, pk, value, pid_lo=info.pid_lo, k=k, bytes_pid=info.bytes_pid,
         bits_pk=info.bits_pk, plan=info.plan, pid_mode=info.pid_mode,
@@ -718,40 +743,117 @@ def stream_bound_and_aggregate(mesh: Mesh,
                              n_dev, padded_p, linf_cap, l0_cap, row_clip_lo,
                              row_clip_hi, middle, group_clip_lo,
                              group_clip_hi, l1_cap, tuple(need_flags),
-                             has_group_clip)
+                             has_group_clip, resilience,
+                             lambda: streaming._input_digest(pid, pk, value))
 
 
 def _run_codec_chunks(mesh, key, emit, counts, n_uniq, fmt, n_c, n_dev,
                       padded_p, linf_cap, l0_cap, row_clip_lo, row_clip_hi,
                       middle, group_clip_lo, group_clip_hi, l1_cap,
-                      need_flags, has_group_clip):
+                      need_flags, has_group_clip, resilience=None,
+                      data_digest_fn=None):
+    """The mesh chunk loop, with the same resilience semantics as the
+    single-device slab loop (ops/streaming._run_slab_loop): each chunk is
+    one slab window — resumable, checkpointed, retried after transient
+    faults. Chunk accumulators are summed (never donated) and injected
+    faults fire before dispatch, so retrying a chunk is always safe; OOM
+    re-issues the chunk after backoff (the chunk granularity is fixed by
+    the mesh shape, so there is no slab budget to degrade)."""
     from pipelinedp_tpu import profiler
+    from pipelinedp_tpu import runtime as runtime_lib
+    from pipelinedp_tpu.runtime import checkpoint as checkpoint_lib
+    from pipelinedp_tpu.runtime import retry as retry_lib
 
     kernel = _codec_scalar_kernel(mesh, padded_p, fmt,
                                   l1_cap is not None, need_flags,
                                   has_group_clip)
     sharding = NamedSharding(mesh, _spec(mesh))
+    part_sharding = NamedSharding(mesh, _part_spec(mesh))
     accs = None
     counts = np.asarray(counts, dtype=np.int32)
     n_uniq = np.asarray(n_uniq, dtype=np.int32)
-    for c in range(n_c):
-        with profiler.stage(f"dp/mesh_stream_chunk_{c}"):
-            slab = emit(c)
-            dslab = jax.device_put(slab, sharding)
-            dvalid = jax.device_put(counts[c * n_dev:(c + 1) * n_dev],
-                                    sharding)
-            duniq = jax.device_put(n_uniq[c * n_dev:(c + 1) * n_dev],
-                                   sharding)
-            args = (jax.random.fold_in(key, c), dslab, dvalid, duniq,
-                    linf_cap, l0_cap, float(row_clip_lo),
-                    float(row_clip_hi), float(middle),
-                    float(group_clip_lo), float(group_clip_hi))
-            if l1_cap is not None:
-                args += (l1_cap,)
-            chunk_accs = kernel(*args)
-            accs = chunk_accs if accs is None else (
-                columnar.PartitionAccumulators(
-                    *(a + b for a, b in zip(accs, chunk_accs))))
+
+    policy = injector = cp_policy = None
+    key_fp = wire_fp = None
+    cursor = 0
+    if resilience is not None:
+        policy = resilience.retry_policy
+        injector = resilience.fault_injector
+        cp_policy = resilience.checkpoint_policy
+        if cp_policy is not None or resilience.resume_from is not None:
+            key_fp = checkpoint_lib.key_fingerprint(key)
+            wire_fp = checkpoint_lib.wire_fingerprint(
+                n_c, repr(("mesh", n_dev, fmt)), counts, n_uniq,
+                data_digest=data_digest_fn() if data_digest_fn else "")
+            cp = resilience.resume_from
+            if cp is None and cp_policy is not None:
+                cp = cp_policy.store.load(cp_policy.run_id)
+            if cp is not None:
+                cp.validate(key_fp=key_fp, wire_fp=wire_fp, n_chunks=n_c,
+                            key_counter=resilience.key_counter)
+                accs = columnar.PartitionAccumulators(
+                    *(jax.device_put(np.array(a), part_sharding)
+                      for a in cp.accs))
+                cursor = int(cp.next_chunk)
+                profiler.count_event(runtime_lib.EVENT_RESUMES)
+
+    ordinal = 0
+    failures = 0
+    since_checkpoint = 0
+    while cursor < n_c:
+        c = cursor
+        window = ordinal
+        ordinal += 1
+        try:
+            with profiler.stage(f"dp/mesh_stream_chunk_{c}"):
+                slab = emit(c)
+                if injector is not None:
+                    injector.check("transfer", window)
+                dslab = jax.device_put(slab, sharding)
+                dvalid = jax.device_put(counts[c * n_dev:(c + 1) * n_dev],
+                                        sharding)
+                duniq = jax.device_put(n_uniq[c * n_dev:(c + 1) * n_dev],
+                                       sharding)
+                if injector is not None:
+                    injector.check("kernel", window)
+                args = (jax.random.fold_in(key, c), dslab, dvalid, duniq,
+                        linf_cap, l0_cap, float(row_clip_lo),
+                        float(row_clip_hi), float(middle),
+                        float(group_clip_lo), float(group_clip_hi))
+                if l1_cap is not None:
+                    args += (l1_cap,)
+                chunk_accs = kernel(*args)
+                accs = chunk_accs if accs is None else (
+                    columnar.PartitionAccumulators(
+                        *(a + b for a, b in zip(accs, chunk_accs))))
+                cursor = c + 1
+        except Exception as exc:
+            failure_kind = retry_lib.classify(exc)
+            if policy is None or failure_kind == retry_lib.FATAL:
+                raise
+            failures += 1
+            if failures > policy.max_retries:
+                raise
+            profiler.count_event(runtime_lib.EVENT_RETRIES)
+            policy.sleep(policy.backoff_s(failures - 1))
+            continue
+        failures = 0
+        since_checkpoint += 1
+        if (cp_policy is not None and cursor < n_c
+                and since_checkpoint >= cp_policy.every_slabs):
+            host_accs = jax.device_get(tuple(accs))
+            cp = checkpoint_lib.StreamCheckpoint(
+                run_id=cp_policy.run_id, next_chunk=cursor, n_chunks=n_c,
+                accs=tuple(np.asarray(a) for a in host_accs),
+                qhist=None,
+                key_fingerprint=key_fp, wire_fingerprint=wire_fp,
+                key_counter=resilience.key_counter)
+            cp_policy.store.save(cp)
+            profiler.count_event(runtime_lib.EVENT_CHECKPOINT_BYTES,
+                                 cp.nbytes())
+            since_checkpoint = 0
+    if cp_policy is not None and cp_policy.delete_on_success:
+        cp_policy.store.delete(cp_policy.run_id)
     return accs
 
 

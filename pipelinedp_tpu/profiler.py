@@ -57,6 +57,21 @@ def event_counts() -> Dict[str, int]:
         return dict(_counters)
 
 
+def reset_events(prefix: Optional[str] = None) -> None:
+    """Zeros the named counters (those starting with ``prefix``, or all).
+
+    Test/bench plumbing: counters are process-global, so suites that
+    assert on deltas (e.g. the runtime/* resilience counters) reset their
+    slice first instead of bookkeeping baselines.
+    """
+    with _counter_lock:
+        if prefix is None:
+            _counters.clear()
+        else:
+            for name in [n for n in _counters if n.startswith(prefix)]:
+                del _counters[name]
+
+
 @contextlib.contextmanager
 def profile(logdir: str,
             create_perfetto_link: bool = False) -> Iterator[None]:

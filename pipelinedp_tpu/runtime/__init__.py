@@ -1,0 +1,91 @@
+"""Resilient streaming runtime: checkpoint/resume, OOM-adaptive retry,
+and at-most-once DP release.
+
+The reference inherits fault tolerance from its backends (Beam and Spark
+re-execute lost work transparently); the TPU-native runtime gets the
+equivalent here, built on two properties the streamed execution path
+already has:
+
+  * determinism — per-chunk PRNG keys are ``fold_in(key, c)`` and the
+    host encode is a pure function of the input, so re-running any chunk
+    reproduces it bitwise;
+  * mergeability — ``PartitionAccumulators`` (and the quantile leaf
+    histogram) add across pid-disjoint chunks, so a prefix of the chunk
+    sequence is a complete, resumable intermediate state.
+
+What lives where:
+
+  * :mod:`checkpoint` — ``StreamCheckpoint`` snapshots
+    ``(accs, qhist, next_chunk, wire/rng fingerprints, KeyStream
+    counter)`` after each slab into a ``CheckpointStore`` (in-memory or
+    file-backed); a resumed run is bit-identical to an uninterrupted one.
+  * :mod:`retry` — ``RetryPolicy``: bounded exponential backoff for
+    transient transfer/kernel errors; on ``RESOURCE_EXHAUSTED`` the slab
+    byte budget is halved and the failed slab re-issued (the per-chunk
+    key schedule never changes, so results stay distribution-identical —
+    bit-identical for a seeded run).
+  * :mod:`journal` — ``ReleaseJournal``: at-most-once noise release. A
+    resumed or retried run that would re-draw already-released noise
+    raises instead of silently degrading the DP guarantee (the budget
+    side lives in ``budget_accounting`` as the spend journal).
+  * :mod:`faults` — ``FaultInjector``: scripted OOM / transfer / kernel /
+    host-crash faults at slab N, driving ``tests/resilience_test.py``.
+
+``JaxDPEngine`` exposes all of it via the ``checkpoint_policy=``,
+``retry_policy=``, ``release_journal=`` and ``fault_injector=`` knobs;
+``ops/streaming.stream_bound_and_aggregate`` and the mesh twin take a
+``resilience=`` bundle plus an explicit ``resume_from=`` hook. See
+RESILIENCE.md for the failure model and recovery semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from pipelinedp_tpu import profiler
+from pipelinedp_tpu.runtime.checkpoint import (  # noqa: F401
+    CheckpointMismatchError, CheckpointPolicy, CheckpointStore,
+    FileCheckpointStore, InMemoryCheckpointStore, StreamCheckpoint,
+    key_fingerprint, wire_fingerprint)
+from pipelinedp_tpu.runtime.faults import (  # noqa: F401
+    FaultInjector, FaultSpec, HostCrash, InjectedFault, InjectedKernelError,
+    InjectedOom, InjectedTransferError)
+from pipelinedp_tpu.runtime.journal import (  # noqa: F401
+    DoubleReleaseError, ReleaseJournal, ReleaseRecord)
+from pipelinedp_tpu.runtime.retry import RetryPolicy, classify  # noqa: F401
+
+# Profiler event-counter names (profiler.count_event / event_count).
+EVENT_RETRIES = "runtime/retries"
+EVENT_DEGRADATIONS = "runtime/degradations"
+EVENT_RESUMES = "runtime/resumes"
+EVENT_CHECKPOINT_BYTES = "runtime/checkpoint_bytes"
+EVENT_NATIVE_FALLBACK = "runtime/native_fallback"
+
+
+@dataclasses.dataclass
+class StreamResilience:
+    """The resilience bundle the streaming drivers consume.
+
+    ``key_counter`` is the engine KeyStream position the streamed kernel
+    key was drawn at; checkpoints record it so a resume under a different
+    key schedule (which could never be bit-identical) is refused instead
+    of silently diverging. -1 = unknown (direct streaming-API callers).
+    """
+    retry_policy: RetryPolicy = dataclasses.field(default_factory=RetryPolicy)
+    fault_injector: Optional[FaultInjector] = None
+    checkpoint_policy: Optional[CheckpointPolicy] = None
+    resume_from: Optional[StreamCheckpoint] = None
+    key_counter: int = -1
+
+
+def resilience_counters() -> Dict[str, int]:
+    """Snapshot of the runtime's resilience counters (bench.py surfaces
+    this dict; all keys always present so dashboards can rely on them)."""
+    return {
+        "retries": profiler.event_count(EVENT_RETRIES),
+        "degradations": profiler.event_count(EVENT_DEGRADATIONS),
+        "resumes": profiler.event_count(EVENT_RESUMES),
+        "checkpoint_bytes": profiler.event_count(EVENT_CHECKPOINT_BYTES),
+        "native_fallbacks": profiler.event_count(EVENT_NATIVE_FALLBACK),
+    }

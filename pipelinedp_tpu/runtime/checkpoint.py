@@ -1,0 +1,260 @@
+"""Streaming checkpoint/resume: snapshot the slab loop's mergeable state.
+
+The streamed execution path (ops/streaming.py, parallel/sharded.py) is a
+fold over pid-disjoint chunks: ``accs_{c+1} = step(fold_in(key, c),
+chunk_c, accs_c)``. Both the per-chunk keys and the host encode are pure
+functions of ``(input, key)``, so the complete resumable state after chunk
+``c`` is just the accumulator arrays (plus the quantile leaf histogram when
+PERCENTILE rides the stream) and the cursor ``c+1`` — everything else is
+re-derived on resume and *verified* against the checkpoint's fingerprints:
+
+  * ``key_fingerprint`` — digest of the streamed kernel key. A resume
+    under a different seed could never be bit-identical; refuse it.
+  * ``wire_fingerprint`` — digest of the wire format + per-bucket row/RLE
+    counts. Catches changed input data, chunk count, or codec planning
+    drift between the checkpointing and the resuming process.
+  * ``key_counter`` — the engine KeyStream position the kernel key was
+    drawn at (-1 when streaming is driven directly, without an engine).
+
+A resumed run replays the remaining chunks with the original per-chunk key
+schedule, so it is bit-identical to an uninterrupted run
+(tests/resilience_test.py pins this on the single-device and mesh paths).
+
+Checkpoints must never contain released noise: they hold pre-noise
+accumulators only, and the at-most-once release rule is enforced
+separately by the release journal (runtime/journal.py).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import tempfile
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class CheckpointMismatchError(RuntimeError):
+    """The checkpoint does not belong to this (input, key, format) run."""
+
+
+def key_fingerprint(key) -> str:
+    """Stable digest of a JAX PRNG key (old-style uint32 or typed)."""
+    import jax
+
+    try:
+        data = jax.random.key_data(key)
+    except (TypeError, ValueError, AttributeError):
+        data = key
+    arr = np.asarray(data)
+    digest = hashlib.sha256()
+    digest.update(str(arr.dtype).encode())
+    digest.update(arr.tobytes())
+    return digest.hexdigest()[:32]
+
+
+def wire_fingerprint(n_chunks: int, fmt_desc,
+                     counts: np.ndarray,
+                     n_uniq: Optional[np.ndarray] = None,
+                     data_digest: str = "") -> str:
+    """Digest of the wire schedule: chunk count, format, per-bucket
+    row counts, (RLE modes) entry counts, and the caller's input-column
+    digest (array_digest) — per-bucket counts depend only on the privacy
+    ids, so the column digest is what catches a mutated pk/value column
+    between checkpoint and resume."""
+    digest = hashlib.sha256()
+    digest.update(repr((int(n_chunks), fmt_desc, data_digest)).encode())
+    digest.update(np.ascontiguousarray(counts, dtype=np.int64).tobytes())
+    if n_uniq is not None:
+        digest.update(np.ascontiguousarray(n_uniq, dtype=np.int64).tobytes())
+    return digest.hexdigest()[:32]
+
+
+def array_digest(*arrays) -> str:
+    """Cheap deterministic digest of (possibly huge) input columns:
+    dtype/shape, a <=64Ki-element stride sample, and the float64 column
+    sum. O(1)-ish in the input size — corruption *detection* for resume
+    validation, not an adversarial integrity check."""
+    digest = hashlib.sha256()
+    for arr in arrays:
+        if arr is None:
+            digest.update(b"none")
+            continue
+        arr = np.asarray(arr)
+        digest.update(str((arr.dtype, arr.shape)).encode())
+        flat = arr.reshape(-1)
+        if flat.size:
+            stride = max(1, flat.size // 65536)
+            digest.update(np.ascontiguousarray(flat[::stride]).tobytes())
+            if np.issubdtype(arr.dtype, np.number):
+                digest.update(
+                    np.float64(flat.sum(dtype=np.float64)).tobytes())
+    return digest.hexdigest()[:32]
+
+
+@dataclasses.dataclass
+class StreamCheckpoint:
+    """One snapshot of the slab loop, taken at a chunk boundary."""
+    run_id: str
+    next_chunk: int  # first chunk NOT yet folded into accs
+    n_chunks: int
+    accs: Tuple[np.ndarray, ...]  # the 5 PartitionAccumulators arrays
+    qhist: Optional[np.ndarray]  # quantile leaf histogram, when streamed
+    key_fingerprint: str
+    wire_fingerprint: str
+    key_counter: int = -1
+
+    def nbytes(self) -> int:
+        total = sum(int(a.nbytes) for a in self.accs)
+        if self.qhist is not None:
+            total += int(self.qhist.nbytes)
+        return total
+
+    def validate(self, *, key_fp: str, wire_fp: str, n_chunks: int,
+                 key_counter: int = -1) -> None:
+        """Refuses a resume that could not be bit-identical."""
+        if self.key_fingerprint != key_fp:
+            raise CheckpointMismatchError(
+                "checkpoint was written under a different PRNG key; "
+                "resuming would change the released distribution")
+        if self.wire_fingerprint != wire_fp:
+            raise CheckpointMismatchError(
+                "checkpoint wire fingerprint does not match this input "
+                "(data, chunk count, or wire format changed since the "
+                "checkpoint was written)")
+        if self.n_chunks != n_chunks:
+            raise CheckpointMismatchError(
+                f"checkpoint covers {self.n_chunks} chunks, this run has "
+                f"{n_chunks}")
+        if (key_counter >= 0 and self.key_counter >= 0
+                and self.key_counter != key_counter):
+            raise CheckpointMismatchError(
+                f"checkpoint was taken at KeyStream position "
+                f"{self.key_counter}, this run is at {key_counter}")
+        if not 0 <= self.next_chunk <= self.n_chunks:
+            raise CheckpointMismatchError(
+                f"corrupt checkpoint cursor {self.next_chunk}")
+
+
+class CheckpointStore(abc.ABC):
+    """Where StreamCheckpoints live between (possibly crashed) runs."""
+
+    @abc.abstractmethod
+    def save(self, checkpoint: StreamCheckpoint) -> None:
+        """Durably replaces the checkpoint for checkpoint.run_id."""
+
+    @abc.abstractmethod
+    def load(self, run_id: str) -> Optional[StreamCheckpoint]:
+        """The latest checkpoint for run_id, or None."""
+
+    @abc.abstractmethod
+    def delete(self, run_id: str) -> None:
+        """Drops run_id's checkpoint (no-op when absent)."""
+
+
+class InMemoryCheckpointStore(CheckpointStore):
+    """Process-local store: survives engine instances, not the process.
+    Arrays are copied on save so donated device buffers and later slab
+    arithmetic can never alias checkpointed state."""
+
+    def __init__(self):
+        self._checkpoints = {}
+
+    def save(self, checkpoint: StreamCheckpoint) -> None:
+        self._checkpoints[checkpoint.run_id] = dataclasses.replace(
+            checkpoint,
+            accs=tuple(np.array(a) for a in checkpoint.accs),
+            qhist=(None if checkpoint.qhist is None
+                   else np.array(checkpoint.qhist)))
+
+    def load(self, run_id: str) -> Optional[StreamCheckpoint]:
+        return self._checkpoints.get(run_id)
+
+    def delete(self, run_id: str) -> None:
+        self._checkpoints.pop(run_id, None)
+
+
+class FileCheckpointStore(CheckpointStore):
+    """File-backed store: one ``<run_id>.npz`` per run under ``root``,
+    written atomically (tmp file + rename) so a crash mid-save leaves the
+    previous checkpoint intact."""
+
+    def __init__(self, root: str):
+        self._root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, run_id: str) -> str:
+        safe = re.sub(r"[^A-Za-z0-9._-]", "_", run_id)
+        return os.path.join(self._root, f"{safe}.npz")
+
+    def save(self, checkpoint: StreamCheckpoint) -> None:
+        meta = json.dumps({
+            "run_id": checkpoint.run_id,
+            "next_chunk": int(checkpoint.next_chunk),
+            "n_chunks": int(checkpoint.n_chunks),
+            "key_fingerprint": checkpoint.key_fingerprint,
+            "wire_fingerprint": checkpoint.wire_fingerprint,
+            "key_counter": int(checkpoint.key_counter),
+            "has_qhist": checkpoint.qhist is not None,
+        })
+        arrays = {f"accs_{i}": np.asarray(a)
+                  for i, a in enumerate(checkpoint.accs)}
+        if checkpoint.qhist is not None:
+            arrays["qhist"] = np.asarray(checkpoint.qhist)
+        arrays["meta"] = np.frombuffer(meta.encode(), dtype=np.uint8)
+        path = self._path(checkpoint.run_id)
+        fd, tmp = tempfile.mkstemp(dir=self._root, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **arrays)
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def load(self, run_id: str) -> Optional[StreamCheckpoint]:
+        path = self._path(run_id)
+        if not os.path.exists(path):
+            return None
+        with np.load(path, allow_pickle=False) as data:
+            meta = json.loads(bytes(data["meta"]).decode())
+            n_accs = sum(1 for name in data.files if name.startswith("accs_"))
+            accs = tuple(data[f"accs_{i}"] for i in range(n_accs))
+            qhist = data["qhist"] if meta["has_qhist"] else None
+        return StreamCheckpoint(
+            run_id=meta["run_id"],
+            next_chunk=meta["next_chunk"],
+            n_chunks=meta["n_chunks"],
+            accs=accs,
+            qhist=qhist,
+            key_fingerprint=meta["key_fingerprint"],
+            wire_fingerprint=meta["wire_fingerprint"],
+            key_counter=meta["key_counter"])
+
+    def delete(self, run_id: str) -> None:
+        path = self._path(run_id)
+        if os.path.exists(path):
+            os.unlink(path)
+
+
+@dataclasses.dataclass
+class CheckpointPolicy:
+    """The engine/streaming knob: where and how often to checkpoint.
+
+    every_slabs: snapshot after this many completed slab windows (1 =
+      after every slab). A snapshot syncs the accumulators to host, so
+      larger values trade recovery granularity for less sync overhead.
+    delete_on_success: drop the checkpoint once the stream completes (the
+      release journal — not a stale checkpoint — is what enforces
+      at-most-once release afterwards).
+    """
+    store: CheckpointStore
+    run_id: str = "default"
+    every_slabs: int = 1
+    delete_on_success: bool = True

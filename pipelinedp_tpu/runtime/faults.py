@@ -1,0 +1,143 @@
+"""Deterministic fault injection for the streamed execution path.
+
+The streaming drivers call ``FaultInjector.check(point, slab_ordinal)`` at
+two points per slab window — ``"transfer"`` (just before ``device_put``)
+and ``"kernel"`` (just before the first chunk dispatch of the window) — and
+the injector raises the scripted fault when its spec matches. Faults fire
+*before* the real operation, so accumulator state is never half-mutated by
+an injected failure (real mid-dispatch failures recover through the
+checkpoint instead; see ops/streaming.py).
+
+``slab_ordinal`` counts slab-window *starts*, including re-issues after a
+retry or degradation — so ``FaultSpec(kind, at_slab=N, times=t)`` means
+"fail the Nth window start and the next t-1 attempts", which is exactly the
+"fails twice, then succeeds" script a retry test needs.
+
+Kinds:
+  * ``oom`` — raises :class:`InjectedOom` (message carries
+    ``RESOURCE_EXHAUSTED`` so the retry classifier treats it like a real
+    device OOM) at the transfer point.
+  * ``transfer`` / ``kernel`` — transient faults at their points.
+  * ``host_crash`` — raises :class:`HostCrash` at the transfer point; the
+    retry layer never catches it (it simulates process death — the test
+    harness "restarts" by building a fresh engine and resuming).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import List, Sequence, Tuple
+
+
+class InjectedFault(RuntimeError):
+    """Base class of scripted transient faults (retryable)."""
+
+
+class InjectedOom(InjectedFault):
+    """Scripted device OOM; classified like a real RESOURCE_EXHAUSTED."""
+
+    def __init__(self, slab_ordinal: int):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: injected device OOM at slab "
+            f"{slab_ordinal} (fault injection)")
+
+
+class InjectedTransferError(InjectedFault):
+    """Scripted host->device transfer failure."""
+
+    def __init__(self, slab_ordinal: int):
+        super().__init__(
+            f"injected transfer fault at slab {slab_ordinal}")
+
+
+class InjectedKernelError(InjectedFault):
+    """Scripted chunk-kernel dispatch failure."""
+
+    def __init__(self, slab_ordinal: int):
+        super().__init__(f"injected kernel fault at slab {slab_ordinal}")
+
+
+class HostCrash(RuntimeError):
+    """Simulated process death: never retried, propagates out of the
+    stream so tests can exercise the resume-from-checkpoint path."""
+
+    def __init__(self, slab_ordinal: int):
+        super().__init__(f"injected host crash at slab {slab_ordinal}")
+
+
+KIND_OOM = "oom"
+KIND_TRANSFER = "transfer"
+KIND_KERNEL = "kernel"
+KIND_HOST_CRASH = "host_crash"
+
+# Which driver callpoint each fault kind fires at, and what it raises.
+_POINT_OF_KIND = {
+    KIND_OOM: "transfer",
+    KIND_TRANSFER: "transfer",
+    KIND_HOST_CRASH: "transfer",
+    KIND_KERNEL: "kernel",
+}
+_EXC_OF_KIND = {
+    KIND_OOM: InjectedOom,
+    KIND_TRANSFER: InjectedTransferError,
+    KIND_KERNEL: InjectedKernelError,
+    KIND_HOST_CRASH: HostCrash,
+}
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """Fire ``kind`` starting at slab-window ``at_slab``, ``times`` times."""
+    kind: str
+    at_slab: int
+    times: int = 1
+
+    def __post_init__(self):
+        if self.kind not in _POINT_OF_KIND:
+            raise ValueError(f"unknown fault kind {self.kind!r}; expected "
+                             f"one of {sorted(_POINT_OF_KIND)}")
+
+
+class FaultInjector:
+    """Scripted, deterministic fault source for the streaming drivers."""
+
+    def __init__(self, specs: Sequence[FaultSpec]):
+        self._specs = [dataclasses.replace(s) for s in specs]
+        self.fired: List[Tuple[str, int]] = []  # (kind, slab_ordinal) log
+
+    def check(self, point: str, slab_ordinal: int) -> None:
+        """Raises the scripted fault if any armed spec matches ``point``
+        at this window; consumes one firing from the spec."""
+        for spec in self._specs:
+            if (spec.times > 0 and _POINT_OF_KIND[spec.kind] == point
+                    and slab_ordinal >= spec.at_slab):
+                spec.times -= 1
+                self.fired.append((spec.kind, slab_ordinal))
+                raise _EXC_OF_KIND[spec.kind](slab_ordinal)
+
+    @property
+    def pending(self) -> int:
+        """Scripted firings not yet consumed."""
+        return sum(max(spec.times, 0) for spec in self._specs)
+
+    @classmethod
+    def chaos(cls, seed: int, n_slabs: int,
+              fire_percent: int = 25) -> "FaultInjector":
+        """A deterministic pseudo-random script over ``n_slabs`` windows.
+
+        Hash-derived (no RNG state, identical across platforms and
+        calls): each window fires one transient fault kind with
+        ``fire_percent`` probability. host_crash is excluded — a chaos
+        run must be completable by retries alone; crash-and-resume has
+        its own scripted tests.
+        """
+        retryable = (KIND_OOM, KIND_TRANSFER, KIND_KERNEL)
+        specs = []
+        for slab in range(n_slabs):
+            digest = hashlib.sha256(f"chaos:{seed}:{slab}".encode()).digest()
+            if digest[0] % 100 < fire_percent:
+                specs.append(
+                    FaultSpec(kind=retryable[digest[1] % len(retryable)],
+                              at_slab=slab))
+        return cls(specs)

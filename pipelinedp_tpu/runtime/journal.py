@@ -1,0 +1,84 @@
+"""At-most-once release journal.
+
+DP correctness survives crashes only if recovery is at-most-once with
+respect to randomness release: a retry that re-draws already-released
+noise publishes two correlated views of the data under one accounted
+budget. The journal makes the release step explicit — the engine commits a
+*release token* derived from the KeyStream state (root-key fingerprint +
+counter) immediately before finalization, and committing the same token
+twice raises :class:`DoubleReleaseError` instead of silently leaking.
+
+The budget side (each mechanism's epsilon/delta spend committed exactly
+once) lives on the accountant itself: ``BudgetAccountant.spend_journal``
+plus the one-shot ``MechanismSpec`` setters in budget_accounting.py.
+
+The journal is deliberately an explicit, caller-owned object (engine knob
+``release_journal=``): its scope defines what "the same release" means.
+Share one journal across the retries/resumes of a production run; give
+independent experiments independent journals (or None — the default — for
+the reference's semantics, where re-release is the caller's accounting
+decision).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import List, Tuple
+
+
+class DoubleReleaseError(RuntimeError):
+    """A committed release (or spend) was about to be replayed."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ReleaseRecord:
+    """One committed release, in commit order."""
+    seq: int
+    kind: str  # e.g. "noise_release"
+    token: Tuple
+
+
+class ReleaseJournal:
+    """Append-only set of committed release tokens."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._committed = {}
+        self._records: List[ReleaseRecord] = []
+
+    def commit(self, token: Tuple, kind: str = "noise_release"
+               ) -> ReleaseRecord:
+        """Records the release; raises if ``token`` was already committed.
+
+        Must be called *before* the release is computed/published, so the
+        failure mode is "refused to re-release", never "released twice".
+        """
+        with self._lock:
+            if token in self._committed:
+                prior = self._committed[token]
+                raise DoubleReleaseError(
+                    f"release token {token!r} was already committed "
+                    f"(record #{prior.seq}, kind={prior.kind!r}): a "
+                    f"resumed or retried run is about to re-draw "
+                    f"already-released noise. Use a fresh seed (or a "
+                    f"fresh journal) if a second, separately-accounted "
+                    f"release is intended.")
+            record = ReleaseRecord(seq=len(self._records), kind=kind,
+                                   token=token)
+            self._committed[token] = record
+            self._records.append(record)
+            return record
+
+    def has(self, token: Tuple) -> bool:
+        with self._lock:
+            return token in self._committed
+
+    @property
+    def records(self) -> Tuple[ReleaseRecord, ...]:
+        with self._lock:
+            return tuple(self._records)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)

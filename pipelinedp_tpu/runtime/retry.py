@@ -1,0 +1,78 @@
+"""Retry/degradation policy for the streamed execution path.
+
+Failure taxonomy (what the slab drivers do with a caught exception):
+
+  * ``oom`` — the device ran out of memory (``RESOURCE_EXHAUSTED``, real
+    or injected). Retrying the identical slab would fail the identical
+    way, so the driver *degrades*: it halves the slab window (equivalently
+    the slab byte budget) and re-issues from the failed slab. The
+    per-chunk key schedule is untouched — chunk keys are
+    ``fold_in(key, c)`` regardless of how chunks group into slabs — so
+    the released values are distribution-identical (bit-identical for a
+    seeded run).
+  * ``transient`` — transfer hiccups, preempted dispatches, injected
+    transfer/kernel faults. Re-issued after bounded exponential backoff.
+  * ``fatal`` — everything else (including :class:`faults.HostCrash` and
+    privacy-relevant guards like the wirecodec corrupted-input
+    RuntimeError). Propagates; recovery is restart + checkpoint resume.
+
+Classification is by exception type for injected faults and by status-code
+substring for real runtime errors (JAX surfaces XLA/PJRT failures as
+RuntimeErrors whose messages carry the gRPC-style status code).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable
+
+from pipelinedp_tpu.runtime import faults
+
+OOM = "oom"
+TRANSIENT = "transient"
+FATAL = "fatal"
+
+# Status codes worth re-issuing a slab for (preemption, link hiccups).
+_TRANSIENT_CODES = ("ABORTED", "UNAVAILABLE", "DEADLINE_EXCEEDED",
+                    "CANCELLED")
+
+
+def classify(exc: BaseException) -> str:
+    """OOM / TRANSIENT / FATAL for a caught slab-loop exception."""
+    if isinstance(exc, faults.HostCrash):
+        return FATAL
+    message = str(exc)
+    if isinstance(exc, faults.InjectedOom) or "RESOURCE_EXHAUSTED" in message:
+        return OOM
+    if isinstance(exc, faults.InjectedFault):
+        return TRANSIENT
+    if isinstance(exc, RuntimeError) and any(code in message
+                                             for code in _TRANSIENT_CODES):
+        return TRANSIENT
+    return FATAL
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Bounded backoff + OOM degradation knobs for the slab drivers.
+
+    max_retries bounds *consecutive* failed attempts of one slab window;
+    a completed window resets the count. OOM degradations that actually
+    shrink the window don't count against it (each halving changes the
+    attempted work, so it is progress, not a blind retry) — the floor is
+    a 1-chunk window, after which OOM falls back to counted retries.
+    """
+    max_retries: int = 3
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 2.0
+    # sleep is injectable so tests assert backoff without waiting it out.
+    sleep: Callable[[float], None] = time.sleep
+
+    def backoff_s(self, attempt: int) -> float:
+        """Exponential backoff delay before retry ``attempt`` (0-based)."""
+        return min(self.backoff_max_s, self.backoff_base_s * (2.0**attempt))
+
+    def degrade_slab_buckets(self, slab_buckets: int) -> int:
+        """Halved slab window (>= 1 chunk) after a device OOM."""
+        return max(1, slab_buckets // 2)

@@ -96,6 +96,74 @@ class TestNaiveBudgetAccountant:
         with pytest.raises(Exception, match="twice"):
             accountant.compute_budgets()
 
+
+class TestBudgetAccountantError:
+    """Regression: the accounting contract violations raise the typed
+    BudgetAccountantError (historically bare Exception), so recovery
+    layers can tell an accounting replay from a transient failure."""
+
+    def test_compute_twice_raises_typed_error(self):
+        accountant = ba.NaiveBudgetAccountant(total_epsilon=1, total_delta=0)
+        accountant.request_budget(MechanismType.LAPLACE)
+        accountant.compute_budgets()
+        with pytest.raises(ba.BudgetAccountantError, match="twice"):
+            accountant.compute_budgets()
+
+    def test_request_after_compute_raises_typed_error(self):
+        accountant = ba.NaiveBudgetAccountant(total_epsilon=1, total_delta=0)
+        accountant.request_budget(MechanismType.LAPLACE)
+        accountant.compute_budgets()
+        with pytest.raises(ba.BudgetAccountantError, match="request_budget"):
+            accountant.request_budget(MechanismType.LAPLACE)
+
+    def test_compute_inside_scope_raises_typed_error(self):
+        accountant = ba.NaiveBudgetAccountant(total_epsilon=1, total_delta=0)
+        scope = accountant.scope(weight=1)
+        with scope:
+            accountant.request_budget(MechanismType.LAPLACE)
+            with pytest.raises(ba.BudgetAccountantError, match="scope"):
+                accountant.compute_budgets()
+
+    def test_error_is_an_exception_subclass(self):
+        # Callers that historically caught Exception keep working.
+        assert issubclass(ba.BudgetAccountantError, Exception)
+
+    def test_replaying_committed_spend_raises(self):
+        spec = ba.MechanismSpec(MechanismType.LAPLACE)
+        spec.set_eps_delta(1.0, 0.0)
+        with pytest.raises(ba.BudgetAccountantError, match="committed"):
+            spec.set_eps_delta(1.0, 0.0)
+        spec2 = ba.MechanismSpec(MechanismType.GAUSSIAN)
+        spec2.set_noise_standard_deviation(2.0)
+        with pytest.raises(ba.BudgetAccountantError, match="committed"):
+            spec2.set_noise_standard_deviation(2.0)
+
+    def test_spend_journal_records_each_mechanism_once(self):
+        accountant = ba.NaiveBudgetAccountant(total_epsilon=2,
+                                              total_delta=1e-6)
+        accountant.request_budget(MechanismType.LAPLACE, weight=3)
+        accountant.request_budget(MechanismType.GAUSSIAN, weight=1)
+        accountant.compute_budgets()
+        journal = accountant.spend_journal
+        assert [record.index for record in journal] == [0, 1]
+        assert journal[0].eps == pytest.approx(1.5)
+        assert journal[1].eps == pytest.approx(0.5)
+        assert journal[0].delta == 0.0
+        assert journal[1].delta == pytest.approx(1e-6)
+
+    def test_pld_spend_journal(self):
+        accountant = ba.PLDBudgetAccountant(total_epsilon=1,
+                                            total_delta=1e-6)
+        accountant.request_budget(MechanismType.LAPLACE)
+        accountant.request_budget(MechanismType.GENERIC)
+        accountant.compute_budgets()
+        journal = accountant.spend_journal
+        assert len(journal) == 2
+        assert all(record.noise_standard_deviation > 0
+                   for record in journal)
+        # GENERIC also resolves (eps0, delta0).
+        assert journal[1].eps is not None and journal[1].eps > 0
+
     def test_scope_normalizes_weights(self):
         accountant = ba.NaiveBudgetAccountant(total_epsilon=1, total_delta=0)
         with accountant.scope(weight=1):

@@ -1,0 +1,693 @@
+"""Resilient streaming runtime tests (pipelinedp_tpu/runtime/).
+
+The contracts pinned here (RESILIENCE.md):
+
+  * kill-and-resume parity — a run interrupted by an injected fault
+    mid-stream and resumed from the last checkpoint releases BIT-IDENTICAL
+    output (seeded device noise) to an uninterrupted run, on the
+    single-device and the 8-device mesh paths;
+  * OOM degradation — an injected RESOURCE_EXHAUSTED at slab N completes
+    the run at a reduced slab budget with unchanged released values;
+  * at-most-once — replaying a committed mechanism spend or re-releasing
+    a finalized epilogue raises; the budget journal shows each spend
+    exactly once;
+  * checkpoint resumes are refused when the key/data/schedule fingerprints
+    do not match (a "resume" that could not be bit-identical);
+  * the wirecodec corrupted-input guard (prep-count vs sorted-bucket
+    mismatch) fires on both streaming paths.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import profiler
+from pipelinedp_tpu import runtime
+from pipelinedp_tpu.budget_accounting import (BudgetAccountantError,
+                                              MechanismSpec)
+from pipelinedp_tpu.aggregate_params import MechanismType
+from pipelinedp_tpu.ops import streaming, wirecodec
+from pipelinedp_tpu.parallel import sharded
+from pipelinedp_tpu.runtime import checkpoint as checkpoint_lib
+
+
+NO_SLEEP = runtime.RetryPolicy(sleep=lambda s: None)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return sharded.make_mesh(8)
+
+
+@pytest.fixture(autouse=True)
+def _reset_runtime_counters():
+    profiler.reset_events("runtime/")
+    yield
+
+
+def _data(n=50_000, n_parts=200, seed=0):
+    rng = np.random.default_rng(seed)
+    pid = rng.integers(1000, 9000, n).astype(np.int64)
+    pk = rng.integers(0, n_parts, n).astype(np.int32)
+    value = rng.uniform(0, 5, n).astype(np.float32)
+    return pid, pk, value
+
+
+def _aggregate(pid, pk, value, *, n_parts=200, seed=3, stream_chunks=8,
+               mesh=None, public=True, metrics=None, **engine_kw):
+    """One seeded device-noise aggregate through the public API."""
+    accountant = pdp.NaiveBudgetAccountant(1e9, 1 - 1e-9)
+    engine = pdp.JaxDPEngine(accountant, seed=seed,
+                             stream_chunks=stream_chunks, mesh=mesh,
+                             secure_host_noise=False, **engine_kw)
+    params = pdp.AggregateParams(
+        metrics=metrics or [pdp.Metrics.COUNT, pdp.Metrics.SUM],
+        max_partitions_contributed=200,
+        max_contributions_per_partition=1000,
+        min_value=0.0,
+        max_value=5.0)
+    result = engine.aggregate(
+        pdp.ColumnarData(pid=pid, pk=pk, value=value), params,
+        public_partitions=list(range(n_parts)) if public else None)
+    accountant.compute_budgets()
+    return result.to_columns()
+
+
+def _assert_same_release(a, b):
+    np.testing.assert_array_equal(a["keep_mask"], b["keep_mask"])
+    for name in a:
+        if name in ("partition_id", "keep_mask"):
+            continue
+        np.testing.assert_array_equal(a[name], b[name], err_msg=name)
+
+
+class TestCheckpointStores:
+
+    def _checkpoint(self, run_id="r", next_chunk=3, qhist=None):
+        rng = np.random.default_rng(1)
+        return checkpoint_lib.StreamCheckpoint(
+            run_id=run_id, next_chunk=next_chunk, n_chunks=8,
+            accs=tuple(rng.random(16).astype(np.float32) for _ in range(5)),
+            qhist=qhist, key_fingerprint="kf", wire_fingerprint="wf",
+            key_counter=2)
+
+    @pytest.mark.parametrize("make_store", [
+        lambda tmp: runtime.InMemoryCheckpointStore(),
+        lambda tmp: runtime.FileCheckpointStore(str(tmp)),
+    ], ids=["memory", "file"])
+    def test_roundtrip(self, tmp_path, make_store):
+        store = make_store(tmp_path)
+        cp = self._checkpoint(qhist=np.ones((16, 4), dtype=np.float32))
+        store.save(cp)
+        loaded = store.load("r")
+        assert loaded.next_chunk == 3
+        assert loaded.n_chunks == 8
+        assert loaded.key_fingerprint == "kf"
+        assert loaded.wire_fingerprint == "wf"
+        assert loaded.key_counter == 2
+        for a, b in zip(cp.accs, loaded.accs):
+            np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(cp.qhist, loaded.qhist)
+        assert store.load("missing") is None
+        store.delete("r")
+        assert store.load("r") is None
+        store.delete("r")  # idempotent
+
+    def test_file_store_save_replaces(self, tmp_path):
+        store = runtime.FileCheckpointStore(str(tmp_path))
+        store.save(self._checkpoint(next_chunk=2))
+        store.save(self._checkpoint(next_chunk=5))
+        assert store.load("r").next_chunk == 5
+
+    def test_memory_store_decouples_arrays(self):
+        store = runtime.InMemoryCheckpointStore()
+        cp = self._checkpoint()
+        store.save(cp)
+        cp.accs[0][:] = -1.0  # caller mutates after save
+        assert float(store.load("r").accs[0][0]) != -1.0
+
+    def test_validate_refuses_mismatches(self):
+        cp = self._checkpoint()
+        cp.validate(key_fp="kf", wire_fp="wf", n_chunks=8, key_counter=2)
+        with pytest.raises(checkpoint_lib.CheckpointMismatchError,
+                           match="PRNG key"):
+            cp.validate(key_fp="other", wire_fp="wf", n_chunks=8)
+        with pytest.raises(checkpoint_lib.CheckpointMismatchError,
+                           match="wire fingerprint"):
+            cp.validate(key_fp="kf", wire_fp="other", n_chunks=8)
+        with pytest.raises(checkpoint_lib.CheckpointMismatchError,
+                           match="chunks"):
+            cp.validate(key_fp="kf", wire_fp="wf", n_chunks=4)
+        with pytest.raises(checkpoint_lib.CheckpointMismatchError,
+                           match="KeyStream"):
+            cp.validate(key_fp="kf", wire_fp="wf", n_chunks=8,
+                        key_counter=7)
+
+
+class TestFaultInjector:
+
+    def test_scripted_fault_fires_once(self):
+        inj = runtime.FaultInjector([runtime.FaultSpec("transfer",
+                                                       at_slab=1)])
+        inj.check("transfer", 0)  # below at_slab: no fire
+        with pytest.raises(runtime.InjectedTransferError):
+            inj.check("transfer", 1)
+        inj.check("transfer", 2)  # consumed
+        assert inj.fired == [("transfer", 1)]
+        assert inj.pending == 0
+
+    def test_kind_point_mapping(self):
+        inj = runtime.FaultInjector([
+            runtime.FaultSpec("kernel", at_slab=0),
+            runtime.FaultSpec("oom", at_slab=0),
+        ])
+        with pytest.raises(runtime.InjectedOom, match="RESOURCE_EXHAUSTED"):
+            inj.check("transfer", 0)
+        with pytest.raises(runtime.InjectedKernelError):
+            inj.check("kernel", 0)
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            runtime.FaultSpec("meteor", at_slab=0)
+
+    def test_chaos_is_deterministic(self):
+        a = runtime.FaultInjector.chaos(seed=4, n_slabs=32)
+        b = runtime.FaultInjector.chaos(seed=4, n_slabs=32)
+        assert [s.__dict__ for s in a._specs] == [s.__dict__
+                                                 for s in b._specs]
+        c = runtime.FaultInjector.chaos(seed=5, n_slabs=32)
+        assert ([s.__dict__ for s in a._specs] !=
+                [s.__dict__ for s in c._specs])
+
+
+class TestRetryPolicy:
+
+    def test_classification(self):
+        assert runtime.classify(runtime.InjectedOom(0)) == "oom"
+        assert runtime.classify(
+            RuntimeError("RESOURCE_EXHAUSTED: out of memory")) == "oom"
+        assert runtime.classify(runtime.InjectedTransferError(0)) == \
+            "transient"
+        assert runtime.classify(runtime.InjectedKernelError(0)) == \
+            "transient"
+        assert runtime.classify(RuntimeError("UNAVAILABLE: link")) == \
+            "transient"
+        assert runtime.classify(runtime.HostCrash(0)) == "fatal"
+        assert runtime.classify(ValueError("bad input")) == "fatal"
+        assert runtime.classify(RuntimeError("wirecodec: prep-time RLE "
+                                             "entry counts disagree")) == \
+            "fatal"
+
+    def test_backoff_bounded(self):
+        policy = runtime.RetryPolicy(backoff_base_s=0.1, backoff_max_s=0.5)
+        assert policy.backoff_s(0) == pytest.approx(0.1)
+        assert policy.backoff_s(1) == pytest.approx(0.2)
+        assert policy.backoff_s(10) == pytest.approx(0.5)
+
+    def test_degrade_floor(self):
+        policy = runtime.RetryPolicy()
+        assert policy.degrade_slab_buckets(8) == 4
+        assert policy.degrade_slab_buckets(1) == 1
+
+
+class TestKillAndResume:
+    """Acceptance: interrupted + resumed == uninterrupted, bitwise."""
+
+    def _run_interrupted_then_resume(self, tmp_path, mesh=None, **agg_kw):
+        pid, pk, value = _data()
+        clean = _aggregate(pid, pk, value, mesh=mesh, **agg_kw)
+        store = runtime.FileCheckpointStore(str(tmp_path))
+        policy = runtime.CheckpointPolicy(store=store, run_id="kill")
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("host_crash", at_slab=1)])
+        with pytest.raises(runtime.HostCrash):
+            _aggregate(pid, pk, value, mesh=mesh, checkpoint_policy=policy,
+                       fault_injector=injector, **agg_kw)
+        checkpoint = store.load("kill")
+        assert checkpoint is not None and checkpoint.next_chunk > 0
+        resumed = _aggregate(pid, pk, value, mesh=mesh,
+                             checkpoint_policy=policy, **agg_kw)
+        assert profiler.event_count(runtime.EVENT_RESUMES) == 1
+        _assert_same_release(clean, resumed)
+        # Success cleans up the checkpoint.
+        assert store.load("kill") is None
+
+    def test_single_device_public(self, tmp_path):
+        self._run_interrupted_then_resume(tmp_path)
+
+    def test_single_device_private_selection(self, tmp_path):
+        self._run_interrupted_then_resume(tmp_path, public=False)
+
+    def test_mesh(self, tmp_path, mesh):
+        self._run_interrupted_then_resume(tmp_path, mesh=mesh,
+                                          stream_chunks=4)
+
+    def test_double_crash_then_resume(self):
+        # Two successive process deaths (a fresh injector per simulated
+        # process — injector state dies with the process) before a third
+        # run resumes to completion.
+        pid, pk, value = _data()
+        clean = _aggregate(pid, pk, value)
+        store = runtime.InMemoryCheckpointStore()
+        policy = runtime.CheckpointPolicy(store=store, run_id="late")
+        with pytest.raises(runtime.HostCrash):
+            _aggregate(pid, pk, value, checkpoint_policy=policy,
+                       fault_injector=runtime.FaultInjector(
+                           [runtime.FaultSpec("host_crash", at_slab=1)]))
+        first_cursor = store.load("late").next_chunk
+        assert first_cursor > 0
+        with pytest.raises(runtime.HostCrash):
+            _aggregate(pid, pk, value, checkpoint_policy=policy,
+                       fault_injector=runtime.FaultInjector(
+                           [runtime.FaultSpec("host_crash", at_slab=0)]))
+        # The second crash fired before any new slab completed, so the
+        # checkpoint is still the first one.
+        assert store.load("late").next_chunk == first_cursor
+        resumed = _aggregate(pid, pk, value, checkpoint_policy=policy)
+        _assert_same_release(clean, resumed)
+
+
+class TestStreamingResumeHook:
+    """The explicit resume_from= hook on the streaming API itself."""
+
+    def _stream(self, pid, pk, value, **kw):
+        return streaming.stream_bound_and_aggregate(
+            jax.random.PRNGKey(7), pid, pk, value, num_partitions=100,
+            linf_cap=1000, l0_cap=100, row_clip_lo=0.0, row_clip_hi=5.0,
+            middle=2.5, group_clip_lo=-np.inf, group_clip_hi=np.inf,
+            n_chunks=8, **kw)
+
+    def test_resume_from_mid_checkpoint_matches(self):
+        pid, pk, value = _data(n=30_000, n_parts=100)
+        full = self._stream(pid, pk, value)
+        store = runtime.InMemoryCheckpointStore()
+        policy = runtime.CheckpointPolicy(store=store, run_id="hook",
+                                          delete_on_success=False)
+        self._stream(pid, pk, value,
+                     resilience=runtime.StreamResilience(
+                         checkpoint_policy=policy))
+        checkpoint = store.load("hook")
+        assert 0 < checkpoint.next_chunk < checkpoint.n_chunks
+        resumed = self._stream(pid, pk, value, resume_from=checkpoint)
+        for a, b in zip(full, resumed):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_resume_refuses_other_key(self):
+        pid, pk, value = _data(n=30_000, n_parts=100)
+        store = runtime.InMemoryCheckpointStore()
+        policy = runtime.CheckpointPolicy(store=store, run_id="wrongkey",
+                                          delete_on_success=False)
+        self._stream(pid, pk, value,
+                     resilience=runtime.StreamResilience(
+                         checkpoint_policy=policy))
+        checkpoint = store.load("wrongkey")
+        with pytest.raises(checkpoint_lib.CheckpointMismatchError,
+                           match="PRNG key"):
+            streaming.stream_bound_and_aggregate(
+                jax.random.PRNGKey(8), pid, pk, value, num_partitions=100,
+                linf_cap=1000, l0_cap=100, row_clip_lo=0.0,
+                row_clip_hi=5.0, middle=2.5, group_clip_lo=-np.inf,
+                group_clip_hi=np.inf, n_chunks=8,
+                resume_from=checkpoint)
+
+    def test_resume_refuses_changed_data(self):
+        pid, pk, value = _data(n=30_000, n_parts=100)
+        store = runtime.InMemoryCheckpointStore()
+        policy = runtime.CheckpointPolicy(store=store, run_id="mutated",
+                                          delete_on_success=False)
+        self._stream(pid, pk, value,
+                     resilience=runtime.StreamResilience(
+                         checkpoint_policy=policy))
+        checkpoint = store.load("mutated")
+        mutated = value.copy()
+        mutated[: len(mutated) // 2] += 1.0
+        with pytest.raises(checkpoint_lib.CheckpointMismatchError,
+                           match="wire fingerprint"):
+            self._stream(pid, pk, mutated, resume_from=checkpoint)
+
+
+class TestOomDegradation:
+    """Acceptance: injected RESOURCE_EXHAUSTED completes the run at a
+    reduced slab budget with unchanged released values."""
+
+    def test_single_oom_degrades_and_completes(self):
+        pid, pk, value = _data()
+        clean = _aggregate(pid, pk, value)
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("oom", at_slab=1)])
+        degraded = _aggregate(pid, pk, value, fault_injector=injector,
+                              retry_policy=NO_SLEEP)
+        assert profiler.event_count(runtime.EVENT_DEGRADATIONS) == 1
+        assert injector.pending == 0
+        _assert_same_release(clean, degraded)
+
+    def test_repeated_oom_degrades_to_floor_then_retries(self):
+        pid, pk, value = _data()
+        clean = _aggregate(pid, pk, value)
+        # 8 chunks in 2 windows of 4: degradations 4->2->1, then counted
+        # retries carry the remaining OOMs.
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("oom", at_slab=0, times=5)])
+        degraded = _aggregate(pid, pk, value, fault_injector=injector,
+                              retry_policy=NO_SLEEP)
+        assert profiler.event_count(runtime.EVENT_DEGRADATIONS) == 2
+        assert profiler.event_count(runtime.EVENT_RETRIES) == 3
+        _assert_same_release(clean, degraded)
+
+    def test_oom_exhaustion_raises(self):
+        pid, pk, value = _data()
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("oom", at_slab=0, times=100)])
+        with pytest.raises(runtime.InjectedOom):
+            _aggregate(pid, pk, value, fault_injector=injector,
+                       retry_policy=runtime.RetryPolicy(
+                           max_retries=2, sleep=lambda s: None))
+
+
+class TestTransientRetry:
+
+    def test_fails_twice_then_succeeds(self):
+        pid, pk, value = _data()
+        clean = _aggregate(pid, pk, value)
+        sleeps = []
+        policy = runtime.RetryPolicy(sleep=sleeps.append)
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("transfer", at_slab=1, times=2)])
+        retried = _aggregate(pid, pk, value, fault_injector=injector,
+                             retry_policy=policy)
+        assert profiler.event_count(runtime.EVENT_RETRIES) == 2
+        assert sleeps == [policy.backoff_s(0), policy.backoff_s(1)]
+        _assert_same_release(clean, retried)
+
+    def test_kernel_fault_retries(self):
+        pid, pk, value = _data()
+        clean = _aggregate(pid, pk, value)
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("kernel", at_slab=0)])
+        retried = _aggregate(pid, pk, value, fault_injector=injector,
+                             retry_policy=NO_SLEEP)
+        assert profiler.event_count(runtime.EVENT_RETRIES) == 1
+        _assert_same_release(clean, retried)
+
+    def test_exhaustion_raises_without_checkpointing(self):
+        pid, pk, value = _data()
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("transfer", at_slab=0, times=10)])
+        with pytest.raises(runtime.InjectedTransferError):
+            _aggregate(pid, pk, value, fault_injector=injector,
+                       retry_policy=runtime.RetryPolicy(
+                           max_retries=3, sleep=lambda s: None))
+
+    def test_max_retries_zero_fails_fast(self):
+        pid, pk, value = _data()
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("transfer", at_slab=0)])
+        with pytest.raises(runtime.InjectedTransferError):
+            _aggregate(pid, pk, value, fault_injector=injector,
+                       retry_policy=runtime.RetryPolicy(max_retries=0))
+
+    def test_mesh_transient_retry(self, mesh):
+        pid, pk, value = _data()
+        clean = _aggregate(pid, pk, value, mesh=mesh, stream_chunks=4)
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("transfer", at_slab=1, times=2)])
+        retried = _aggregate(pid, pk, value, mesh=mesh, stream_chunks=4,
+                             fault_injector=injector,
+                             retry_policy=NO_SLEEP)
+        assert profiler.event_count(runtime.EVENT_RETRIES) == 2
+        _assert_same_release(clean, retried)
+
+
+class TestChaosMatrix:
+    """CI's fault-injection job sweeps PIPELINEDP_TPU_CHAOS_SEED; each
+    seeded chaos script must be fully absorbed by retries + checkpoints
+    with a bit-identical release."""
+
+    def _seeds(self):
+        env = os.environ.get("PIPELINEDP_TPU_CHAOS_SEED")
+        return [int(env)] if env is not None else [0, 1, 2]
+
+    def test_chaos_run_matches_clean(self, tmp_path):
+        pid, pk, value = _data()
+        clean = _aggregate(pid, pk, value)
+        for seed in self._seeds():
+            injector = runtime.FaultInjector.chaos(seed=seed, n_slabs=16)
+            store = runtime.FileCheckpointStore(str(tmp_path / str(seed)))
+            chaotic = _aggregate(
+                pid, pk, value, fault_injector=injector,
+                checkpoint_policy=runtime.CheckpointPolicy(
+                    store=store, run_id=f"chaos{seed}"),
+                retry_policy=runtime.RetryPolicy(max_retries=20,
+                                                 sleep=lambda s: None))
+            _assert_same_release(clean, chaotic)
+
+
+class TestAtMostOnceRelease:
+    """Acceptance: replaying a committed mechanism or re-releasing a
+    finalized epilogue raises; the journal shows each spend once."""
+
+    def test_re_release_same_seed_raises(self):
+        pid, pk, value = _data(n=20_000)
+        journal = runtime.ReleaseJournal()
+        _aggregate(pid, pk, value, release_journal=journal)
+        assert len(journal) == 1
+        assert journal.records[0].kind == "noise_release"
+        with pytest.raises(runtime.DoubleReleaseError):
+            _aggregate(pid, pk, value, release_journal=journal)
+        assert len(journal) == 1  # the refused release was not recorded
+
+    def test_fresh_seed_is_a_new_release(self):
+        pid, pk, value = _data(n=20_000)
+        journal = runtime.ReleaseJournal()
+        _aggregate(pid, pk, value, release_journal=journal, seed=1)
+        _aggregate(pid, pk, value, release_journal=journal, seed=2)
+        assert len(journal) == 2
+
+    def test_resumed_run_after_release_raises(self, tmp_path):
+        # Completed + released once; a later "resume" of the same run id
+        # (stale orchestration) must refuse before drawing noise.
+        pid, pk, value = _data(n=20_000)
+        journal = runtime.ReleaseJournal()
+        policy = runtime.CheckpointPolicy(
+            store=runtime.FileCheckpointStore(str(tmp_path)),
+            run_id="released")
+        _aggregate(pid, pk, value, release_journal=journal,
+                   checkpoint_policy=policy)
+        with pytest.raises(runtime.DoubleReleaseError):
+            _aggregate(pid, pk, value, release_journal=journal,
+                       checkpoint_policy=policy)
+
+    def test_legacy_epilogue_also_journaled(self):
+        pid, pk, value = _data(n=20_000)
+        journal = runtime.ReleaseJournal()
+        _aggregate(pid, pk, value, release_journal=journal,
+                   fused_epilogue=False)
+        with pytest.raises(runtime.DoubleReleaseError):
+            _aggregate(pid, pk, value, release_journal=journal,
+                       fused_epilogue=False)
+
+    def test_select_partitions_journaled(self):
+        # Every release-producing entry point commits, not just
+        # aggregate: a same-seed replay of select_partitions refuses.
+        pid, pk, _ = _data(n=5_000, n_parts=20)
+        journal = runtime.ReleaseJournal()
+
+        def select():
+            accountant = pdp.NaiveBudgetAccountant(1.0, 1e-6)
+            engine = pdp.JaxDPEngine(accountant, seed=11,
+                                     release_journal=journal)
+            result = engine.select_partitions(
+                pdp.ColumnarData(pid=pid, pk=pk, value=None),
+                pdp.SelectPartitionsParams(max_partitions_contributed=5))
+            accountant.compute_budgets()
+            return list(result)
+
+        select()
+        assert journal.records[0].kind == "selection_release"
+        with pytest.raises(runtime.DoubleReleaseError):
+            select()
+
+    def test_add_dp_noise_journaled(self):
+        journal = runtime.ReleaseJournal()
+
+        def add_noise():
+            accountant = pdp.NaiveBudgetAccountant(1.0, 1e-6)
+            engine = pdp.JaxDPEngine(accountant, seed=12,
+                                     secure_host_noise=False,
+                                     release_journal=journal)
+            result = engine.add_dp_noise(
+                [("a", 10.0), ("b", 20.0)],
+                pdp.AddDPNoiseParams(noise_kind=pdp.NoiseKind.LAPLACE,
+                                     l0_sensitivity=1,
+                                     linf_sensitivity=1.0))
+            accountant.compute_budgets()
+            return list(result)
+
+        add_noise()
+        with pytest.raises(runtime.DoubleReleaseError):
+            add_noise()
+
+    def test_journal_commit_is_atomic_per_token(self):
+        journal = runtime.ReleaseJournal()
+        journal.commit(("t", 1))
+        with pytest.raises(runtime.DoubleReleaseError, match="already"):
+            journal.commit(("t", 1))
+        journal.commit(("t", 2))
+        assert [r.token for r in journal.records] == [("t", 1), ("t", 2)]
+        assert journal.has(("t", 1)) and not journal.has(("t", 3))
+
+
+class TestBudgetSpendJournal:
+    """The budget half of at-most-once (budget_accounting.py)."""
+
+    def test_naive_journal_one_record_per_mechanism(self):
+        accountant = pdp.NaiveBudgetAccountant(1.0, 1e-6)
+        accountant.request_budget(MechanismType.LAPLACE)
+        accountant.request_budget(MechanismType.GAUSSIAN)
+        assert accountant.spend_journal == ()
+        accountant.compute_budgets()
+        journal = accountant.spend_journal
+        assert [r.index for r in journal] == [0, 1]
+        assert journal[0].mechanism_type == MechanismType.LAPLACE
+        assert journal[0].eps + journal[1].eps == pytest.approx(1.0)
+        assert journal[1].delta == pytest.approx(1e-6)
+
+    def test_pld_journal_one_record_per_mechanism(self):
+        accountant = pdp.PLDBudgetAccountant(1.0, 1e-6)
+        accountant.request_budget(MechanismType.LAPLACE)
+        accountant.request_budget(MechanismType.GAUSSIAN)
+        accountant.compute_budgets()
+        journal = accountant.spend_journal
+        assert len(journal) == 2
+        assert all(r.noise_standard_deviation > 0 for r in journal)
+
+    def test_replaying_committed_spend_raises(self):
+        spec = MechanismSpec(mechanism_type=MechanismType.LAPLACE)
+        spec.set_eps_delta(1.0, 0.0)
+        with pytest.raises(BudgetAccountantError, match="committed"):
+            spec.set_eps_delta(0.5, 0.0)
+        spec2 = MechanismSpec(mechanism_type=MechanismType.GAUSSIAN)
+        spec2.set_noise_standard_deviation(2.0)
+        with pytest.raises(BudgetAccountantError, match="committed"):
+            spec2.set_noise_standard_deviation(3.0)
+
+    def test_compute_budgets_twice_raises_typed(self):
+        accountant = pdp.NaiveBudgetAccountant(1.0, 1e-6)
+        accountant.request_budget(MechanismType.LAPLACE)
+        accountant.compute_budgets()
+        with pytest.raises(BudgetAccountantError, match="twice"):
+            accountant.compute_budgets()
+
+
+class TestWirecodecCorruptionGuard:
+    """Satellite: input mutated between prep and sort must trip the
+    prep-count vs sorted-bucket RuntimeError on BOTH streaming paths.
+
+    The native encoder snapshots rows at prep time, so real mutation of
+    the caller's arrays cannot corrupt it; the mutation is simulated at
+    the seam — sort_range reporting counts that disagree with prep's."""
+
+    @pytest.fixture()
+    def corrupted_sort(self, monkeypatch):
+        if wirecodec._load_packer() is None:
+            pytest.skip("native codec unavailable")
+        original = wirecodec.NativeRleEncoder.sort_range
+
+        def lying_sort(self, b0, b1):
+            n_uniq = original(self, b0, b1)
+            return n_uniq + 1  # post-sort counts disagree with prep's
+
+        monkeypatch.setattr(wirecodec.NativeRleEncoder, "sort_range",
+                            lying_sort)
+
+    def test_single_device_guard_fires(self, corrupted_sort):
+        pid, pk, value = _data(n=30_000, n_parts=100)
+        with pytest.raises(RuntimeError, match="prep-time RLE entry"):
+            streaming.stream_bound_and_aggregate(
+                jax.random.PRNGKey(0), pid, pk, value, num_partitions=100,
+                linf_cap=1000, l0_cap=100, row_clip_lo=0.0,
+                row_clip_hi=5.0, middle=2.5, group_clip_lo=-np.inf,
+                group_clip_hi=np.inf, n_chunks=8)
+
+    def test_mesh_guard_fires(self, corrupted_sort, mesh):
+        pid, pk, value = _data(n=30_000, n_parts=100)
+        with pytest.raises(RuntimeError, match="prep-time RLE entry"):
+            sharded.stream_bound_and_aggregate(
+                mesh, jax.random.PRNGKey(0), pid, pk, value,
+                num_partitions=100, linf_cap=1000, l0_cap=100,
+                row_clip_lo=0.0, row_clip_hi=5.0, middle=2.5,
+                group_clip_lo=-np.inf, group_clip_hi=np.inf, n_chunks=4)
+
+    def test_guard_is_fatal_not_retried(self, corrupted_sort):
+        # A privacy-relevant guard must not be eaten by the retry layer.
+        pid, pk, value = _data(n=30_000, n_parts=100)
+        injector_free = runtime.StreamResilience(retry_policy=NO_SLEEP)
+        with pytest.raises(RuntimeError, match="prep-time RLE entry"):
+            streaming.stream_bound_and_aggregate(
+                jax.random.PRNGKey(0), pid, pk, value, num_partitions=100,
+                linf_cap=1000, l0_cap=100, row_clip_lo=0.0,
+                row_clip_hi=5.0, middle=2.5, group_clip_lo=-np.inf,
+                group_clip_hi=np.inf, n_chunks=8,
+                resilience=injector_free)
+
+
+class TestRequireNative:
+    """Satellite: PIPELINEDP_TPU_REQUIRE_NATIVE=1 turns the silent numpy
+    fallback into a hard error."""
+
+    def test_build_failure_raises_when_required(self, monkeypatch):
+        from pipelinedp_tpu.native import loader
+        monkeypatch.setattr(loader, "_build", lambda stem: False)
+        monkeypatch.setattr(loader, "_try_load",
+                            lambda so, sym, ver: None)
+        monkeypatch.setattr(loader, "_libs", {})
+        monkeypatch.setenv(loader.REQUIRE_NATIVE_ENV, "1")
+        with pytest.raises(loader.NativeRequiredError):
+            loader._load_lib("no_such_lib", "abi")
+
+    def test_cached_failure_raises_when_required(self, monkeypatch):
+        from pipelinedp_tpu.native import loader
+        monkeypatch.setattr(loader, "_libs", {"no_such_lib": None})
+        monkeypatch.setenv(loader.REQUIRE_NATIVE_ENV, "1")
+        with pytest.raises(loader.NativeRequiredError):
+            loader._load_lib("no_such_lib", "abi")
+
+    def test_silent_fallback_without_env(self, monkeypatch):
+        from pipelinedp_tpu.native import loader
+        monkeypatch.setattr(loader, "_build", lambda stem: False)
+        monkeypatch.setattr(loader, "_try_load",
+                            lambda so, sym, ver: None)
+        monkeypatch.setattr(loader, "_libs", {})
+        monkeypatch.delenv(loader.REQUIRE_NATIVE_ENV, raising=False)
+        assert loader._load_lib("no_such_lib", "abi") is None
+
+    def test_ci_job_asserts_native_available(self):
+        # Under the CI env (REQUIRE_NATIVE set) the real libraries must
+        # load — a toolchain regression fails here, not as a silent
+        # numpy fallback.
+        from pipelinedp_tpu.native import loader
+        if not loader._native_required():
+            pytest.skip("PIPELINEDP_TPU_REQUIRE_NATIVE not set")
+        assert loader.load_row_packer() is not None
+        assert loader.load() is not None
+
+
+class TestCounters:
+
+    def test_resilience_counters_keys_always_present(self):
+        counters = runtime.resilience_counters()
+        assert set(counters) == {"retries", "degradations", "resumes",
+                                 "checkpoint_bytes", "native_fallbacks"}
+        assert all(isinstance(v, int) for v in counters.values())
+
+    def test_checkpoint_bytes_counted(self):
+        pid, pk, value = _data(n=20_000)
+        policy = runtime.CheckpointPolicy(
+            store=runtime.InMemoryCheckpointStore(), run_id="bytes")
+        _aggregate(pid, pk, value, checkpoint_policy=policy)
+        assert profiler.event_count(runtime.EVENT_CHECKPOINT_BYTES) > 0
