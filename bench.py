@@ -81,7 +81,14 @@ def bench_e2e(pid, pk, value, n_runs=3):
     import pipelinedp_tpu as pdp
     from pipelinedp_tpu import profiler
 
+    from pipelinedp_tpu.ops import streaming
+
+    scatter_keys = (streaming.EVENT_PARTITION_SCATTERS,
+                    streaming.EVENT_COMPACT_MERGE_SCATTERS,
+                    streaming.EVENT_COMPACT_CHUNKS)
+
     def run(seed):
+        before = {k: profiler.event_count(k) for k in scatter_keys}
         with profiler.collect_stage_times() as stages:
             t0 = time.perf_counter()
             data = pdp.ColumnarData(pid=pid, pk=pk, value=value)
@@ -93,7 +100,13 @@ def bench_e2e(pid, pk, value, n_runs=3):
             n_kept = int(np.asarray(cols["keep_mask"]).sum())
             assert n_kept > 0
             elapsed = time.perf_counter() - t0
-        return elapsed, dict(stages)
+        stages = dict(stages)
+        # Executed scatter-pass counts for THIS aggregate (the structural
+        # evidence of the compact merge: row-scale partition passes per
+        # chunk -> compact merge passes per aggregate).
+        for k in scatter_keys:
+            stages["#" + k] = profiler.event_count(k) - before[k]
+        return elapsed, stages
 
     run(100)  # warmup/compile
     # min-of-n: the host->device link bandwidth varies ~2x between runs;
@@ -117,6 +130,11 @@ def _coarse_phases(stages: dict, e2e_s: float) -> dict:
         # previous slab's transfer + kernels) vs serialized up front.
         "wire_sort_pipelined_s": round(sort_piped, 3),
         "wire_sort_upfront_s": round(sort_upfront, 3),
+        # Host seconds the lookahead prefetcher spent encoding upcoming
+        # slabs on background threads (sort+emit fully overlapped with
+        # the in-flight window's transfer + kernels).
+        "wire_sort_parallel_s": round(
+            stages.get("dp/wire_sort_parallel", 0.0), 3),
         # Host side of the slab loop: sort (nested) + emit + async puts +
         # kernel dispatch.
         "stream_host_s": round(slab_host, 3),
@@ -133,6 +151,16 @@ def _coarse_phases(stages: dict, e2e_s: float) -> dict:
     }
     phases["host_encode_overlapped"] = bool(
         sort_upfront == 0.0 and slab_host > 0.0)
+    # Executed scatter-pass counters (see bench_e2e.run): legacy pays
+    # row-scale partition scatters per chunk; the compact merge pays
+    # compact-input merge scatters once per aggregate.
+    from pipelinedp_tpu.ops import streaming
+    phases["partition_scatter_passes"] = int(
+        stages.get("#" + streaming.EVENT_PARTITION_SCATTERS, 0))
+    phases["compact_merge_scatter_passes"] = int(
+        stages.get("#" + streaming.EVENT_COMPACT_MERGE_SCATTERS, 0))
+    phases["compact_chunks"] = int(
+        stages.get("#" + streaming.EVENT_COMPACT_CHUNKS, 0))
     return phases
 
 
@@ -383,6 +411,9 @@ def main():
         })
     except Exception as e:  # noqa: BLE001
         extra["utility_sweep_error"] = f"{type(e).__name__}: {e}"[:200]
+    from pipelinedp_tpu.native import loader
+    from pipelinedp_tpu.ops import streaming as streaming_mod
+
     print(json.dumps({
         "metric": "DP-aggregated partitions/sec (COUNT+SUM, 1M keys), "
                   "end-to-end through JaxDPEngine.aggregate",
@@ -393,6 +424,11 @@ def main():
         "kernel_vs_baseline": round(kernel_pps / cpu_pps, 2),
         "cpu_baseline_partitions_per_sec": round(cpu_pps, 1),
         "e2e_phases": e2e_phases,
+        # Encode/pipeline tuning in effect (README "Tuning knobs"):
+        # encode_threads 0 = auto (hardware concurrency, capped 16).
+        "encode_threads": loader.encode_threads(),
+        "host_cores": os.cpu_count(),
+        "prefetch_depth": streaming_mod.prefetch_depth(),
         "resilience": _resilience_counters(),
         **extra,
     }))

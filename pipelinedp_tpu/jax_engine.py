@@ -266,6 +266,7 @@ class JaxDPEngine:
                  stream_chunks: Optional[int] = None,
                  value_transfer_dtype=None,
                  transfer_encoding: str = "auto",
+                 compact_merge="auto",
                  fused_epilogue: bool = True,
                  epilogue_cache: Optional[finalize_ops.EpilogueCache] = None,
                  checkpoint_policy=None,
@@ -298,6 +299,14 @@ class JaxDPEngine:
         # "auto": the lossless RLE/bit-plane wire codec (ops/wirecodec.py);
         # "bytes": the legacy fixed-width byte packing. Both exact.
         self._transfer_encoding = transfer_encoding
+        # Compact chunk merge (ops/streaming.py): streamed chunks emit
+        # compact per-group subtotal columns and ONE final merge scatters
+        # them into the dense accumulators, instead of every chunk
+        # re-paying the full [num_partitions] partition passes. "auto"
+        # engages at >= streaming.COMPACT_MIN_PARTITIONS partitions (the
+        # regime where those passes dominate); True forces it; False
+        # restores the legacy per-chunk scatters (the parity oracle).
+        self._compact_merge = compact_merge
         # Resilience knobs (pipelinedp_tpu/runtime/, RESILIENCE.md):
         #   checkpoint_policy: runtime.CheckpointPolicy — snapshot the
         #     streamed slab loop after each slab and auto-resume from the
@@ -1010,7 +1019,8 @@ class JaxDPEngine:
                     value_transfer_dtype=self._value_transfer_dtype,
                     need_flags=need_flags,
                     has_group_clip=has_group_clip,
-                    resilience=self._stream_resilience(key_counter))
+                    resilience=self._stream_resilience(key_counter),
+                    compact_merge=self._compact_merge)
             else:
                 # Stage (hash-shard + device_put) once; both the aggregate
                 # and the quantile-histogram kernels reuse the staged
@@ -1083,7 +1093,8 @@ class JaxDPEngine:
                 has_group_clip=has_group_clip,
                 transfer_encoding=self._transfer_encoding,
                 quantile_spec=quantile_spec,
-                resilience=self._stream_resilience(key_counter))
+                resilience=self._stream_resilience(key_counter),
+                compact_merge=self._compact_merge)
             if has_quantile:
                 accs, streamed_qhist = accs
         else:

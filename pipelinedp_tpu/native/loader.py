@@ -48,6 +48,46 @@ class NativeRequiredError(RuntimeError):
     """Native library unavailable while REQUIRE_NATIVE_ENV demands it."""
 
 
+# Worker-pool width for the native encode (pdp_pack_buckets,
+# pdp_rle_sort_range, pdp_rle_emit_range): 0 = auto (hardware
+# concurrency, capped at 16 in the C++), 1..64 forces the width. Output
+# is bit-identical at every width (disjoint buckets per worker); the knob
+# only trades host wall time — see README "Tuning knobs".
+ENCODE_THREADS_ENV = "PIPELINEDP_TPU_ENCODE_THREADS"
+
+
+def env_int(name: str, default: int, lo: int, hi: int) -> int:
+    """Validated integer env knob: unset/empty -> default; junk or
+    out-of-range values raise instead of silently running misconfigured."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        value = int(raw.strip())
+    except ValueError:
+        raise ValueError(
+            f"{name} must be an integer, got {raw!r}") from None
+    if not lo <= value <= hi:
+        raise ValueError(
+            f"{name} must be in [{lo}, {hi}], got {value}")
+    return value
+
+
+def encode_threads() -> int:
+    """The validated PIPELINEDP_TPU_ENCODE_THREADS value (0 = auto)."""
+    return env_int(ENCODE_THREADS_ENV, 0, 0, 64)
+
+
+def apply_encode_threads(lib) -> int:
+    """Pushes the env-configured worker-pool width into the native
+    library (re-read per call so tests can flip the env between
+    encodes). Returns the applied value."""
+    n = encode_threads()
+    if lib is not None and hasattr(lib, "pdp_set_encode_threads"):
+        lib.pdp_set_encode_threads(n)
+    return n
+
+
 def _native_required() -> bool:
     return os.environ.get(REQUIRE_NATIVE_ENV,
                           "").strip().lower() in ("1", "true", "yes")
@@ -152,8 +192,14 @@ def load() -> Optional[ctypes.CDLL]:
 def load_row_packer() -> Optional[ctypes.CDLL]:
     """The row bucketing/packing library; None on failure."""
     lib = _load_lib("row_packer", "pdp_row_packer_abi_version",
-                    abi_version=5)
+                    abi_version=6)
     if lib is not None and not getattr(lib, "_pdp_typed", False):
+        fn = lib.pdp_set_encode_threads
+        fn.restype = None
+        fn.argtypes = [ctypes.c_int]
+        fn = lib.pdp_get_encode_threads
+        fn.restype = ctypes.c_int
+        fn.argtypes = []
         fn = lib.pdp_rle_prep
         fn.restype = ctypes.c_void_p
         fn.argtypes = [
@@ -216,6 +262,10 @@ def load_row_packer() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int64),  # counts
         ]
         lib._pdp_typed = True
+    if lib is not None:
+        # Re-applied on every load call (the CDLL itself is cached) so an
+        # env change between encodes takes effect immediately.
+        apply_encode_threads(lib)
     return lib
 
 

@@ -28,6 +28,23 @@ namespace {
 
 constexpr uint32_t kHashMult = 2654435761u;
 
+// Worker-pool width override (0 = auto: hardware_concurrency capped at
+// 16). Set through pdp_set_encode_threads — the Python loader wires the
+// validated PIPELINEDP_TPU_ENCODE_THREADS value through before encode
+// calls. Output is bit-identical for every width: workers own disjoint
+// buckets (RunPool) or disjoint input ranges with precomputed write
+// offsets (pdp_pack_buckets), so the thread count only changes wall
+// time, never bytes.
+std::atomic<int> g_encode_threads{0};
+
+int64_t PoolWidth(int64_t auto_cap) {
+  const int forced = g_encode_threads.load(std::memory_order_relaxed);
+  if (forced > 0) return forced;
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t n = hw < 1 ? 1 : static_cast<int64_t>(hw);
+  return n > auto_cap ? auto_cap : n;
+}
+
 inline uint32_t BucketOf(int32_t shifted, uint32_t n_buckets) {
   return ((static_cast<uint32_t>(shifted) * kHashMult) >> 16) % n_buckets;
 }
@@ -120,10 +137,13 @@ int pdp_pack_buckets(const int32_t* pid, const int32_t* pk,
                 bytes_pid, bytes_pk, value_f16 != 0,
                 out,      cap,      bytes_pid + bytes_pk + (value_f16 ? 2 : 4)};
 
-  unsigned hw = std::thread::hardware_concurrency();
-  int64_t n_threads = hw < 1 ? 1 : static_cast<int64_t>(hw);
-  if (n_threads > 16) n_threads = 16;
-  if (n < (1 << 16)) n_threads = 1;
+  int64_t n_threads = PoolWidth(16);
+  if (g_encode_threads.load(std::memory_order_relaxed) <= 0 &&
+      n < (1 << 16)) {
+    n_threads = 1;  // auto mode: thread spawn beats the work below 64k rows
+  }
+  if (n_threads > n && n > 0) n_threads = n;
+  if (n_threads < 1) n_threads = 1;
   int64_t per = (n + n_threads - 1) / n_threads;
 
   // Pass 1: per-thread per-bucket counts.
@@ -351,9 +371,7 @@ void PackPlanes(const int32_t* col, int64_t m, int bits, int64_t cap8,
 }
 
 void RunPool(int64_t k0, int64_t k1, const std::function<void(int64_t)>& fn) {
-  unsigned hw = std::thread::hardware_concurrency();
-  int64_t pool = hw < 1 ? 1 : static_cast<int64_t>(hw);
-  if (pool > 16) pool = 16;
+  int64_t pool = PoolWidth(16);
   if (pool > k1 - k0) pool = k1 - k0;
   if (pool <= 1) {
     for (int64_t b = k0; b < k1; ++b) fn(b);
@@ -618,6 +636,20 @@ int pdp_rle_emit_range(void* handle, int64_t b0, int64_t b1, int pid_mode,
 
 void pdp_rle_free(void* handle) { delete static_cast<RleState*>(handle); }
 
-int pdp_row_packer_abi_version() { return 5; }
+// Encode worker-pool width: 0 restores auto (hardware_concurrency capped
+// at 16); values are clamped to [0, 64]. Applies to pdp_pack_buckets,
+// pdp_rle_sort_range and pdp_rle_emit_range. The callers' loader wires
+// PIPELINEDP_TPU_ENCODE_THREADS through here.
+void pdp_set_encode_threads(int n) {
+  if (n < 0) n = 0;
+  if (n > 64) n = 64;
+  g_encode_threads.store(n, std::memory_order_relaxed);
+}
+
+int pdp_get_encode_threads() {
+  return g_encode_threads.load(std::memory_order_relaxed);
+}
+
+int pdp_row_packer_abi_version() { return 6; }
 
 }  // extern "C"

@@ -525,6 +525,209 @@ def bound_and_aggregate(key: jax.Array,
     )
 
 
+class CompactGroups(NamedTuple):
+    """One streamed chunk's per-partition subtotals in compact form.
+
+    Instead of scattering the chunk's kept groups into the full
+    [num_partitions] accumulators (a full-HBM partition pass per
+    accumulator per chunk), the chunk emits its subtotals as at most
+    ``max_groups`` (pk, value) pairs: every distinct partition the chunk
+    touches contributes ONE entry per accumulator, already reduced in the
+    chunk's group order. ``merge_compact_chunks`` folds any number of
+    chunks into the dense accumulators with ONE scatter per accumulator
+    column — bit-identical to the legacy per-chunk scatters when the
+    group stage is active (the fold order per partition is the same:
+    within-chunk group order, then chunk order).
+
+    pk: int32[max_groups]; entries >= num_partitions (padding sentinel)
+    or negative (empty runs) are dropped by the merge. The five value
+    columns are float32[max_groups]; n_kept is the kept-group count (its
+    contract is n_kept <= max_groups — the driver asserts it).
+    """
+    pk: jnp.ndarray
+    pid_count: jnp.ndarray
+    count: jnp.ndarray
+    sum: jnp.ndarray
+    norm_sum: jnp.ndarray
+    norm_sq_sum: jnp.ndarray
+    n_kept: jnp.ndarray
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_partitions", "max_groups",
+                                    "need_count", "need_sum", "need_norm",
+                                    "need_norm_sq", "has_group_clip",
+                                    "pid_sorted", "max_segments"))
+def bound_and_aggregate_compact(key: jax.Array,
+                                pid: jnp.ndarray,
+                                pk: jnp.ndarray,
+                                value: jnp.ndarray,
+                                valid: jnp.ndarray,
+                                *,
+                                num_partitions: int,
+                                max_groups: int,
+                                linf_cap,
+                                l0_cap,
+                                row_clip_lo,
+                                row_clip_hi,
+                                middle,
+                                group_clip_lo,
+                                group_clip_hi,
+                                l1_cap=None,
+                                need_count: bool = True,
+                                need_sum: bool = True,
+                                need_norm: bool = True,
+                                need_norm_sq: bool = True,
+                                has_group_clip: bool = True,
+                                pid_sorted: bool = False,
+                                max_segments: Optional[int] = None
+                                ) -> CompactGroups:
+    """bound_and_aggregate that stops BEFORE the partition scatter.
+
+    Identical sampling to bound_and_aggregate (same sampler, same
+    statics, same key) and identical group accumulators; but instead of
+    the final [num_partitions] segment-sums it compacts the kept groups
+    (<= distinct pids * l0_cap, bounded statically by ``max_groups``),
+    stable-sorts them by partition id and reduces each partition's run to
+    ONE subtotal — in the kept groups' original order, which is exactly
+    the order the legacy partition scatter adds them in. The caller
+    merges any number of chunks with merge_compact_chunks.
+
+    With has_group_clip=False the group stage still runs (no clip
+    applied); the result equals the legacy direct row->partition scatter
+    in exact arithmetic but may differ in float32 ULPs (different
+    association), unlike the has_group_clip=True mode which is bitwise.
+    """
+    n = pid.shape[0]
+    # Same trace-time dispatch as bound_and_aggregate (static flags +
+    # structural l1_cap test) so the sampling decisions replay bitwise.
+    # dplint: disable=DPL003 — static/structural branch, resolved per compile
+    if (pid_sorted and l1_cap is None
+            and presorted_fits(n, num_partitions, max_segments)):
+        s = _sample_rows_and_groups_presorted(
+            key, pid, pk, valid, linf_cap, l0_cap,
+            num_partitions=num_partitions,
+            max_segments=int(max_segments) if max_segments else n,
+            value=value)
+    else:
+        s = _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap,
+                                    l1_cap, value=value, need_order=False)
+    sval = s.sval
+
+    dtype = jnp.promote_types(sval.dtype, jnp.float32)
+    w = s.keep_row.astype(dtype)
+    vclip = jnp.clip(sval, row_clip_lo, row_clip_hi).astype(dtype)
+    vnorm = vclip - middle
+    keepg_start = (s.is_start & s.svalid & s.keep_group_row).astype(dtype)
+    gseg = functools.partial(jax.ops.segment_sum,
+                             segment_ids=s.group_id,
+                             num_segments=n,
+                             indices_are_sorted=True)
+    zeros_n = jnp.zeros((n,), dtype=dtype)
+    g_count = gseg(w) if need_count else None
+    if need_sum:
+        g_sum = gseg(vclip * w)
+        if has_group_clip:
+            g_sum = jnp.clip(g_sum, group_clip_lo, group_clip_hi)
+    else:
+        g_sum = None
+    g_norm = gseg(vnorm * w) if need_norm else None
+    g_norm_sq = gseg(vnorm * vnorm * w) if need_norm_sq else None
+    g_pk = _group_pk(s, num_partitions, gseg)
+    g_keep = gseg(keepg_start)
+    gw = (g_keep > 0).astype(dtype)
+    g_pk_safe = jnp.where(g_keep > 0, g_pk, 0).astype(jnp.int32)
+
+    # The same scatter operands the legacy partition pass would feed
+    # (value * gw, in group order) — compacted instead of scattered.
+    cols = (gw,
+            g_count * gw if need_count else zeros_n,
+            g_sum * gw if need_sum else zeros_n,
+            g_norm * gw if need_norm else zeros_n,
+            g_norm_sq * gw if need_norm_sq else zeros_n)
+
+    kept = g_keep > 0
+    g = max_groups
+    pos = (jnp.cumsum(kept.astype(jnp.int32)) - 1)
+    idx = jnp.where(kept, pos, g)
+    cpk = jnp.full((g,), num_partitions, dtype=jnp.int32)
+    cpk = cpk.at[idx].set(g_pk_safe, mode="drop")
+    ccols = [jnp.zeros((g,), dtype=dtype).at[idx].set(c, mode="drop")
+             for c in cols]
+
+    # Stable sort by pk: equal-pk groups stay in group order, so the run
+    # reduction below adds them in exactly the legacy scatter's order.
+    sorted_ops = jax.lax.sort([cpk] + ccols, num_keys=1, is_stable=True)
+    spk_c = sorted_ops[0]
+    is_run_start = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), spk_c[1:] != spk_c[:-1]])
+    run_id = (jnp.cumsum(is_run_start) - 1).astype(jnp.int32)
+    rseg = functools.partial(jax.ops.segment_sum, segment_ids=run_id,
+                             num_segments=g, indices_are_sorted=True)
+    run_pk = jax.ops.segment_max(spk_c, run_id, num_segments=g,
+                                 indices_are_sorted=True)
+    subtot = [rseg(c) for c in sorted_ops[1:]]
+    n_kept = jnp.sum(kept.astype(jnp.int32))
+    return CompactGroups(run_pk, subtot[0], subtot[1], subtot[2],
+                         subtot[3], subtot[4], n_kept)
+
+
+@functools.partial(jax.jit, static_argnames=("num_partitions",
+                                             "need_flags"))
+def merge_compact_chunks(accs: PartitionAccumulators,
+                         pk: jnp.ndarray,
+                         pid_count: jnp.ndarray,
+                         count: jnp.ndarray,
+                         sum_: jnp.ndarray,
+                         norm_sum: jnp.ndarray,
+                         norm_sq_sum: jnp.ndarray,
+                         *,
+                         num_partitions: int,
+                         need_flags=(True, True, True, True)
+                         ) -> PartitionAccumulators:
+    """ONE [num_partitions] scatter per accumulator merges every chunk.
+
+    Inputs are [n_chunks, max_groups] stacks of CompactGroups columns.
+    The flatten is chunk-major, so per partition the scatter adds the
+    chunk subtotals in chunk order on top of ``accs`` — reproducing the
+    legacy loop's ``accs = accs + chunk_scatter`` fold bitwise (each
+    chunk contributes at most one entry per partition). Sentinel /
+    negative pk entries drop.
+    """
+    flat_pk = pk.reshape(-1)
+
+    def scat(base, col):
+        return base.at[flat_pk].add(col.reshape(-1), mode="drop")
+
+    return PartitionAccumulators(
+        pid_count=scat(accs.pid_count, pid_count),
+        count=scat(accs.count, count) if need_flags[0] else accs.count,
+        sum=scat(accs.sum, sum_) if need_flags[1] else accs.sum,
+        norm_sum=(scat(accs.norm_sum, norm_sum)
+                  if need_flags[2] else accs.norm_sum),
+        norm_sq_sum=(scat(accs.norm_sq_sum, norm_sq_sum)
+                     if need_flags[3] else accs.norm_sq_sum),
+    )
+
+
+def compact_group_bound(cap: int, ucap: int, l0_cap) -> Optional[int]:
+    """Static kept-group bound for one chunk, or None when unavailable.
+
+    Kept groups per pid-disjoint chunk <= distinct pids * l0_cap, and the
+    RLE wire format bounds distinct pids per bucket by its entry capacity
+    (ucap); total groups are also <= the row capacity (cap). Requires a
+    concrete (host) l0_cap — a traced value cannot size a static shape.
+    """
+    try:
+        l0 = int(l0_cap)
+    except (TypeError, ValueError):
+        return None
+    if l0 < 1:
+        return None
+    bound = min(int(cap), int(ucap) * l0)
+    return max(8, (bound + 7) & ~7)
+
+
 def _group_pk(s: SampledRows, num_partitions: int, gseg) -> jnp.ndarray:
     """Each group slot's partition id: a float32-reduced column when ids
     fit float32 exactly (< 2^24), an integer pass otherwise. Always

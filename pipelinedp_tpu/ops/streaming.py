@@ -69,6 +69,60 @@ SLAB_BYTE_BUDGET = 192 * 1024 * 1024
 # count; per-transfer fixed costs keep this from going per-row.
 PIPELINED_SLAB_BYTE_BUDGET = 48 * 1024 * 1024
 
+# Tuning knobs (validated in loader.env_int; README "Tuning knobs").
+# PIPELINEDP_TPU_SLAB_BYTES overrides BOTH slab byte budgets above;
+# PIPELINEDP_TPU_PREFETCH_SLABS bounds the background encode lookahead
+# (0 disables prefetch, default 1 slab ahead).
+SLAB_BYTES_ENV = "PIPELINEDP_TPU_SLAB_BYTES"
+PREFETCH_ENV = "PIPELINEDP_TPU_PREFETCH_SLABS"
+
+# Profiler event counters (profiler.count_event / event_count), counted
+# per EXECUTED pass by the slab drivers:
+#   EVENT_PARTITION_SCATTERS — full-[num_partitions] scatter passes whose
+#     input is row/group scale (the expensive kind: one per accumulator
+#     per chunk on the legacy path);
+#   EVENT_COMPACT_MERGE_SCATTERS — [num_partitions] scatters whose input
+#     is the compact per-chunk subtotal columns (once per accumulator per
+#     MERGE, not per chunk);
+#   EVENT_COMPACT_CHUNKS — chunks that emitted compact group columns.
+EVENT_PARTITION_SCATTERS = "ops/partition_scatter_passes"
+EVENT_COMPACT_MERGE_SCATTERS = "ops/compact_merge_scatter_passes"
+EVENT_COMPACT_CHUNKS = "ops/compact_chunk_emits"
+
+# compact_merge="auto" engages the compact chunk merge at this partition
+# count and above. The merge trades the per-chunk full-[num_partitions]
+# scatter passes for a per-chunk compaction (group stage + a [G]-sized
+# sort) — a win exactly when the [P]-output passes dominate (the 1M-
+# partition headline regime: BASELINE.md round-4 measured ~0.74 s per
+# full-partition pass on the bench chip), a loss when P is small and the
+# partition passes are nearly free (the CPU smoke at 30k partitions
+# measured the compaction overhead at ~2x the whole legacy kernel).
+COMPACT_MIN_PARTITIONS = 1 << 17
+
+
+def _compact_enabled(compact_merge, num_partitions: int) -> bool:
+    """Resolves the compact_merge knob (True / False / "auto")."""
+    if compact_merge is True:
+        return True
+    if compact_merge == "auto":
+        return num_partitions >= COMPACT_MIN_PARTITIONS
+    return False
+
+
+def prefetch_depth() -> int:
+    """Validated PIPELINEDP_TPU_PREFETCH_SLABS (0..4, default 1): how many
+    slab windows the background encoder may run ahead of the transfer."""
+    from pipelinedp_tpu.native import loader
+    return loader.env_int(PREFETCH_ENV, 1, 0, 4)
+
+
+def slab_byte_budget(pipelined: bool) -> int:
+    """The slab byte budget, honoring the PIPELINEDP_TPU_SLAB_BYTES
+    override (1 MiB .. 4 GiB)."""
+    from pipelinedp_tpu.native import loader
+    default = PIPELINED_SLAB_BYTE_BUDGET if pipelined else SLAB_BYTE_BUDGET
+    return loader.env_int(SLAB_BYTES_ENV, default, 1 << 20, 1 << 32)
+
 
 def _num_chunks(n_rows: int) -> int:
     # ~8 MB of packed bytes per chunk minimum, capped at the default.
@@ -214,6 +268,71 @@ def _chunk_step_rle(key, row, n_valid, n_uniq, accs, linf_cap, l0_cap,
 
 @functools.partial(
     jax.jit,
+    static_argnames=("num_partitions", "fmt", "max_groups", "need_flags",
+                     "has_group_clip"))
+def _chunk_step_rle_compact(key, row, n_valid, n_uniq, linf_cap, l0_cap,
+                            row_clip_lo, row_clip_hi, middle, group_clip_lo,
+                            group_clip_hi, l1_cap=None, *,
+                            num_partitions: int, fmt: wirecodec.WireFormat,
+                            max_groups: int,
+                            need_flags=(True, True, True, True),
+                            has_group_clip: bool = True):
+    """_chunk_step_rle that emits compact per-group columns instead of
+    scattering into the full [num_partitions] accumulators.
+
+    Same decode, same sampler (identical statics and key), same group
+    accumulators — but the chunk's contribution leaves the kernel as at
+    most ``max_groups`` (pk, subtotal) pairs per accumulator
+    (columnar.CompactGroups); ONE final merge scatters every chunk
+    (columnar.merge_compact_chunks). Nothing is donated, so a failed
+    dispatch can never poison the running state.
+    """
+    pid, pk, value, valid = wirecodec.decode_bucket(row, n_valid, n_uniq,
+                                                    fmt)
+    if value is None:
+        value = jnp.zeros((fmt.cap,), dtype=jnp.float32)
+    return columnar.bound_and_aggregate_compact(
+        key, pid, pk, value, valid,
+        num_partitions=num_partitions,
+        max_groups=max_groups,
+        linf_cap=linf_cap,
+        l0_cap=l0_cap,
+        row_clip_lo=row_clip_lo,
+        row_clip_hi=row_clip_hi,
+        middle=middle,
+        group_clip_lo=group_clip_lo,
+        group_clip_hi=group_clip_hi,
+        l1_cap=l1_cap,
+        need_count=need_flags[0],
+        need_sum=need_flags[1],
+        need_norm=need_flags[2],
+        need_norm_sq=need_flags[3],
+        has_group_clip=has_group_clip,
+        pid_sorted=fmt.pid_sorted,
+        max_segments=fmt.ucap if fmt.pid_sorted else None)
+
+
+def _merge_pending(accs, pending, num_partitions, need_flags):
+    """Folds a list of CompactGroups into the dense accumulators with one
+    scatter per accumulator column; validates the static group bound."""
+    max_kept = int(jax.device_get(
+        jnp.max(jnp.stack([p.n_kept for p in pending]))))
+    max_groups = pending[0].pk.shape[0]
+    if max_kept > max_groups:
+        raise RuntimeError(
+            f"compact merge: a chunk kept {max_kept} groups, above the "
+            f"static bound {max_groups} — the pid-sorted wire contract "
+            f"was violated; refusing to release truncated accumulators")
+    profiler.count_event(EVENT_COMPACT_MERGE_SCATTERS,
+                         1 + sum(bool(f) for f in need_flags))
+    stacked = [jnp.stack([p[i] for p in pending]) for i in range(6)]
+    return columnar.merge_compact_chunks(
+        accs, *stacked, num_partitions=num_partitions,
+        need_flags=tuple(need_flags))
+
+
+@functools.partial(
+    jax.jit,
     static_argnames=("num_partitions", "fmt", "num_leaves", "need_flags",
                      "has_group_clip"),
     donate_argnums=(4, 5))
@@ -293,6 +412,7 @@ def stream_bound_and_aggregate(
     quantile_spec: Optional[Tuple[int, float, float]] = None,
     resilience=None,
     resume_from=None,
+    compact_merge="auto",
 ) -> columnar.PartitionAccumulators:
     """Chunked, transfer-overlapped twin of columnar.bound_and_aggregate.
 
@@ -322,6 +442,17 @@ def stream_bound_and_aggregate(
       resilience.checkpoint_policy.store). A resumed run is bit-identical
       to an uninterrupted one — per-chunk keys are fold_in(key, c) and
       accumulators are mergeable.
+    compact_merge: each chunk emits compact per-group subtotal columns
+      (bounded by the wire format's per-chunk pid capacity * l0_cap) and
+      ONE final set of [num_partitions] scatters merges all chunks,
+      instead of every chunk re-paying the full partition scatters.
+      Applies to the pid-sorted wire-codec path without quantile_spec.
+      "auto" (default) engages at >= COMPACT_MIN_PARTITIONS partitions —
+      the regime where the [P]-output passes dominate; True forces it,
+      False restores the legacy per-chunk scatters (the parity oracle).
+      With group-level sum clipping active the released accumulators are
+      bit-identical to the legacy path; without it they agree in exact
+      arithmetic (float32 association may differ in the last ulp).
 
     Returns per-partition accumulators on device, identical in distribution
     to the single-shot kernel.
@@ -377,6 +508,36 @@ def stream_bound_and_aggregate(
                 need_flags=tuple(need_flags),
                 has_group_clip=has_group_clip), qhist
 
+        def compact_plan(fmt):
+            """(compact_step, merge_fn) for this wire format, or (None,
+            None) when the compact merge does not apply (PID_PLANES has
+            no per-chunk pid bound; quantile histograms stay legacy)."""
+            if not (_compact_enabled(compact_merge, num_partitions)
+                    and quantile_spec is None
+                    and fmt.pid_mode == wirecodec.PID_RLE):
+                return None, None
+            max_groups = columnar.compact_group_bound(fmt.cap, fmt.ucap,
+                                                      l0_cap)
+            if max_groups is None:
+                return None, None
+
+            def compact_step(c, bucket_row, n_valid, n_uniq_c):
+                return _chunk_step_rle_compact(
+                    jax.random.fold_in(key, c), bucket_row, n_valid,
+                    n_uniq_c, linf_cap, l0_cap, row_clip_lo, row_clip_hi,
+                    middle, group_clip_lo, group_clip_hi, l1_cap,
+                    num_partitions=num_partitions, fmt=fmt,
+                    max_groups=max_groups, need_flags=tuple(need_flags),
+                    has_group_clip=has_group_clip)
+
+            def merge_fn(accs, pending):
+                return _merge_pending(accs, pending, num_partitions,
+                                      tuple(need_flags))
+
+            return compact_step, merge_fn
+
+        scatter_passes = 1 + sum(bool(f) for f in need_flags)
+
         if enc is not None:
             # Pipelined encode. Every slab shares ONE wire format (one
             # XLA compile for the chunk kernel). Three schedules, best
@@ -420,8 +581,7 @@ def stream_bound_and_aggregate(
                         cap=cap,
                         ucap=wirecodec.round_ucap(int(n_uniq.max())),
                         value=info.plan)
-                budget = (PIPELINED_SLAB_BYTE_BUDGET if pipelined_sort
-                          else SLAB_BYTE_BUDGET)
+                budget = slab_byte_budget(pipelined_sort)
                 n_t = n_transfers or _num_transfers(fmt.width * k, k,
                                                     budget)
 
@@ -440,10 +600,13 @@ def stream_bound_and_aggregate(
                                 "buckets")
                     return enc.emit_range(s0, s1, fmt)
 
+                compact_step, merge_fn = compact_plan(fmt)
                 accs, qhist = _run_slab_loop(
                     key, k, counts, n_uniq, fmt, prepare_slab, step_chunk,
                     n_t, num_partitions, quantile_spec, resilience,
-                    lambda: _input_digest(pid, pk, value))
+                    lambda: _input_digest(pid, pk, value),
+                    compact_step=compact_step, merge_fn=merge_fn,
+                    scatter_passes=scatter_passes)
         else:
             with profiler.stage("dp/wire_encode"):
                 slab, counts, n_uniq, fmt = wirecodec.encode_buckets_numpy(
@@ -452,11 +615,14 @@ def stream_bound_and_aggregate(
                     plan=info.plan, pid_mode=info.pid_mode,
                     bits_pid=info.bits_pid)
             n_t = n_transfers or _num_transfers(slab.nbytes, k)
+            compact_step, merge_fn = compact_plan(fmt)
             accs, qhist = _run_slab_loop(
                 key, k, counts, n_uniq, fmt,
                 lambda s0, s1: slab[s0:s1], step_chunk,
                 n_t, num_partitions, quantile_spec, resilience,
-                lambda: _input_digest(pid, pk, value))
+                lambda: _input_digest(pid, pk, value),
+                compact_step=compact_step, merge_fn=merge_fn,
+                scatter_passes=scatter_passes)
         if quantile_spec is not None:
             return accs, qhist
         return accs
@@ -506,7 +672,8 @@ def stream_bound_and_aggregate(
         ("bytes", bytes_pid, bytes_pk, value_f16, width),
         lambda s0, s1: buckets[s0:s1], step_chunk_bytes,
         n_t, num_partitions, None, resilience,
-        lambda: _input_digest(pid, pk, value))
+        lambda: _input_digest(pid, pk, value),
+        scatter_passes=1 + sum(bool(f) for f in need_flags))
     return accs
 
 
@@ -518,7 +685,8 @@ def _input_digest(pid, pk, value) -> str:
 
 def _run_slab_loop(key, k, counts, n_uniq, fmt_desc, prepare_slab,
                    step_chunk, n_transfers, num_partitions, quantile_spec,
-                   resilience, data_digest_fn=None):
+                   resilience, data_digest_fn=None, *,
+                   compact_step=None, merge_fn=None, scatter_passes=5):
     """The resilient slab loop shared by every streaming encode path.
 
     Iterates chunks [0, k) in slab windows: ``prepare_slab(s0, s1)``
@@ -526,6 +694,29 @@ def _run_slab_loop(key, k, counts, n_uniq, fmt_desc, prepare_slab,
     slice otherwise), one async ``device_put`` ships it, and
     ``step_chunk(c, row, accs, qhist, n_valid, n_uniq_c)`` folds each
     chunk into the running accumulators with its ``fold_in(key, c)`` key.
+
+    Lookahead prefetch: a bounded background pool (``prefetch_depth()``
+    windows ahead, default 1) runs ``prepare_slab`` for upcoming windows
+    on host threads while the current window's device_put + kernels are
+    in flight — so the host sort+emit overlaps device work even through
+    the loop's synchronous tail. ``prepare_slab`` is a pure function of
+    its range (the native sort is idempotent per bucket), so a prefetched
+    slab that is discarded — fault, OOM window degradation, resume — is
+    simply recomputed; released values never depend on prefetch state.
+    The pool is drained before the loop returns or raises, so no
+    background encode can touch a closed native encoder.
+
+    Compact-merge mode (``compact_step``/``merge_fn`` set): each chunk's
+    kernel returns compact per-group subtotal columns instead of
+    scattering into the full [num_partitions] accumulators; the pending
+    columns fold into ``accs`` only at checkpoint time and once at the
+    end (columnar.merge_compact_chunks — one scatter per accumulator for
+    ALL chunks). Nothing is donated in this mode, so a failed dispatch
+    can never poison the running state and retries simply re-issue.
+    Checkpoint format and resume semantics are unchanged: a checkpoint
+    always stores dense accumulators, and a resumed run folds its
+    remaining chunks onto them in the same per-partition order as an
+    uninterrupted run (bit-identical).
 
     With a ``runtime.StreamResilience`` attached the loop additionally:
 
@@ -594,70 +785,136 @@ def _run_slab_loop(key, k, counts, n_uniq, fmt_desc, prepare_slab,
         profiler.count_event(runtime_lib.EVENT_CHECKPOINT_BYTES,
                              cp.nbytes())
 
+    compact = compact_step is not None and merge_fn is not None
+    pending = []  # compact mode: per-chunk CompactGroups since last merge
+
     slab_buckets = max(1, (k + n_transfers - 1) // n_transfers)
     ordinal = 0  # slab-window starts incl. re-issues (fault script index)
     failures = 0  # consecutive failed attempts of the current window
     since_checkpoint = 0
-    while cursor < k:
-        s1 = min(cursor + slab_buckets, k)
-        window = ordinal
-        ordinal += 1
-        in_dispatch = False
-        try:
-            with profiler.stage(f"dp/stream_slab_{cursor}"):
-                slab = prepare_slab(cursor, s1)
-                if injector is not None:
-                    injector.check("transfer", window)
-                dslab = jax.device_put(slab)
-                if injector is not None:
-                    injector.check("kernel", window)
-                s0 = cursor
-                for c in range(s0, s1):
-                    in_dispatch = True
-                    accs, qhist = step_chunk(c, dslab[c - s0], accs, qhist,
-                                             int(counts[c]),
-                                             int(n_uniq[c])
-                                             if n_uniq is not None else 0)
-                    in_dispatch = False
-                    cursor = c + 1
-        except Exception as exc:
-            failure_kind = retry_lib.classify(exc)
-            if policy is None or failure_kind == retry_lib.FATAL:
-                raise
-            if in_dispatch:
-                # The failing chunk step may have consumed its donated
-                # accumulator buffers; only a checkpoint restores a
-                # trustworthy state.
-                cp = (cp_policy.store.load(cp_policy.run_id)
-                      if cp_policy is not None else None)
-                if cp is None:
+
+    # Lookahead prefetch pool (see docstring). Window keys are the exact
+    # (s0, s1) ranges, so a budget degradation naturally invalidates
+    # stale prefetches; stage times recorded by pool threads merge into
+    # this thread's collectors via the adopted sinks.
+    depth = prefetch_depth()
+    executor = None
+    inflight = {}
+    parent_sinks = profiler.current_sinks()
+
+    def _prefetch_call(a, b):
+        with profiler.adopt_sinks(parent_sinks):
+            with profiler.stage("dp/wire_sort_parallel"):
+                return prepare_slab(a, b)
+
+    def _discard_inflight():
+        for fut in inflight.values():
+            fut.cancel()
+        inflight.clear()
+
+    try:
+        if depth > 0 and k > 1:
+            import concurrent.futures
+            executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=depth, thread_name_prefix="pdp-slab-prefetch")
+        while cursor < k:
+            s1 = min(cursor + slab_buckets, k)
+            window = ordinal
+            ordinal += 1
+            in_dispatch = False
+            try:
+                with profiler.stage(f"dp/stream_slab_{cursor}"):
+                    fut = inflight.pop((cursor, s1), None)
+                    slab = (fut.result() if fut is not None
+                            else prepare_slab(cursor, s1))
+                    if executor is not None:
+                        nxt0 = s1
+                        while len(inflight) < depth and nxt0 < k:
+                            nxt1 = min(nxt0 + slab_buckets, k)
+                            if (nxt0, nxt1) not in inflight:
+                                inflight[(nxt0, nxt1)] = executor.submit(
+                                    _prefetch_call, nxt0, nxt1)
+                            nxt0 = nxt1
+                    if injector is not None:
+                        injector.check("transfer", window)
+                    dslab = jax.device_put(slab)
+                    if injector is not None:
+                        injector.check("kernel", window)
+                    s0 = cursor
+                    for c in range(s0, s1):
+                        n_valid = int(counts[c])
+                        n_uniq_c = (int(n_uniq[c])
+                                    if n_uniq is not None else 0)
+                        if compact:
+                            pending.append(
+                                compact_step(c, dslab[c - s0], n_valid,
+                                             n_uniq_c))
+                            profiler.count_event(EVENT_COMPACT_CHUNKS)
+                        else:
+                            in_dispatch = True
+                            accs, qhist = step_chunk(c, dslab[c - s0],
+                                                     accs, qhist, n_valid,
+                                                     n_uniq_c)
+                            in_dispatch = False
+                            profiler.count_event(EVENT_PARTITION_SCATTERS,
+                                                 scatter_passes)
+                        cursor = c + 1
+            except Exception as exc:
+                failure_kind = retry_lib.classify(exc)
+                if policy is None or failure_kind == retry_lib.FATAL:
                     raise
-                cp.validate(key_fp=key_fp, wire_fp=wire_fp, n_chunks=k,
-                            key_counter=resilience.key_counter)
-                accs, qhist, cursor = _restore_checkpoint(
-                    cp, expects_qhist=quantile_spec is not None)
-                profiler.count_event(runtime_lib.EVENT_RESUMES)
-            if failure_kind == retry_lib.OOM:
-                smaller = policy.degrade_slab_buckets(slab_buckets)
-                if smaller < slab_buckets:
-                    # Re-issue from the failed chunk with a halved slab
-                    # byte budget; the per-chunk key schedule is
-                    # untouched, so results are unchanged.
-                    slab_buckets = smaller
-                    profiler.count_event(runtime_lib.EVENT_DEGRADATIONS)
-                    continue
-            failures += 1
-            if failures > policy.max_retries:
-                raise
-            profiler.count_event(runtime_lib.EVENT_RETRIES)
-            policy.sleep(policy.backoff_s(failures - 1))
-            continue
-        failures = 0
-        since_checkpoint += 1
-        if (cp_policy is not None and cursor < k
-                and since_checkpoint >= cp_policy.every_slabs):
-            save_checkpoint(cursor, accs, qhist)
-            since_checkpoint = 0
+                if in_dispatch:
+                    # The failing chunk step may have consumed its donated
+                    # accumulator buffers; only a checkpoint restores a
+                    # trustworthy state. (Compact mode never donates, so
+                    # it never lands here.)
+                    cp = (cp_policy.store.load(cp_policy.run_id)
+                          if cp_policy is not None else None)
+                    if cp is None:
+                        raise
+                    cp.validate(key_fp=key_fp, wire_fp=wire_fp, n_chunks=k,
+                                key_counter=resilience.key_counter)
+                    accs, qhist, cursor = _restore_checkpoint(
+                        cp, expects_qhist=quantile_spec is not None)
+                    pending.clear()
+                    profiler.count_event(runtime_lib.EVENT_RESUMES)
+                if failure_kind == retry_lib.OOM:
+                    smaller = policy.degrade_slab_buckets(slab_buckets)
+                    if smaller < slab_buckets:
+                        # Re-issue from the failed chunk with a halved
+                        # slab byte budget; the per-chunk key schedule is
+                        # untouched, so results are unchanged. Window
+                        # boundaries move — in-flight prefetches for the
+                        # old boundaries are discarded (pure recompute).
+                        slab_buckets = smaller
+                        _discard_inflight()
+                        profiler.count_event(
+                            runtime_lib.EVENT_DEGRADATIONS)
+                        continue
+                failures += 1
+                if failures > policy.max_retries:
+                    raise
+                profiler.count_event(runtime_lib.EVENT_RETRIES)
+                policy.sleep(policy.backoff_s(failures - 1))
+                continue
+            failures = 0
+            since_checkpoint += 1
+            if (cp_policy is not None and cursor < k
+                    and since_checkpoint >= cp_policy.every_slabs):
+                if compact and pending:
+                    # Fold pending compact chunks into the dense base so
+                    # the checkpoint format stays dense accumulators.
+                    accs = merge_fn(accs, pending)
+                    pending = []
+                save_checkpoint(cursor, accs, qhist)
+                since_checkpoint = 0
+    finally:
+        _discard_inflight()
+        if executor is not None:
+            executor.shutdown(wait=True)
+    if compact and pending:
+        accs = merge_fn(accs, pending)
+        pending = []
     if cp_policy is not None and cp_policy.delete_on_success:
         cp_policy.store.delete(cp_policy.run_id)
     return accs, qhist

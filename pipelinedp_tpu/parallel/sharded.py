@@ -610,6 +610,99 @@ def _codec_scalar_kernel(mesh: Mesh, padded_p: int, fmt, has_l1: bool,
     return jax.jit(fn)
 
 
+@functools.lru_cache(maxsize=None)
+def _codec_compact_kernel(mesh: Mesh, padded_p: int, fmt, max_groups: int,
+                          has_l1: bool, need_flags,
+                          has_group_clip: bool):
+    """Compact-merge twin of _codec_scalar_kernel: each device decodes its
+    bucket and emits compact per-group subtotal columns
+    (columnar.CompactGroups, [max_groups] per device) instead of
+    scattering into [padded_p] and reduce-scattering per chunk. The
+    per-chunk collectives move to the single merge kernel below."""
+    from pipelinedp_tpu.ops import wirecodec
+
+    axes = tuple(mesh.axis_names)
+
+    def local_step(key, row, n_valid, n_uniq, linf_cap, l0_cap, row_clip_lo,
+                   row_clip_hi, middle, group_clip_lo, group_clip_hi,
+                   *l1_args):
+        pid, pk, value, valid = wirecodec.decode_bucket(
+            row[0], n_valid[0], n_uniq[0], fmt)
+        if value is None:
+            value = jnp.zeros((fmt.cap,), dtype=jnp.float32)
+        cg = columnar.bound_and_aggregate_compact(
+            _device_key(key, axes), pid, pk, value, valid,
+            num_partitions=padded_p,
+            max_groups=max_groups,
+            linf_cap=linf_cap,
+            l0_cap=l0_cap,
+            row_clip_lo=row_clip_lo,
+            row_clip_hi=row_clip_hi,
+            middle=middle,
+            group_clip_lo=group_clip_lo,
+            group_clip_hi=group_clip_hi,
+            l1_cap=l1_args[0] if has_l1 else None,
+            need_count=need_flags[0],
+            need_sum=need_flags[1],
+            need_norm=need_flags[2],
+            need_norm_sq=need_flags[3],
+            has_group_clip=has_group_clip,
+            pid_sorted=fmt.pid_sorted,
+            max_segments=fmt.ucap if fmt.pid_sorted else None)
+        return columnar.CompactGroups(
+            cg.pk, cg.pid_count, cg.count, cg.sum, cg.norm_sum,
+            cg.norm_sq_sum, jnp.reshape(cg.n_kept, (1,)))
+
+    spec = _spec(mesh)
+    fn = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(), spec, spec, spec) + (P(),) * (8 if has_l1 else 7),
+        out_specs=columnar.CompactGroups(*(spec,) * 7),
+        check_vma=False)
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _compact_merge_kernel(mesh: Mesh, padded_p: int, n_c: int, need_flags):
+    """Folds n_c chunks of per-device compact group columns into the
+    dense sharded accumulators inside ONE executable.
+
+    Bit-parity contract with the legacy chunk loop: the legacy loop runs
+    ``accs = accs + reduce_scatter(local_scatter(chunk c))`` chunk by
+    chunk, so the merge must keep exactly that per-partition fold order —
+    one local [padded_p] scatter (from the compact columns, so the input
+    is max_groups entries, not row-scale) and one reduce-scatter per
+    chunk, folded in chunk order. The collectives stay per chunk; the
+    expensive row/group-scale partition passes are gone."""
+    scatter_axes = _scatter_axes(mesh)
+    needed = (True,) + tuple(bool(f) for f in need_flags)
+
+    def local_step(accs, *flat):
+        cols = list(accs)
+        for c in range(n_c):
+            chunk = flat[c * 6:(c + 1) * 6]
+            cpk = chunk[0]
+            for i in range(5):
+                if not needed[i]:
+                    continue
+                partial = jnp.zeros((padded_p,), jnp.float32).at[cpk].add(
+                    chunk[1 + i], mode="drop")
+                cols[i] = cols[i] + _reduce_scatter(partial, scatter_axes)
+        return columnar.PartitionAccumulators(*cols)
+
+    spec = _spec(mesh)
+    part = _part_spec(mesh)
+    fn = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(columnar.PartitionAccumulators(*(part,) * 5),)
+        + (spec,) * (6 * n_c),
+        out_specs=columnar.PartitionAccumulators(*(part,) * 5),
+        check_vma=False)
+    return jax.jit(fn)
+
+
 def stream_bound_and_aggregate(mesh: Mesh,
                                key: jax.Array,
                                pid: np.ndarray,
@@ -630,7 +723,8 @@ def stream_bound_and_aggregate(mesh: Mesh,
                                need_flags=(True, True, True, True),
                                has_group_clip: bool = True,
                                resilience=None,
-                               resume_from=None
+                               resume_from=None,
+                               compact_merge="auto"
                                ) -> columnar.PartitionAccumulators:
     """Chunked, transfer-overlapped multi-chip bound-and-aggregate.
 
@@ -648,6 +742,14 @@ def stream_bound_and_aggregate(mesh: Mesh,
     mesh checkpoints per chunk; OOM degradation does not apply here (the
     chunk granularity is fixed by the mesh shape), so RESOURCE_EXHAUSTED
     re-issues the chunk like a transient fault.
+
+    compact_merge: as on the single-device path — each chunk's devices
+    emit compact per-group subtotal columns and ONE merge executable
+    folds every chunk (per-chunk reduce-scatters preserved for bit
+    parity, but the row/group-scale partition scatters are gone).
+    "auto" (default) engages at >= streaming.COMPACT_MIN_PARTITIONS
+    padded partitions; False restores the legacy per-chunk
+    scatter+reduce-scatter loop.
     """
     import dataclasses
 
@@ -732,7 +834,8 @@ def stream_bound_and_aggregate(mesh: Mesh,
                                      tuple(need_flags), has_group_clip,
                                      resilience,
                                      lambda: streaming._input_digest(
-                                         pid, pk, value))
+                                         pid, pk, value),
+                                     compact_merge=compact_merge)
     slab, counts, n_uniq, fmt = wirecodec.encode_buckets_numpy(
         pid, pk, value, pid_lo=info.pid_lo, k=k, bytes_pid=info.bytes_pid,
         bits_pk=info.bits_pk, plan=info.plan, pid_mode=info.pid_mode,
@@ -744,34 +847,77 @@ def stream_bound_and_aggregate(mesh: Mesh,
                              row_clip_hi, middle, group_clip_lo,
                              group_clip_hi, l1_cap, tuple(need_flags),
                              has_group_clip, resilience,
-                             lambda: streaming._input_digest(pid, pk, value))
+                             lambda: streaming._input_digest(pid, pk, value),
+                             compact_merge=compact_merge)
 
 
 def _run_codec_chunks(mesh, key, emit, counts, n_uniq, fmt, n_c, n_dev,
                       padded_p, linf_cap, l0_cap, row_clip_lo, row_clip_hi,
                       middle, group_clip_lo, group_clip_hi, l1_cap,
                       need_flags, has_group_clip, resilience=None,
-                      data_digest_fn=None):
+                      data_digest_fn=None, compact_merge: bool = True):
     """The mesh chunk loop, with the same resilience semantics as the
     single-device slab loop (ops/streaming._run_slab_loop): each chunk is
     one slab window — resumable, checkpointed, retried after transient
     faults. Chunk accumulators are summed (never donated) and injected
     faults fire before dispatch, so retrying a chunk is always safe; OOM
     re-issues the chunk after backoff (the chunk granularity is fixed by
-    the mesh shape, so there is no slab budget to degrade)."""
+    the mesh shape, so there is no slab budget to degrade).
+
+    Like the single-device loop it prefetches upcoming chunks' host
+    encode on a bounded background pool (streaming.prefetch_depth
+    windows, discarded safely on any failure — emit is pure), and in
+    compact-merge mode collects per-device compact group columns per
+    chunk, folding them into the dense sharded accumulators only at
+    checkpoints and once at the end (_compact_merge_kernel, which keeps
+    the legacy per-partition fold order for bit parity)."""
     from pipelinedp_tpu import profiler
     from pipelinedp_tpu import runtime as runtime_lib
+    from pipelinedp_tpu.ops import streaming
     from pipelinedp_tpu.runtime import checkpoint as checkpoint_lib
     from pipelinedp_tpu.runtime import retry as retry_lib
 
-    kernel = _codec_scalar_kernel(mesh, padded_p, fmt,
-                                  l1_cap is not None, need_flags,
-                                  has_group_clip)
+    max_groups = None
+    if (streaming._compact_enabled(compact_merge, padded_p)
+            and fmt.pid_sorted):
+        max_groups = columnar.compact_group_bound(fmt.cap, fmt.ucap,
+                                                  l0_cap)
+    compact = max_groups is not None
+    if compact:
+        kernel = _codec_compact_kernel(mesh, padded_p, fmt, max_groups,
+                                       l1_cap is not None, need_flags,
+                                       has_group_clip)
+    else:
+        kernel = _codec_scalar_kernel(mesh, padded_p, fmt,
+                                      l1_cap is not None, need_flags,
+                                      has_group_clip)
+    scatter_passes = 1 + sum(bool(f) for f in need_flags)
     sharding = NamedSharding(mesh, _spec(mesh))
     part_sharding = NamedSharding(mesh, _part_spec(mesh))
     accs = None
+    pending = []  # compact mode: CompactGroups per chunk since last merge
     counts = np.asarray(counts, dtype=np.int32)
     n_uniq = np.asarray(n_uniq, dtype=np.int32)
+
+    def merge_pending(accs, pending):
+        if accs is None:
+            accs = columnar.PartitionAccumulators(
+                *(jax.device_put(np.zeros(padded_p, np.float32),
+                                 part_sharding) for _ in range(5)))
+        max_kept = int(jax.device_get(jnp.max(
+            jnp.concatenate([p.n_kept for p in pending]))))
+        if max_kept > max_groups:
+            raise RuntimeError(
+                f"compact merge: a chunk kept {max_kept} groups, above "
+                f"the static bound {max_groups} — the pid-sorted wire "
+                f"contract was violated; refusing to release truncated "
+                f"accumulators")
+        profiler.count_event(streaming.EVENT_COMPACT_MERGE_SCATTERS,
+                             scatter_passes * len(pending))
+        merge = _compact_merge_kernel(mesh, padded_p, len(pending),
+                                      tuple(need_flags))
+        flat = [a for p in pending for a in p[:6]]
+        return merge(accs, *flat)
 
     policy = injector = cp_policy = None
     key_fp = wire_fp = None
@@ -800,58 +946,106 @@ def _run_codec_chunks(mesh, key, emit, counts, n_uniq, fmt, n_c, n_dev,
     ordinal = 0
     failures = 0
     since_checkpoint = 0
-    while cursor < n_c:
-        c = cursor
-        window = ordinal
-        ordinal += 1
-        try:
-            with profiler.stage(f"dp/mesh_stream_chunk_{c}"):
-                slab = emit(c)
-                if injector is not None:
-                    injector.check("transfer", window)
-                dslab = jax.device_put(slab, sharding)
-                dvalid = jax.device_put(counts[c * n_dev:(c + 1) * n_dev],
-                                        sharding)
-                duniq = jax.device_put(n_uniq[c * n_dev:(c + 1) * n_dev],
-                                       sharding)
-                if injector is not None:
-                    injector.check("kernel", window)
-                args = (jax.random.fold_in(key, c), dslab, dvalid, duniq,
-                        linf_cap, l0_cap, float(row_clip_lo),
-                        float(row_clip_hi), float(middle),
-                        float(group_clip_lo), float(group_clip_hi))
-                if l1_cap is not None:
-                    args += (l1_cap,)
-                chunk_accs = kernel(*args)
-                accs = chunk_accs if accs is None else (
-                    columnar.PartitionAccumulators(
-                        *(a + b for a, b in zip(accs, chunk_accs))))
-                cursor = c + 1
-        except Exception as exc:
-            failure_kind = retry_lib.classify(exc)
-            if policy is None or failure_kind == retry_lib.FATAL:
-                raise
-            failures += 1
-            if failures > policy.max_retries:
-                raise
-            profiler.count_event(runtime_lib.EVENT_RETRIES)
-            policy.sleep(policy.backoff_s(failures - 1))
-            continue
-        failures = 0
-        since_checkpoint += 1
-        if (cp_policy is not None and cursor < n_c
-                and since_checkpoint >= cp_policy.every_slabs):
-            host_accs = jax.device_get(tuple(accs))
-            cp = checkpoint_lib.StreamCheckpoint(
-                run_id=cp_policy.run_id, next_chunk=cursor, n_chunks=n_c,
-                accs=tuple(np.asarray(a) for a in host_accs),
-                qhist=None,
-                key_fingerprint=key_fp, wire_fingerprint=wire_fp,
-                key_counter=resilience.key_counter)
-            cp_policy.store.save(cp)
-            profiler.count_event(runtime_lib.EVENT_CHECKPOINT_BYTES,
-                                 cp.nbytes())
-            since_checkpoint = 0
+
+    depth = streaming.prefetch_depth()
+    executor = None
+    inflight = {}
+    parent_sinks = profiler.current_sinks()
+
+    def _prefetch_call(c):
+        with profiler.adopt_sinks(parent_sinks):
+            with profiler.stage("dp/wire_sort_parallel"):
+                return emit(c)
+
+    def _discard_inflight():
+        for fut in inflight.values():
+            fut.cancel()
+        inflight.clear()
+
+    try:
+        if depth > 0 and n_c > 1:
+            import concurrent.futures
+            executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=depth, thread_name_prefix="pdp-chunk-prefetch")
+        while cursor < n_c:
+            c = cursor
+            window = ordinal
+            ordinal += 1
+            try:
+                with profiler.stage(f"dp/mesh_stream_chunk_{c}"):
+                    fut = inflight.pop(c, None)
+                    slab = fut.result() if fut is not None else emit(c)
+                    if executor is not None:
+                        nxt = c + 1
+                        while len(inflight) < depth and nxt < n_c:
+                            if nxt not in inflight:
+                                inflight[nxt] = executor.submit(
+                                    _prefetch_call, nxt)
+                            nxt += 1
+                    if injector is not None:
+                        injector.check("transfer", window)
+                    dslab = jax.device_put(slab, sharding)
+                    dvalid = jax.device_put(
+                        counts[c * n_dev:(c + 1) * n_dev], sharding)
+                    duniq = jax.device_put(
+                        n_uniq[c * n_dev:(c + 1) * n_dev], sharding)
+                    if injector is not None:
+                        injector.check("kernel", window)
+                    args = (jax.random.fold_in(key, c), dslab, dvalid,
+                            duniq, linf_cap, l0_cap, float(row_clip_lo),
+                            float(row_clip_hi), float(middle),
+                            float(group_clip_lo), float(group_clip_hi))
+                    if l1_cap is not None:
+                        args += (l1_cap,)
+                    if compact:
+                        pending.append(kernel(*args))
+                        profiler.count_event(streaming.EVENT_COMPACT_CHUNKS)
+                    else:
+                        chunk_accs = kernel(*args)
+                        accs = chunk_accs if accs is None else (
+                            columnar.PartitionAccumulators(
+                                *(a + b for a, b in zip(accs,
+                                                        chunk_accs))))
+                        profiler.count_event(
+                            streaming.EVENT_PARTITION_SCATTERS,
+                            scatter_passes)
+                    cursor = c + 1
+            except Exception as exc:
+                failure_kind = retry_lib.classify(exc)
+                if policy is None or failure_kind == retry_lib.FATAL:
+                    raise
+                failures += 1
+                if failures > policy.max_retries:
+                    raise
+                profiler.count_event(runtime_lib.EVENT_RETRIES)
+                policy.sleep(policy.backoff_s(failures - 1))
+                continue
+            failures = 0
+            since_checkpoint += 1
+            if (cp_policy is not None and cursor < n_c
+                    and since_checkpoint >= cp_policy.every_slabs):
+                if compact and pending:
+                    accs = merge_pending(accs, pending)
+                    pending = []
+                host_accs = jax.device_get(tuple(accs))
+                cp = checkpoint_lib.StreamCheckpoint(
+                    run_id=cp_policy.run_id, next_chunk=cursor,
+                    n_chunks=n_c,
+                    accs=tuple(np.asarray(a) for a in host_accs),
+                    qhist=None,
+                    key_fingerprint=key_fp, wire_fingerprint=wire_fp,
+                    key_counter=resilience.key_counter)
+                cp_policy.store.save(cp)
+                profiler.count_event(runtime_lib.EVENT_CHECKPOINT_BYTES,
+                                     cp.nbytes())
+                since_checkpoint = 0
+    finally:
+        _discard_inflight()
+        if executor is not None:
+            executor.shutdown(wait=True)
+    if compact and pending:
+        accs = merge_pending(accs, pending)
+        pending = []
     if cp_policy is not None and cp_policy.delete_on_success:
         cp_policy.store.delete(cp_policy.run_id)
     return accs

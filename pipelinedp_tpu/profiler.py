@@ -28,8 +28,39 @@ from typing import Dict, Iterator, Optional
 import jax
 
 # Active wall-clock stage collectors (see collect_stage_times). Thread-local
-# so concurrent engines don't interleave their phase budgets.
+# so concurrent engines don't interleave their phase budgets; worker pools
+# an engine spawns (slab prefetch, encode workers) join their parent's
+# collectors explicitly via adopt_sinks(current_sinks()). Sink updates are
+# guarded by _sink_lock — multiple threads record into one sink dict.
 _collect = threading.local()
+_sink_lock = threading.Lock()
+
+
+def current_sinks() -> list:
+    """This thread's active stage-time sinks (share with adopt_sinks)."""
+    return list(getattr(_collect, "sinks", None) or ())
+
+
+def _add_stage_time(sinks, name: str, dt: float) -> None:
+    """Thread-safe accumulation of one stage timing into the sinks."""
+    with _sink_lock:
+        for sink in sinks:
+            sink[name] = sink.get(name, 0.0) + dt
+
+
+@contextlib.contextmanager
+def adopt_sinks(sinks) -> "Iterator[None]":
+    """Installs a parent thread's collectors into this (worker) thread so
+    its stage() timings merge into the parent's collect_stage_times()
+    dict. Restores the worker's previous sinks on exit; safe to nest."""
+    prev = getattr(_collect, "sinks", None)
+    mine = list(prev or ())
+    mine.extend(s for s in sinks if s not in mine)
+    _collect.sinks = mine
+    try:
+        yield
+    finally:
+        _collect.sinks = prev
 
 # Global named counters: compile/trace/cache telemetry (ops/finalize uses
 # them to count epilogue retraces and executable-cache hits). Unlike stage
@@ -96,9 +127,7 @@ def stage(name: str) -> Iterator[None]:
             with jax.profiler.TraceAnnotation(name):
                 yield
         finally:
-            dt = time.perf_counter() - t0
-            for sink in sinks:
-                sink[name] = sink.get(name, 0.0) + dt
+            _add_stage_time(sinks, name, time.perf_counter() - t0)
         return
     with jax.profiler.TraceAnnotation(name):
         yield

@@ -1,0 +1,350 @@
+"""Compact chunk merge (ISSUE 5 tentpole 3): streamed chunks emit compact
+per-group subtotal columns and ONE final set of [num_partitions] scatters
+merges all chunks.
+
+Contracts pinned here:
+
+  * structural — profiler op counters show the row/group-scale full-[P]
+    partition-scatter passes per streamed aggregate drop from
+    (1 + needed) * k chunks to 0, replaced by ONE compact-input merge
+    scatter per accumulator (single-device) / per accumulator per chunk
+    with compact inputs (mesh, which keeps its per-chunk reduce-scatter
+    for bit parity);
+  * bit parity — released accumulators are bit-identical to the legacy
+    per-chunk scatter path under a fixed seed when the group stage is
+    active (has_group_clip=True), single-device and mesh8; the
+    no-group-clip mode agrees exactly for integer-valued accumulators
+    and to float32 tolerance otherwise (association differs);
+  * the compact path composes with the engine (public API), resumes
+    bit-identically through checkpoints, and falls back to the legacy
+    path where its static group bound does not exist (PID_PLANES).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import profiler
+from pipelinedp_tpu import runtime
+from pipelinedp_tpu.ops import columnar, streaming, wirecodec
+from pipelinedp_tpu.parallel import sharded
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return sharded.make_mesh(8)
+
+
+@pytest.fixture(autouse=True)
+def _reset_ops_counters():
+    profiler.reset_events("ops/")
+    yield
+
+
+def _data(n=50_000, n_parts=200, seed=0, ratings=True):
+    rng = np.random.default_rng(seed)
+    pid = rng.integers(1000, 9000, n).astype(np.int64)
+    pk = rng.integers(0, n_parts, n).astype(np.int32)
+    if ratings:
+        value = rng.integers(1, 6, n).astype(np.float32)
+    else:
+        value = rng.uniform(0, 5, n).astype(np.float32)
+    return pid, pk, value
+
+
+def _stream(pid, pk, value, compact, **over):
+    kw = dict(num_partitions=200, linf_cap=1000, l0_cap=100,
+              row_clip_lo=0.0, row_clip_hi=5.0, middle=2.5,
+              group_clip_lo=-np.inf, group_clip_hi=np.inf, n_chunks=8)
+    kw.update(over)
+    return streaming.stream_bound_and_aggregate(
+        jax.random.PRNGKey(7), pid, pk, value, compact_merge=compact, **kw)
+
+
+class TestScatterPassCounters:
+    """Acceptance: full-[P] row/group-input scatter passes drop from
+    (1 + needed) * k to 0, replaced by (1 + needed) compact-input merge
+    scatters for the whole aggregate."""
+
+    def test_headline_shape_3k_to_3(self):
+        # COUNT+SUM, no group clip: 1 (pid_count) + 2 needed = 3 passes.
+        pid, pk, value = _data()
+        kw = dict(need_flags=(True, True, False, False),
+                  has_group_clip=False)
+        _stream(pid, pk, value, compact=False, **kw)
+        assert profiler.event_count(
+            streaming.EVENT_PARTITION_SCATTERS) == 3 * 8
+        assert profiler.event_count(
+            streaming.EVENT_COMPACT_MERGE_SCATTERS) == 0
+        profiler.reset_events("ops/")
+        _stream(pid, pk, value, compact=True, **kw)
+        assert profiler.event_count(
+            streaming.EVENT_PARTITION_SCATTERS) == 0
+        assert profiler.event_count(
+            streaming.EVENT_COMPACT_MERGE_SCATTERS) == 3
+        assert profiler.event_count(streaming.EVENT_COMPACT_CHUNKS) == 8
+
+    def test_all_flags_5k_to_5(self):
+        pid, pk, value = _data()
+        _stream(pid, pk, value, compact=True)
+        assert profiler.event_count(
+            streaming.EVENT_COMPACT_MERGE_SCATTERS) == 5
+        assert profiler.event_count(
+            streaming.EVENT_PARTITION_SCATTERS) == 0
+
+    def test_mesh_row_scale_passes_drop_to_zero(self, mesh):
+        pid, pk, value = _data()
+        kw = dict(num_partitions=200, linf_cap=1000, l0_cap=100,
+                  row_clip_lo=0.0, row_clip_hi=5.0, middle=2.5,
+                  group_clip_lo=-np.inf, group_clip_hi=np.inf, n_chunks=4,
+                  need_flags=(True, True, False, False),
+                  has_group_clip=False)
+        sharded.stream_bound_and_aggregate(
+            mesh, jax.random.PRNGKey(7), pid, pk, value,
+            compact_merge=False, **kw)
+        assert profiler.event_count(
+            streaming.EVENT_PARTITION_SCATTERS) == 3 * 4
+        profiler.reset_events("ops/")
+        sharded.stream_bound_and_aggregate(
+            mesh, jax.random.PRNGKey(7), pid, pk, value,
+            compact_merge=True, **kw)
+        assert profiler.event_count(
+            streaming.EVENT_PARTITION_SCATTERS) == 0
+        # The mesh merge keeps one compact-input scatter per accumulator
+        # per chunk (its reduce-scatter fold is per chunk for bit parity).
+        assert profiler.event_count(
+            streaming.EVENT_COMPACT_MERGE_SCATTERS) == 3 * 4
+
+
+class TestBitParity:
+    """Acceptance: released values bit-identical to the pre-merge path
+    under a fixed seed (single-device and mesh8)."""
+
+    def test_group_clip_bitwise_single_device(self):
+        pid, pk, value = _data(ratings=False)
+        kw = dict(group_clip_lo=0.0, group_clip_hi=50.0,
+                  has_group_clip=True, linf_cap=7, l0_cap=13)
+        legacy = _stream(pid, pk, value, compact=False, **kw)
+        compact = _stream(pid, pk, value, compact=True, **kw)
+        for name, a, b in zip(legacy._fields, legacy, compact):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=name)
+
+    def test_group_clip_bitwise_mesh(self, mesh):
+        pid, pk, value = _data(ratings=False)
+        kw = dict(num_partitions=200, linf_cap=7, l0_cap=13,
+                  row_clip_lo=0.0, row_clip_hi=5.0, middle=2.5,
+                  group_clip_lo=0.0, group_clip_hi=50.0, n_chunks=4,
+                  has_group_clip=True)
+        legacy = sharded.stream_bound_and_aggregate(
+            mesh, jax.random.PRNGKey(7), pid, pk, value,
+            compact_merge=False, **kw)
+        compact = sharded.stream_bound_and_aggregate(
+            mesh, jax.random.PRNGKey(7), pid, pk, value,
+            compact_merge=True, **kw)
+        for name, a, b in zip(legacy._fields, legacy, compact):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=name)
+
+    def test_no_group_clip_close_and_counts_exact(self):
+        # Without the group stage the legacy path folds rows directly;
+        # the compact path folds per-group subtotals — equal in exact
+        # arithmetic, so integer accumulators (counts) stay bitwise and
+        # float sums agree to ulp-level tolerance.
+        pid, pk, value = _data(ratings=False)
+        kw = dict(has_group_clip=False, linf_cap=7, l0_cap=13)
+        legacy = _stream(pid, pk, value, compact=False, **kw)
+        compact = _stream(pid, pk, value, compact=True, **kw)
+        np.testing.assert_array_equal(np.asarray(legacy.count),
+                                      np.asarray(compact.count))
+        np.testing.assert_array_equal(np.asarray(legacy.pid_count),
+                                      np.asarray(compact.pid_count))
+        for name, a, b in zip(legacy._fields, legacy, compact):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-4, err_msg=name)
+
+    def test_value_none_count_exact(self):
+        pid, pk, _ = _data()
+        kw = dict(need_flags=(True, False, False, False),
+                  has_group_clip=False)
+        legacy = _stream(pid, pk, None, compact=False, **kw)
+        compact = _stream(pid, pk, None, compact=True, **kw)
+        np.testing.assert_array_equal(np.asarray(legacy.count),
+                                      np.asarray(compact.count))
+        np.testing.assert_array_equal(np.asarray(legacy.pid_count),
+                                      np.asarray(compact.pid_count))
+
+    def test_engine_release_bitwise_group_clip(self):
+        # Full public API with per-partition sum bounds (group clip):
+        # released columns identical between compact and legacy engines.
+        pid, pk, value = _data(n=30_000)
+
+        def run(compact):
+            accountant = pdp.NaiveBudgetAccountant(1e9, 1 - 1e-9)
+            engine = pdp.JaxDPEngine(accountant, seed=3, stream_chunks=8,
+                                     secure_host_noise=False,
+                                     compact_merge=compact)
+            params = pdp.AggregateParams(
+                metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+                max_partitions_contributed=20,
+                max_contributions_per_partition=50,
+                min_sum_per_partition=0.0,
+                max_sum_per_partition=100.0)
+            result = engine.aggregate(
+                pdp.ColumnarData(pid=pid, pk=pk, value=value), params,
+                public_partitions=list(range(200)))
+            accountant.compute_budgets()
+            return result.to_columns()
+
+        legacy, compact = run(False), run(True)
+        for name in legacy:
+            np.testing.assert_array_equal(legacy[name], compact[name],
+                                          err_msg=name)
+
+
+class TestCompactResilience:
+    """The compact path must keep the checkpoint/resume bit-identity
+    contract: merges happen at checkpoints, a resumed run folds its
+    remaining chunks onto the restored dense base in the same order."""
+
+    def _stream(self, pid, pk, value, **kw):
+        return streaming.stream_bound_and_aggregate(
+            jax.random.PRNGKey(7), pid, pk, value, num_partitions=100,
+            linf_cap=1000, l0_cap=100, row_clip_lo=0.0, row_clip_hi=5.0,
+            middle=2.5, group_clip_lo=0.0, group_clip_hi=500.0,
+            has_group_clip=True, n_chunks=8, compact_merge=True, **kw)
+
+    def test_resume_mid_stream_bitwise(self):
+        pid, pk, value = _data(n=30_000, n_parts=100)
+        full = self._stream(pid, pk, value)
+        store = runtime.InMemoryCheckpointStore()
+        policy = runtime.CheckpointPolicy(store=store, run_id="compact",
+                                          delete_on_success=False)
+        self._stream(pid, pk, value,
+                     resilience=runtime.StreamResilience(
+                         checkpoint_policy=policy))
+        checkpoint = store.load("compact")
+        assert 0 < checkpoint.next_chunk < checkpoint.n_chunks
+        resumed = self._stream(pid, pk, value, resume_from=checkpoint)
+        for a, b in zip(full, resumed):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_oom_degradation_bitwise(self):
+        pid, pk, value = _data(n=30_000, n_parts=100)
+        clean = self._stream(pid, pk, value)
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("oom", at_slab=1)])
+        degraded = self._stream(
+            pid, pk, value,
+            resilience=runtime.StreamResilience(
+                retry_policy=runtime.RetryPolicy(sleep=lambda s: None),
+                fault_injector=injector))
+        assert injector.pending == 0
+        for a, b in zip(clean, degraded):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestCompactApplicability:
+
+    def test_pid_planes_falls_back_to_legacy(self):
+        # Near-unique ids choose PID_PLANES, which has no per-chunk pid
+        # bound: the compact path must not engage (and results stay sane).
+        n = 40_000
+        rng = np.random.default_rng(5)
+        pid = rng.permutation(n).astype(np.int64)
+        pk = rng.integers(0, 100, n).astype(np.int32)
+        value = np.ones(n, dtype=np.float32)
+        accs = streaming.stream_bound_and_aggregate(
+            jax.random.PRNGKey(0), pid, pk, value, num_partitions=100,
+            linf_cap=n, l0_cap=100, row_clip_lo=0.0, row_clip_hi=1.0,
+            middle=0.5, group_clip_lo=-np.inf, group_clip_hi=np.inf,
+            n_chunks=4, has_group_clip=False, compact_merge=True)
+        assert profiler.event_count(streaming.EVENT_COMPACT_CHUNKS) == 0
+        assert profiler.event_count(
+            streaming.EVENT_PARTITION_SCATTERS) > 0
+        np.testing.assert_allclose(np.asarray(accs.count),
+                                   np.bincount(pk, minlength=100))
+
+    def test_auto_threshold(self):
+        # "auto" engages only where the [P]-output passes dominate.
+        assert streaming._compact_enabled("auto",
+                                          streaming.COMPACT_MIN_PARTITIONS)
+        assert not streaming._compact_enabled("auto", 30_000)
+        assert streaming._compact_enabled(True, 1)
+        assert not streaming._compact_enabled(False, 1 << 20)
+
+    def test_compact_group_bound(self):
+        assert columnar.compact_group_bound(1024, 16, 4) == 64
+        assert columnar.compact_group_bound(48, 16, 100) == 48
+        assert columnar.compact_group_bound(1024, 16, 0) is None
+        assert columnar.compact_group_bound(
+            1024, 16, jax.numpy.arange(3)) is None
+
+    def test_merge_guard_refuses_truncation(self):
+        # A CompactGroups claiming more kept groups than its static bound
+        # must refuse to merge (wire-contract violation).
+        import jax.numpy as jnp
+        cg = columnar.CompactGroups(
+            pk=jnp.zeros(8, jnp.int32),
+            pid_count=jnp.zeros(8), count=jnp.zeros(8), sum=jnp.zeros(8),
+            norm_sum=jnp.zeros(8), norm_sq_sum=jnp.zeros(8),
+            n_kept=jnp.asarray(9, jnp.int32))
+        accs = columnar.PartitionAccumulators(
+            *(jnp.zeros(4) for _ in range(5)))
+        with pytest.raises(RuntimeError, match="static bound"):
+            streaming._merge_pending(accs, [cg], 4, (True,) * 4)
+
+    def test_quantile_path_stays_legacy(self):
+        # quantile_spec accumulates a dense [P, leaves] histogram; the
+        # compact merge must not engage there.
+        pid, pk, value = _data(n=20_000, n_parts=50)
+        accs, qhist = streaming.stream_bound_and_aggregate(
+            jax.random.PRNGKey(1), pid, pk, value, num_partitions=50,
+            linf_cap=1000, l0_cap=50, row_clip_lo=0.0, row_clip_hi=5.0,
+            middle=2.5, group_clip_lo=-np.inf, group_clip_hi=np.inf,
+            n_chunks=4, quantile_spec=(16, 0.0, 5.0), compact_merge=True)
+        assert profiler.event_count(streaming.EVENT_COMPACT_CHUNKS) == 0
+        assert qhist.shape == (50, 16)
+
+
+class TestCompactKernelUnit:
+    """bound_and_aggregate_compact against bound_and_aggregate directly:
+    merging ONE chunk's compact columns must reproduce the dense kernel
+    bitwise (group-clip mode)."""
+
+    @pytest.mark.parametrize("pid_sorted", [False, True])
+    def test_single_chunk_roundtrip(self, pid_sorted):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(2)
+        n, P = 4096, 64
+        pid = np.sort(rng.integers(0, 300, n)) if pid_sorted else \
+            rng.integers(0, 300, n)
+        pk = rng.integers(0, P, n).astype(np.int32)
+        value = rng.uniform(0, 5, n).astype(np.float32)
+        valid = np.ones(n, dtype=bool)
+        key = jax.random.PRNGKey(9)
+        kw = dict(num_partitions=P, linf_cap=5, l0_cap=7,
+                  row_clip_lo=0.0, row_clip_hi=5.0, middle=2.5,
+                  group_clip_lo=0.0, group_clip_hi=20.0,
+                  has_group_clip=True, pid_sorted=pid_sorted,
+                  max_segments=512 if pid_sorted else None)
+        dense = columnar.bound_and_aggregate(
+            key, jnp.asarray(pid.astype(np.int32)), jnp.asarray(pk),
+            jnp.asarray(value), jnp.asarray(valid), **kw)
+        max_groups = columnar.compact_group_bound(n, 300, kw["l0_cap"])
+        cg = columnar.bound_and_aggregate_compact(
+            key, jnp.asarray(pid.astype(np.int32)), jnp.asarray(pk),
+            jnp.asarray(value), jnp.asarray(valid),
+            max_groups=max_groups, **kw)
+        assert int(cg.n_kept) <= max_groups
+        base = columnar.PartitionAccumulators(
+            *(jnp.zeros(P, jnp.float32) for _ in range(5)))
+        merged = columnar.merge_compact_chunks(
+            base, *(jnp.stack([c]) for c in cg[:6]), num_partitions=P,
+            need_flags=(True, True, True, True))
+        for name, a, b in zip(dense._fields, dense, merged):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=name)

@@ -36,3 +36,75 @@ class TestProfiler:
         traces = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
                            recursive=True)
         assert traces, f"no trace files under {logdir}"
+
+
+class TestThreadSafety:
+    """Stage timers and event counters under concurrent recording — the
+    encode/prefetch worker pools record from pool threads into the main
+    thread's collectors (ISSUE 5 satellite: lock + thread-local sinks)."""
+
+    def test_stage_time_hammer_no_lost_updates(self):
+        import threading
+
+        n_threads, n_iters = 8, 5_000
+        with profiler.collect_stage_times() as sink:
+            sinks = profiler.current_sinks()
+
+            def worker():
+                for _ in range(n_iters):
+                    profiler._add_stage_time(sinks, "hammer", 1.0)
+
+            threads = [threading.Thread(target=worker)
+                       for _ in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        # Exactly one update per call: lost updates would undercount.
+        assert sink["hammer"] == float(n_threads * n_iters)
+
+    def test_event_count_hammer(self):
+        import threading
+
+        profiler.reset_events("test/")
+        n_threads, n_iters = 8, 5_000
+
+        def worker():
+            for _ in range(n_iters):
+                profiler.count_event("test/hammer")
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert profiler.event_count("test/hammer") == n_threads * n_iters
+        profiler.reset_events("test/")
+
+    def test_adopt_sinks_merges_worker_stages(self):
+        import threading
+
+        with profiler.collect_stage_times() as sink:
+            parent_sinks = profiler.current_sinks()
+
+            def worker():
+                with profiler.adopt_sinks(parent_sinks):
+                    with profiler.stage("worker_stage"):
+                        pass
+
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+            # The worker thread's stage landed in the parent's sink; the
+            # worker's thread-local state was restored on exit.
+            assert "worker_stage" in sink
+            assert sink["worker_stage"] >= 0.0
+
+    def test_adopt_sinks_restores_previous(self):
+        with profiler.collect_stage_times() as outer:
+            with profiler.adopt_sinks([{}]):
+                pass
+            with profiler.stage("after_adopt"):
+                pass
+        assert "after_adopt" in outer

@@ -447,6 +447,78 @@ class TestChaosMatrix:
             _assert_same_release(clean, chaotic)
 
 
+class TestPrefetchInterplay:
+    """ISSUE 5 satellite: a FaultInjector crash / OOM-degrade while a
+    lookahead prefetch is in flight must resume bit-identically to an
+    uninterrupted run, on both the single-device and mesh paths. The
+    prefetched slab for the window after the fault is discarded and
+    recomputed — prepare_slab is pure, so released values cannot depend
+    on prefetch state."""
+
+    @pytest.fixture(autouse=True)
+    def _deep_prefetch(self, monkeypatch):
+        # Depth 2: when the fault fires at window 1, windows 2 and 3 are
+        # already prefetching in the background.
+        monkeypatch.setenv(streaming.PREFETCH_ENV, "2")
+        yield
+
+    def test_crash_with_prefetch_in_flight_resumes_bitwise(self, tmp_path):
+        pid, pk, value = _data()
+        clean = _aggregate(pid, pk, value)
+        store = runtime.FileCheckpointStore(str(tmp_path))
+        policy = runtime.CheckpointPolicy(store=store, run_id="pf-kill")
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("host_crash", at_slab=1)])
+        with pytest.raises(runtime.HostCrash):
+            _aggregate(pid, pk, value, checkpoint_policy=policy,
+                       fault_injector=injector)
+        resumed = _aggregate(pid, pk, value, checkpoint_policy=policy)
+        _assert_same_release(clean, resumed)
+
+    def test_oom_degrade_discards_stale_prefetches(self):
+        # Degradation halves the slab window: prefetches keyed by the old
+        # boundaries no longer match and must be recomputed, not spliced.
+        pid, pk, value = _data()
+        clean = _aggregate(pid, pk, value)
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("oom", at_slab=1)])
+        degraded = _aggregate(pid, pk, value, fault_injector=injector,
+                              retry_policy=NO_SLEEP)
+        assert profiler.event_count(runtime.EVENT_DEGRADATIONS) == 1
+        _assert_same_release(clean, degraded)
+
+    def test_mesh_crash_with_prefetch_resumes_bitwise(self, tmp_path, mesh):
+        pid, pk, value = _data()
+        clean = _aggregate(pid, pk, value, mesh=mesh, stream_chunks=4)
+        store = runtime.FileCheckpointStore(str(tmp_path))
+        policy = runtime.CheckpointPolicy(store=store, run_id="pf-mesh")
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("host_crash", at_slab=1)])
+        with pytest.raises(runtime.HostCrash):
+            _aggregate(pid, pk, value, mesh=mesh, stream_chunks=4,
+                       checkpoint_policy=policy, fault_injector=injector)
+        resumed = _aggregate(pid, pk, value, mesh=mesh, stream_chunks=4,
+                             checkpoint_policy=policy)
+        _assert_same_release(clean, resumed)
+
+    def test_prefetch_disabled_matches_enabled(self, monkeypatch):
+        # Depth 0 (no background encode) must release identical bits:
+        # prefetch is a scheduling choice, never a semantic one.
+        pid, pk, value = _data()
+        with_prefetch = _aggregate(pid, pk, value)
+        monkeypatch.setenv(streaming.PREFETCH_ENV, "0")
+        without = _aggregate(pid, pk, value)
+        _assert_same_release(with_prefetch, without)
+
+    def test_prefetch_overlap_recorded(self):
+        # The background encode's host seconds surface under the
+        # dp/wire_sort_parallel stage (bench reports wire_sort_parallel_s).
+        pid, pk, value = _data()
+        with profiler.collect_stage_times() as stages:
+            _aggregate(pid, pk, value)
+        assert any(k == "dp/wire_sort_parallel" for k in stages), stages
+
+
 class TestAtMostOnceRelease:
     """Acceptance: replaying a committed mechanism or re-releasing a
     finalized epilogue raises; the journal shows each spend once."""
