@@ -1186,6 +1186,7 @@ class JaxDPEngine:
                 # float64 numpy with noise_core's full granularity
                 # snapping.
                 with profiler.stage("dp/finalize_transfer"):
+                    # dplint: disable=DPL007 — secure-host-noise path: this transfer IS the mechanism boundary; host_epilogue adds float64 noise_core noise before anything is released
                     host_accs, host_vec = jax.device_get(
                         (accs, vector_sums))
                 metric_cols, keep = finalize_ops.host_epilogue(

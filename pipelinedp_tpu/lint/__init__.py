@@ -8,13 +8,19 @@ release-path randomness must come from the secure sampler. dplint checks
 these machine-checkably on every change — the same role secure-RNG review
 plays for Google's C++ differential-privacy library.
 
-Rules:
+Rules (DPL007-010 are whole-program, built on the dpflow layer in
+lint/flow/ — project symbol table, import-resolved call graph, forward
+dataflow with per-file digest caching):
   DPL001 prng-key-reuse        — key consumed twice without split/fold_in
   DPL002 unaccounted-noise     — noise drawn with no MechanismSpec in sight
   DPL003 jit-hostile-construct — .item()/np.*/branching on traced values
   DPL004 insecure-rng          — np.random / stdlib random on release path
   DPL005 budget-literal-misuse — eps<=0, delta>=1, hand-rolled eps/2 splits
   DPL006 unguarded-float64     — jnp.float64 that silently becomes float32
+  DPL007 release-path-taint    — private column to host without bound+noise
+  DPL008 thread-escape         — unlocked pool-worker write to shared state
+  DPL009 commit-before-draw    — noise reachable before the journal commit
+  DPL010 donated-buffer-reuse  — donate_argnums operand read after the call
 
 Run: ``python -m pipelinedp_tpu.lint pipelinedp_tpu/`` (exits nonzero on
 new findings) — see LINT.md for the rule catalog with before/after
@@ -26,6 +32,8 @@ from pipelinedp_tpu.lint.engine import (
     Finding,
     LintResult,
     ModuleContext,
+    ProjectContext,
+    ProjectRule,
     Rule,
     default_rules,
     lint_paths,
@@ -37,6 +45,8 @@ __all__ = [
     "LintConfig",
     "LintResult",
     "ModuleContext",
+    "ProjectContext",
+    "ProjectRule",
     "Rule",
     "default_rules",
     "lint_paths",

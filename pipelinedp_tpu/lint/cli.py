@@ -5,6 +5,14 @@ findings, 2 = usage or internal error. The default baseline file,
 ``dplint-baseline.json`` in the current directory, is loaded when present;
 ``--write-baseline`` snapshots the current findings so existing debt can
 be ratcheted down without blocking CI.
+
+Pre-commit latency: ``--changed-only`` lints just the files git reports
+changed (worktree + index, against ``--diff-base`` when given), so the
+gate runs in seconds. CI integration: ``--format=sarif`` emits SARIF
+2.1.0 for inline annotations, ``--forbid-suppressions`` turns every
+suppressed finding into a reported one (the dpflow-strict gates), and
+the dpflow summary cache is controlled by ``--flow-cache`` /
+``--no-flow-cache`` (default ``./.dpflow-cache.json``).
 """
 
 from __future__ import annotations
@@ -12,20 +20,26 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
+import time
 from typing import List, Optional, Sequence
 
 from pipelinedp_tpu.lint import engine
 from pipelinedp_tpu.lint.config import DEFAULT_CONFIG
 
 DEFAULT_BASELINE = "dplint-baseline.json"
+DEFAULT_FLOW_CACHE = ".dpflow-cache.json"
+
+SARIF_SCHEMA_URI = ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
 
 
 def _build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="pipelinedp-tpu-lint",
-        description="AST-based privacy & JAX-correctness linter for "
-                    "pipelinedp_tpu (rules DPL001-DPL006).")
+        description="AST + dataflow privacy & JAX-correctness linter for "
+                    "pipelinedp_tpu (rules DPL001-DPL010).")
     parser.add_argument("paths", nargs="*",
                         help="files or directories to scan (default: "
                              "pipelinedp_tpu/ under the current directory)")
@@ -42,8 +56,23 @@ def _build_parser() -> argparse.ArgumentParser:
                              "(e.g. DPL001,DPL003)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
-    parser.add_argument("--format", choices=("text", "json"),
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
                         default="text", dest="fmt")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="lint only files git reports as changed "
+                             "(worktree + index) under the given paths")
+    parser.add_argument("--diff-base", default=None,
+                        help="with --changed-only: diff against this git "
+                             "rev (default: the working tree vs HEAD)")
+    parser.add_argument("--flow-cache", default=DEFAULT_FLOW_CACHE,
+                        help="dpflow per-file summary cache path "
+                             f"(default: ./{DEFAULT_FLOW_CACHE})")
+    parser.add_argument("--no-flow-cache", action="store_true",
+                        help="disable the dpflow summary cache")
+    parser.add_argument("--forbid-suppressions", action="store_true",
+                        help="report suppressed findings as findings "
+                             "(the strict gates for ops/finalize.py and "
+                             "runtime/)")
     parser.add_argument("--show-suppressed", action="store_true",
                         help="also print suppressed findings (informational)")
     parser.add_argument("-v", "--verbose", action="store_true",
@@ -64,6 +93,89 @@ def _select_rules(spec: Optional[str]) -> List[engine.Rule]:
             f"{', '.join(sorted(unknown))} (known: "
             f"{', '.join(sorted(by_id))})")
     return [by_id[rid] for rid in sorted(wanted)]
+
+
+def _changed_files(paths: Sequence[str],
+                   diff_base: Optional[str]) -> Optional[List[str]]:
+    """Changed .py files under ``paths`` per git, or None on git failure.
+
+    Worktree + index changes relative to HEAD by default; with
+    ``diff_base``, everything that differs from that rev (the pre-commit
+    / PR-gate shapes respectively). Untracked .py files count as changed.
+    """
+    cmds = [["git", "diff", "--name-only", "-z", diff_base or "HEAD"],
+            ["git", "ls-files", "--others", "--exclude-standard", "-z"]]
+    changed: List[str] = []
+    for cmd in cmds:
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=30, check=True).stdout
+        except (OSError, subprocess.SubprocessError):
+            return None
+        changed.extend(p for p in out.split("\0") if p.endswith(".py"))
+    roots = [os.path.normpath(os.path.abspath(p)) for p in paths]
+    selected = []
+    for rel in sorted(set(changed)):
+        abspath = os.path.normpath(os.path.abspath(rel))
+        if not os.path.exists(abspath):
+            continue  # deleted files have nothing to lint
+        for root in roots:
+            if abspath == root or abspath.startswith(root + os.sep):
+                selected.append(rel)
+                break
+    return selected
+
+
+def _sarif_payload(findings, rules) -> dict:
+    """SARIF 2.1.0 document for CI inline annotations."""
+    rule_ids = sorted({f.rule_id for f in findings} |
+                      {r.rule_id for r in rules})
+    by_id = {r.rule_id: r for r in rules}
+    sarif_rules = []
+    for rid in rule_ids:
+        rule = by_id.get(rid)
+        desc = (rule.description if rule is not None
+                else "dplint engine diagnostic")
+        name = rule.name if rule is not None else "engine"
+        entry = {
+            "id": rid,
+            "name": name,
+            "shortDescription": {"text": desc},
+        }
+        if rule is not None and rule.hint:
+            entry["help"] = {"text": rule.hint}
+        sarif_rules.append(entry)
+    rule_index = {e["id"]: i for i, e in enumerate(sarif_rules)}
+    results = [{
+        "ruleId": f.rule_id,
+        "ruleIndex": rule_index[f.rule_id],
+        "level": "error",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path,
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(f.line, 1),
+                           "startColumn": max(f.col, 1)},
+            },
+        }],
+    } for f in findings]
+    return {
+        "$schema": SARIF_SCHEMA_URI,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "pipelinedp-tpu-lint",
+                    "informationUri":
+                        "https://github.com/OpenMined/PipelineDP",
+                    "rules": sarif_rules,
+                },
+            },
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -88,8 +200,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(e, file=sys.stderr)
         return 2
 
-    result = engine.lint_paths(paths, config=DEFAULT_CONFIG, rules=rules)
+    if args.changed_only:
+        changed = _changed_files(paths, args.diff_base)
+        if changed is None:
+            print("pipelinedp-tpu-lint: --changed-only requires a git "
+                  "checkout (git diff failed)", file=sys.stderr)
+            return 2
+        if not changed:
+            print("pipelinedp-tpu-lint: no changed files under "
+                  f"{', '.join(paths)}", file=sys.stderr)
+            return 0
+        paths = changed
+
+    flow_cache = None if args.no_flow_cache else args.flow_cache
+    t0 = time.perf_counter()
+    result = engine.lint_paths(paths, config=DEFAULT_CONFIG, rules=rules,
+                               flow_cache_path=flow_cache)
+    elapsed = time.perf_counter() - t0
     findings = result.all_reportable
+    if args.forbid_suppressions and result.suppressed:
+        findings = sorted(
+            findings + result.suppressed,
+            key=lambda f: (f.path, f.line, f.col, f.rule_id))
 
     baseline_path = args.baseline or (
         DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None)
@@ -117,6 +249,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "col": f.col, "message": f.message, "hint": f.hint,
         } for f in findings]
         print(json.dumps(payload, indent=2))
+    elif args.fmt == "sarif":
+        print(json.dumps(_sarif_payload(findings, rules), indent=2))
     else:
         for f in findings:
             print(f.format(verbose=args.verbose))
@@ -124,7 +258,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             for f in result.suppressed:
                 print(f"[suppressed] {f.format()}")
         summary = (f"pipelinedp-tpu-lint: {len(findings)} new finding(s), "
-                   f"{len(result.suppressed)} suppressed")
+                   f"{len(result.suppressed)} suppressed "
+                   f"[{elapsed:.2f}s, flow cache "
+                   f"{result.flow_cache_hits} hit(s) / "
+                   f"{result.flow_cache_misses} miss(es)]")
         print(summary, file=sys.stderr)
 
     return 1 if findings else 0

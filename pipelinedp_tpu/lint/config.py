@@ -56,6 +56,30 @@ class LintConfig:
         "pipelinedp_tpu.lint.*",
     )
 
+    # DPL007 — the mechanism-primitive and host-encode layer dpflow
+    # trusts as *opaque*: handling raw private columns is these modules'
+    # job, their host materializations are mechanism-internal (never a
+    # release), and exposures must not propagate to callers. Everything
+    # else — the orchestration layer (jax_engine, dp_engine, runtime,
+    # backends, dataframes) — is analyzed.
+    release_taint_trusted: Tuple[str, ...] = (
+        "pipelinedp_tpu.noise_core",
+        "pipelinedp_tpu.ops.noise",
+        "pipelinedp_tpu.ops.selection",
+        "pipelinedp_tpu.ops.quantiles",
+        "pipelinedp_tpu.ops.columnar",
+        "pipelinedp_tpu.ops.encoding",
+        "pipelinedp_tpu.ops.wirecodec",
+        "pipelinedp_tpu.contribution_bounders",
+        "pipelinedp_tpu.partition_selection",
+        "pipelinedp_tpu.quantile_tree",
+        "pipelinedp_tpu.data_extractors",
+        "pipelinedp_tpu.native.*",
+        "pipelinedp_tpu.dataset_histograms.*",
+        "pipelinedp_tpu.analysis.*",
+        "pipelinedp_tpu.lint.*",
+    )
+
     @staticmethod
     def _matches(module: str, patterns: Sequence[str]) -> bool:
         return any(fnmatch.fnmatch(module, p) for p in patterns)
@@ -68,6 +92,9 @@ class LintConfig:
 
     def is_budget_literal_exempt(self, module: str) -> bool:
         return self._matches(module, self.budget_literal_exempt)
+
+    def is_release_taint_trusted(self, module: str) -> bool:
+        return self._matches(module, self.release_taint_trusted)
 
 
 DEFAULT_CONFIG = LintConfig()

@@ -4,9 +4,12 @@ Architecture: one AST parse per file, shared by every rule through a
 ``ModuleContext``; rules are stateless objects returning ``Finding``s.
 Three layers decide what the CLI ultimately reports:
 
-1. inline suppressions — ``# dplint: disable=DPL001  <justification>`` on
-   the offending line (or on a comment-only line directly above it), and
-   ``# dplint: disable-file=DPL004`` anywhere in the file;
+1. inline suppressions — ``# dplint: disable=DPL001 — <justification>``
+   on the offending line (or on a comment-only line directly above it),
+   and ``# dplint: disable-file=DPL004 — <justification>`` anywhere in
+   the file. The justification is mandatory: a bare directive still
+   suppresses its target but surfaces as a DPL000 finding, so unreviewed
+   silencing cannot land;
 2. the baseline — a JSON snapshot of accepted findings, matched by
    content fingerprint (rule id + file + normalized line text + occurrence
    index) so findings don't resurrect when unrelated lines shift;
@@ -32,6 +35,9 @@ _SUPPRESS_RE = re.compile(
     r"#\s*dplint:\s*(disable|disable-file)\s*=\s*"
     r"(all|DPL\d{3}(?:\s*,\s*DPL\d{3})*)", re.IGNORECASE)
 _COMMENT_ONLY_RE = re.compile(r"^\s*#")
+# What follows the directive must contain a word character to count as a
+# justification (separators like `—`, `-`, `:` alone do not).
+_JUSTIFIED_RE = re.compile(r"\w")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -87,6 +93,43 @@ class Rule(abc.ABC):
         ...
 
 
+@dataclasses.dataclass
+class ProjectContext:
+    """Everything a whole-program rule needs: every parsed module plus
+    the dpflow views (symbol table, call graph, fixed points)."""
+    modules: Dict[str, ModuleContext]  # keyed by repo-relative path
+    config: LintConfig
+    flow: object  # lint.flow.ProjectFlow (typed loosely: lazy import)
+
+    def relpath_of(self, module: str) -> str:
+        for relpath, ctx in self.modules.items():
+            if ctx.module == module:
+                return relpath
+        return module
+
+    def finding(self, rule: "Rule", module: str, line: int, col: int,
+                message: str) -> Finding:
+        return Finding(rule.rule_id, self.relpath_of(module), line, col,
+                       message, rule.hint)
+
+
+class ProjectRule(Rule):
+    """A rule that analyzes the whole scanned set at once (DPL007-010).
+
+    ``check`` is a no-op; the runner calls ``check_project`` after every
+    module has been parsed and summarized. Findings still carry a
+    (path, line) location, so inline suppressions and the baseline apply
+    exactly as they do to per-module rules.
+    """
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        return ()
+
+    @abc.abstractmethod
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        ...
+
+
 # ---------------------------------------------------------------------------
 # Suppressions
 # ---------------------------------------------------------------------------
@@ -98,12 +141,16 @@ class Suppressions:
     def __init__(self, lines: Sequence[str]):
         self.file_level: Set[str] = set()
         self.by_line: Dict[int, Set[str]] = {}
+        # Directives with no justification text: (line, directive codes).
+        self.unjustified: List[tuple] = []
         for i, line in enumerate(lines, start=1):
             m = _SUPPRESS_RE.search(line)
             if not m:
                 continue
             kind = m.group(1).lower()
             codes = {c.strip().upper() for c in m.group(2).split(",")}
+            if not _JUSTIFIED_RE.search(line[m.end():]):
+                self.unjustified.append((i, ",".join(sorted(codes))))
             if kind == "disable-file":
                 self.file_level |= codes
             else:
@@ -226,6 +273,8 @@ class LintResult:
     suppressed: List[Finding]
     parse_errors: List[Finding]
     lines_by_path: Dict[str, List[str]]
+    flow_cache_hits: int = 0
+    flow_cache_misses: int = 0
 
     @property
     def all_reportable(self) -> List[Finding]:
@@ -240,8 +289,14 @@ def default_rules() -> List[Rule]:
 def lint_paths(paths: Sequence[str],
                config: Optional[LintConfig] = None,
                rules: Optional[Sequence[Rule]] = None,
-               root: Optional[str] = None) -> LintResult:
-    """Runs every rule over every .py file under ``paths``."""
+               root: Optional[str] = None,
+               flow_cache_path: Optional[str] = None) -> LintResult:
+    """Runs every rule over every .py file under ``paths``.
+
+    ``flow_cache_path`` persists the dpflow per-file summaries keyed by
+    content digest (see lint/flow/cache.py); None keeps the flow layer
+    fully in-memory.
+    """
     config = config or DEFAULT_CONFIG
     rules = list(rules) if rules is not None else default_rules()
     root = os.path.abspath(root or os.getcwd())
@@ -249,6 +304,11 @@ def lint_paths(paths: Sequence[str],
     suppressed: List[Finding] = []
     parse_errors: List[Finding] = []
     lines_by_path: Dict[str, List[str]] = {}
+    module_ctxs: Dict[str, ModuleContext] = {}
+    digests: Dict[str, str] = {}
+    suppressions_by_path: Dict[str, Suppressions] = {}
+    module_rules = [r for r in rules if not isinstance(r, ProjectRule)]
+    project_rules = [r for r in rules if isinstance(r, ProjectRule)]
 
     for path in iter_python_files(paths):
         abspath = os.path.abspath(path)
@@ -274,10 +334,45 @@ def lint_paths(paths: Sequence[str],
                             lines=lines,
                             aliases=astutils.build_aliases(tree),
                             config=config)
+        module_ctxs[relpath] = ctx
+        digests[relpath] = hashlib.sha1(source.encode("utf-8")).hexdigest()
         suppressions = Suppressions(lines)
-        for rule in rules:
+        suppressions_by_path[relpath] = suppressions
+        for line, codes in suppressions.unjustified:
+            # Unsuppressible by design: the fix is writing the reason.
+            findings.append(Finding(
+                "DPL000", relpath, line, 1,
+                f"suppression of {codes} has no justification; append "
+                f"the reviewed reason after the directive"))
+        for rule in module_rules:
             for finding in rule.check(ctx):
                 if suppressions.is_suppressed(finding):
+                    suppressed.append(finding)
+                else:
+                    findings.append(finding)
+
+    flow_hits = flow_misses = 0
+    if project_rules and module_ctxs:
+        from pipelinedp_tpu.lint import flow as flow_lib
+
+        cache = flow_lib.FlowCache(flow_cache_path)
+        summaries = {}
+        for relpath, ctx in module_ctxs.items():
+            digest = digests[relpath]
+            summary = cache.get(relpath, digest)
+            if summary is None:
+                summary = flow_lib.extract_module(ctx.module, ctx.tree,
+                                                  ctx.aliases)
+                cache.put(relpath, digest, summary)
+            summaries[relpath] = summary
+        cache.save()
+        flow_hits, flow_misses = cache.hits, cache.misses
+        project = ProjectContext(modules=module_ctxs, config=config,
+                                 flow=flow_lib.ProjectFlow(summaries))
+        for rule in project_rules:
+            for finding in rule.check_project(project):
+                supp = suppressions_by_path.get(finding.path)
+                if supp is not None and supp.is_suppressed(finding):
                     suppressed.append(finding)
                 else:
                     findings.append(finding)
@@ -286,4 +381,6 @@ def lint_paths(paths: Sequence[str],
     findings.sort(key=key)
     suppressed.sort(key=key)
     parse_errors.sort(key=key)
-    return LintResult(findings, suppressed, parse_errors, lines_by_path)
+    return LintResult(findings, suppressed, parse_errors, lines_by_path,
+                      flow_cache_hits=flow_hits,
+                      flow_cache_misses=flow_misses)

@@ -1,0 +1,35 @@
+"""dpflow: interprocedural privacy-dataflow and concurrency analysis.
+
+The flow layer underneath dplint's whole-program rules (DPL007–DPL010):
+
+  summary.py  per-file extraction — call sites, taint flows, pool-worker
+              hazards, donate_argnums — a pure function of one file
+  cache.py    digest-keyed summary cache so warm runs skip extraction
+  graph.py    project symbol table, import-resolved call graph (method
+              resolution through project classes, __init__ re-exports,
+              import cycles), reachability + taint-exposure fixed points
+
+See LINT.md ("dpflow") for the analysis contracts and knobs.
+"""
+
+from pipelinedp_tpu.lint.flow.cache import (
+    DEFAULT_CACHE_PATH,
+    FlowCache,
+    source_digest,
+)
+from pipelinedp_tpu.lint.flow.graph import ProjectFlow
+from pipelinedp_tpu.lint.flow.summary import (
+    FunctionSummary,
+    ModuleSummary,
+    extract_module,
+)
+
+__all__ = [
+    "DEFAULT_CACHE_PATH",
+    "FlowCache",
+    "FunctionSummary",
+    "ModuleSummary",
+    "ProjectFlow",
+    "extract_module",
+    "source_digest",
+]

@@ -1,0 +1,78 @@
+"""dpflow per-file digest cache: warm analyzer runs skip extraction.
+
+The extraction walk (flow/summary.py) is a pure function of one file's
+source text, so its output is cached keyed by the file's content digest.
+A warm run over an unchanged tree loads every summary from the cache and
+pays only the cross-file resolution passes (flow/graph.py), which are
+cheap — that is what keeps the CI lint gate inside its wall-time budget
+as the tree grows.
+
+The cache is a single JSON file (default ``.dpflow-cache.json`` in the
+invocation directory; ``--flow-cache``/``--no-flow-cache`` on the CLI).
+It is safe to delete at any time and must NOT be committed — a stale or
+corrupt cache entry is ignored (digest mismatch or schema drift), never
+trusted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Optional
+
+from pipelinedp_tpu.lint.flow.summary import ModuleSummary
+
+CACHE_VERSION = 1
+DEFAULT_CACHE_PATH = ".dpflow-cache.json"
+
+
+def source_digest(source: str) -> str:
+    return hashlib.sha1(source.encode("utf-8")).hexdigest()
+
+
+class FlowCache:
+    """Digest-keyed summary store with hit/miss counters."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self.hits = 0
+        self.misses = 0
+        self._entries: Dict[str, dict] = {}
+        self._dirty = False
+        if path is not None and os.path.exists(path):
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    data = json.load(fh)
+                if data.get("version") == CACHE_VERSION:
+                    self._entries = dict(data.get("files", {}))
+            except (OSError, ValueError):
+                self._entries = {}  # corrupt cache: rebuild from scratch
+
+    def get(self, relpath: str, digest: str) -> Optional[ModuleSummary]:
+        entry = self._entries.get(relpath)
+        if entry is not None and entry.get("digest") == digest:
+            summary = ModuleSummary.from_json(entry.get("summary", {}))
+            if summary is not None:
+                self.hits += 1
+                return summary
+        self.misses += 1
+        return None
+
+    def put(self, relpath: str, digest: str,
+            summary: ModuleSummary) -> None:
+        self._entries[relpath] = {"digest": digest,
+                                  "summary": summary.to_json()}
+        self._dirty = True
+
+    def save(self) -> None:
+        if self.path is None or not self._dirty:
+            return
+        payload = {"version": CACHE_VERSION, "files": self._entries}
+        tmp = f"{self.path}.tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(payload, fh, sort_keys=True)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # a cache that cannot persist is a slow run, not an error

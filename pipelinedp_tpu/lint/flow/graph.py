@@ -1,0 +1,280 @@
+"""dpflow project graph: symbol resolution, call edges, fixed points.
+
+Consumes the per-file :class:`~pipelinedp_tpu.lint.flow.summary.ModuleSummary`
+objects (fresh or digest-cached) and builds the whole-program views the
+DPL007–DPL010 rules query:
+
+  * a project **symbol table**: every function/method qualname, classes
+    with their resolved base lists, and module import/re-export aliases —
+    so ``pipelinedp_tpu.ops.noise.add_noise`` resolves whether it was
+    imported directly, through ``from ... import`` renames, or via an
+    ``__init__`` re-export (import cycles are a non-issue: resolution runs
+    over the already-built index, not at import time);
+  * an import-resolved **call graph** with ``self.meth()`` resolved
+    through the defining class and its project bases (method resolution
+    through ``JaxDPEngine`` and friends);
+  * ``reaching(pattern)``: the set of functions whose transitive call
+    closure contains a target matching ``pattern`` — the "can this call
+    chain draw noise / bound contributions" queries;
+  * the DPL007 **exposure** fixed point: per function parameter, whether
+    a value entering with a given sanitization-flag set can reach a host
+    sink unsanitized through this function (monotone, cycle-safe).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, FrozenSet, List, Optional, Set, Tuple
+
+from pipelinedp_tpu.lint.flow import summary as summary_lib
+from pipelinedp_tpu.lint.flow.summary import (
+    ALL_FLAGS,
+    CallSite,
+    FunctionSummary,
+    ModuleSummary,
+    TaintFlow,
+)
+
+_SELF_RE = re.compile(r"^self:(?P<cls>\w+)\.(?P<rest>.+)$")
+
+
+class ProjectFlow:
+    """Whole-program view over a set of module summaries."""
+
+    def __init__(self, summaries: Dict[str, ModuleSummary]):
+        # module dotted name -> summary
+        self.modules: Dict[str, ModuleSummary] = {
+            s.module: s for s in summaries.values()}
+        # function qualname (module + in-module name) -> summary
+        self.functions: Dict[str, FunctionSummary] = {}
+        # function qualname -> module dotted name
+        self.function_module: Dict[str, str] = {}
+        for mod, msum in self.modules.items():
+            for name, fsum in msum.functions.items():
+                qual = f"{mod}.{name}"
+                self.functions[qual] = fsum
+                self.function_module[qual] = mod
+        self._edges: Dict[str, Tuple[str, ...]] = {}
+        self._reach_cache: Dict[str, FrozenSet[str]] = {}
+        self._resolve_cache: Dict[Tuple[str, str], Optional[str]] = {}
+
+    # -- symbol resolution --------------------------------------------------
+
+    def resolve(self, target: str, module: str) -> Optional[str]:
+        """Project function qualname for a call target, else None.
+
+        Handles full qualnames, ``__init__`` re-exports and assignment
+        aliases (followed with a cycle guard), classes (-> their
+        ``__init__``), and ``self:Cls.meth`` markers (method resolution
+        through the class and its project bases).
+        """
+        key = (target, module)
+        if key not in self._resolve_cache:
+            self._resolve_cache[key] = self._resolve(target, module, set())
+        return self._resolve_cache[key]
+
+    def _resolve(self, target: str, module: str,
+                 seen: Set[str]) -> Optional[str]:
+        if not target or target in seen:
+            return None
+        seen.add(target)
+        m = _SELF_RE.match(target)
+        if m:
+            return self._resolve_method(m.group("cls"), m.group("rest"),
+                                        module, seen)
+        if target in self.functions:
+            return target
+        # Split `pkg.mod.name` into a known module prefix + remainder.
+        parts = target.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:cut])
+            if mod not in self.modules:
+                continue
+            rest = ".".join(parts[cut:])
+            msum = self.modules[mod]
+            if rest in msum.functions:
+                return f"{mod}.{rest}"
+            head = parts[cut]
+            if head in msum.classes:
+                meth = ".".join(parts[cut + 1:]) or "__init__"
+                return self._resolve_method(head, meth, mod, seen)
+            if head in msum.aliases:
+                forwarded = msum.aliases[head]
+                tail = ".".join(parts[cut + 1:])
+                full = f"{forwarded}.{tail}" if tail else forwarded
+                return self._resolve(full, mod, seen)
+            return None
+        return None
+
+    def _resolve_method(self, cls: str, meth: str, module: str,
+                        seen: Set[str]) -> Optional[str]:
+        """`Cls.meth` through the class and its (project) bases."""
+        mod: Optional[str] = module
+        queue: List[Tuple[str, str]] = [(module, cls)]
+        visited: Set[Tuple[str, str]] = set()
+        while queue:
+            mod, cname = queue.pop(0)
+            if (mod, cname) in visited or mod not in self.modules:
+                continue
+            visited.add((mod, cname))
+            msum = self.modules[mod]
+            qual = f"{mod}.{cname}.{meth}"
+            if qual in self.functions:
+                return qual
+            for base in msum.classes.get(cname, ()):
+                resolved_base = self._resolve_class(base, mod)
+                if resolved_base is not None:
+                    queue.append(resolved_base)
+        return None
+
+    def _resolve_class(self, dotted: str,
+                       module: str) -> Optional[Tuple[str, str]]:
+        """(module, class) for a resolved base-class dotted name."""
+        parts = dotted.split(".")
+        for cut in range(len(parts), 0, -1):
+            mod = ".".join(parts[:cut])
+            if mod in self.modules:
+                rest = parts[cut:]
+                if len(rest) == 1 and rest[0] in self.modules[mod].classes:
+                    return (mod, rest[0])
+                if len(rest) == 1 and rest[0] in self.modules[mod].aliases:
+                    return self._resolve_class(
+                        self.modules[mod].aliases[rest[0]], mod)
+                return None
+        # Same-module bare class name.
+        if len(parts) == 1 and module in self.modules and \
+                parts[0] in self.modules[module].classes:
+            return (module, parts[0])
+        return None
+
+    # -- call graph ---------------------------------------------------------
+
+    def edges(self, qual: str) -> Tuple[str, ...]:
+        """Project callees of one function (resolved, deduped)."""
+        if qual not in self._edges:
+            fsum = self.functions.get(qual)
+            out: List[str] = []
+            if fsum is not None:
+                module = self.function_module[qual]
+                for call in fsum.calls:
+                    callee = self.resolve(call.target, module)
+                    if callee is not None and callee not in out:
+                        out.append(callee)
+            self._edges[qual] = tuple(out)
+        return self._edges[qual]
+
+    def reaching(self, pattern: str) -> FrozenSet[str]:
+        """Functions whose transitive call closure contains a call-site
+        target matching ``pattern`` (regex search over the raw resolved
+        target string, so external facts like ``jax.device_get`` match
+        without being project symbols)."""
+        if pattern in self._reach_cache:
+            return self._reach_cache[pattern]
+        rx = re.compile(pattern)
+        hits: Set[str] = set()
+        for qual, fsum in self.functions.items():
+            if any(rx.search(c.target) for c in fsum.calls):
+                hits.add(qual)
+        # Reverse propagation to callers, to a fixed point.
+        changed = True
+        while changed:
+            changed = False
+            for qual in self.functions:
+                if qual in hits:
+                    continue
+                if any(callee in hits for callee in self.edges(qual)):
+                    hits.add(qual)
+                    changed = True
+        result = frozenset(hits)
+        self._reach_cache[pattern] = result
+        return result
+
+    def direct_hits(self, qual: str, pattern: str) -> List[CallSite]:
+        """This function's own call sites matching ``pattern``."""
+        rx = re.compile(pattern)
+        fsum = self.functions.get(qual)
+        if fsum is None:
+            return []
+        return [c for c in fsum.calls if rx.search(c.target)]
+
+    # -- DPL010 support -----------------------------------------------------
+
+    def donating(self) -> Dict[str, Tuple[int, ...]]:
+        """qualname -> donated positional indices for every jit-donating
+        function/wrapper in the project."""
+        return {qual: fsum.donated
+                for qual, fsum in self.functions.items() if fsum.donated}
+
+    # -- DPL007 exposure fixed point -----------------------------------------
+
+    def exposure(self, trusted: Callable[[str], bool]
+                 ) -> Dict[Tuple[str, str, FrozenSet[str]], bool]:
+        """exposed[(func_qual, param, have_flags)] — can a value entering
+        ``param`` with ``have_flags`` already applied reach a host sink
+        without gaining the full {bound, noise} set?
+
+        ``trusted(module)`` marks modules whose internals are exempt
+        (the mechanism-primitive layer): their functions never expose.
+        Monotone fixed point from all-False, so call cycles converge.
+        """
+        flag_sets = [frozenset(), frozenset((summary_lib.FLAG_BOUND,)),
+                     frozenset((summary_lib.FLAG_NOISE,))]
+        exposed: Dict[Tuple[str, str, FrozenSet[str]], bool] = {}
+        for qual, fsum in self.functions.items():
+            for p in fsum.params:
+                for have in flag_sets:
+                    exposed[(qual, p, have)] = False
+
+        def flow_exposes(qual: str, module: str, flow: TaintFlow,
+                         have: FrozenSet[str]) -> bool:
+            combined = have | frozenset(flow.gained)
+            if combined == ALL_FLAGS:
+                return False
+            if flow.kind == "sink":
+                return True
+            callee = self.resolve(flow.detail, module)
+            if callee is None or trusted(self.function_module[callee]):
+                return False
+            csum = self.functions[callee]
+            if flow.arg_pos >= len(csum.params):
+                return False
+            cparam = csum.params[flow.arg_pos]
+            key = (callee, cparam, combined)
+            return exposed.get(key, False)
+
+        changed = True
+        while changed:
+            changed = False
+            for qual, fsum in self.functions.items():
+                module = self.function_module[qual]
+                if trusted(module):
+                    continue
+                for flow in fsum.flows:
+                    for have in flag_sets:
+                        key = (qual, flow.origin, have)
+                        if key not in exposed or exposed[key]:
+                            continue
+                        if flow_exposes(qual, module, flow, have):
+                            exposed[key] = True
+                            changed = True
+        self._flow_exposes = flow_exposes
+        return exposed
+
+    def root_exposures(self, trusted: Callable[[str], bool]
+                       ) -> List[Tuple[str, TaintFlow]]:
+        """(function qualname, flow) pairs where a private value that
+        *originates* in that function's parameters reaches a host sink
+        unsanitized — the DPL007 finding sites. A flow's ``gained``
+        already includes the origin parameter's base flags (e.g. ``accs``
+        parameters start contribution-bounded), so roots evaluate with no
+        extra incoming flags."""
+        self.exposure(trusted)
+        out: List[Tuple[str, TaintFlow]] = []
+        for qual, fsum in self.functions.items():
+            module = self.function_module[qual]
+            if trusted(module):
+                continue
+            for flow in fsum.flows:
+                if self._flow_exposes(qual, module, flow, frozenset()):
+                    out.append((qual, flow))
+        return out

@@ -1,0 +1,850 @@
+"""dpflow per-module extraction: one AST walk -> a serializable summary.
+
+The flow layer (LINT.md "dpflow") splits interprocedural analysis into a
+per-file *extraction* pass and a cross-file *resolution* pass
+(flow/graph.py). Everything extracted here is a pure function of one
+file's source text, which is what makes the digest cache (flow/cache.py)
+sound: a file whose content hash is unchanged contributes the identical
+summary, so warm runs skip the walk entirely.
+
+A :class:`ModuleSummary` carries, per function (including methods and
+nested ``<locals>`` functions):
+
+  * every call site with its alias-resolved dotted target — lexically
+    visible local/module functions resolve to their full project
+    qualname, ``self.x()`` inside a class resolves through the class when
+    it defines ``x`` and is left as a ``self:Cls.x`` marker for the
+    cross-module MRO walk otherwise;
+  * taint flows for DPL007: how values originating in private-column
+    parameters reach host-materialization sinks or project callees, and
+    which sanitization flags (contribution bounding / noise) the value
+    gained on the way;
+  * pool-worker hazards for DPL008: unguarded writes, from callables
+    handed to an executor/thread, to state shared with the enclosing
+    scope — decidable per file, so the summary stores finished hazards;
+  * donated-argument positions for DPL010 (``donate_argnums`` on a
+    ``jax.jit`` decorator or wrapper assignment).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from pipelinedp_tpu.lint import astutils
+
+SUMMARY_VERSION = 1
+
+# -- taint vocabulary (DPL007) ----------------------------------------------
+
+FLAG_BOUND = "bound"
+FLAG_NOISE = "noise"
+ALL_FLAGS = frozenset((FLAG_BOUND, FLAG_NOISE))
+
+# Parameters holding raw private columns (taint with no flags) and
+# bounded-but-unnoised aggregates (taint with FLAG_BOUND).
+RAW_PARAM_RE = re.compile(r"^(?:pid|pids|pk|pks|value|values|raw_values)$")
+BOUNDED_PARAM_RE = re.compile(r"^(?:accs|acc|accumulators|qhist)$")
+
+# Call targets that *sanitize*: passing a tainted value through one of
+# these (or through a project function that transitively reaches one)
+# adds the flag to the flowing value.
+BOUND_TARGET_RE = re.compile(
+    r"(?:^|\.)(?:bound_and_aggregate(?:_compact)?|bound_row_mask|"
+    r"bound_contributions)$|(?:^|\.)contribution_bounders\.")
+NOISE_TARGET_RE = re.compile(
+    r"(?:^|\.)noise_core\.(?:add_|sample_)|"
+    r"^pipelinedp_tpu\.ops\.noise\.|"
+    r"^jax\.random\.(?:laplace|normal)$")
+
+# Host-materialization sinks: a value leaving the device/accumulator
+# world for host python. ``.tolist()`` is matched structurally (method
+# call on a tainted expression).
+SINK_TARGETS = frozenset({"jax.device_get"})
+SINK_METHOD = "tolist"
+
+# Shape-preserving transforms: taint flows through unchanged.
+_PASSTHROUGH_RE = re.compile(r"^(?:numpy|jax\.numpy|jax\.lax)\.")
+_PASSTHROUGH_BUILTINS = frozenset({
+    "tuple", "list", "abs", "min", "max", "sum", "sorted", "reversed",
+    "zip", "enumerate", "float", "int",
+})
+
+# Release-randomness draws (DPL009): actual noise/selection sampling,
+# deliberately NOT the contribution-bounding samplers (jax.random inside
+# ops/columnar) — bounding randomness is pre-release and legitimately
+# precedes the journal commit.
+DRAW_TARGET_RE = re.compile(
+    r"(?:^|\.)noise_core\.(?:add_|sample_)|"
+    r"^pipelinedp_tpu\.ops\.noise\.|"
+    r"(?:^|\.)select_partitions$|(?:^|\.)select_vec$")
+
+# Journal-commit calls (DPL009 anchors).
+COMMIT_TARGET_RE = re.compile(r"(?:^|\.)_?commit(?:_release)?$")
+
+# Mutating container methods (DPL008 write detection).
+_MUTATORS = frozenset({
+    "append", "extend", "add", "update", "insert", "remove", "discard",
+    "pop", "popitem", "clear", "setdefault", "appendleft",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class CallSite:
+    """One call with its alias-resolved dotted target.
+
+    ``target`` forms: a full dotted name ("jax.device_get",
+    "pipelinedp_tpu.noise_core.add_laplace_noise_array"), a project
+    qualname when the callee is lexically visible in the same module,
+    a ``self:Cls.meth`` marker for unresolved method calls on self/cls,
+    or "" when the callee expression has no dotted name (e.g. a call on
+    a subscript).
+    """
+    target: str
+    line: int
+
+    def to_json(self) -> list:
+        return [self.target, self.line]
+
+    @staticmethod
+    def from_json(data: Sequence) -> "CallSite":
+        return CallSite(target=data[0], line=int(data[1]))
+
+
+@dataclasses.dataclass(frozen=True)
+class TaintFlow:
+    """One DPL007 flow event inside a function.
+
+    kind == "sink": a value originating in param ``origin`` reached the
+    host sink ``detail`` at ``line`` having gained ``gained`` flags.
+    kind == "call": the value was passed to project callee ``detail`` at
+    positional ``arg_pos`` — exposure depends on the callee's summary.
+    """
+    origin: str
+    gained: Tuple[str, ...]
+    kind: str
+    line: int
+    detail: str
+    arg_pos: int = -1
+
+    def to_json(self) -> list:
+        return [self.origin, list(self.gained), self.kind, self.line,
+                self.detail, self.arg_pos]
+
+    @staticmethod
+    def from_json(data: Sequence) -> "TaintFlow":
+        return TaintFlow(origin=data[0], gained=tuple(data[1]),
+                         kind=data[2], line=int(data[3]), detail=data[4],
+                         arg_pos=int(data[5]))
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolHazard:
+    """One DPL008 finding candidate — fully decided at extraction."""
+    line: int
+    col: int
+    worker: str  # worker callable name
+    name: str    # the captured variable written
+    write: str   # human-readable write description
+    shared_line: int  # where the enclosing scope touches the same name
+
+    def to_json(self) -> list:
+        return [self.line, self.col, self.worker, self.name, self.write,
+                self.shared_line]
+
+    @staticmethod
+    def from_json(data: Sequence) -> "PoolHazard":
+        return PoolHazard(line=int(data[0]), col=int(data[1]),
+                          worker=data[2], name=data[3], write=data[4],
+                          shared_line=int(data[5]))
+
+
+@dataclasses.dataclass
+class FunctionSummary:
+    name: str       # qualified within the module: "f", "Cls.meth",
+    #                 "outer.<locals>.inner"
+    line: int
+    params: Tuple[str, ...]
+    calls: Tuple[CallSite, ...]
+    flows: Tuple[TaintFlow, ...]
+    hazards: Tuple[PoolHazard, ...]
+    donated: Tuple[int, ...]  # donate_argnums positions, if jit-donating
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "line": self.line,
+            "params": list(self.params),
+            "calls": [c.to_json() for c in self.calls],
+            "flows": [f.to_json() for f in self.flows],
+            "hazards": [h.to_json() for h in self.hazards],
+            "donated": list(self.donated),
+        }
+
+    @staticmethod
+    def from_json(data: dict) -> "FunctionSummary":
+        return FunctionSummary(
+            name=data["name"],
+            line=int(data["line"]),
+            params=tuple(data["params"]),
+            calls=tuple(CallSite.from_json(c) for c in data["calls"]),
+            flows=tuple(TaintFlow.from_json(f) for f in data["flows"]),
+            hazards=tuple(PoolHazard.from_json(h) for h in data["hazards"]),
+            donated=tuple(int(i) for i in data["donated"]),
+        )
+
+
+@dataclasses.dataclass
+class ModuleSummary:
+    module: str
+    functions: Dict[str, FunctionSummary]  # keyed by in-module qualname
+    classes: Dict[str, Tuple[str, ...]]    # class name -> resolved bases
+    aliases: Dict[str, str]                # import/re-export aliases
+
+    def to_json(self) -> dict:
+        return {
+            "version": SUMMARY_VERSION,
+            "module": self.module,
+            "functions": {k: f.to_json()
+                          for k, f in self.functions.items()},
+            "classes": {k: list(v) for k, v in self.classes.items()},
+            "aliases": dict(self.aliases),
+        }
+
+    @staticmethod
+    def from_json(data: dict) -> Optional["ModuleSummary"]:
+        if data.get("version") != SUMMARY_VERSION:
+            return None
+        return ModuleSummary(
+            module=data["module"],
+            functions={k: FunctionSummary.from_json(f)
+                       for k, f in data["functions"].items()},
+            classes={k: tuple(v) for k, v in data["classes"].items()},
+            aliases=dict(data["aliases"]),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Extraction
+# ---------------------------------------------------------------------------
+
+
+def _donated_argnums(fn: ast.AST, aliases: Dict[str, str]) -> Tuple[int, ...]:
+    """donate_argnums positions from a jit decorator, else ()."""
+    for deco in getattr(fn, "decorator_list", ()):
+        nums = _donate_from_jit_call(deco, aliases)
+        if nums:
+            return nums
+    return ()
+
+
+def _donate_from_jit_call(node: ast.AST,
+                          aliases: Dict[str, str]) -> Tuple[int, ...]:
+    """donate_argnums out of `jax.jit(...)` / `functools.partial(jax.jit,
+    ...)` call expressions (decorators or wrapper assignments)."""
+    if not isinstance(node, ast.Call):
+        return ()
+    target = astutils.call_target(node, aliases)
+    is_jit = target == "jax.jit"
+    if target == "functools.partial" and node.args:
+        inner = astutils.resolve(node.args[0], aliases)
+        is_jit = inner == "jax.jit"
+    if not is_jit:
+        return ()
+    for kw in node.keywords:
+        if kw.arg == "donate_argnums":
+            value = kw.value
+            if isinstance(value, (ast.Tuple, ast.List)):
+                elts = value.elts
+            else:
+                elts = [value]
+            nums = []
+            for e in elts:
+                n = astutils.literal_number(e)
+                if n is not None:
+                    nums.append(int(n))
+            return tuple(nums)
+    return ()
+
+
+class _Scope:
+    """Lexical function scope during extraction."""
+
+    def __init__(self, qual: str, node: ast.AST, parent: Optional["_Scope"],
+                 cls: Optional[str]):
+        self.qual = qual
+        self.node = node
+        self.parent = parent
+        self.cls = cls  # enclosing class name for methods
+        # name -> in-module qualname of lexically visible nested defs
+        self.local_defs: Dict[str, str] = {}
+
+
+class Extractor(ast.NodeVisitor):
+    """One-pass extraction of a ModuleSummary from a parsed module."""
+
+    def __init__(self, module: str, tree: ast.AST,
+                 aliases: Dict[str, str]):
+        self.module = module
+        self.tree = tree
+        self.aliases = dict(aliases)
+        self.functions: Dict[str, FunctionSummary] = {}
+        self.classes: Dict[str, Tuple[str, ...]] = {}
+        self._module_defs: Dict[str, str] = {}
+
+    def run(self) -> ModuleSummary:
+        self._collect_module_level()
+        scope = _Scope(qual="", node=self.tree, parent=None, cls=None)
+        scope.local_defs = dict(self._module_defs)
+        for node in ast.iter_child_nodes(self.tree):
+            self._walk_container(node, scope, cls=None)
+        return ModuleSummary(module=self.module, functions=self.functions,
+                             classes=self.classes, aliases=self.aliases)
+
+    # -- module-level symbol discovery --------------------------------------
+
+    def _collect_module_level(self) -> None:
+        for node in ast.iter_child_nodes(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._module_defs[node.name] = node.name
+            elif isinstance(node, ast.ClassDef):
+                bases = []
+                for b in node.bases:
+                    resolved = astutils.resolve(b, self.aliases)
+                    if resolved:
+                        bases.append(resolved)
+                self.classes[node.name] = tuple(bases)
+                for meth in ast.iter_child_nodes(node):
+                    if isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self._module_defs.setdefault(
+                            f"{node.name}.{meth.name}",
+                            f"{node.name}.{meth.name}")
+            elif isinstance(node, ast.Assign):
+                # Module-level re-export: `name = other.thing` /
+                # `name = thing` extends the alias map, and
+                # `name = jax.jit(f, donate_argnums=...)` registers a
+                # donating wrapper under `name`.
+                if len(node.targets) == 1 and isinstance(
+                        node.targets[0], ast.Name):
+                    target_name = node.targets[0].id
+                    resolved = astutils.resolve(node.value, self.aliases)
+                    if resolved is not None:
+                        self.aliases.setdefault(target_name, resolved)
+                    nums = _donate_from_jit_call(node.value, self.aliases)
+                    if nums and isinstance(node.value, ast.Call):
+                        wrapped = (node.value.args[0]
+                                   if node.value.args else None)
+                        self.functions[target_name] = FunctionSummary(
+                            name=target_name, line=node.lineno, params=(),
+                            calls=(CallSite(
+                                astutils.resolve(wrapped, self.aliases)
+                                or "", node.lineno),) if wrapped else (),
+                            flows=(), hazards=(), donated=nums)
+
+    # -- scope walking ------------------------------------------------------
+
+    def _walk_container(self, node: ast.AST, scope: _Scope,
+                        cls: Optional[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._extract_function(node, scope, cls)
+        elif isinstance(node, ast.ClassDef):
+            for child in ast.iter_child_nodes(node):
+                self._walk_container(child, scope, cls=node.name)
+
+    def _extract_function(self, fn, parent_scope: _Scope,
+                          cls: Optional[str]) -> None:
+        if cls and not parent_scope.qual:
+            qual = f"{cls}.{fn.name}"
+        elif parent_scope.qual:
+            qual = f"{parent_scope.qual}.<locals>.{fn.name}"
+        else:
+            qual = fn.name
+        scope = _Scope(qual=qual, node=fn, parent=parent_scope, cls=cls)
+        # Lexically visible defs: enclosing scopes first, then own nested.
+        visible = dict(parent_scope.local_defs)
+        for child in ast.iter_child_nodes(fn):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visible[child.name] = f"{qual}.<locals>.{child.name}"
+        scope.local_defs = visible
+
+        args = fn.args
+        params = tuple(a.arg for a in (list(args.posonlyargs) +
+                                       list(args.args) +
+                                       list(args.kwonlyargs)))
+        calls = self._collect_calls(fn, scope)
+        flows = _TaintWalker(self, scope).run(fn, params)
+        hazards = _find_pool_hazards(self, fn, scope)
+        self.functions[qual] = FunctionSummary(
+            name=qual, line=fn.lineno, params=params, calls=tuple(calls),
+            flows=tuple(flows), hazards=tuple(hazards),
+            donated=_donated_argnums(fn, self.aliases))
+        for child in ast.iter_child_nodes(fn):
+            self._walk_container(child, scope, cls=None)
+
+    # -- call resolution ----------------------------------------------------
+
+    def resolve_call(self, node: ast.Call, scope: _Scope) -> str:
+        """The dotted target of a call, module-locally resolved."""
+        dotted = astutils.dotted_name(node.func)
+        if dotted is None:
+            return ""
+        head, _, rest = dotted.partition(".")
+        if head in ("self", "cls") and scope.cls_context() is not None:
+            cls = scope.cls_context()
+            meth = rest.split(".")[0] if rest else ""
+            local = f"{cls}.{meth}"
+            if local in self._module_defs and not rest.partition(".")[2]:
+                return f"{self.module}.{local}"
+            return f"self:{cls}.{rest}" if rest else dotted
+        if not rest and dotted in scope.local_defs:
+            return f"{self.module}.{scope.local_defs[dotted]}"
+        resolved = astutils.resolve(node.func, self.aliases)
+        return resolved or dotted
+
+    def _collect_calls(self, fn, scope: _Scope) -> List[CallSite]:
+        calls: List[CallSite] = []
+        own_nested = {id(c) for c in ast.iter_child_nodes(fn)
+                      if isinstance(c, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))}
+
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue  # nested scopes summarized separately
+                if isinstance(child, ast.Call):
+                    calls.append(CallSite(self.resolve_call(child, scope),
+                                          child.lineno))
+                walk(child)
+
+        walk(fn)
+        return calls
+
+
+def _scope_cls(scope: _Scope) -> Optional[str]:
+    s = scope
+    while s is not None:
+        if s.cls is not None:
+            return s.cls
+        s = s.parent
+    return None
+
+
+_Scope.cls_context = _scope_cls
+
+
+def extract_module(module: str, tree: ast.AST,
+                   aliases: Dict[str, str]) -> ModuleSummary:
+    return Extractor(module, tree, aliases).run()
+
+
+def iter_scopes(module: str, tree: ast.AST, aliases: Dict[str, str]):
+    """Yields ``(qualname, function_node, scope, extractor)`` for every
+    function scope in a module, with the extractor's ``resolve_call``
+    usable against the yielded scope — the shared walk for analyses that
+    need the AST at analysis time (DPL010's path-sensitive pass)."""
+    ex = Extractor(module, tree, aliases)
+    ex._collect_module_level()
+    root = _Scope(qual="", node=tree, parent=None, cls=None)
+    root.local_defs = dict(ex._module_defs)
+    out = []
+
+    def walk(node, parent_scope, cls):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if cls and not parent_scope.qual:
+                qual = f"{cls}.{node.name}"
+            elif parent_scope.qual:
+                qual = f"{parent_scope.qual}.<locals>.{node.name}"
+            else:
+                qual = node.name
+            scope = _Scope(qual=qual, node=node, parent=parent_scope,
+                           cls=cls)
+            visible = dict(parent_scope.local_defs)
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    visible[child.name] = f"{qual}.<locals>.{child.name}"
+            scope.local_defs = visible
+            out.append((qual, node, scope, ex))
+            for child in ast.iter_child_nodes(node):
+                walk(child, scope, None)
+        elif isinstance(node, ast.ClassDef):
+            for child in ast.iter_child_nodes(node):
+                walk(child, parent_scope, node.name)
+        else:
+            for child in ast.iter_child_nodes(node):
+                walk(child, parent_scope, cls)
+
+    for child in ast.iter_child_nodes(tree):
+        walk(child, root, None)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DPL007 intraprocedural taint walk
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class _Taint:
+    origin: str
+    gained: frozenset
+
+
+class _TaintWalker:
+    """Forward walk of one function body tracking private-column taint.
+
+    Precision over recall, the dplint house stance: a value returned by
+    an unrecognized callee stops being tracked (no type inference), and
+    merges across branches keep only flags guaranteed on every tainted
+    path.
+    """
+
+    def __init__(self, extractor: Extractor, scope: _Scope):
+        self.ex = extractor
+        self.scope = scope
+        self.flows: List[TaintFlow] = []
+
+    def run(self, fn, params: Tuple[str, ...]) -> List[TaintFlow]:
+        state: Dict[str, _Taint] = {}
+        for p in params:
+            if RAW_PARAM_RE.match(p):
+                state[p] = _Taint(p, frozenset())
+            elif BOUNDED_PARAM_RE.match(p):
+                state[p] = _Taint(p, frozenset((FLAG_BOUND,)))
+        if state:
+            self._block(fn.body, state)
+        return self.flows
+
+    # -- statements ---------------------------------------------------------
+
+    def _block(self, stmts, state) -> None:
+        for stmt in stmts:
+            self._statement(stmt, state)
+
+    def _statement(self, stmt, state) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.Assign):
+            taint = self._expr(stmt.value, state)
+            for target in stmt.targets:
+                self._bind(target, taint, state)
+            return
+        if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            if getattr(stmt, "value", None) is not None:
+                taint = self._expr(stmt.value, state)
+                self._bind(stmt.target, taint, state)
+            return
+        if isinstance(stmt, ast.If):
+            self._expr(stmt.test, state)
+            states = []
+            for branch in (stmt.body, stmt.orelse):
+                bstate = dict(state)
+                self._block(branch, bstate)
+                states.append(bstate)
+            merged: Dict[str, _Taint] = {}
+            for name in set(states[0]) | set(states[1]):
+                taints = [s[name] for s in states if name in s]
+                gained = frozenset.intersection(
+                    *(t.gained for t in taints))
+                merged[name] = _Taint(taints[0].origin, gained)
+            state.clear()
+            state.update(merged)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter, state)
+            self._bind(stmt.target, None, state)
+            for _ in range(2):
+                self._block(stmt.body, state)
+            self._block(stmt.orelse, state)
+            return
+        if isinstance(stmt, ast.While):
+            self._expr(stmt.test, state)
+            for _ in range(2):
+                self._block(stmt.body, state)
+            self._block(stmt.orelse, state)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._expr(item.context_expr, state)
+            self._block(stmt.body, state)
+            return
+        if isinstance(stmt, ast.Try):
+            self._block(stmt.body, state)
+            for handler in stmt.handlers:
+                self._block(handler.body, dict(state))
+            self._block(stmt.orelse, state)
+            self._block(stmt.finalbody, state)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(child, state)
+
+    def _bind(self, target, taint: Optional[_Taint], state) -> None:
+        names: List[str] = []
+        if isinstance(target, ast.Name):
+            names = [target.id]
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            names = [e.id for e in target.elts if isinstance(e, ast.Name)]
+        for name in names:
+            if taint is None:
+                state.pop(name, None)
+            else:
+                state[name] = taint
+
+    # -- expressions --------------------------------------------------------
+
+    def _expr(self, node, state) -> Optional[_Taint]:
+        if node is None:
+            return None
+        if isinstance(node, ast.Name):
+            return state.get(node.id)
+        if isinstance(node, ast.Call):
+            return self._call(node, state)
+        if isinstance(node, ast.Attribute):
+            return self._expr(node.value, state)
+        if isinstance(node, ast.Subscript):
+            taint = self._expr(node.value, state)
+            self._expr(node.slice, state)
+            return taint
+        if isinstance(node, (ast.Lambda, ast.FunctionDef)):
+            return None
+        children = [self._expr(c, state)
+                    for c in ast.iter_child_nodes(node)
+                    if isinstance(c, ast.expr)]
+        return self._merge(children)
+
+    @staticmethod
+    def _merge(taints) -> Optional[_Taint]:
+        tainted = [t for t in taints if t is not None]
+        if not tainted:
+            return None
+        gained = frozenset.intersection(*(t.gained for t in tainted))
+        return _Taint(tainted[0].origin, gained)
+
+    def _call(self, node: ast.Call, state) -> Optional[_Taint]:
+        target = self.ex.resolve_call(node, self.scope)
+        arg_exprs = list(node.args) + [kw.value for kw in node.keywords]
+        # `.tolist()` on a tainted expression is a host sink regardless of
+        # what the receiver resolves to.
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == SINK_METHOD):
+            taint = self._expr(node.func.value, state)
+            if taint is not None and taint.gained != ALL_FLAGS:
+                self._sink(taint, node, ".tolist()")
+            return None
+        arg_taints = [self._expr(a, state) for a in arg_exprs]
+        if target in SINK_TARGETS:
+            for taint in arg_taints:
+                if taint is not None and taint.gained != ALL_FLAGS:
+                    self._sink(taint, node, target)
+            return None
+        merged = self._merge(arg_taints)
+        if BOUND_TARGET_RE.search(target):
+            if merged is None:
+                return None
+            return _Taint(merged.origin,
+                          merged.gained | frozenset((FLAG_BOUND,)))
+        if NOISE_TARGET_RE.search(target):
+            if merged is None:
+                return None
+            return _Taint(merged.origin,
+                          merged.gained | frozenset((FLAG_NOISE,)))
+        if (_PASSTHROUGH_RE.match(target)
+                or target in _PASSTHROUGH_BUILTINS):
+            return merged
+        # Project-resolvable callee: record per-argument pass-through
+        # flows; exposure is decided interprocedurally (flow/graph.py).
+        if target.startswith(f"{self.ex.module}.") or \
+                target.startswith("pipelinedp_tpu.") or \
+                target.startswith("self:") or \
+                target.startswith("tests."):
+            for pos, taint in enumerate(arg_taints[:len(node.args)]):
+                if taint is not None and taint.gained != ALL_FLAGS:
+                    self.flows.append(TaintFlow(
+                        origin=taint.origin,
+                        gained=tuple(sorted(taint.gained)),
+                        kind="call", line=node.lineno, detail=target,
+                        arg_pos=pos))
+        # Unknown result: stop tracking (no type inference).
+        return None
+
+    def _sink(self, taint: _Taint, node: ast.AST, sink: str) -> None:
+        self.flows.append(TaintFlow(
+            origin=taint.origin, gained=tuple(sorted(taint.gained)),
+            kind="sink", line=node.lineno, detail=sink))
+
+
+# ---------------------------------------------------------------------------
+# DPL008 pool-worker hazard detection
+# ---------------------------------------------------------------------------
+
+
+def _bound_names(fn) -> Set[str]:
+    """Names locally bound inside a function scope (params, assignments,
+    loop/with/except targets, comprehension targets, nested def names)."""
+    bound: Set[str] = set()
+    args = fn.args
+    for a in (list(args.posonlyargs) + list(args.args) +
+              list(args.kwonlyargs)):
+        bound.add(a.arg)
+    if args.vararg:
+        bound.add(args.vararg.arg)
+    if args.kwarg:
+        bound.add(args.kwarg.arg)
+
+    def collect_target(t):
+        # Only true bindings: `x.attr = ...` / `x[k] = ...` mutate an
+        # existing object and must NOT make `x` look locally bound.
+        if isinstance(t, ast.Name):
+            bound.add(t.id)
+        elif isinstance(t, ast.Starred):
+            collect_target(t.value)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                collect_target(e)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                collect_target(t)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            collect_target(node.target)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            collect_target(node.target)
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            collect_target(node.optional_vars)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            bound.add(node.name)
+        elif isinstance(node, ast.comprehension):
+            collect_target(node.target)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            bound.add(node.name)
+        elif isinstance(node, ast.Nonlocal):
+            bound.difference_update(node.names)  # shared, not local
+        elif isinstance(node, ast.Global):
+            bound.difference_update(node.names)
+    return bound
+
+
+_LOCKISH_RE = re.compile(r"lock", re.IGNORECASE)
+_HANDOFF_RE = re.compile(r"(?:^|\.)adopt_sinks$")
+
+
+def _guarded_lines(fn, aliases: Dict[str, str]) -> Set[int]:
+    """Line numbers inside `with <lock>:` / `with adopt_sinks(...):`
+    blocks of the worker body."""
+    guarded: Set[int] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            expr = item.context_expr
+            dotted = astutils.dotted_name(
+                expr.func if isinstance(expr, ast.Call) else expr)
+            if dotted and (_LOCKISH_RE.search(dotted)
+                           or _HANDOFF_RE.search(dotted)):
+                for sub in ast.walk(node):
+                    guarded.add(getattr(sub, "lineno", node.lineno))
+                break
+    return guarded
+
+
+def _worker_refs(fn, aliases: Dict[str, str]) -> Dict[str, int]:
+    """Names of callables handed to a pool/thread in this scope ->
+    submit-site line: `x.submit(f, ...)`, `x.map(f, ...)`,
+    `threading.Thread(target=f)`."""
+    refs: Dict[str, int] = {}
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        fn_expr = None
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("submit", "map") and node.args:
+            fn_expr = node.args[0]
+        elif astutils.call_target(node, aliases) == "threading.Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    fn_expr = kw.value
+        if isinstance(fn_expr, ast.Name):
+            refs.setdefault(fn_expr.id, node.lineno)
+    return refs
+
+
+def _find_pool_hazards(ex: Extractor, fn, scope: _Scope) -> List[PoolHazard]:
+    refs = _worker_refs(fn, ex.aliases)
+    if not refs:
+        return []
+    workers = {child.name: child for child in ast.iter_child_nodes(fn)
+               if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
+               and child.name in refs}
+    if not workers:
+        return []
+
+    # Names the enclosing scope touches outside the worker defs (the
+    # "other side" of a cross-thread conflict), with a representative line.
+    outside: Dict[str, int] = {}
+    worker_ids = {id(w) for w in workers.values()}
+
+    def scan_outside(node):
+        for child in ast.iter_child_nodes(node):
+            if id(child) in worker_ids:
+                continue
+            if isinstance(child, ast.Name):
+                outside.setdefault(child.id, child.lineno)
+            scan_outside(child)
+
+    scan_outside(fn)
+
+    hazards: List[PoolHazard] = []
+    for wname, worker in workers.items():
+        bound = _bound_names(worker)
+        guarded = _guarded_lines(worker, ex.aliases)
+        nonlocals: Set[str] = set()
+        for node in ast.walk(worker):
+            if isinstance(node, ast.Nonlocal):
+                nonlocals.update(node.names)
+
+        def free_base(expr) -> Optional[ast.Name]:
+            while isinstance(expr, (ast.Attribute, ast.Subscript)):
+                expr = expr.value
+            if isinstance(expr, ast.Name) and expr.id not in bound:
+                return expr
+            return None
+
+        def emit(node, base: ast.Name, write: str):
+            if node.lineno in guarded:
+                return
+            if base.id not in outside:
+                return
+            hazards.append(PoolHazard(
+                line=node.lineno, col=node.col_offset + 1, worker=wname,
+                name=base.id, write=write,
+                shared_line=outside[base.id]))
+
+        for node in ast.walk(worker):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)):
+                        base = free_base(t)
+                        if base is not None:
+                            kind = ("attribute"
+                                    if isinstance(t, ast.Attribute)
+                                    else "element")
+                            emit(node, base, f"{kind} write")
+                    elif isinstance(t, ast.Name) and t.id in nonlocals:
+                        emit(node, t, "nonlocal rebind")
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATORS:
+                base = free_base(node.func.value)
+                if base is not None:
+                    emit(node, base, f".{node.func.attr}() mutation")
+    return hazards

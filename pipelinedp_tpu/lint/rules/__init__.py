@@ -6,6 +6,12 @@ from pipelinedp_tpu.lint.rules.jit_hostility import JitHostilityRule
 from pipelinedp_tpu.lint.rules.insecure_rng import InsecureRngRule
 from pipelinedp_tpu.lint.rules.budget_literals import BudgetLiteralRule
 from pipelinedp_tpu.lint.rules.float64_guard import Float64GuardRule
+from pipelinedp_tpu.lint.rules.release_taint import ReleaseTaintRule
+from pipelinedp_tpu.lint.rules.thread_escape import ThreadEscapeRule
+from pipelinedp_tpu.lint.rules.commit_before_draw import (
+    CommitBeforeDrawRule,
+)
+from pipelinedp_tpu.lint.rules.donated_reuse import DonatedReuseRule
 
 ALL_RULES = (
     KeyReuseRule,
@@ -14,6 +20,10 @@ ALL_RULES = (
     InsecureRngRule,
     BudgetLiteralRule,
     Float64GuardRule,
+    ReleaseTaintRule,
+    ThreadEscapeRule,
+    CommitBeforeDrawRule,
+    DonatedReuseRule,
 )
 
 __all__ = [cls.__name__ for cls in ALL_RULES] + ["ALL_RULES"]

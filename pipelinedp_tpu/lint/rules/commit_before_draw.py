@@ -1,0 +1,79 @@
+"""DPL009 — commit-before-draw: release randomness before the journal.
+
+The at-most-once release contract (runtime/journal.py, RESILIENCE.md)
+only holds if the ``ReleaseJournal`` commit happens strictly *before*
+any release randomness is drawn: a crash between commit and publication
+then errs on the side of zero releases, never two correlated ones. A
+noise / selection draw that is reachable before the commit inverts the
+failure mode — a retried run can publish a second view of the data under
+one accounted budget before the journal ever refuses.
+
+For every function that commits (``*.commit`` / ``_commit_release``),
+dpflow checks that no call executing before the first commit can
+transitively reach a release-randomness draw (``noise_core.add_* /
+sample_*``, ``ops.noise``, ``select_partitions`` / ``select_vec`` —
+deliberately NOT the contribution-bounding samplers, whose pre-release
+randomness legitimately precedes the commit; key *derivation* via
+``KeyStream.derive`` / ``fold_in`` is pure and also exempt).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, List
+
+from pipelinedp_tpu.lint.engine import Finding, ProjectContext, ProjectRule
+from pipelinedp_tpu.lint.flow.summary import (
+    COMMIT_TARGET_RE,
+    DRAW_TARGET_RE,
+)
+
+
+class CommitBeforeDrawRule(ProjectRule):
+    rule_id = "DPL009"
+    name = "commit-before-draw"
+    description = ("A release-randomness draw is reachable before the "
+                   "ReleaseJournal commit in a release-producing entry "
+                   "point.")
+    hint = ("Commit the release token first — "
+            "`self._commit_release(key_counter)` before any call chain "
+            "that can reach a noise or selection draw; see "
+            "runtime/journal.py for why the ordering is the whole "
+            "at-most-once guarantee.")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        flow = project.flow
+        drawers = flow.reaching(DRAW_TARGET_RE.pattern)
+        draw_rx = re.compile(DRAW_TARGET_RE.pattern)
+        findings: List[Finding] = []
+        for qual, fsum in flow.functions.items():
+            commit_lines = [c.line for c in fsum.calls
+                            if COMMIT_TARGET_RE.search(c.target)]
+            if not commit_lines:
+                continue
+            first_commit = min(commit_lines)
+            module = flow.function_module[qual]
+            relpath = project.relpath_of(module)
+            func = qual[len(module) + 1:]
+            seen = set()
+            for call in fsum.calls:
+                if call.line >= first_commit:
+                    continue
+                resolved = flow.resolve(call.target, module)
+                direct = bool(draw_rx.search(call.target))
+                if not direct and resolved not in drawers:
+                    continue
+                if call.line in seen:
+                    continue
+                seen.add(call.line)
+                leaf = call.target.split(".")[-1]
+                how = ("draws release randomness"
+                       if direct else "can reach a release-randomness "
+                                      "draw")
+                findings.append(Finding(
+                    self.rule_id, relpath, call.line, 1,
+                    f"`{leaf}` {how} before the release-journal commit "
+                    f"at line {first_commit} of `{func}` — a retried run "
+                    f"could re-draw already-released noise",
+                    self.hint))
+        return findings

@@ -1,0 +1,227 @@
+"""DPL010 — donated-buffer reuse: reading an operand jit already ate.
+
+``donate_argnums`` hands the operand's device buffer to XLA: after the
+call — **including when the call raises mid-dispatch** — the Python name
+still binds the donated (now invalid or aliased) array. Reading it again
+double-counts a chunk or feeds poisoned accumulator state into a DP
+release; this is exactly the failure class the streaming loop's
+checkpoint-restore-on-dispatch-failure and the compact (never-donating)
+path were built around (ops/streaming.py, PR 5).
+
+dpflow resolves every call site against the project's donating jit
+wrappers (``@functools.partial(jax.jit, ..., donate_argnums=...)``
+decorators and ``name = jax.jit(f, donate_argnums=...)`` assignments,
+recorded in the per-file summaries) and then runs a path-sensitive walk
+of each function: after a donating call, its donated operand names are
+poisoned until rebound; a read on any path is a finding. Exception paths
+are first-class — a poison event anywhere in a ``try`` body is live in
+every handler and the ``finally`` block, because the raise can land
+between consumption and the rebinding assignment (``accs =
+step(..., accs, ...)`` is safe on the fallthrough path, poisoned in the
+handler).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from pipelinedp_tpu.lint.engine import Finding, ProjectContext, ProjectRule
+from pipelinedp_tpu.lint.flow import summary as summary_lib
+
+
+class DonatedReuseRule(ProjectRule):
+    rule_id = "DPL010"
+    name = "donated-buffer-reuse"
+    description = ("An operand donated to a jit call (donate_argnums) is "
+                   "read again on some path after the call, including "
+                   "exception paths.")
+    hint = ("Rebind the name from the call result (`accs = step(...,"
+            " accs, ...)`), restore from a checkpoint on the exception "
+            "path, or use the compact (non-donating) step when retries "
+            "must see intact state.")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        flow = project.flow
+        donating = flow.donating()
+        if not donating:
+            return []
+        findings: List[Finding] = []
+        for relpath, ctx in project.modules.items():
+            for qual, fn, scope, ex in summary_lib.iter_scopes(
+                    ctx.module, ctx.tree, ctx.aliases):
+                walker = _PoisonWalker(ex, scope, ctx.module, flow,
+                                       donating)
+                for name, call_line, read in walker.run(fn):
+                    findings.append(Finding(
+                        self.rule_id, relpath, read.lineno,
+                        read.col_offset + 1,
+                        f"`{name}` was donated to the jit call at line "
+                        f"{call_line} and is read again here — the "
+                        f"buffer is consumed even if that call raised",
+                        self.hint))
+        return findings
+
+
+def _terminates(stmts) -> bool:
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+class _PoisonWalker:
+    """Path-sensitive poison propagation for one function body."""
+
+    def __init__(self, extractor, scope, module: str, flow, donating):
+        self.ex = extractor
+        self.scope = scope
+        self.module = module
+        self.flow = flow
+        self.donating = donating
+        # (name, read line) dedupe across the loop double-pass.
+        self._seen: Set[Tuple[str, int]] = set()
+        self.findings: List[Tuple[str, int, ast.AST]] = []
+
+    def run(self, fn) -> List[Tuple[str, int, ast.AST]]:
+        state: Dict[str, int] = {}  # poisoned name -> donating call line
+        self._block(fn.body, state, events=None)
+        return self.findings
+
+    # -- statements ---------------------------------------------------------
+
+    def _block(self, stmts, state: Dict[str, int],
+               events: Optional[List[Tuple[str, int]]]) -> None:
+        for stmt in stmts:
+            self._statement(stmt, state, events)
+
+    def _statement(self, stmt, state, events) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # separate scope (closures analyzed on their own)
+        if isinstance(stmt, ast.Assign):
+            self._eval(stmt.value, state, events)
+            for t in stmt.targets:
+                self._kill(t, state)
+            return
+        if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            if getattr(stmt, "value", None) is not None:
+                self._eval(stmt.value, state, events)
+            if isinstance(stmt, ast.AugAssign):
+                # x += f(...) reads x as well.
+                self._read_names(stmt.target, state)
+            self._kill(stmt.target, state)
+            return
+        if isinstance(stmt, ast.If):
+            self._eval(stmt.test, state, events)
+            surviving = []
+            for branch in (stmt.body, stmt.orelse):
+                bstate = dict(state)
+                self._block(branch, bstate, events)
+                if not _terminates(branch):
+                    surviving.append(bstate)
+            if surviving:
+                state.clear()
+                for bstate in surviving:  # union: poisoned on any path
+                    state.update(bstate)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._eval(stmt.iter, state, events)
+            self._kill(stmt.target, state)
+            for _ in range(2):  # pass 2 catches loop-carried poison
+                self._block(stmt.body, state, events)
+            self._block(stmt.orelse, state, events)
+            return
+        if isinstance(stmt, ast.While):
+            self._eval(stmt.test, state, events)
+            for _ in range(2):
+                self._block(stmt.body, state, events)
+            self._block(stmt.orelse, state, events)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._eval(item.context_expr, state, events)
+                if item.optional_vars is not None:
+                    self._kill(item.optional_vars, state)
+            self._block(stmt.body, state, events)
+            return
+        if isinstance(stmt, ast.Try):
+            local_events: List[Tuple[str, int]] = []
+            body_state = dict(state)
+            self._block(stmt.body, body_state, local_events)
+            if events is not None:
+                events.extend(local_events)
+            # Handlers see every poison event of the try body: the raise
+            # can land between the donation and the rebinding kill.
+            handler_entry = dict(state)
+            for name, line in local_events:
+                handler_entry[name] = line
+            for handler in stmt.handlers:
+                self._block(handler.body, dict(handler_entry), events)
+            self._block(stmt.orelse, body_state, events)
+            final_state = dict(body_state)
+            final_state.update(handler_entry)
+            self._block(stmt.finalbody, final_state, events)
+            state.clear()
+            state.update(body_state)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._eval(child, state, events)
+
+    # -- expressions --------------------------------------------------------
+
+    def _eval(self, node, state, events) -> None:
+        """Reads flagged, then donations applied (a call's own operands
+        are read *by* the call legally; they poison only afterwards)."""
+        if node is None:
+            return
+        pending: List[Tuple[str, int]] = []
+        self._walk_expr(node, state, events, pending)
+        for name, line in pending:
+            state[name] = line
+            if events is not None:
+                events.append((name, line))
+
+    def _walk_expr(self, node, state, events, pending) -> None:
+        if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            return
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                self._flag(node, state)
+            return
+        if isinstance(node, ast.Call):
+            for child in ast.iter_child_nodes(node):
+                self._walk_expr(child, state, events, pending)
+            target = self.ex.resolve_call(node, self.scope)
+            resolved = self.flow.resolve(target, self.module)
+            indices = self.donating.get(resolved, ())
+            for idx in indices:
+                if idx < len(node.args) and isinstance(node.args[idx],
+                                                       ast.Name):
+                    pending.append((node.args[idx].id, node.lineno))
+            return
+        for child in ast.iter_child_nodes(node):
+            self._walk_expr(child, state, events, pending)
+
+    def _read_names(self, node, state) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name):
+                self._flag(sub, state)
+
+    def _flag(self, name_node: ast.Name, state) -> None:
+        line = state.get(name_node.id)
+        if line is None:
+            return
+        key = (name_node.id, name_node.lineno)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append((name_node.id, line, name_node))
+
+    @staticmethod
+    def _kill(target, state) -> None:
+        if isinstance(target, ast.Name):
+            state.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                _PoisonWalker._kill(e, state)

@@ -1,0 +1,64 @@
+"""DPL007 — release-path taint: private data reaching the host unnoised.
+
+The DP contract is that nothing derived from private input columns leaves
+the device/accumulator world until it has been contribution-**bounded**
+AND had a calibrated **noise** mechanism applied. A ``jax.device_get`` or
+``.tolist()`` of a value that skipped either step is a raw-statistic
+release — invisible to the budget accountant and unprotected by the
+mechanism, no matter how many layers of helper functions sit between the
+column and the sync.
+
+dpflow tracks values originating in private-column parameters (``pid`` /
+``pk`` / ``value`` raw; ``accs`` / ``qhist`` accumulators, which enter
+already bounded) through assignments, numpy/jnp transforms and project
+call chains (flow/summary.py + flow/graph.py), and flags any path that
+reaches a host-materialization sink while missing a sanitization flag.
+The mechanism-primitive layer (``LintConfig.release_taint_trusted``) is
+opaque-trusted: its internal host syncs are mechanism bookkeeping, not
+releases.
+
+Precision over recall, like every dplint rule: values returned by
+unrecognized callees stop being tracked rather than guessed at, so a
+DPL007 finding means a *demonstrable* unsanitized flow.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from pipelinedp_tpu.lint.engine import Finding, ProjectContext, ProjectRule
+from pipelinedp_tpu.lint.flow.summary import ALL_FLAGS
+
+
+class ReleaseTaintRule(ProjectRule):
+    rule_id = "DPL007"
+    name = "release-path-taint"
+    description = ("A private input column (or pre-noise accumulator) "
+                   "reaches host materialization without contribution "
+                   "bounding and a noise mechanism on the path.")
+    hint = ("Route the value through the bound-and-aggregate kernel and a "
+            "noise_core / ops.noise mechanism before any device_get / "
+            ".tolist(); if the host transfer is mechanism-internal by "
+            "design (e.g. the secure-host-noise epilogue), suppress with "
+            "a written justification.")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        flow = project.flow
+        trusted = project.config.is_release_taint_trusted
+        findings: List[Finding] = []
+        for qual, tf in flow.root_exposures(trusted):
+            module = flow.function_module[qual]
+            missing = sorted(ALL_FLAGS - set(tf.gained))
+            func = qual[len(module) + 1:]
+            if tf.kind == "sink":
+                what = f"is materialized to host by `{tf.detail}`"
+            else:
+                callee = tf.detail.split(".")[-1]
+                what = (f"is handed to `{callee}` which materializes it "
+                        f"to host")
+            findings.append(Finding(
+                self.rule_id, project.relpath_of(module), tf.line, 1,
+                f"private value `{tf.origin}` in `{func}` {what} without "
+                f"{' or '.join(missing)} applied on the path",
+                self.hint))
+        return findings

@@ -774,6 +774,7 @@ def _run_slab_loop(key, k, counts, n_uniq, fmt_desc, prepare_slab,
                 profiler.count_event(runtime_lib.EVENT_RESUMES)
 
     def save_checkpoint(next_chunk, accs, qhist):
+        # dplint: disable=DPL007 — checkpoint snapshot of pre-noise accumulators: never released, consumed only by fingerprint-validated resume (RESILIENCE.md)
         host_accs, host_q = jax.device_get((tuple(accs), qhist))
         cp = checkpoint_lib.StreamCheckpoint(
             run_id=cp_policy.run_id, next_chunk=next_chunk, n_chunks=k,

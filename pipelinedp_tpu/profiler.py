@@ -16,6 +16,28 @@ TensorBoard/Perfetto; the engine's stages show up as named trace spans via
 
 Annotations are no-ops when no trace is active, so they stay in the engine
 permanently.
+
+Thread-safety contract (PR 5's pool + lock machinery, checked by dplint
+DPL008): the engine's worker pools (slab prefetch, encode workers) call
+into this module from pool threads, so entry points are classified:
+
+  * **pool-safe**: ``stage()``, ``current_sinks()``, ``adopt_sinks()``,
+    ``count_event()``, ``event_count()``, ``event_counts()`` — sink
+    mutation funnels through ``_add_stage_time`` under ``_sink_lock``,
+    counters through ``_counter_lock``, and the sink *list* is
+    thread-local (``adopt_sinks`` installs the parent's collectors into
+    the worker's own ``_collect`` slot, never sharing the list object
+    across threads).
+  * **owning-thread only**: ``collect_stage_times()`` (registers the
+    sink dict on the calling thread; workers must join via
+    ``adopt_sinks(current_sinks())`` captured on the parent),
+    ``profile()`` / ``reset_events()`` (process-global trace/counter
+    state; call from the driver thread, not from workers).
+
+Set ``PIPELINEDP_TPU_DEBUG_LOCKS=1`` (validated via
+``native.loader.env_int``) to assert the sink lock is held around every
+sink mutation — a cheap canary for refactors that bypass
+``_add_stage_time``.
 """
 
 from __future__ import annotations
@@ -36,14 +58,32 @@ _collect = threading.local()
 _sink_lock = threading.Lock()
 
 
+# Debug-locks env knob: name kept here, validation delegated to the
+# shared loader.env_int helper (unset/empty -> off; junk raises).
+DEBUG_LOCKS_ENV = "PIPELINEDP_TPU_DEBUG_LOCKS"
+
+
+def _debug_locks() -> bool:
+    """Re-read per call so tests can flip the env between stages."""
+    from pipelinedp_tpu.native import loader
+    return bool(loader.env_int(DEBUG_LOCKS_ENV, 0, 0, 1))
+
+
 def current_sinks() -> list:
-    """This thread's active stage-time sinks (share with adopt_sinks)."""
+    """This thread's active stage-time sinks (share with adopt_sinks).
+    Pool-safe: returns a fresh list snapshot of thread-local state."""
     return list(getattr(_collect, "sinks", None) or ())
 
 
 def _add_stage_time(sinks, name: str, dt: float) -> None:
-    """Thread-safe accumulation of one stage timing into the sinks."""
+    """Thread-safe accumulation of one stage timing into the sinks —
+    the single place sink dicts are mutated; every caller (any thread)
+    goes through the lock acquired here."""
     with _sink_lock:
+        if _debug_locks():
+            assert _sink_lock.locked(), (
+                "sink mutation outside _sink_lock — a refactor bypassed "
+                "_add_stage_time's locking")
         for sink in sinks:
             sink[name] = sink.get(name, 0.0) + dt
 
@@ -52,7 +92,9 @@ def _add_stage_time(sinks, name: str, dt: float) -> None:
 def adopt_sinks(sinks) -> "Iterator[None]":
     """Installs a parent thread's collectors into this (worker) thread so
     its stage() timings merge into the parent's collect_stage_times()
-    dict. Restores the worker's previous sinks on exit; safe to nest."""
+    dict. Restores the worker's previous sinks on exit; safe to nest.
+    Pool-safe: the handoff half of the cross-thread protocol — capture
+    ``current_sinks()`` on the parent, enter this on the worker."""
     prev = getattr(_collect, "sinks", None)
     mine = list(prev or ())
     mine.extend(s for s in sinks if s not in mine)
@@ -71,7 +113,8 @@ _counters: Dict[str, int] = {}
 
 
 def count_event(name: str, n: int = 1) -> None:
-    """Increments a named global counter (e.g. one per jit trace)."""
+    """Increments a named global counter (e.g. one per jit trace).
+    Pool-safe: guarded by _counter_lock."""
     with _counter_lock:
         _counters[name] = _counters.get(name, 0) + n
 
@@ -142,6 +185,11 @@ def collect_stage_times() -> Iterator[Dict[str, float]]:
     only dispatches async device work (device_put, jitted kernels) is
     cheap here even when the device is busy long after; that asymmetry is
     exactly what the bench's overlap report keys off.
+
+    Owning-thread only: registers the sink on the *calling* thread's
+    collector list; pool workers join through
+    ``adopt_sinks(current_sinks())`` captured on this thread instead of
+    entering this context themselves.
     """
     sink: Dict[str, float] = {}
     sinks = getattr(_collect, "sinks", None)

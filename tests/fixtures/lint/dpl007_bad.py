@@ -1,0 +1,27 @@
+"""dplint fixture — DPL007 violations: private columns reach the host."""
+
+import jax
+import numpy as np
+
+from pipelinedp_tpu.ops import columnar
+
+
+def leak_raw_column(value):
+    # Raw private values synced to host: no bounding, no noise.
+    return jax.device_get(value)
+
+
+def _host_rows(values):
+    return values.tolist()
+
+
+def leak_via_helper(pid, n):
+    totals = np.bincount(pid, minlength=n)
+    return _host_rows(totals)
+
+
+def leak_bounded_only(key, pid, pk, value, n):
+    accs = columnar.bound_and_aggregate(key, pid, pk, value,
+                                        num_partitions=n)
+    # Bounded but un-noised aggregates are still a raw statistic.
+    return jax.device_get(accs)

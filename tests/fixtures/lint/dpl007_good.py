@@ -1,0 +1,23 @@
+"""dplint fixture — DPL007 clean: bounded + noised before the host sync.
+
+``spec`` is a resolved budget_accounting.MechanismSpec (the noise scale
+derives from the accountant, satisfying DPL002 as well).
+"""
+
+import jax
+import numpy as np
+
+from pipelinedp_tpu import noise_core
+from pipelinedp_tpu.ops import columnar
+
+
+def released_metrics(key, pid, pk, value, spec, n):
+    accs = columnar.bound_and_aggregate(key, pid, pk, value,
+                                        num_partitions=n)
+    noised = noise_core.add_laplace_noise_array(accs, 1.0 / spec.eps)
+    return jax.device_get(noised)
+
+
+def host_shape_only(value):
+    # Shape metadata never materializes the column itself.
+    return np.asarray(value).shape

@@ -1,0 +1,17 @@
+"""dplint fixture — DPL008 violations: unlocked pool-shared writes."""
+
+import concurrent.futures
+
+
+def racy_pipeline(stats, results, state):
+
+    def worker(i):
+        stats["chunks"] = stats.get("chunks", 0) + 1
+        results.append(i)
+        state.cursor = i
+
+    with concurrent.futures.ThreadPoolExecutor(2) as pool:
+        for i in range(4):
+            pool.submit(worker, i)
+    stats["total"] = len(results)
+    return state.cursor

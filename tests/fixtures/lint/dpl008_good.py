@@ -1,0 +1,25 @@
+"""dplint fixture — DPL008 clean: locked writes + adopt_sinks handoff."""
+
+import concurrent.futures
+import threading
+
+from pipelinedp_tpu import profiler
+
+
+def locked_pipeline(stats, results):
+    lock = threading.Lock()
+    parent_sinks = profiler.current_sinks()
+
+    def worker(i):
+        with profiler.adopt_sinks(parent_sinks):
+            payload = i * 2
+        with lock:
+            stats["chunks"] = stats.get("chunks", 0) + 1
+            results.append(payload)
+        return payload
+
+    with concurrent.futures.ThreadPoolExecutor(2) as pool:
+        futures = [pool.submit(worker, i) for i in range(4)]
+        done = [f.result() for f in futures]
+    stats["total"] = len(done)
+    return results

@@ -1,0 +1,24 @@
+"""dplint fixture — DPL009 violations: noise drawn before the commit.
+
+``spec`` is a resolved budget_accounting.MechanismSpec; the journal is a
+runtime.ReleaseJournal. The commit must precede every draw so a crash
+lands on the zero-release side (RESILIENCE.md).
+"""
+
+from pipelinedp_tpu import noise_core
+
+
+def release_after_draw(journal, token, totals, spec):
+    noised = noise_core.add_laplace_noise_array(totals, 1.0 / spec.eps)
+    journal.commit(token)
+    return noised
+
+
+def _draw(totals, spec):
+    return noise_core.add_gaussian_noise_array(totals, spec.std)
+
+
+def release_via_helper(journal, token, totals, spec):
+    noised = _draw(totals, spec)
+    journal.commit(token)
+    return noised

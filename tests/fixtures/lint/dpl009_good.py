@@ -1,0 +1,13 @@
+"""dplint fixture — DPL009 clean: the journal commit precedes the draw.
+
+``spec`` is a resolved budget_accounting.MechanismSpec; the journal is a
+runtime.ReleaseJournal.
+"""
+
+from pipelinedp_tpu import noise_core
+
+
+def release_with_commit_first(journal, token, totals, spec):
+    journal.commit(token)
+    noised = noise_core.add_laplace_noise_array(totals, 1.0 / spec.eps)
+    return noised

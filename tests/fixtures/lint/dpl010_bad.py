@@ -1,0 +1,34 @@
+"""dplint fixture — DPL010 violations: donated operands read again."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(accs, delta):
+    return accs + delta
+
+
+def double_count(accs, delta):
+    out = step(accs, delta)
+    # `accs` was donated into step: this read double-counts the buffer.
+    return out + accs
+
+
+def loop_without_rebind(accs, deltas):
+    out = None
+    for d in deltas:
+        out = step(accs, d)
+    return out
+
+
+def poisoned_exception_path(accs, delta):
+    try:
+        accs = step(accs, delta)
+    except RuntimeError:
+        # The raise can land after the donation consumed the buffer but
+        # before the rebinding assignment took effect.
+        return jnp.sum(accs)
+    return accs

@@ -1,0 +1,25 @@
+"""dplint fixture — DPL010 clean: rebind or restore, never reuse."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(accs, delta):
+    return accs + delta
+
+
+def rebind_each_step(accs, deltas):
+    for d in deltas:
+        accs = step(accs, d)
+    return accs
+
+
+def restore_on_failure(accs, delta, checkpoint):
+    try:
+        accs = step(accs, delta)
+    except RuntimeError:
+        accs = jnp.asarray(checkpoint)
+    return accs

@@ -27,6 +27,10 @@ MIN_BAD_FINDINGS = {
     "DPL004": 3,  # np.random x2, stdlib random
     "DPL005": 5,  # eps=-1, delta=1.5, eps=0, eps/2, 0.5*delta
     "DPL006": 1,
+    "DPL007": 3,  # raw sink, interprocedural sink, bounded-only sink
+    "DPL008": 3,  # element write, mutator call, attribute write
+    "DPL009": 2,  # direct draw before commit, draw via helper
+    "DPL010": 3,  # read after donate, loop carry, exception path
 }
 ALL_RULE_IDS = sorted(MIN_BAD_FINDINGS)
 
@@ -139,21 +143,43 @@ class TestSuppressions:
         assert len(result.suppressed) == 1
 
     def test_file_level_suppression(self, tmp_path):
-        src = "# dplint: disable-file=DPL005\n" + self.BAD
+        src = "# dplint: disable-file=DPL005 — fixture-wide\n" + self.BAD
         result = self._lint_file(tmp_path, src)
         assert result.findings == []
 
     def test_disable_all(self, tmp_path):
         src = ("def f(run):\n"
-               "    return run(eps=-1.0)  # dplint: disable=all\n")
+               "    return run(eps=-1.0)  # dplint: disable=all — test\n")
         result = self._lint_file(tmp_path, src)
         assert result.findings == []
 
     def test_wrong_rule_id_does_not_suppress(self, tmp_path):
         src = ("def f(run):\n"
-               "    return run(eps=-1.0)  # dplint: disable=DPL001\n")
+               "    return run(eps=-1.0)"
+               "  # dplint: disable=DPL001 — wrong id on purpose\n")
         result = self._lint_file(tmp_path, src)
         assert [f.rule_id for f in result.findings] == ["DPL005"]
+
+    def test_bare_suppression_becomes_dpl000(self, tmp_path):
+        # The directive still silences its target, but the missing
+        # justification surfaces as an unsuppressible DPL000 finding.
+        src = ("def f(run):\n"
+               "    return run(eps=-1.0)  # dplint: disable=DPL005\n")
+        result = self._lint_file(tmp_path, src)
+        assert [f.rule_id for f in result.findings] == ["DPL000"]
+        assert "justification" in result.findings[0].message
+        assert [f.rule_id for f in result.suppressed] == ["DPL005"]
+
+    def test_bare_file_level_suppression_flagged(self, tmp_path):
+        src = "# dplint: disable-file=DPL005\n" + self.BAD
+        result = self._lint_file(tmp_path, src)
+        assert [f.rule_id for f in result.findings] == ["DPL000"]
+
+    def test_separator_alone_is_not_a_justification(self, tmp_path):
+        src = ("def f(run):\n"
+               "    return run(eps=-1.0)  # dplint: disable=DPL005 —\n")
+        result = self._lint_file(tmp_path, src)
+        assert [f.rule_id for f in result.findings] == ["DPL000"]
 
 
 class TestBaseline:
@@ -239,6 +265,64 @@ class TestCli:
         payload = json.loads(capsys.readouterr().out)
         assert payload and payload[0]["rule"] == "DPL006"
         assert payload[0]["line"] > 0
+
+    def test_sarif_format(self, capsys):
+        """--format=sarif emits structurally valid SARIF 2.1.0: the
+        required top-level keys, a tool.driver with a rule catalog, and
+        results whose ruleIndex/locations resolve."""
+        import json
+        assert lint_main([fixture("dpl007_bad.py"), "--format", "sarif",
+                          "--no-baseline", "--no-flow-cache"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["version"] == "2.1.0"
+        assert "sarif-schema-2.1.0" in doc["$schema"]
+        run = doc["runs"][0]
+        driver = run["tool"]["driver"]
+        assert driver["name"] == "pipelinedp-tpu-lint"
+        rule_ids = [r["id"] for r in driver["rules"]]
+        assert "DPL007" in rule_ids
+        for rule in driver["rules"]:
+            assert rule["shortDescription"]["text"]
+        assert run["results"], "findings expected"
+        for res in run["results"]:
+            assert res["ruleId"] == driver["rules"][res["ruleIndex"]]["id"]
+            assert res["level"] == "error"
+            assert res["message"]["text"]
+            region = res["locations"][0]["physicalLocation"]["region"]
+            assert region["startLine"] >= 1
+            assert region["startColumn"] >= 1
+
+    def test_forbid_suppressions_reports_suppressed(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        (tmp_path / "mod.py").write_text(
+            "def f(run):\n"
+            "    return run(eps=-1.0)"
+            "  # dplint: disable=DPL005 — justified\n")
+        assert lint_main(["mod.py", "--no-baseline"]) == 0
+        assert lint_main(["mod.py", "--no-baseline",
+                          "--forbid-suppressions"]) == 1
+
+    def test_changed_only_clean_when_nothing_changed(self, tmp_path,
+                                                     monkeypatch, capsys):
+        import subprocess
+        monkeypatch.chdir(tmp_path)
+        (tmp_path / "mod.py").write_text(
+            "def f(run):\n    return run(eps=-1.0)\n")
+        env = dict(os.environ,
+                   GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+                   GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+        for cmd in (["git", "init", "-q"], ["git", "add", "."],
+                    ["git", "commit", "-qm", "seed"]):
+            subprocess.run(cmd, cwd=tmp_path, env=env, check=True,
+                           capture_output=True)
+        # Committed violation, nothing changed: the fast gate passes.
+        assert lint_main(["mod.py", "--changed-only"]) == 0
+        # Touch the file: the violation is now in the changed set.
+        (tmp_path / "mod.py").write_text(
+            "def f(run):\n    return run(eps=-1.0)  # touched\n")
+        assert lint_main(["mod.py", "--changed-only",
+                          "--no-baseline"]) == 1
 
     def test_module_entry_point_subprocess(self):
         """Acceptance: `python -m pipelinedp_tpu.lint` exits 0 on the

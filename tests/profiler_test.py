@@ -108,3 +108,30 @@ class TestThreadSafety:
             with profiler.stage("after_adopt"):
                 pass
         assert "after_adopt" in outer
+
+
+class TestDebugLocks:
+    """PIPELINEDP_TPU_DEBUG_LOCKS=1 asserts the sink lock around every
+    sink mutation (validated through native.loader.env_int)."""
+
+    def test_debug_locks_assertion_passes_on_locked_path(self, monkeypatch):
+        monkeypatch.setenv(profiler.DEBUG_LOCKS_ENV, "1")
+        with profiler.collect_stage_times() as sink:
+            with profiler.stage("debug_locks_stage"):
+                pass
+            profiler._add_stage_time(profiler.current_sinks(),
+                                     "direct", 0.5)
+        assert "debug_locks_stage" in sink
+        assert sink["direct"] == 0.5
+
+    def test_debug_locks_off_by_default(self, monkeypatch):
+        monkeypatch.delenv(profiler.DEBUG_LOCKS_ENV, raising=False)
+        assert profiler._debug_locks() is False
+
+    def test_debug_locks_env_is_validated(self, monkeypatch):
+        monkeypatch.setenv(profiler.DEBUG_LOCKS_ENV, "banana")
+        with pytest.raises(ValueError, match="DEBUG_LOCKS"):
+            profiler._debug_locks()
+        monkeypatch.setenv(profiler.DEBUG_LOCKS_ENV, "7")
+        with pytest.raises(ValueError, match=r"\[0, 1\]"):
+            profiler._debug_locks()
