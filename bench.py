@@ -199,13 +199,36 @@ def bench_e2e_steady(pid, pk, value, n_calls=4, secure_host_noise=True):
     }
 
 
-def bench_kernel(pid, pk, value) -> float:
-    """Fused device step on resident data (sustained throughput)."""
+def bench_kernel(pid, pk, value) -> dict:
+    """Fused device step on resident data (sustained throughput).
+
+    Three sort configurations of the same bounding kernel A/B the round-9
+    tentpole on resident columns:
+      * general — unsorted rows, 4-key/7-operand sort (the historical
+        kernel-resident row since round 1, kept for trajectory
+        continuity: this is the ~305k/s floor the tentpole targets);
+      * packed — rows pre-sorted by pid on host (untimed prep — the
+        streamed wire delivers this order for free), packed 3-key global
+        sort with the float32 value payload (the wire-ingest kernel of
+        rounds 6-8, segment_sort=False);
+      * tiled — the same packed keys over bucketed segment-local tiles
+        with the narrow value payload and int32 group accumulation (this
+        round's default, segment_sort="auto"). Bit-identical sampling to
+        packed.
+
+    Returns {partitions_per_sec (headline = tiled), *_partitions_per_sec
+    per config, sort: per-config columnar.sort_cost rows + reduction
+    ratios}; the modeled costs are also credited to the ops/sort_*
+    profiler counters exactly as the streaming drivers do per executed
+    chunk.
+    """
     import jax
     import jax.numpy as jnp
 
+    from pipelinedp_tpu import profiler
     from pipelinedp_tpu.ops import columnar, noise as noise_ops
     from pipelinedp_tpu.ops import selection as selection_ops
+    from pipelinedp_tpu.ops import wirecodec
     from pipelinedp_tpu import partition_selection as ps_lib
     from pipelinedp_tpu import noise_core
 
@@ -217,47 +240,189 @@ def bench_kernel(pid, pk, value) -> float:
     count_scale = L0_CAP * LINF_CAP / (EPS / 3)
     sum_scale = L0_CAP * LINF_CAP * 5.0 / (EPS / 3)
 
-    @jax.jit
-    def step(key, pid, pk, value):
-        valid = jnp.ones(N_ROWS, dtype=bool)
-        accs = columnar.bound_and_aggregate(
-            key, pid, pk, value, valid,
-            num_partitions=N_PARTITIONS,
-            linf_cap=LINF_CAP, l0_cap=L0_CAP,
-            row_clip_lo=0.0, row_clip_hi=5.0, middle=2.5,
-            group_clip_lo=-jnp.inf, group_clip_hi=jnp.inf,
-            need_norm=False, need_norm_sq=False, has_group_clip=False)
-        k_sel, k_c, k_s = jax.random.split(jax.random.fold_in(key, 1), 3)
-        keep, _ = selection_ops.select_partitions(k_sel, accs.pid_count, sp,
-                                                  accs.pid_count > 0)
-        dp_count = noise_ops.add_noise(
-            k_c, accs.count, False, count_scale,
-            noise_core.laplace_granularity(count_scale))
-        dp_sum = noise_ops.add_noise(
-            k_s, accs.sum, False, sum_scale,
-            noise_core.laplace_granularity(sum_scale))
-        return dp_count, dp_sum, keep
+    def make_step(**kernel_kwargs):
+        @jax.jit
+        def step(key, pid, pk, value):
+            valid = jnp.ones(N_ROWS, dtype=bool)
+            accs = columnar.bound_and_aggregate(
+                key, pid, pk, value, valid,
+                num_partitions=N_PARTITIONS,
+                linf_cap=LINF_CAP, l0_cap=L0_CAP,
+                row_clip_lo=0.0, row_clip_hi=5.0, middle=2.5,
+                group_clip_lo=-jnp.inf, group_clip_hi=jnp.inf,
+                need_norm=False, need_norm_sq=False, has_group_clip=False,
+                **kernel_kwargs)
+            k_sel, k_c, k_s = jax.random.split(jax.random.fold_in(key, 1),
+                                               3)
+            keep, _ = selection_ops.select_partitions(
+                k_sel, accs.pid_count, sp, accs.pid_count > 0)
+            dp_count = noise_ops.add_noise(
+                k_c, accs.count, False, count_scale,
+                noise_core.laplace_granularity(count_scale))
+            dp_sum = noise_ops.add_noise(
+                k_s, accs.sum, False, sum_scale,
+                noise_core.laplace_granularity(sum_scale))
+            return dp_count, dp_sum, keep
+
+        return step
 
     def force(x):
-        # device_get of a scalar reduction guarantees the computation ran to
-        # completion even on platforms where block_until_ready is lax.
+        # device_get of a scalar reduction guarantees the computation ran
+        # to completion even on platforms where block_until_ready is lax.
         return float(jax.device_get(jnp.sum(x[0]) + jnp.sum(x[1])))
 
-    key = jax.random.PRNGKey(0)
-    dpid = jax.device_put(pid)
-    dpk = jax.device_put(pk)
-    dvalue = jax.device_put(value)
-    jax.block_until_ready((dpid, dpk, dvalue))
+    def measure(step, columns, cost):
+        key = jax.random.PRNGKey(0)
+        dev = [jax.device_put(c) for c in columns]
+        jax.block_until_ready(dev)
+        force(step(jax.random.fold_in(key, 100), *dev))  # warmup/compile
+        times = []
+        for i in range(3):
+            t0 = time.perf_counter()
+            force(step(jax.random.fold_in(key, i), *dev))
+            times.append(time.perf_counter() - t0)
+            profiler.count_event(columnar.EVENT_SORT_ROWS, cost["rows"])
+            profiler.count_event(columnar.EVENT_SORT_TILES, cost["tiles"])
+            profiler.count_event(columnar.EVENT_SORT_BYTES,
+                                 cost["operand_bytes"])
+        return N_PARTITIONS / min(times)
 
-    # Warmup/compile.
-    force(step(jax.random.fold_in(key, 100), dpid, dpk, dvalue))
+    # Host prep for the pid-sorted configs (untimed: the streamed wire
+    # delivers pid-sorted buckets as a by-product of its host encode).
+    order = np.argsort(pid, kind="stable")
+    spid, spk, svalue = pid[order], pk[order], value[order]
+    per_pid = np.bincount(spid - spid.min())
+    max_run = int(per_pid.max())
+    max_segments = wirecodec.round_ucap(int((per_pid > 0).sum()))
+    tile_slack = -(-max_run // 8) * 8
+    tile_rows = 1 << max(10, (4 * max_run - 1).bit_length())
+    # Narrow value payload: star ratings 1..5 are their own plane index
+    # (lo=0, scale=1, 3 bits) — the same affine-grid contract the wire
+    # codec's VALUE_PLANES mode ships.
+    int_clip = columnar.int_accumulation_plan(0.0, 1.0, 3, 0.0, 5.0,
+                                              LINF_CAP)
 
-    times = []
-    for i in range(3):
-        t0 = time.perf_counter()
-        force(step(jax.random.fold_in(key, i), dpid, dpk, dvalue))
-        times.append(time.perf_counter() - t0)
-    return N_PARTITIONS / min(times)
+    sort_kw = dict(num_partitions=N_PARTITIONS, max_segments=max_segments,
+                   pid_sorted=True)
+    costs = {
+        "general": columnar.sort_cost(N_ROWS,
+                                      num_partitions=N_PARTITIONS),
+        "packed": columnar.sort_cost(N_ROWS, **sort_kw),
+        "tiled": columnar.sort_cost(N_ROWS, tile_rows=tile_rows,
+                                    tile_slack=tile_slack, value_bytes=1,
+                                    **sort_kw),
+    }
+    out = {"sort": {name: dict(c) for name, c in costs.items()}}
+    out["sort"]["tiled_vs_packed_operand_byte_reduction"] = round(
+        1.0 - costs["tiled"]["operand_bytes"]
+        / max(costs["packed"]["operand_bytes"], 1), 3)
+    out["sort"]["tiled_vs_general_operand_byte_reduction"] = round(
+        1.0 - costs["tiled"]["operand_bytes"]
+        / max(costs["general"]["operand_bytes"], 1), 3)
+
+    out["general_partitions_per_sec"] = round(
+        measure(make_step(), [pid, pk, value], costs["general"]), 1)
+    packed_kw = dict(pid_sorted=True, max_segments=max_segments)
+    out["packed_partitions_per_sec"] = round(
+        measure(make_step(**packed_kw), [spid, spk, svalue],
+                costs["packed"]), 1)
+    tiled_kw = dict(tile_rows=tile_rows, tile_slack=tile_slack,
+                    value_is_index=True, value_lo=0.0, value_scale=1.0,
+                    value_sort_bits=3, **packed_kw)
+    if int_clip is not None:
+        tiled_kw.update(int_accumulate=True, int_clip_lo=int_clip[0],
+                        int_clip_hi=int_clip[1])
+    out["partitions_per_sec"] = round(
+        measure(make_step(**tiled_kw),
+                [spid, spk, svalue.astype(np.int32)], costs["tiled"]), 1)
+    return out
+
+
+# VECTOR_SUM row (ROADMAP item 5): k=64 dense vectors are 64x the value
+# bytes per row, so the row count scales down to keep the resident
+# footprint near the scalar headline's; partitions scale with it so
+# density (rows per partition) matches the headline shape.
+VEC_ROWS = int(os.environ.get("BENCH_VECTOR_ROWS", 2_000_000))
+VEC_DIM = 64
+VEC_PARTITIONS = max(VEC_ROWS * N_PARTITIONS // N_ROWS, 1)
+
+# PERCENTILE row: the streamed quantile path holds a dense
+# [partitions, 16^4 leaves] histogram, so the partition count is bounded
+# by the device histogram budget (ops/quantiles.MAX_HISTOGRAM_ELEMENTS),
+# not by the scatter passes; rows stay above MIN_STREAM_ROWS so the row
+# masks ride the streamed (tiled-sort) kernels.
+PCT_ROWS = int(os.environ.get("BENCH_PCT_ROWS", 4_000_000))
+PCT_PARTITIONS = int(os.environ.get("BENCH_PCT_PARTITIONS", 2_000))
+
+
+def _engine_row(make_data, params, n_partitions, n_runs=2):
+    """Generic engine e2e row -> (partitions/sec, per-phase dict): the
+    same warmup + min-of-n + stage-collection protocol as bench_e2e, for
+    metrics beyond COUNT+SUM (VECTOR_SUM, PERCENTILE)."""
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import profiler
+
+    def run(seed):
+        with profiler.collect_stage_times() as stages:
+            t0 = time.perf_counter()
+            accountant = pdp.NaiveBudgetAccountant(EPS, DELTA)
+            engine = pdp.JaxDPEngine(accountant, seed=seed)
+            result = engine.aggregate(make_data(), params)
+            accountant.compute_budgets()
+            cols = result.to_columns()
+            assert int(np.asarray(cols["keep_mask"]).sum()) > 0
+            elapsed = time.perf_counter() - t0
+        return elapsed, dict(stages)
+
+    run(100)  # warmup/compile
+    results = [run(i) for i in range(n_runs)]
+    best_s, best_stages = min(results, key=lambda r: r[0])
+    return n_partitions / best_s, _coarse_phases(best_stages, best_s)
+
+
+def bench_vector_sum(n_runs=2):
+    """VECTOR_SUM (k=64) through the full engine path."""
+    import pipelinedp_tpu as pdp
+
+    rng = np.random.default_rng(3)
+    pk = np.minimum((VEC_PARTITIONS * rng.random(VEC_ROWS)**4).astype(
+        np.int32), VEC_PARTITIONS - 1)
+    pid = rng.integers(0, max(VEC_ROWS // 10, 1), VEC_ROWS,
+                       dtype=np.int32)
+    vec = rng.integers(1, 6, (VEC_ROWS, VEC_DIM)).astype(np.float32)
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.VECTOR_SUM],
+        max_partitions_contributed=L0_CAP,
+        max_contributions_per_partition=LINF_CAP,
+        vector_size=VEC_DIM,
+        vector_max_norm=5.0,
+        vector_norm_kind=pdp.NormKind.Linf)
+    return _engine_row(
+        lambda: pdp.ColumnarData(pid=pid, pk=pk, value=vec), params,
+        VEC_PARTITIONS, n_runs=n_runs)
+
+
+def bench_percentile(n_runs=2):
+    """PERCENTILE(50)+PERCENTILE(90) through the streamed quantile path."""
+    import pipelinedp_tpu as pdp
+
+    rng = np.random.default_rng(4)
+    pk = np.minimum((PCT_PARTITIONS * rng.random(PCT_ROWS)**4).astype(
+        np.int32), PCT_PARTITIONS - 1)
+    pid = rng.integers(0, max(PCT_ROWS // 10, 1), PCT_ROWS,
+                       dtype=np.int32)
+    # Integer grid values: the wire ships affine plane indices, so the
+    # streamed row-mask kernel exercises the narrow tiled sort.
+    value = rng.integers(0, 101, PCT_ROWS).astype(np.float32)
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.PERCENTILE(50), pdp.Metrics.PERCENTILE(90)],
+        max_partitions_contributed=L0_CAP,
+        max_contributions_per_partition=LINF_CAP,
+        min_value=0.0,
+        max_value=100.0)
+    return _engine_row(
+        lambda: pdp.ColumnarData(pid=pid, pk=pk, value=value), params,
+        PCT_PARTITIONS, n_runs=n_runs)
 
 
 def bench_utility_sweep():
@@ -371,7 +536,8 @@ def main():
         steady["e2e_steady_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
         e2e_pps, e2e_phases = bench_e2e(pid, pk, value)
-        kernel_pps = bench_kernel(pid, pk, value)
+        kernel = bench_kernel(pid, pk, value)
+        kernel_pps = kernel["partitions_per_sec"]
     except Exception as e:  # noqa: BLE001 — report the failure, don't crash
         print(json.dumps({
             "metric": "DP-aggregated partitions/sec (COUNT+SUM, 1M keys)",
@@ -384,6 +550,18 @@ def main():
         }))
         sys.exit(0)
     extra = dict(steady)
+    try:
+        vec_pps, vec_phases = bench_vector_sum()
+        extra["vector_sum_k64_partitions_per_sec"] = round(vec_pps, 1)
+        extra["vector_sum_k64_phases"] = vec_phases
+    except Exception as e:  # noqa: BLE001
+        extra["vector_sum_k64_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        pct_pps, pct_phases = bench_percentile()
+        extra["percentile_partitions_per_sec"] = round(pct_pps, 1)
+        extra["percentile_phases"] = pct_phases
+    except Exception as e:  # noqa: BLE001
+        extra["percentile_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
         # De-confounding row (round-5 advisor): the same shape with
         # uniform CONTINUOUS values, which defeat the affine-integer plane
@@ -422,6 +600,11 @@ def main():
         "vs_baseline": round(e2e_pps / cpu_pps, 2),
         "kernel_partitions_per_sec": round(kernel_pps, 1),
         "kernel_vs_baseline": round(kernel_pps / cpu_pps, 2),
+        # Round-9 tentpole A/B on the kernel-resident row: general (the
+        # historical ~305k floor), packed (rounds 6-8 wire kernel), tiled
+        # (segment-local sort + narrow payload, the new default) — with
+        # the modeled ops/sort_* counters per configuration.
+        "kernel_sort": kernel,
         "cpu_baseline_partitions_per_sec": round(cpu_pps, 1),
         "e2e_phases": e2e_phases,
         # Encode/pipeline tuning in effect (README "Tuning knobs"):

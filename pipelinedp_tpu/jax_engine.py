@@ -42,6 +42,7 @@ from pipelinedp_tpu.data_extractors import DataExtractors
 from pipelinedp_tpu.ops import columnar, encoding, noise as noise_ops
 from pipelinedp_tpu.ops import finalize as finalize_ops
 from pipelinedp_tpu.ops import streaming
+from pipelinedp_tpu.ops import wirecodec
 from pipelinedp_tpu.ops import quantiles as quantile_ops
 from pipelinedp_tpu.ops import selection as selection_ops
 from pipelinedp_tpu import quantile_tree as quantile_tree_lib
@@ -267,6 +268,7 @@ class JaxDPEngine:
                  value_transfer_dtype=None,
                  transfer_encoding: str = "auto",
                  compact_merge="auto",
+                 segment_sort="auto",
                  fused_epilogue: bool = True,
                  epilogue_cache: Optional[finalize_ops.EpilogueCache] = None,
                  checkpoint_policy=None,
@@ -307,6 +309,18 @@ class JaxDPEngine:
         # regime where those passes dominate); True forces it; False
         # restores the legacy per-chunk scatters (the parity oracle).
         self._compact_merge = compact_merge
+        # Bucketed segment-local sort inside the streamed chunk kernels
+        # (ops/columnar tiled sampler; wirecodec.plan_segment_tiling):
+        # the packed 3-key bounding sort runs over fixed-width bucket
+        # tiles (span = tile width, not chunk rows) instead of the whole
+        # chunk, with tile slack sized from the wire's prep-time max
+        # single-pid run — together with the narrow-dtype sort payload
+        # and int32 group accumulation that ride with it. Released values
+        # are BIT-identical in every mode — the knob is pure kernel
+        # geometry. "auto" engages when the tile heuristic wins; True
+        # forces tiling whenever geometry permits; False restores the
+        # full round-8 kernel (the parity oracle).
+        self._segment_sort = segment_sort
         # Resilience knobs (pipelinedp_tpu/runtime/, RESILIENCE.md):
         #   checkpoint_policy: runtime.CheckpointPolicy — snapshot the
         #     streamed slab loop after each slab and auto-resume from the
@@ -994,6 +1008,10 @@ class JaxDPEngine:
         streamed_qhist = None
         norm_ord = {NormKind.Linf: 0, NormKind.L1: 1,
                     NormKind.L2: 2}[params.vector_norm_kind or NormKind.Linf]
+        vec_sorted_kw = {}
+        if is_vector:
+            pid, pk, value, vec_sorted_kw = self._presort_vector_rows(
+                pid, pk, value, n_rows, num_partitions, l1_cap)
         if self._mesh is not None:
             from pipelinedp_tpu.parallel import sharded
             if (not is_vector and not has_quantile and
@@ -1020,7 +1038,8 @@ class JaxDPEngine:
                     need_flags=need_flags,
                     has_group_clip=has_group_clip,
                     resilience=self._stream_resilience(key_counter),
-                    compact_merge=self._compact_merge)
+                    compact_merge=self._compact_merge,
+                    segment_sort=self._segment_sort)
             else:
                 # Stage (hash-shard + device_put) once; both the aggregate
                 # and the quantile-histogram kernels reuse the staged
@@ -1036,7 +1055,8 @@ class JaxDPEngine:
                         l0_cap=l0_cap,
                         max_norm=params.vector_max_norm,
                         norm_ord=norm_ord,
-                        l1_cap=l1_cap)
+                        l1_cap=l1_cap,
+                        **vec_sorted_kw)
                 else:
                     accs = sharded.bound_and_aggregate(
                         self._mesh, k_kernel, pid, pk, value, valid_rows,
@@ -1060,7 +1080,8 @@ class JaxDPEngine:
                 l0_cap=l0_cap,
                 max_norm=params.vector_max_norm,
                 norm_ord=norm_ord,
-                l1_cap=l1_cap)
+                l1_cap=l1_cap,
+                **vec_sorted_kw)
         elif (self._can_stream(has_quantile, num_partitions) and
               self._stream_chunks != 1 and
               (self._stream_chunks is not None or
@@ -1094,7 +1115,8 @@ class JaxDPEngine:
                 transfer_encoding=self._transfer_encoding,
                 quantile_spec=quantile_spec,
                 resilience=self._stream_resilience(key_counter),
-                compact_merge=self._compact_merge)
+                compact_merge=self._compact_merge,
+                segment_sort=self._segment_sort)
             if has_quantile:
                 accs, streamed_qhist = accs
         else:
@@ -1305,6 +1327,42 @@ class JaxDPEngine:
             fault_injector=self._fault_injector,
             checkpoint_policy=self._checkpoint_policy,
             key_counter=key_counter)
+
+    def _presort_vector_rows(self, pid, pk, value, n_rows: int,
+                             num_partitions: int, l1_cap):
+        """Host presort enabling the packed 3-key bounding sort on the
+        VECTOR_SUM path -> (pid, pk, value, kernel kwargs).
+
+        The vector path has no wire codec delivering pid-sorted rows for
+        free, so a stable host argsort buys the packed 4-operand sampler
+        sort (columnar.bound_and_aggregate_vector pid_sorted — vs the
+        general path's 7 operands). Same sampling distribution, different
+        draws than the unsorted kernel, so segment_sort=False restores
+        the legacy draw-for-draw behavior. On a mesh the stable shard
+        partition (shard_rows_by_pid) preserves in-shard order, so every
+        device's block stays pid-sorted; the global distinct-pid count
+        bounds each shard's segments. L1 mode keeps the general sampler
+        (the packed layout has no L1 pre-sample), as does a packed
+        layout that does not fit this shape (presorted_fits).
+        """
+        no_sort_kw: dict = {}
+        if (self._segment_sort is False or l1_cap is not None
+                or n_rows == 0 or isinstance(pid, jax.Array)):
+            return pid, pk, value, no_sort_kw
+        p_fit = num_partitions
+        if self._mesh is not None:
+            from pipelinedp_tpu.parallel import sharded
+            p_fit = sharded.padded_num_partitions(self._mesh,
+                                                  num_partitions)
+        pid = np.asarray(pid)
+        order = np.argsort(pid, kind="stable")
+        spid = pid[order]
+        distinct = 1 + int(np.count_nonzero(np.diff(spid)))
+        max_segments = wirecodec.round_ucap(distinct)
+        if not columnar.presorted_fits(n_rows, p_fit, max_segments):
+            return pid, pk, value, no_sort_kw
+        return (spid, np.asarray(pk)[order], np.asarray(value)[order],
+                dict(pid_sorted=True, max_segments=max_segments))
 
     def _can_stream(self, has_quantile: bool, num_partitions: int) -> bool:
         """PERCENTILE can ride the stream when the dense [partitions,

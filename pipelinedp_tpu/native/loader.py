@@ -192,7 +192,7 @@ def load() -> Optional[ctypes.CDLL]:
 def load_row_packer() -> Optional[ctypes.CDLL]:
     """The row bucketing/packing library; None on failure."""
     lib = _load_lib("row_packer", "pdp_row_packer_abi_version",
-                    abi_version=6)
+                    abi_version=7)
     if lib is not None and not getattr(lib, "_pdp_typed", False):
         fn = lib.pdp_set_encode_threads
         fn.restype = None

@@ -404,7 +404,10 @@ extern "C" {
 // idx = rint((value - v_lo) / v_scale), verified bit-exact against the
 // float32 reconstruction the device performs. stats[0] is set to 1 (and
 // nullptr returned) if any row fails verification or leaves [0, 2^20);
-// stats[1] returns the maximum index (for the bit-width of the planes).
+// stats[1] returns the maximum index (for the bit-width of the planes);
+// stats[2] returns the max rows of any single pid when the count table
+// was built (ABI 7; -1 otherwise) — it bounds every pid segment in every
+// bucket, sizing the tile slack of the kernel's segment-local sort.
 //
 // pid_span / n_entries: when n_entries is non-null and the shifted pid
 // span fits the count-table budget, n_entries[b] receives the EXACT
@@ -426,6 +429,7 @@ void* pdp_rle_prep(const int32_t* pid, const int32_t* pk, const float* value,
   }
   stats[0] = 0;
   stats[1] = 0;
+  stats[2] = -1;
   auto* st = new RleState();
   st->n = n;
   st->k = k;
@@ -453,14 +457,19 @@ void* pdp_rle_prep(const int32_t* pid, const int32_t* pk, const float* value,
   if (n_entries != nullptr) {
     if (count_entries) {
       for (int64_t b = 0; b < k; ++b) n_entries[b] = 0;
+      int64_t max_run = 0;
       for (int64_t s = 0; s <= pid_span; ++s) {
         const uint32_t c = pid_count[s];
         if (c) {
+          if (static_cast<int64_t>(c) > max_run) {
+            max_run = static_cast<int64_t>(c);
+          }
           n_entries[BucketOf(static_cast<int32_t>(s),
                              static_cast<uint32_t>(k))] +=
               (c + kRunSplit - 1) / kRunSplit;
         }
       }
+      stats[2] = max_run;
     } else {
       n_entries[0] = -1;
     }
@@ -650,6 +659,6 @@ int pdp_get_encode_threads() {
   return g_encode_threads.load(std::memory_order_relaxed);
 }
 
-int pdp_row_packer_abi_version() { return 6; }
+int pdp_row_packer_abi_version() { return 7; }
 
 }  // extern "C"

@@ -55,7 +55,8 @@ item of BASELINE.md's round-4 floor analysis.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional
+import math
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -218,15 +219,94 @@ def _sample_rows_and_groups(key: jax.Array, pid: jnp.ndarray,
 _MIN_RAND_BITS = 12
 _KEY_BITS = 96  # three uint32 sort keys
 
+# Profiler event counters for the bounding sorts (counted per EXECUTED
+# chunk kernel by the streaming drivers and by bench.py, from the static
+# sort_cost model below — the kernels themselves are jitted and cannot
+# count per execution):
+#   ops/sort_rows          rows entering the sampler sort (incl. tile pad)
+#   ops/sort_tiles         independently sorted tiles (1 for global sorts)
+#   ops/sort_operand_bytes modeled bytes the O(rows * log span) sort
+#                          network moves: rows * bytes_per_row * log2(span).
+#                          The tiled path shrinks it through the log factor
+#                          (span = tile width, not chunk rows) and the
+#                          narrowed value payload.
+EVENT_SORT_ROWS = "ops/sort_rows"
+EVENT_SORT_TILES = "ops/sort_tiles"
+EVENT_SORT_BYTES = "ops/sort_operand_bytes"
+
+
+def packed_key_layout(n: int, num_partitions: int,
+                      max_segments: Optional[int] = None
+                      ) -> Tuple[int, int, int, int]:
+    """(segbits, pkbits, randbits, padbits) of the packed 3-key layout.
+
+    The single source of truth shared by ``presorted_fits`` and the
+    packed/tiled samplers — they previously duplicated these formulas, so
+    a drift in one silently broke the fit check at the capacity edge.
+    randbits/padbits are only meaningful when the layout fits
+    (``presorted_fits``); randbits is clamped to [0, 32].
+    """
+    seg_cap = int(max_segments) if max_segments is not None else int(n)
+    segbits = max(1, seg_cap.bit_length())
+    pkbits = max(1, int(max(num_partitions - 1, 0)).bit_length())
+    randbits = min(32, max(0, _KEY_BITS - segbits - 32 - pkbits))
+    padbits = max(0, _KEY_BITS - segbits - 32 - pkbits - randbits)
+    return segbits, pkbits, randbits, padbits
+
 
 def presorted_fits(n: int, num_partitions: int,
                    max_segments: Optional[int] = None) -> bool:
     """Whether the packed 3-key presorted sort has enough bits for the
     (segment, ghash, pk, rand) fields at this shape."""
-    seg_cap = int(max_segments) if max_segments is not None else int(n)
-    segbits = max(1, seg_cap.bit_length())
-    pkbits = max(1, int(max(num_partitions - 1, 0)).bit_length())
-    return segbits + 32 + pkbits + _MIN_RAND_BITS <= _KEY_BITS
+    segbits, pkbits, randbits, _ = packed_key_layout(n, num_partitions,
+                                                     max_segments)
+    return randbits >= _MIN_RAND_BITS
+
+
+def sort_cost(n: int, *, num_partitions: int,
+              max_segments: Optional[int] = None, pid_sorted: bool = False,
+              tile_rows: int = 0, tile_slack: int = 0,
+              has_value: bool = True, value_bytes: int = 4,
+              need_order: bool = False, l1_mode: bool = False) -> dict:
+    """Static cost model of the sampler sort one kernel execution runs.
+
+    Mirrors _dispatch_sampler's trace-time dispatch exactly, so host
+    drivers can account the compiled kernel's sort without instrumenting
+    jitted code. Returns {kind, rows, span, tiles, bytes_per_row,
+    operand_bytes}: ``operand_bytes`` is the O(rows * log span) traffic
+    model ``rows * bytes_per_row * max(1, ceil(log2(span)))`` — the bytes
+    an O(N log N) sort network moves — credited to the profiler counters
+    EVENT_SORT_ROWS / EVENT_SORT_TILES / EVENT_SORT_BYTES per executed
+    chunk by the streaming drivers and bench.py.
+    """
+    if n <= 0:
+        return {"kind": "empty", "rows": 0, "span": 1, "tiles": 0,
+                "bytes_per_row": 0, "operand_bytes": 0}
+    packed = (pid_sorted and not l1_mode
+              and presorted_fits(n, num_partitions, max_segments))
+    if packed:
+        bpr = 12 + (value_bytes if has_value else 0) + (4 if need_order
+                                                        else 0)
+        if tile_rows and tile_rows + tile_slack < n:
+            w = tile_rows + tile_slack
+            tiles = -(-n // tile_rows)
+            rows = tiles * w
+            return {"kind": "tiled", "rows": rows, "span": w,
+                    "tiles": tiles, "bytes_per_row": bpr,
+                    "operand_bytes":
+                        rows * bpr * max(1, (w - 1).bit_length())}
+        return {"kind": "packed", "rows": n, "span": n, "tiles": 1,
+                "bytes_per_row": bpr,
+                "operand_bytes": n * bpr * max(1, (n - 1).bit_length())}
+    # General 4-key sort: pid/ghash/pk/tiebreak keys + valid payload
+    # (+ order, + value); max_contributions mode pays the L1 pre-sample
+    # lexsort (2 keys + the implicit iota payload) on top.
+    bpr = 17 + (4 if need_order else 0) + (value_bytes if has_value else 0)
+    cost = n * bpr * max(1, (n - 1).bit_length())
+    if l1_mode:
+        cost += n * 12 * max(1, (n - 1).bit_length())
+    return {"kind": "general", "rows": n, "span": n, "tiles": 1,
+            "bytes_per_row": bpr, "operand_bytes": cost}
 
 
 def _pack_key_bits(fields) -> list:
@@ -299,6 +379,66 @@ def _prefix_changed(keys, prefix_bits: int) -> jnp.ndarray:
     return jnp.concatenate([jnp.ones((1,), dtype=bool), changed])
 
 
+def _packed_sort_fields(key: jax.Array, pid: jnp.ndarray, pk: jnp.ndarray,
+                        valid: jnp.ndarray, *, num_partitions: int,
+                        max_segments: int):
+    """Shared key construction of the packed and tiled presorted samplers.
+
+    Returns (keys, is_new_pid, segbits, pkbits): the three uint32 sort
+    keys (padding rows already forced to all-ones, sorting strictly last)
+    and the pid-boundary mask the tiled path bins from. Both samplers MUST
+    derive their keys here — the tiled path's bit-parity contract is that
+    its key sequence (and therefore every downstream sampling decision) is
+    identical to the packed global sort's.
+    """
+    n = pid.shape[0]
+    k1, k2 = jax.random.split(key)
+    salt = jax.random.bits(k2, (), dtype=jnp.uint32)
+    ghash = _group_hash(pid, pk, salt)
+
+    segbits, pkbits, randbits, padbits = packed_key_layout(
+        n, num_partitions, max_segments)
+
+    is_new_pid = valid & jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), pid[1:] != pid[:-1]])
+    seg = jnp.maximum(jnp.cumsum(is_new_pid.astype(jnp.int32)) - 1,
+                      0).astype(jnp.uint32)
+    rand = jax.random.bits(k1, (n,), dtype=jnp.uint32)
+    if randbits < 32:
+        rand = rand >> jnp.uint32(32 - randbits)
+    fields = [(seg, segbits), (ghash, 32),
+              (pk.astype(jnp.uint32), pkbits), (rand, randbits)]
+    if padbits:
+        fields.append((jnp.zeros((n,), dtype=jnp.uint32), padbits))
+    keys = _pack_key_bits(fields)
+    # Padding rows sort strictly last: all-ones keys, and a valid row's
+    # segment field is <= max_segments - 1 < 2^segbits - 1.
+    ones = jnp.uint32(0xFFFFFFFF)
+    keys = [jnp.where(valid, kk, ones) for kk in keys]
+    return keys, is_new_pid, segbits, pkbits
+
+
+def _sampled_from_packed(skeys, n: int, n_valid, segbits: int, pkbits: int,
+                         linf_cap, l0_cap, sval, order) -> SampledRows:
+    """Shared epilogue over packed-key-sorted rows: field extraction,
+    segment/group boundaries, Linf/L0 sampling. Validity is positional
+    (padding keys are all-ones, strictly above any valid key)."""
+    svalid = jnp.arange(n, dtype=jnp.int32) < n_valid
+    sseg = _extract_key_bits(skeys, 0, segbits).astype(jnp.int32)
+    spk = _extract_key_bits(skeys, segbits + 32, pkbits).astype(jnp.int32)
+
+    is_start = _prefix_changed(skeys, segbits + 32 + pkbits)
+    keep_row = svalid & (_segment_rank(is_start) < linf_cap)
+    group_id = (jnp.cumsum(is_start) - 1).astype(jnp.int32)
+    is_pid_start = _prefix_changed(skeys, segbits)
+    first_group_of_pid = jax.lax.cummax(
+        jnp.where(is_pid_start, group_id, 0))
+    group_rank = group_id - first_group_of_pid
+    keep_group_row = svalid & (group_rank < l0_cap)
+    return SampledRows(order, sseg, spk, svalid, is_start, group_id,
+                       keep_row, keep_group_row, sval)
+
+
 def _sample_rows_and_groups_presorted(key: jax.Array, pid: jnp.ndarray,
                                       pk: jnp.ndarray, valid: jnp.ndarray,
                                       linf_cap, l0_cap, *,
@@ -328,31 +468,9 @@ def _sample_rows_and_groups_presorted(key: jax.Array, pid: jnp.ndarray,
     use pid equality structure); order is None unless need_order.
     """
     n = pid.shape[0]
-    k1, k2 = jax.random.split(key)
-    salt = jax.random.bits(k2, (), dtype=jnp.uint32)
-    ghash = _group_hash(pid, pk, salt)
-
-    segbits = max(1, int(max_segments).bit_length())
-    pkbits = max(1, int(max(num_partitions - 1, 0)).bit_length())
-    randbits = min(32, _KEY_BITS - segbits - 32 - pkbits)
-    padbits = _KEY_BITS - segbits - 32 - pkbits - randbits
-
-    is_new_pid = valid & jnp.concatenate(
-        [jnp.ones((1,), dtype=bool), pid[1:] != pid[:-1]])
-    seg = jnp.maximum(jnp.cumsum(is_new_pid.astype(jnp.int32)) - 1,
-                      0).astype(jnp.uint32)
-    rand = jax.random.bits(k1, (n,), dtype=jnp.uint32)
-    if randbits < 32:
-        rand = rand >> jnp.uint32(32 - randbits)
-    fields = [(seg, segbits), (ghash, 32),
-              (pk.astype(jnp.uint32), pkbits), (rand, randbits)]
-    if padbits:
-        fields.append((jnp.zeros((n,), dtype=jnp.uint32), padbits))
-    keys = _pack_key_bits(fields)
-    # Padding rows sort strictly last: all-ones keys, and a valid row's
-    # segment field is <= max_segments - 1 < 2^segbits - 1.
-    ones = jnp.uint32(0xFFFFFFFF)
-    keys = [jnp.where(valid, kk, ones) for kk in keys]
+    keys, _, segbits, pkbits = _packed_sort_fields(
+        key, pid, pk, valid, num_partitions=num_partitions,
+        max_segments=max_segments)
 
     operands = list(keys)
     if value is not None:
@@ -365,27 +483,234 @@ def _sample_rows_and_groups_presorted(key: jax.Array, pid: jnp.ndarray,
     order = sorted_ops[-1] if need_order else None
 
     n_valid = jnp.sum(valid.astype(jnp.int32))
-    svalid = jnp.arange(n, dtype=jnp.int32) < n_valid
-    sseg = _extract_key_bits(skeys, 0, segbits).astype(jnp.int32)
-    spk = _extract_key_bits(skeys, segbits + 32, pkbits).astype(jnp.int32)
+    return _sampled_from_packed(skeys, n, n_valid, segbits, pkbits,
+                                linf_cap, l0_cap, sval, order)
 
-    is_start = _prefix_changed(skeys, segbits + 32 + pkbits)
-    keep_row = svalid & (_segment_rank(is_start) < linf_cap)
-    group_id = (jnp.cumsum(is_start) - 1).astype(jnp.int32)
-    is_pid_start = _prefix_changed(skeys, segbits)
-    first_group_of_pid = jax.lax.cummax(
-        jnp.where(is_pid_start, group_id, 0))
-    group_rank = group_id - first_group_of_pid
-    keep_group_row = svalid & (group_rank < l0_cap)
-    return SampledRows(order, sseg, spk, svalid, is_start, group_id,
-                       keep_row, keep_group_row, sval)
+
+def _sample_rows_and_groups_tiled(key: jax.Array, pid: jnp.ndarray,
+                                  pk: jnp.ndarray, valid: jnp.ndarray,
+                                  linf_cap, l0_cap, *,
+                                  num_partitions: int,
+                                  max_segments: int,
+                                  tile_rows: int,
+                                  tile_slack: int,
+                                  value: Optional[jnp.ndarray] = None,
+                                  need_order: bool = False
+                                  ) -> SampledRows:
+    """Bucketed segment-local twin of _sample_rows_and_groups_presorted.
+
+    Same contract, same packed keys, BIT-IDENTICAL sampling decisions —
+    but the sort runs over fixed-width tiles instead of the whole chunk,
+    dropping sort cost from O(n log n) to O(n log B):
+
+      1. one-pass hash-bucket binning: each row's pid-segment START index
+         (a cummax over the pid boundaries, the same machinery as
+         _segment_rank) assigns the whole segment to tile
+         ``start // tile_rows`` — so no segment ever straddles a tile and
+         tile t's segments all precede tile t+1's;
+      2. rows gather into a [n_tiles, tile_rows + tile_slack] grid at slot
+         ``row - tile * tile_rows`` (injective; slack absorbs a segment
+         that begins near a tile's end — the caller guarantees no pid has
+         more than tile_slack rows, derived from the wire's prep-time
+         per-pid run counts), empty slots carrying all-ones keys;
+      3. ONE batched stable 3-key sort along the tile axis — slots are in
+         arrival order within each tile, so stable per-tile ties resolve
+         exactly like the global stable sort's;
+      4. tiles compact back to [n] by concatenating their valid prefixes
+         (per-tile valid counts -> offsets -> a near-sequential gather).
+
+    Equal keys never span tiles (equal seg => same segment => same tile),
+    and segments are tile-ordered, so the concatenation IS the globally
+    sorted sequence: identical keys, identical tie order, therefore
+    identical SampledRows bits to the packed global sort.
+
+    Contract violation backstop: if a segment exceeds tile_slack rows
+    (corrupt wire metadata — the drivers' prep-count guard fires first on
+    the native path), overflowing rows drop from the grid; the binned-row
+    count then disagrees with n_valid and every row is invalidated, so a
+    violated contract yields empty accumulators rather than a silently
+    re-sampled release.
+    """
+    n = pid.shape[0]
+    keys, is_new_pid, segbits, pkbits = _packed_sort_fields(
+        key, pid, pk, valid, num_partitions=num_partitions,
+        max_segments=max_segments)
+
+    b = int(tile_rows)
+    w = int(tile_rows + tile_slack)
+    t = -(-n // b)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    seg_start = jax.lax.cummax(jnp.where(is_new_pid, idx, 0))
+    tile_of = seg_start // jnp.int32(b)
+
+    # Grid gather: candidate source row of slot (tile, j) is
+    # tile * tile_rows + j; it belongs there iff its segment starts in
+    # this tile. Near-sequential reads (each row is probed by at most two
+    # tiles), no scatter.
+    src = (jnp.arange(t, dtype=jnp.int32)[:, None] * b
+           + jnp.arange(w, dtype=jnp.int32)[None, :])
+    srcc = jnp.minimum(src, n - 1)
+    slot_valid = ((src < n) & valid[srcc]
+                  & (tile_of[srcc]
+                     == jnp.arange(t, dtype=jnp.int32)[:, None]))
+    ones = jnp.uint32(0xFFFFFFFF)
+    operands = [jnp.where(slot_valid, kk[srcc], ones) for kk in keys]
+    if value is not None:
+        operands.append(
+            jnp.where(slot_valid, value[srcc],
+                      jnp.zeros((), dtype=value.dtype)))
+    if need_order:
+        operands.append(jnp.where(slot_valid, srcc, n - 1))
+    sorted_ops = jax.lax.sort(operands, dimension=1, num_keys=3,
+                              is_stable=True)
+
+    # Compaction: tile t's valid rows sorted to its prefix of length m[t];
+    # output row i lives at (tile t*, slot i - offset[t*]).
+    m = jnp.sum(slot_valid.astype(jnp.int32), axis=1)
+    cum = jnp.cumsum(m)
+    t_star = jnp.minimum(
+        jnp.searchsorted(cum, idx, side="right").astype(jnp.int32), t - 1)
+    j_star = idx - (cum[t_star] - m[t_star])
+    flat = jnp.clip(t_star * w + j_star, 0, t * w - 1)
+
+    n_valid = jnp.sum(valid.astype(jnp.int32))
+    # Contract backstop (docstring): dropped rows invalidate everything.
+    n_valid = jnp.where(cum[-1] == n_valid, n_valid, 0)
+    tail = idx >= n_valid
+    skeys = [jnp.where(tail, ones, op.reshape(-1)[flat])
+             for op in sorted_ops[:3]]
+    pos = 3
+    sval = None
+    if value is not None:
+        sval = jnp.where(tail, jnp.zeros((), dtype=value.dtype),
+                         sorted_ops[3].reshape(-1)[flat])
+        pos = 4
+    order = None
+    if need_order:
+        # Tail rows point at themselves: under the prefix-validity
+        # contract those are exactly the padding input rows, so the
+        # scatter-back in bound_row_mask never collides with a valid row.
+        order = jnp.where(tail, idx, sorted_ops[pos].reshape(-1)[flat])
+    return _sampled_from_packed(skeys, n, n_valid, segbits, pkbits,
+                                linf_cap, l0_cap, sval, order)
+
+
+def _dispatch_sampler(key, pid, pk, valid, linf_cap, l0_cap, l1_cap, *,
+                      num_partitions, max_segments, pid_sorted, tile_rows,
+                      tile_slack, value, need_order=False) -> SampledRows:
+    """Trace-time sampler dispatch shared by every bounding kernel.
+
+    pid_sorted/max_segments/tile_* are static and `l1_cap is None` is a
+    pytree-structure (not value) test — the branch is deliberately
+    resolved at trace time, like the need_* flags. All three samplers
+    produce the same sampling distribution; the tiled and packed presorted
+    samplers are additionally BIT-identical to each other.
+    """
+    n = pid.shape[0]
+    # dplint: disable=DPL003 — static/structural branch, resolved per compile
+    if (pid_sorted and l1_cap is None
+            and presorted_fits(n, num_partitions, max_segments)):
+        max_seg = int(max_segments) if max_segments else n
+        if tile_rows and tile_rows + tile_slack < n:
+            return _sample_rows_and_groups_tiled(
+                key, pid, pk, valid, linf_cap, l0_cap,
+                num_partitions=num_partitions, max_segments=max_seg,
+                tile_rows=tile_rows, tile_slack=tile_slack, value=value,
+                need_order=need_order)
+        return _sample_rows_and_groups_presorted(
+            key, pid, pk, valid, linf_cap, l0_cap,
+            num_partitions=num_partitions, max_segments=max_seg,
+            value=value, need_order=need_order)
+    return _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap,
+                                   l1_cap, value=value,
+                                   need_order=need_order)
+
+
+def _narrow_sort_value(value, value_is_index: bool, value_sort_bits: int):
+    """Value operand as it rides the sort: index payloads narrow to the
+    smallest dtype their plane count fits (uint8/uint16), halving or
+    quartering the payload bytes the sort moves."""
+    if not value_is_index or value is None or not value_sort_bits:
+        return value
+    if value_sort_bits <= 8:
+        return value.astype(jnp.uint8)
+    if value_sort_bits <= 16:
+        return value.astype(jnp.uint16)
+    return value
+
+
+def _widen_sorted_value(sval, value_is_index: bool, value_lo, value_scale):
+    """(float value column, int32 index column or None) post-sort.
+
+    The float expression mirrors wirecodec.decode_bucket's plane
+    reconstruction bit for bit, so moving the widening to after the sort
+    cannot change any released value.
+    """
+    if not value_is_index:
+        return sval, None
+    sval_i = sval.astype(jnp.int32)
+    sval_f = (jnp.float32(value_lo)
+              + sval_i.astype(jnp.float32) * jnp.float32(value_scale))
+    return sval_f, sval_i
+
+
+def int_accumulation_plan(plan_lo, plan_scale, plan_bits: int, row_clip_lo,
+                          row_clip_hi, linf_cap
+                          ) -> Optional[Tuple[int, int]]:
+    """(int-domain row clip bounds) when the group-stage count and sum
+    columns may accumulate in int32 BIT-IDENTICALLY to the float32 path,
+    else None.
+
+    Exactness argument: when the value grid (lo + idx * scale) and any
+    finite row clip bound are integers, AND |lo| + max_idx * |scale| <
+    2^24 (so the float32 reconstruction's intermediate product and sum
+    are themselves exactly representable integers — without this a
+    product >= 2^24 can round, e.g. lo=-16777215, scale=3, idx=5592407
+    reconstructs 5.0 in float32 but 6 in int32), every per-row clipped
+    value is the same exact integer in float32 AND int32; with at most
+    linf_cap kept rows per group and linf_cap * max|value| < 2^24, every
+    float32 partial sum of the legacy group segment-sum is an exactly
+    representable integer — so the int32 sums widen to the same float32
+    bits at the partition fold. Requires a concrete (host) linf_cap; a
+    traced cap cannot be bounded statically.
+    """
+    try:
+        linf = int(linf_cap)
+    except (TypeError, ValueError):
+        return None
+    lo, scale = float(plan_lo), float(plan_scale)
+    if linf < 1 or not lo.is_integer() or not scale.is_integer():
+        return None
+    max_idx = (1 << int(plan_bits)) - 1
+    if abs(lo) + max_idx * abs(scale) >= (1 << 24):
+        return None
+    bounds = [abs(lo), abs(lo + max_idx * scale)]
+    iclo, ichi = -(2**31) + 1, 2**31 - 1
+    for bound, is_lo in ((float(row_clip_lo), True),
+                        (float(row_clip_hi), False)):
+        if math.isfinite(bound):
+            if not bound.is_integer():
+                return None
+            bounds.append(abs(bound))
+            if is_lo:
+                iclo = int(bound)
+            else:
+                ichi = int(bound)
+        elif math.isnan(bound):
+            return None
+    if linf * max(bounds) >= (1 << 24):
+        return None
+    return iclo, ichi
 
 
 @functools.partial(jax.jit,
                    static_argnames=("num_partitions", "need_count",
                                     "need_sum", "need_norm",
                                     "need_norm_sq", "has_group_clip",
-                                    "pid_sorted", "max_segments"))
+                                    "pid_sorted", "max_segments",
+                                    "tile_rows", "tile_slack",
+                                    "value_is_index", "value_sort_bits",
+                                    "int_accumulate"))
 def bound_and_aggregate(key: jax.Array,
                         pid: jnp.ndarray,
                         pk: jnp.ndarray,
@@ -407,7 +732,16 @@ def bound_and_aggregate(key: jax.Array,
                         need_norm_sq: bool = True,
                         has_group_clip: bool = True,
                         pid_sorted: bool = False,
-                        max_segments: Optional[int] = None
+                        max_segments: Optional[int] = None,
+                        tile_rows: int = 0,
+                        tile_slack: int = 0,
+                        value_is_index: bool = False,
+                        value_lo=0.0,
+                        value_scale=1.0,
+                        value_sort_bits: int = 0,
+                        int_accumulate: bool = False,
+                        int_clip_lo=None,
+                        int_clip_hi=None
                         ) -> PartitionAccumulators:
     """Contribution bounding + per-partition aggregation, fully fused.
 
@@ -434,29 +768,37 @@ def bound_and_aggregate(key: jax.Array,
       max_segments: static upper bound on distinct pids among valid rows
         (presorted path only; tightens the packed segment field — the wire
         decode path passes its RLE entry capacity).
+      tile_rows/tile_slack: static tile geometry of the bucketed
+        segment-local sort (_sample_rows_and_groups_tiled); 0 keeps the
+        global packed sort. Requires pid_sorted and tile_slack >= the
+        longest single-pid run (the drivers derive it from the wire's
+        prep-time per-pid counts). Bit-identical sampling either way.
+      value_is_index: the value column arrives as the int32 affine plane
+        index of the wire codec (VALUE_PLANES); it rides the sort narrow
+        (value_sort_bits picks uint8/uint16 when the plane count fits)
+        and widens to float32 AFTER the sort with
+        value_lo + idx * value_scale — the exact decode expression, so
+        released values are unchanged.
+      int_accumulate: accumulate the group-stage count and sum columns in
+        int32, widening to float32 only at the partition fold. Only valid
+        under the int_accumulation_plan gate (host-verified exactness —
+        bit-identical to the float32 path); int_clip_lo/hi are the
+        int-domain row clip bounds the plan returned. Ignored without a
+        group stage.
     """
     n = pid.shape[0]
     if n == 0:
         # Same dtype contract as the non-empty path, which accumulates in
         # at least float32 regardless of the value dtype.
-        zeros = jnp.zeros((num_partitions,),
-                          dtype=jnp.promote_types(value.dtype, jnp.float32))
+        zeros = jnp.zeros((num_partitions,), dtype=jnp.float32)
         return PartitionAccumulators(zeros, zeros, zeros, zeros, zeros)
-    # Trace-time dispatch: pid_sorted/max_segments are static and
-    # `l1_cap is None` is a pytree-structure (not value) test — the branch
-    # is deliberately resolved at trace time, like the need_* flags.
-    # dplint: disable=DPL003 — static/structural branch, resolved per compile
-    if (pid_sorted and l1_cap is None
-            and presorted_fits(n, num_partitions, max_segments)):
-        s = _sample_rows_and_groups_presorted(
-            key, pid, pk, valid, linf_cap, l0_cap,
-            num_partitions=num_partitions,
-            max_segments=int(max_segments) if max_segments else n,
-            value=value)
-    else:
-        s = _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap,
-                                    l1_cap, value=value, need_order=False)
-    sval = s.sval
+    s = _dispatch_sampler(
+        key, pid, pk, valid, linf_cap, l0_cap, l1_cap,
+        num_partitions=num_partitions, max_segments=max_segments,
+        pid_sorted=pid_sorted, tile_rows=tile_rows, tile_slack=tile_slack,
+        value=_narrow_sort_value(value, value_is_index, value_sort_bits))
+    sval, sval_i = _widen_sorted_value(s.sval, value_is_index, value_lo,
+                                       value_scale)
 
     # -- rows -> (pid, pk) group accumulators ------------------------------
     # Separate scalar segment-sums over the sorted (monotone) group ids:
@@ -502,9 +844,23 @@ def bound_and_aggregate(key: jax.Array,
                              indices_are_sorted=True)
     # Each gated-off accumulator saves one full-HBM group pass and one
     # partition pass (the kernel is pass-count bound; module docstring).
-    g_count = gseg(w) if need_count else None
-    g_sum = (jnp.clip(gseg(vclip * w), group_clip_lo, group_clip_hi)
-             if need_sum else None)
+    if int_accumulate and sval_i is not None:
+        # Narrow-dtype group accumulation (gate: int_accumulation_plan).
+        # Counts and clipped sums are exact integers in both domains, so
+        # the int32 sums widen to the legacy float32 bits at the fold.
+        w_i = s.keep_row.astype(jnp.int32)
+        vclip_i = jnp.clip(
+            jnp.asarray(value_lo).astype(jnp.int32)
+            + sval_i * jnp.asarray(value_scale).astype(jnp.int32),
+            int_clip_lo, int_clip_hi)
+        g_count = gseg(w_i).astype(dtype) if need_count else None
+        g_sum = (jnp.clip(gseg(vclip_i * w_i).astype(dtype),
+                          group_clip_lo, group_clip_hi)
+                 if need_sum else None)
+    else:
+        g_count = gseg(w) if need_count else None
+        g_sum = (jnp.clip(gseg(vclip * w), group_clip_lo, group_clip_hi)
+                 if need_sum else None)
     g_norm = gseg(vnorm * w) if need_norm else None
     g_norm_sq = gseg(vnorm * vnorm * w) if need_norm_sq else None
     g_pk = _group_pk(s, num_partitions, gseg)
@@ -557,7 +913,10 @@ class CompactGroups(NamedTuple):
                    static_argnames=("num_partitions", "max_groups",
                                     "need_count", "need_sum", "need_norm",
                                     "need_norm_sq", "has_group_clip",
-                                    "pid_sorted", "max_segments"))
+                                    "pid_sorted", "max_segments",
+                                    "tile_rows", "tile_slack",
+                                    "value_is_index", "value_sort_bits",
+                                    "int_accumulate"))
 def bound_and_aggregate_compact(key: jax.Array,
                                 pid: jnp.ndarray,
                                 pk: jnp.ndarray,
@@ -580,7 +939,16 @@ def bound_and_aggregate_compact(key: jax.Array,
                                 need_norm_sq: bool = True,
                                 has_group_clip: bool = True,
                                 pid_sorted: bool = False,
-                                max_segments: Optional[int] = None
+                                max_segments: Optional[int] = None,
+                                tile_rows: int = 0,
+                                tile_slack: int = 0,
+                                value_is_index: bool = False,
+                                value_lo=0.0,
+                                value_scale=1.0,
+                                value_sort_bits: int = 0,
+                                int_accumulate: bool = False,
+                                int_clip_lo=None,
+                                int_clip_hi=None
                                 ) -> CompactGroups:
     """bound_and_aggregate that stops BEFORE the partition scatter.
 
@@ -599,20 +967,15 @@ def bound_and_aggregate_compact(key: jax.Array,
     association), unlike the has_group_clip=True mode which is bitwise.
     """
     n = pid.shape[0]
-    # Same trace-time dispatch as bound_and_aggregate (static flags +
-    # structural l1_cap test) so the sampling decisions replay bitwise.
-    # dplint: disable=DPL003 — static/structural branch, resolved per compile
-    if (pid_sorted and l1_cap is None
-            and presorted_fits(n, num_partitions, max_segments)):
-        s = _sample_rows_and_groups_presorted(
-            key, pid, pk, valid, linf_cap, l0_cap,
-            num_partitions=num_partitions,
-            max_segments=int(max_segments) if max_segments else n,
-            value=value)
-    else:
-        s = _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap,
-                                    l1_cap, value=value, need_order=False)
-    sval = s.sval
+    # Same trace-time sampler dispatch as bound_and_aggregate (shared
+    # _dispatch_sampler) so the sampling decisions replay bitwise.
+    s = _dispatch_sampler(
+        key, pid, pk, valid, linf_cap, l0_cap, l1_cap,
+        num_partitions=num_partitions, max_segments=max_segments,
+        pid_sorted=pid_sorted, tile_rows=tile_rows, tile_slack=tile_slack,
+        value=_narrow_sort_value(value, value_is_index, value_sort_bits))
+    sval, sval_i = _widen_sorted_value(s.sval, value_is_index, value_lo,
+                                       value_scale)
 
     dtype = jnp.promote_types(sval.dtype, jnp.float32)
     w = s.keep_row.astype(dtype)
@@ -624,13 +987,21 @@ def bound_and_aggregate_compact(key: jax.Array,
                              num_segments=n,
                              indices_are_sorted=True)
     zeros_n = jnp.zeros((n,), dtype=dtype)
-    g_count = gseg(w) if need_count else None
-    if need_sum:
-        g_sum = gseg(vclip * w)
-        if has_group_clip:
-            g_sum = jnp.clip(g_sum, group_clip_lo, group_clip_hi)
+    if int_accumulate and sval_i is not None:
+        # Same narrow-dtype group accumulation as bound_and_aggregate
+        # (gate: int_accumulation_plan; bit-identical widening).
+        w_i = s.keep_row.astype(jnp.int32)
+        vclip_i = jnp.clip(
+            jnp.asarray(value_lo).astype(jnp.int32)
+            + sval_i * jnp.asarray(value_scale).astype(jnp.int32),
+            int_clip_lo, int_clip_hi)
+        g_count = gseg(w_i).astype(dtype) if need_count else None
+        g_sum = gseg(vclip_i * w_i).astype(dtype) if need_sum else None
     else:
-        g_sum = None
+        g_count = gseg(w) if need_count else None
+        g_sum = gseg(vclip * w) if need_sum else None
+    if need_sum and has_group_clip:
+        g_sum = jnp.clip(g_sum, group_clip_lo, group_clip_hi)
     g_norm = gseg(vnorm * w) if need_norm else None
     g_norm_sq = gseg(vnorm * vnorm * w) if need_norm_sq else None
     g_pk = _group_pk(s, num_partitions, gseg)
@@ -743,7 +1114,8 @@ def _group_pk(s: SampledRows, num_partitions: int, gseg) -> jnp.ndarray:
     return gseg(jnp.where(s.svalid, s.spk, 0) * start_w_i)
 
 
-@functools.partial(jax.jit, static_argnames=("num_partitions", "norm_ord"))
+@functools.partial(jax.jit, static_argnames=("num_partitions", "norm_ord",
+                                             "pid_sorted", "max_segments"))
 def bound_and_aggregate_vector(key: jax.Array,
                                pid: jnp.ndarray,
                                pk: jnp.ndarray,
@@ -755,7 +1127,9 @@ def bound_and_aggregate_vector(key: jax.Array,
                                l0_cap,
                                max_norm,
                                norm_ord: int,
-                               l1_cap=None
+                               l1_cap=None,
+                               pid_sorted: bool = False,
+                               max_segments: Optional[int] = None
                                ) -> tuple[jnp.ndarray, PartitionAccumulators]:
     """VECTOR_SUM path: per-row norm clipping + the same two-stage sampling.
 
@@ -763,6 +1137,14 @@ def bound_and_aggregate_vector(key: jax.Array,
     L1/L2 norm scaling. Returns (vector_sums [num_partitions, D],
     scalar PartitionAccumulators) — the scalar accumulators ride along so
     callers never need a second pass over the rows.
+
+    pid_sorted: the presorted-ingest contract of
+    _sample_rows_and_groups_presorted holds (pid nondecreasing over a
+    valid prefix); the sampler then runs the packed 3-key sort shared
+    with the scalar path (_pack_key_bits layout) carrying only the row
+    order — 4 sort operands instead of the general path's 7; the [N, D]
+    vector payload is gathered once by the sorted order either way. Same
+    sampling distribution, different draws; ignored in L1 mode.
     """
     n = pid.shape[0]
     d = value.shape[1]
@@ -770,8 +1152,11 @@ def bound_and_aggregate_vector(key: jax.Array,
         zeros = jnp.zeros((num_partitions,), dtype=value.dtype)
         return (jnp.zeros((num_partitions, d), dtype=value.dtype),
                 PartitionAccumulators(zeros, zeros, zeros, zeros, zeros))
-    s = _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap,
-                                l1_cap)
+    s = _dispatch_sampler(key, pid, pk, valid, linf_cap, l0_cap, l1_cap,
+                          num_partitions=num_partitions,
+                          max_segments=max_segments,
+                          pid_sorted=pid_sorted, tile_rows=0, tile_slack=0,
+                          value=None, need_order=True)
     sval = value[s.order]
 
     if norm_ord == 0:
@@ -813,12 +1198,15 @@ def bound_and_aggregate_vector(key: jax.Array,
 
 @functools.partial(jax.jit,
                    static_argnames=("pid_sorted", "max_segments",
-                                    "num_partitions"))
+                                    "num_partitions", "tile_rows",
+                                    "tile_slack"))
 def bound_row_mask(key: jax.Array, pid: jnp.ndarray, pk: jnp.ndarray,
                    valid: jnp.ndarray, linf_cap, l0_cap,
                    l1_cap=None, *, pid_sorted: bool = False,
                    max_segments: Optional[int] = None,
-                   num_partitions: Optional[int] = None) -> jnp.ndarray:
+                   num_partitions: Optional[int] = None,
+                   tile_rows: int = 0,
+                   tile_slack: int = 0) -> jnp.ndarray:
     """Per-row keep mask (original row order) after Linf + L0 bounding.
 
     Identical sampling decisions to bound_and_aggregate for the same key —
@@ -833,19 +1221,16 @@ def bound_row_mask(key: jax.Array, pid: jnp.ndarray, pk: jnp.ndarray,
     n = pid.shape[0]
     if n == 0:
         return jnp.zeros((0,), dtype=bool)
-    # Same trace-time dispatch as bound_and_aggregate (static flags +
-    # structural l1_cap test) so replayed sampling stays identical.
-    # dplint: disable=DPL003 — static/structural branch, resolved per compile
-    if (pid_sorted and l1_cap is None and num_partitions is not None
-            and presorted_fits(n, num_partitions, max_segments)):
-        s = _sample_rows_and_groups_presorted(
-            key, pid, pk, valid, linf_cap, l0_cap,
-            num_partitions=num_partitions,
-            max_segments=int(max_segments) if max_segments else n,
-            need_order=True)
-    else:
-        s = _sample_rows_and_groups(key, pid, pk, valid, linf_cap, l0_cap,
-                                    l1_cap)
+    # Same trace-time sampler dispatch as bound_and_aggregate (shared
+    # _dispatch_sampler, incl. the tiled path) so replayed sampling stays
+    # identical.
+    s = _dispatch_sampler(
+        key, pid, pk, valid, linf_cap, l0_cap, l1_cap,
+        num_partitions=num_partitions if num_partitions is not None else 0,
+        max_segments=max_segments,
+        pid_sorted=pid_sorted and num_partitions is not None,
+        tile_rows=tile_rows, tile_slack=tile_slack, value=None,
+        need_order=True)
     keep_sorted_rows = s.keep_row & s.keep_group_row
     return jnp.zeros((n,), dtype=bool).at[s.order].set(keep_sorted_rows)
 

@@ -16,6 +16,20 @@ through it the PyDP algorithm): eps/delta split evenly across tree levels;
 per-level noise uses L1 sensitivity l0*linf (Laplace) or L2 sensitivity
 sqrt(l0)*linf (Gaussian), since each contribution increments exactly one
 node per level.
+
+Sampling-replay contract: the per-row keep mask feeding leaf_histograms
+comes from columnar.bound_row_mask called with the SAME key and the SAME
+sort statics as the aggregation kernel of the run — including the
+pid_sorted/max_segments flags and, since round 9, the tile_rows/tile_slack
+geometry of the bucketed segment-local sort (streaming
+._chunk_step_rle_quantile plumbs all four from the chunk's WireFormat).
+The packed 3-key sort is where the sampling randomness lives, so any
+divergence in sort configuration between the two kernels would silently
+de-correlate "rows kept for COUNT/SUM" from "rows kept for PERCENTILE" of
+one release. The tiled and global packed sorts are bit-identical by
+construction (ops/columnar._sample_rows_and_groups_tiled), which is what
+lets segment_sort="auto" flip geometry per chunk without touching the
+replayed masks.
 """
 
 from __future__ import annotations

@@ -100,6 +100,72 @@ EVENT_COMPACT_CHUNKS = "ops/compact_chunk_emits"
 COMPACT_MIN_PARTITIONS = 1 << 17
 
 
+def finish_wire_plan(fmt, segment_sort, max_run, *, num_partitions: int,
+                     row_clip_lo, row_clip_hi, linf_cap, l1_mode: bool,
+                     with_quantile_mask: bool = False):
+    """Finalizes a wire format for the chunk kernels -> (fmt, int_clip,
+    sort_stats). Shared by the single-device slab loop and the mesh chunk
+    loop (parallel/sharded.py) so both paths resolve the segment_sort
+    knob, the int32-accumulation gate, and the per-chunk sort cost
+    identically.
+
+    fmt gains tile geometry when the knob + prep-time max_run allow
+    (wirecodec.plan_segment_tiling); int_clip is the int32 row-clip pair
+    when VALUE_PLANES chunks may accumulate in int32 bit-identically
+    (columnar.int_accumulation_plan), else None; sort_stats is the
+    columnar.sort_cost dict one executed chunk kernel credits to the
+    ops/sort_* counters (plus the replayed row-mask sort when the chunk
+    also feeds quantile histograms).
+
+    segment_sort=False is the full round-8 parity oracle: no tiling, the
+    value widens to float32 at decode (f32 sort payload), and the group
+    stage accumulates in float32 — so the knob A/Bs this PR's whole
+    kernel-side change, not just the tile geometry."""
+    if segment_sort is False:
+        fmt = dataclasses.replace(fmt, tile_rows=0, tile_slack=0,
+                                  sort_value_narrow=False)
+        clip = None
+    else:
+        fmt = wirecodec.plan_segment_tiling(fmt, segment_sort, max_run)
+        clip = None
+        if fmt.value.mode == wirecodec.VALUE_PLANES:
+            clip = columnar.int_accumulation_plan(
+                fmt.value.lo, fmt.value.scale, fmt.value.bits,
+                row_clip_lo, row_clip_hi, linf_cap)
+        if clip is not None:
+            clip = (np.int32(clip[0]), np.int32(clip[1]))
+    vb = 4
+    if (fmt.value.mode == wirecodec.VALUE_PLANES
+            and fmt.sort_value_narrow):
+        vb = 1 if fmt.value.bits <= 8 else (
+            2 if fmt.value.bits <= 16 else 4)
+    tiles = ((fmt.tile_rows, fmt.tile_slack) if fmt.pid_sorted
+             else (0, 0))
+    kw = dict(num_partitions=num_partitions,
+              max_segments=fmt.ucap if fmt.pid_sorted else None,
+              pid_sorted=fmt.pid_sorted, tile_rows=tiles[0],
+              tile_slack=tiles[1], l1_mode=l1_mode)
+    cost = columnar.sort_cost(fmt.cap, value_bytes=vb, **kw)
+    stats = {name: cost[name]
+             for name in ("rows", "tiles", "operand_bytes")}
+    if with_quantile_mask:
+        mask = columnar.sort_cost(fmt.cap, has_value=False,
+                                  need_order=True, **kw)
+        stats = {name: stats[name] + mask[name] for name in stats}
+    return fmt, clip, stats
+
+
+def _count_sort_stats(stats) -> None:
+    """Credits one executed chunk kernel's sort cost to the ops/sort_*
+    profiler counters (columnar.sort_cost model — the jitted kernels
+    cannot count per execution, so the drivers do it per dispatched
+    chunk)."""
+    profiler.count_event(columnar.EVENT_SORT_ROWS, int(stats["rows"]))
+    profiler.count_event(columnar.EVENT_SORT_TILES, int(stats["tiles"]))
+    profiler.count_event(columnar.EVENT_SORT_BYTES,
+                         int(stats["operand_bytes"]))
+
+
 def _compact_enabled(compact_merge, num_partitions: int) -> bool:
     """Resolves the compact_merge knob (True / False / "auto")."""
     if compact_merge is True:
@@ -221,29 +287,52 @@ def _chunk_step(key, buf, n_valid, accs, linf_cap, l0_cap, row_clip_lo,
         *(a + c for a, c in zip(accs, chunk_accs)))
 
 
+def _decode_for_kernel(row, n_valid, n_uniq, fmt):
+    """Shared decode of the wire chunk steps: VALUE_PLANES chunks keep the
+    narrow int32 plane index through the kernel's sort (widened after it
+    with the identical reconstruction expression — bit-for-bit the same
+    released values); other modes decode to float32 as before. Returns
+    (pid, pk, value, valid, value_kwargs-for-the-kernel)."""
+    value_as_index = (fmt.value.mode == wirecodec.VALUE_PLANES
+                      and fmt.sort_value_narrow)
+    pid, pk, value, valid = wirecodec.decode_bucket(
+        row, n_valid, n_uniq, fmt, value_as_index=value_as_index)
+    if value is None:
+        value = jnp.zeros((fmt.cap,), dtype=jnp.float32)
+        value_as_index = False
+    kwargs = dict(
+        tile_rows=fmt.tile_rows if fmt.pid_sorted else 0,
+        tile_slack=fmt.tile_slack if fmt.pid_sorted else 0,
+        value_is_index=value_as_index,
+        value_lo=np.float32(fmt.value.lo),
+        value_scale=np.float32(fmt.value.scale),
+        value_sort_bits=fmt.value.bits if value_as_index else 0)
+    return pid, pk, value, valid, kwargs
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("num_partitions", "fmt", "need_flags",
-                     "has_group_clip"),
+                     "has_group_clip", "int_accumulate"),
     donate_argnums=(4,))
 def _chunk_step_rle(key, row, n_valid, n_uniq, accs, linf_cap, l0_cap,
                     row_clip_lo, row_clip_hi, middle, group_clip_lo,
-                    group_clip_hi, l1_cap=None, *,
+                    group_clip_hi, l1_cap=None, int_clip=None, *,
                     num_partitions: int, fmt: wirecodec.WireFormat,
                     need_flags=(True, True, True, True),
-                    has_group_clip: bool = True):
+                    has_group_clip: bool = True,
+                    int_accumulate: bool = False):
     """Decode one wire-codec bucket, bound+aggregate it, add into accs.
 
     Buckets are pid-disjoint, so bounding each independently with the full
     caps and summing accumulators is exact (see module docstring). In
     PID_RLE mode the decoded rows are pid-sorted by construction, so the
-    kernel runs its cheaper presorted sampler (fmt.pid_sorted plumbs the
-    invariant; fmt.ucap bounds the distinct pids per bucket).
+    kernel runs its cheaper presorted sampler — tiled into bounded-span
+    segment-local sorts when fmt carries tile geometry (fmt.pid_sorted
+    plumbs the invariant; fmt.ucap bounds the distinct pids per bucket).
     """
-    pid, pk, value, valid = wirecodec.decode_bucket(row, n_valid, n_uniq,
+    pid, pk, value, valid, vkw = _decode_for_kernel(row, n_valid, n_uniq,
                                                     fmt)
-    if value is None:
-        value = jnp.zeros((fmt.cap,), dtype=jnp.float32)
     chunk_accs = columnar.bound_and_aggregate(
         key, pid, pk, value, valid,
         num_partitions=num_partitions,
@@ -261,7 +350,11 @@ def _chunk_step_rle(key, row, n_valid, n_uniq, accs, linf_cap, l0_cap,
         need_norm_sq=need_flags[3],
         has_group_clip=has_group_clip,
         pid_sorted=fmt.pid_sorted,
-        max_segments=fmt.ucap if fmt.pid_sorted else None)
+        max_segments=fmt.ucap if fmt.pid_sorted else None,
+        int_accumulate=int_accumulate,
+        int_clip_lo=int_clip[0] if int_clip is not None else None,
+        int_clip_hi=int_clip[1] if int_clip is not None else None,
+        **vkw)
     return columnar.PartitionAccumulators(
         *(a + c for a, c in zip(accs, chunk_accs)))
 
@@ -269,14 +362,15 @@ def _chunk_step_rle(key, row, n_valid, n_uniq, accs, linf_cap, l0_cap,
 @functools.partial(
     jax.jit,
     static_argnames=("num_partitions", "fmt", "max_groups", "need_flags",
-                     "has_group_clip"))
+                     "has_group_clip", "int_accumulate"))
 def _chunk_step_rle_compact(key, row, n_valid, n_uniq, linf_cap, l0_cap,
                             row_clip_lo, row_clip_hi, middle, group_clip_lo,
-                            group_clip_hi, l1_cap=None, *,
+                            group_clip_hi, l1_cap=None, int_clip=None, *,
                             num_partitions: int, fmt: wirecodec.WireFormat,
                             max_groups: int,
                             need_flags=(True, True, True, True),
-                            has_group_clip: bool = True):
+                            has_group_clip: bool = True,
+                            int_accumulate: bool = False):
     """_chunk_step_rle that emits compact per-group columns instead of
     scattering into the full [num_partitions] accumulators.
 
@@ -287,10 +381,8 @@ def _chunk_step_rle_compact(key, row, n_valid, n_uniq, linf_cap, l0_cap,
     (columnar.merge_compact_chunks). Nothing is donated, so a failed
     dispatch can never poison the running state.
     """
-    pid, pk, value, valid = wirecodec.decode_bucket(row, n_valid, n_uniq,
+    pid, pk, value, valid, vkw = _decode_for_kernel(row, n_valid, n_uniq,
                                                     fmt)
-    if value is None:
-        value = jnp.zeros((fmt.cap,), dtype=jnp.float32)
     return columnar.bound_and_aggregate_compact(
         key, pid, pk, value, valid,
         num_partitions=num_partitions,
@@ -309,7 +401,11 @@ def _chunk_step_rle_compact(key, row, n_valid, n_uniq, linf_cap, l0_cap,
         need_norm_sq=need_flags[3],
         has_group_clip=has_group_clip,
         pid_sorted=fmt.pid_sorted,
-        max_segments=fmt.ucap if fmt.pid_sorted else None)
+        max_segments=fmt.ucap if fmt.pid_sorted else None,
+        int_accumulate=int_accumulate,
+        int_clip_lo=int_clip[0] if int_clip is not None else None,
+        int_clip_hi=int_clip[1] if int_clip is not None else None,
+        **vkw)
 
 
 def _merge_pending(accs, pending, num_partitions, need_flags):
@@ -353,7 +449,7 @@ def _chunk_step_rle_quantile(key, row, n_valid, n_uniq, accs, qhist,
     _sample_rows_and_groups with bound_and_aggregate).
     """
     from pipelinedp_tpu.ops import quantiles as quantile_ops
-    pid, pk, value, valid = wirecodec.decode_bucket(row, n_valid, n_uniq,
+    pid, pk, value, valid, vkw = _decode_for_kernel(row, n_valid, n_uniq,
                                                     fmt)
     chunk_accs = columnar.bound_and_aggregate(
         key, pid, pk, value, valid,
@@ -372,14 +468,22 @@ def _chunk_step_rle_quantile(key, row, n_valid, n_uniq, accs, qhist,
         need_norm_sq=need_flags[3],
         has_group_clip=has_group_clip,
         pid_sorted=fmt.pid_sorted,
-        max_segments=fmt.ucap if fmt.pid_sorted else None)
-    # Same pid_sorted statics as the aggregation kernel, so the replayed
-    # sampling decisions stay identical (shared packed-key sort).
+        max_segments=fmt.ucap if fmt.pid_sorted else None,
+        **vkw)
+    # Same pid_sorted/tile statics as the aggregation kernel, so the
+    # replayed sampling decisions stay identical (shared packed-key sort,
+    # tiled or global).
     row_keep = columnar.bound_row_mask(
         key, pid, pk, valid, linf_cap, l0_cap, l1_cap=l1_cap,
         pid_sorted=fmt.pid_sorted,
         max_segments=fmt.ucap if fmt.pid_sorted else None,
-        num_partitions=num_partitions)
+        num_partitions=num_partitions,
+        tile_rows=vkw["tile_rows"], tile_slack=vkw["tile_slack"])
+    if vkw["value_is_index"]:
+        # The leaf histogram buckets float values; reconstruct with the
+        # decode expression (bit-exact twin of the non-index decode).
+        value = (jnp.float32(fmt.value.lo)
+                 + value.astype(jnp.float32) * jnp.float32(fmt.value.scale))
     chunk_hist = quantile_ops.leaf_histograms(pk, value, row_keep,
                                               num_partitions=num_partitions,
                                               num_leaves=num_leaves,
@@ -413,6 +517,7 @@ def stream_bound_and_aggregate(
     resilience=None,
     resume_from=None,
     compact_merge="auto",
+    segment_sort="auto",
 ) -> columnar.PartitionAccumulators:
     """Chunked, transfer-overlapped twin of columnar.bound_and_aggregate.
 
@@ -453,6 +558,14 @@ def stream_bound_and_aggregate(
       With group-level sum clipping active the released accumulators are
       bit-identical to the legacy path; without it they agree in exact
       arithmetic (float32 association may differ in the last ulp).
+    segment_sort: the bucketed segment-local sort inside the chunk kernel
+      (columnar tiled sampler; wirecodec.plan_segment_tiling), plus the
+      narrow-dtype sort payload and int32 group accumulation that ride
+      with it. "auto" (default) engages on the pid-sorted wire when the
+      tile heuristic wins; True forces tiling whenever geometry permits;
+      False restores the full round-8 kernel (global packed sort, f32
+      payload, float accumulation — the parity oracle). BIT-identical
+      released values in every mode — the knob is pure kernel geometry.
 
     Returns per-partition accumulators on device, identical in distribution
     to the single-shot kernel.
@@ -486,9 +599,17 @@ def stream_bound_and_aggregate(
                 pid, pk, value, num_partitions=num_partitions, k=k,
                 value_transfer_dtype=value_transfer_dtype)
 
-        # `fmt` is late-bound from the enclosing scope: both encode
-        # branches below assign it before the slab loop makes the first
-        # call.
+        # `fmt`, `int_clip` and `sort_stats` are late-bound from the
+        # enclosing scope: both encode branches below run
+        # _finish_wire_plan before the slab loop makes the first call.
+        def _finish_wire_plan(wire_fmt):
+            return finish_wire_plan(
+                wire_fmt, segment_sort, info.max_run,
+                num_partitions=num_partitions, row_clip_lo=row_clip_lo,
+                row_clip_hi=row_clip_hi, linf_cap=linf_cap,
+                l1_mode=l1_cap is not None,
+                with_quantile_mask=quantile_spec is not None)
+
         def step_chunk(c, bucket_row, accs, qhist, n_valid, n_uniq_c):
             if quantile_spec is not None:
                 return _chunk_step_rle_quantile(
@@ -503,10 +624,11 @@ def stream_bound_and_aggregate(
             return _chunk_step_rle(
                 jax.random.fold_in(key, c), bucket_row, n_valid, n_uniq_c,
                 accs, linf_cap, l0_cap, row_clip_lo, row_clip_hi, middle,
-                group_clip_lo, group_clip_hi, l1_cap,
+                group_clip_lo, group_clip_hi, l1_cap, int_clip,
                 num_partitions=num_partitions, fmt=fmt,
                 need_flags=tuple(need_flags),
-                has_group_clip=has_group_clip), qhist
+                has_group_clip=has_group_clip,
+                int_accumulate=int_clip is not None), qhist
 
         def compact_plan(fmt):
             """(compact_step, merge_fn) for this wire format, or (None,
@@ -525,10 +647,11 @@ def stream_bound_and_aggregate(
                 return _chunk_step_rle_compact(
                     jax.random.fold_in(key, c), bucket_row, n_valid,
                     n_uniq_c, linf_cap, l0_cap, row_clip_lo, row_clip_hi,
-                    middle, group_clip_lo, group_clip_hi, l1_cap,
+                    middle, group_clip_lo, group_clip_hi, l1_cap, int_clip,
                     num_partitions=num_partitions, fmt=fmt,
                     max_groups=max_groups, need_flags=tuple(need_flags),
-                    has_group_clip=has_group_clip)
+                    has_group_clip=has_group_clip,
+                    int_accumulate=int_clip is not None)
 
             def merge_fn(accs, pending):
                 return _merge_pending(accs, pending, num_partitions,
@@ -581,6 +704,7 @@ def stream_bound_and_aggregate(
                         cap=cap,
                         ucap=wirecodec.round_ucap(int(n_uniq.max())),
                         value=info.plan)
+                fmt, int_clip, sort_stats = _finish_wire_plan(fmt)
                 budget = slab_byte_budget(pipelined_sort)
                 n_t = n_transfers or _num_transfers(fmt.width * k, k,
                                                     budget)
@@ -606,7 +730,7 @@ def stream_bound_and_aggregate(
                     n_t, num_partitions, quantile_spec, resilience,
                     lambda: _input_digest(pid, pk, value),
                     compact_step=compact_step, merge_fn=merge_fn,
-                    scatter_passes=scatter_passes)
+                    scatter_passes=scatter_passes, sort_stats=sort_stats)
         else:
             with profiler.stage("dp/wire_encode"):
                 slab, counts, n_uniq, fmt = wirecodec.encode_buckets_numpy(
@@ -614,6 +738,7 @@ def stream_bound_and_aggregate(
                     bytes_pid=info.bytes_pid, bits_pk=info.bits_pk,
                     plan=info.plan, pid_mode=info.pid_mode,
                     bits_pid=info.bits_pid)
+            fmt, int_clip, sort_stats = _finish_wire_plan(fmt)
             n_t = n_transfers or _num_transfers(slab.nbytes, k)
             compact_step, merge_fn = compact_plan(fmt)
             accs, qhist = _run_slab_loop(
@@ -622,7 +747,7 @@ def stream_bound_and_aggregate(
                 n_t, num_partitions, quantile_spec, resilience,
                 lambda: _input_digest(pid, pk, value),
                 compact_step=compact_step, merge_fn=merge_fn,
-                scatter_passes=scatter_passes)
+                scatter_passes=scatter_passes, sort_stats=sort_stats)
         if quantile_spec is not None:
             return accs, qhist
         return accs
@@ -667,13 +792,18 @@ def stream_bound_and_aggregate(
                            need_flags=tuple(need_flags),
                            has_group_clip=has_group_clip), qhist
 
+    bytes_cost = columnar.sort_cost(int(buckets.shape[1]),
+                                    num_partitions=num_partitions,
+                                    l1_mode=l1_cap is not None)
     accs, _ = _run_slab_loop(
         key, k, counts, None,
         ("bytes", bytes_pid, bytes_pk, value_f16, width),
         lambda s0, s1: buckets[s0:s1], step_chunk_bytes,
         n_t, num_partitions, None, resilience,
         lambda: _input_digest(pid, pk, value),
-        scatter_passes=1 + sum(bool(f) for f in need_flags))
+        scatter_passes=1 + sum(bool(f) for f in need_flags),
+        sort_stats={name: bytes_cost[name]
+                    for name in ("rows", "tiles", "operand_bytes")})
     return accs
 
 
@@ -686,7 +816,8 @@ def _input_digest(pid, pk, value) -> str:
 def _run_slab_loop(key, k, counts, n_uniq, fmt_desc, prepare_slab,
                    step_chunk, n_transfers, num_partitions, quantile_spec,
                    resilience, data_digest_fn=None, *,
-                   compact_step=None, merge_fn=None, scatter_passes=5):
+                   compact_step=None, merge_fn=None, scatter_passes=5,
+                   sort_stats=None):
     """The resilient slab loop shared by every streaming encode path.
 
     Iterates chunks [0, k) in slab windows: ``prepare_slab(s0, s1)``
@@ -859,6 +990,8 @@ def _run_slab_loop(key, k, counts, n_uniq, fmt_desc, prepare_slab,
                             in_dispatch = False
                             profiler.count_event(EVENT_PARTITION_SCATTERS,
                                                  scatter_passes)
+                        if sort_stats is not None:
+                            _count_sort_stats(sort_stats)
                         cursor = c + 1
             except Exception as exc:
                 failure_kind = retry_lib.classify(exc)

@@ -87,6 +87,15 @@ class WireFormat:
     pid_mode PID_RLE lays out [uniq ids | uint16 runs | pk planes | value];
     PID_PLANES lays out [pid planes | pk planes | value] (bits_pid planes,
     arrival order, no sortedness guarantee).
+
+    tile_rows/tile_slack describe the bucketed segment-local sort the
+    kernel may run over the decoded rows (columnar tiled sampler): tiles
+    of tile_rows rows, slack >= the longest single-pid run in any bucket
+    (known on host from the prep-time per-pid counts). They are sort
+    GEOMETRY, not wire layout — the byte offsets above are unaffected, and
+    per-bucket tile offsets are derived on device in one pass from the
+    RLE segment starts (offset arrays could not ride this dataclass: it
+    must stay hashable/jit-static). 0 = untiled (global packed sort).
     """
     bytes_pid: int
     bits_pk: int
@@ -95,6 +104,13 @@ class WireFormat:
     value: ValuePlan
     pid_mode: int = PID_RLE
     bits_pid: int = 0  # pid bit-planes per row (PID_PLANES only)
+    tile_rows: int = 0  # segment-local sort tile width (0 = untiled)
+    tile_slack: int = 0  # per-tile slack >= max single-pid run
+    # VALUE_PLANES chunks ride the kernel sort as the narrow plane index
+    # (widened to float32 after it — bit-identical releases). False
+    # restores the round-8 widen-at-decode kernel; like the tile fields
+    # this is kernel geometry, not wire layout (segment_sort=False).
+    sort_value_narrow: bool = True
 
     @property
     def cap_bytes(self) -> int:
@@ -399,6 +415,7 @@ def decode_bucket(
     n_valid: jnp.ndarray,
     n_uniq: jnp.ndarray,
     fmt: WireFormat,
+    value_as_index: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, Optional[jnp.ndarray], jnp.ndarray]:
     """Decode one bucket row of the slab -> (pid, pk, value|None, valid).
 
@@ -408,6 +425,12 @@ def decode_bucket(
     is the invariant the fused kernel's presorted sampler relies on. In
     PID_PLANES mode rows are in arrival order (no sortedness guarantee).
     Rows >= n_valid are garbage with valid=False.
+
+    value_as_index (VALUE_PLANES only): return the raw int32 plane index
+    instead of the reconstructed float32 — the kernel then carries the
+    narrow index through its sort and widens with the identical
+    ``lo + idx * scale`` float32 expression afterwards, so released
+    values are bit-for-bit unchanged.
     """
     o_cnt, o_pk, o_val, _ = fmt._offsets
     cap, ucap = fmt.cap, fmt.ucap
@@ -439,6 +462,9 @@ def decode_bucket(
         idx = _unpack_planes(
             row[o_val:o_val + plan.bits * fmt.cap_bytes].reshape(
                 plan.bits, fmt.cap_bytes), plan.bits, cap)
+        if value_as_index:
+            valid = jnp.arange(cap, dtype=jnp.int32) < n_valid
+            return pid, pk, idx, valid
         # Must mirror the host verification expression exactly (f32 ops).
         value = (jnp.float32(plan.lo)
                  + idx.astype(jnp.float32) * jnp.float32(plan.scale))
@@ -493,7 +519,8 @@ class NativeRleEncoder:
     encode_buckets_numpy).
     """
 
-    def __init__(self, lib, handle, counts, k, plan, entry_counts=None):
+    def __init__(self, lib, handle, counts, k, plan, entry_counts=None,
+                 max_run: int = -1):
         self._lib = lib
         self._handle = handle
         self.counts = counts
@@ -502,6 +529,8 @@ class NativeRleEncoder:
         # Exact per-bucket RLE entry counts from prep (pre-sort), or None
         # when the pid span exceeded the native count-table budget.
         self.entry_counts = entry_counts
+        # Max rows of any single pid (same count table; -1 = uncounted).
+        self.max_run = max_run
 
     @property
     def plan(self) -> ValuePlan:
@@ -543,7 +572,9 @@ class NativeRleEncoder:
                   if plan.mode == VALUE_PLANES and not use_inline else None)
         counts = np.zeros(k, dtype=np.int64)
         entries = np.zeros(k, dtype=np.int64)
-        stats = np.zeros(2, dtype=np.int64)
+        # stats: [0] inline verification failed, [1] max value index,
+        # [2] max rows of any single pid (ABI 7; -1 when uncounted).
+        stats = np.zeros(3, dtype=np.int64)
         handle = lib.pdp_rle_prep(
             pid32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             pk32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
@@ -564,7 +595,8 @@ class NativeRleEncoder:
             plan = dataclasses.replace(
                 plan, bits=max(1, int(stats[1]).bit_length()))
         entry_counts = None if entries[0] < 0 else entries
-        return cls(lib, handle, counts, k, plan, entry_counts)
+        return cls(lib, handle, counts, k, plan, entry_counts,
+                   max_run=int(stats[2]))
 
     def sort_range(self, b0: int, b1: int) -> np.ndarray:
         """Sorts buckets [b0, b1) by pid; returns their RLE entry counts."""
@@ -658,28 +690,66 @@ def encode_buckets(pid, pk, value, *, pid_lo, k, bytes_pid, bits_pk, plan,
 _MAX_ENTRY_COUNT_SPAN = 1 << 26
 
 
-def rle_entry_counts_numpy(pid, pid_lo: int, k: int,
-                           pid_span: int) -> Optional[np.ndarray]:
-    """Exact per-bucket RLE entry counts WITHOUT sorting, or None when the
-    pid span is too large to count cheaply.
+def rle_entry_stats_numpy(pid, pid_lo: int, k: int, pid_span: int
+                          ) -> Tuple[Optional[np.ndarray], int]:
+    """(per-bucket RLE entry counts, max rows of any single pid) WITHOUT
+    sorting, or (None, -1) when the pid span is too large to count
+    cheaply.
 
     A pid hashes to exactly one bucket, so bucket b's post-sort entry
     count is sum(ceil(rows_of_pid / 65535)) over the pids landing in b —
     computable from a per-pid bincount. This is what lets the caller fix
-    the wire format before any sort and pipeline the sort per slab.
+    the wire format before any sort and pipeline the sort per slab. The
+    max per-pid row count from the same bincount bounds every pid-segment
+    span in every bucket — the tile-slack input of the segment-local
+    tiled sort (plan_segment_tiling).
     """
     n = len(pid)
     if pid_span < 0 or pid_span + 1 > min(_MAX_ENTRY_COUNT_SPAN,
                                           max(4 * n, 1 << 22)):
-        return None
+        return None, -1
     shifted = (np.asarray(pid) - pid_lo).astype(np.int64, copy=False)
     per = np.bincount(shifted, minlength=pid_span + 1)
     nz = np.flatnonzero(per)
     bucket = ((nz.astype(np.uint32) * _HASH_MULT) >> np.uint32(16)) % \
         np.uint32(k)
     entries = -(-per[nz] // _RUN_SPLIT)
-    return np.bincount(bucket, weights=entries,
-                       minlength=k).astype(np.int64)
+    counts = np.bincount(bucket, weights=entries,
+                         minlength=k).astype(np.int64)
+    return counts, int(per.max()) if n else 0
+
+
+def rle_entry_counts_numpy(pid, pid_lo: int, k: int,
+                           pid_span: int) -> Optional[np.ndarray]:
+    """rle_entry_stats_numpy without the max-run stat (compat surface)."""
+    return rle_entry_stats_numpy(pid, pid_lo, k, pid_span)[0]
+
+
+def plan_segment_tiling(fmt: WireFormat, segment_sort,
+                        max_run: int) -> WireFormat:
+    """Resolves the ``segment_sort`` knob into tile geometry on ``fmt``.
+
+    segment_sort: False disables; True forces tiling whenever the
+    geometry is non-degenerate; "auto" additionally requires enough tiles
+    per bucket (>= 8) that the shorter sort span pays for the binning and
+    compaction passes. Tiling needs the max single-pid run (``max_run``,
+    from prep-time per-pid counts — tile_slack must bound every segment;
+    unknown/-1 disables) and pid-sorted arrival (PID_RLE).
+
+    Tile width: the smallest power of two >= 4 * max_run (so slack stays
+    <= ~25% of a tile) and >= 1024 (smaller tiles are all padding).
+    """
+    if segment_sort is False or fmt.pid_mode != PID_RLE:
+        return fmt
+    if max_run is None or max_run <= 0:
+        return fmt
+    slack = _round8(max_run)
+    tile = 1 << max(10, (4 * max_run - 1).bit_length())
+    if tile + slack >= fmt.cap:
+        return fmt
+    if segment_sort == "auto" and tile > fmt.cap // 8:
+        return fmt
+    return dataclasses.replace(fmt, tile_rows=tile, tile_slack=slack)
 
 
 def choose_pid_mode(n: int, pid_span: int, bytes_pid: int,
@@ -732,6 +802,10 @@ class EncodeInfo:
     # Exact per-bucket RLE entry counts known BEFORE sorting, or None
     # (then PID_RLE callers must learn ucap from an upfront sort).
     entry_counts: Optional[np.ndarray]
+    # Max rows of any single pid (bounds every pid segment in every
+    # bucket — the tile-slack input of plan_segment_tiling), or -1 when
+    # the span was too large to count.
+    max_run: int = -1
 
 
 def make_encoder(pid: np.ndarray, pk, value, *, num_partitions: int, k: int,
@@ -765,18 +839,19 @@ def make_encoder(pid: np.ndarray, pk, value, *, num_partitions: int, k: int,
     value_f16 = (value_transfer_dtype is not None
                  and np.dtype(value_transfer_dtype) == np.float16)
 
-    def info_for(plan, vidx, entry_counts):
+    def info_for(plan, vidx, entry_counts, max_run=-1):
         pid_mode, bits_pid = choose_pid_mode(len(pid), pid_span, bytes_pid,
                                              entry_counts)
         return EncodeInfo(plan=plan, vidx=vidx, pid_lo=pid_lo,
                           pid_span=pid_span, bytes_pid=bytes_pid,
                           bits_pk=bits_pk, pid_mode=pid_mode,
-                          bits_pid=bits_pid, entry_counts=entry_counts)
+                          bits_pid=bits_pid, entry_counts=entry_counts,
+                          max_run=max_run)
 
     def fallback_info():
         plan, vidx = plan_and_index(value, value_f16)
-        entries = rle_entry_counts_numpy(pid, pid_lo, k, pid_span)
-        return info_for(plan, vidx, entries)
+        entries, max_run = rle_entry_stats_numpy(pid, pid_lo, k, pid_span)
+        return info_for(plan, vidx, entries, max_run)
 
     if _load_packer() is None:
         # Numpy fallback: needs the fully verified plan and index on the
@@ -789,7 +864,7 @@ def make_encoder(pid: np.ndarray, pk, value, *, num_partitions: int, k: int,
                                   plan=tentative, inline_vidx=True,
                                   out_status=status, pid_span=pid_span)
     if enc is not None:
-        return enc, info_for(enc.plan, None, enc.entry_counts)
+        return enc, info_for(enc.plan, None, enc.entry_counts, enc.max_run)
     if status.get("inline_failed"):
         # The sample-chosen scale failed the full array: re-plan with the
         # full chunked host verification (which tries the other scales)
@@ -798,9 +873,9 @@ def make_encoder(pid: np.ndarray, pk, value, *, num_partitions: int, k: int,
         enc = NativeRleEncoder.create(pid, pk, value, vidx, pid_lo=pid_lo,
                                       k=k, plan=plan, pid_span=pid_span)
         if enc is not None:
-            return enc, info_for(plan, vidx, enc.entry_counts)
-        entries = rle_entry_counts_numpy(pid, pid_lo, k, pid_span)
-        return None, info_for(plan, vidx, entries)
+            return enc, info_for(plan, vidx, enc.entry_counts, enc.max_run)
+        entries, max_run = rle_entry_stats_numpy(pid, pid_lo, k, pid_span)
+        return None, info_for(plan, vidx, entries, max_run)
     return None, fallback_info()
 
 

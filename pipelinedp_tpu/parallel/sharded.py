@@ -222,8 +222,16 @@ def _scalar_kernel(mesh: Mesh, padded_p: int, has_l1: bool = False,
 
 @functools.lru_cache(maxsize=None)
 def _vector_kernel(mesh: Mesh, padded_p: int, norm_ord: int,
-                   has_l1: bool = False):
-    """Sharded twin of columnar.bound_and_aggregate_vector."""
+                   has_l1: bool = False, pid_sorted: bool = False,
+                   max_segments=None):
+    """Sharded twin of columnar.bound_and_aggregate_vector.
+
+    pid_sorted: every device's local block is pid-nondecreasing over its
+    valid prefix (the host pre-sorted rows by pid before the stable
+    shard partition of shard_rows_by_pid, which preserves in-shard
+    order), so the local sampler runs the packed 3-key sort shared with
+    the scalar path; max_segments bounds the distinct pids of any one
+    shard (the global distinct-pid count is always valid)."""
 
     axes = tuple(mesh.axis_names)
     scatter = _scatter_axes(mesh)
@@ -237,7 +245,9 @@ def _vector_kernel(mesh: Mesh, padded_p: int, norm_ord: int,
             l0_cap=l0_cap,
             max_norm=max_norm,
             norm_ord=norm_ord,
-            l1_cap=l1_args[0] if has_l1 else None)
+            l1_cap=l1_args[0] if has_l1 else None,
+            pid_sorted=pid_sorted,
+            max_segments=max_segments)
         return (_reduce_scatter(vector_sums, scatter),
                 jax.tree.map(lambda x: _reduce_scatter(x, scatter), accs))
 
@@ -560,14 +570,18 @@ def bound_and_aggregate(mesh: Mesh,
 
 @functools.lru_cache(maxsize=None)
 def _codec_scalar_kernel(mesh: Mesh, padded_p: int, fmt, has_l1: bool,
-                         need_flags, has_group_clip: bool):
+                         need_flags, has_group_clip: bool,
+                         int_clip=None):
     """Wire-codec decode + bound-and-aggregate, shard-local.
 
     Each device receives ONE codec bucket row of the [n_dev, W] slab,
     decodes it with elementwise ops (ops/wirecodec.decode_bucket), runs
     the fused kernel, and reduce-scatters the per-partition partials —
-    the multi-chip twin of streaming._chunk_step_rle."""
-    from pipelinedp_tpu.ops import wirecodec
+    the multi-chip twin of streaming._chunk_step_rle. fmt carries the
+    segment-local sort tile geometry (streaming.finish_wire_plan);
+    int_clip is the static int32 row-clip pair of the int-accumulation
+    gate, or None for the float32 accumulators."""
+    from pipelinedp_tpu.ops import streaming
 
     axes = tuple(mesh.axis_names)
     scatter_axes = _scatter_axes(mesh)
@@ -575,10 +589,8 @@ def _codec_scalar_kernel(mesh: Mesh, padded_p: int, fmt, has_l1: bool,
     def local_step(key, row, n_valid, n_uniq, linf_cap, l0_cap, row_clip_lo,
                    row_clip_hi, middle, group_clip_lo, group_clip_hi,
                    *l1_args):
-        pid, pk, value, valid = wirecodec.decode_bucket(
+        pid, pk, value, valid, vkw = streaming._decode_for_kernel(
             row[0], n_valid[0], n_uniq[0], fmt)
-        if value is None:
-            value = jnp.zeros((fmt.cap,), dtype=jnp.float32)
         accs = columnar.bound_and_aggregate(
             _device_key(key, axes), pid, pk, value, valid,
             num_partitions=padded_p,
@@ -596,7 +608,11 @@ def _codec_scalar_kernel(mesh: Mesh, padded_p: int, fmt, has_l1: bool,
             need_norm_sq=need_flags[3],
             has_group_clip=has_group_clip,
             pid_sorted=fmt.pid_sorted,
-            max_segments=fmt.ucap if fmt.pid_sorted else None)
+            max_segments=fmt.ucap if fmt.pid_sorted else None,
+            int_accumulate=int_clip is not None,
+            int_clip_lo=int_clip[0] if int_clip is not None else None,
+            int_clip_hi=int_clip[1] if int_clip is not None else None,
+            **vkw)
         return columnar.PartitionAccumulators(
             *(_reduce_scatter(a, scatter_axes) for a in accs))
 
@@ -613,23 +629,21 @@ def _codec_scalar_kernel(mesh: Mesh, padded_p: int, fmt, has_l1: bool,
 @functools.lru_cache(maxsize=None)
 def _codec_compact_kernel(mesh: Mesh, padded_p: int, fmt, max_groups: int,
                           has_l1: bool, need_flags,
-                          has_group_clip: bool):
+                          has_group_clip: bool, int_clip=None):
     """Compact-merge twin of _codec_scalar_kernel: each device decodes its
     bucket and emits compact per-group subtotal columns
     (columnar.CompactGroups, [max_groups] per device) instead of
     scattering into [padded_p] and reduce-scattering per chunk. The
     per-chunk collectives move to the single merge kernel below."""
-    from pipelinedp_tpu.ops import wirecodec
+    from pipelinedp_tpu.ops import streaming
 
     axes = tuple(mesh.axis_names)
 
     def local_step(key, row, n_valid, n_uniq, linf_cap, l0_cap, row_clip_lo,
                    row_clip_hi, middle, group_clip_lo, group_clip_hi,
                    *l1_args):
-        pid, pk, value, valid = wirecodec.decode_bucket(
+        pid, pk, value, valid, vkw = streaming._decode_for_kernel(
             row[0], n_valid[0], n_uniq[0], fmt)
-        if value is None:
-            value = jnp.zeros((fmt.cap,), dtype=jnp.float32)
         cg = columnar.bound_and_aggregate_compact(
             _device_key(key, axes), pid, pk, value, valid,
             num_partitions=padded_p,
@@ -648,7 +662,11 @@ def _codec_compact_kernel(mesh: Mesh, padded_p: int, fmt, max_groups: int,
             need_norm_sq=need_flags[3],
             has_group_clip=has_group_clip,
             pid_sorted=fmt.pid_sorted,
-            max_segments=fmt.ucap if fmt.pid_sorted else None)
+            max_segments=fmt.ucap if fmt.pid_sorted else None,
+            int_accumulate=int_clip is not None,
+            int_clip_lo=int_clip[0] if int_clip is not None else None,
+            int_clip_hi=int_clip[1] if int_clip is not None else None,
+            **vkw)
         return columnar.CompactGroups(
             cg.pk, cg.pid_count, cg.count, cg.sum, cg.norm_sum,
             cg.norm_sq_sum, jnp.reshape(cg.n_kept, (1,)))
@@ -724,7 +742,8 @@ def stream_bound_and_aggregate(mesh: Mesh,
                                has_group_clip: bool = True,
                                resilience=None,
                                resume_from=None,
-                               compact_merge="auto"
+                               compact_merge="auto",
+                               segment_sort="auto"
                                ) -> columnar.PartitionAccumulators:
     """Chunked, transfer-overlapped multi-chip bound-and-aggregate.
 
@@ -750,6 +769,13 @@ def stream_bound_and_aggregate(mesh: Mesh,
     "auto" (default) engages at >= streaming.COMPACT_MIN_PARTITIONS
     padded partitions; False restores the legacy per-chunk
     scatter+reduce-scatter loop.
+
+    segment_sort: the bucketed segment-local sort inside each device's
+    chunk kernel, as on the single-device path (streaming
+    .stream_bound_and_aggregate) — "auto"/True/False resolve through the
+    shared streaming.finish_wire_plan, so mesh and single-device runs of
+    the same wire make the same tiling decision. BIT-identical released
+    values either way.
     """
     import dataclasses
 
@@ -827,6 +853,15 @@ def stream_bound_and_aggregate(mesh: Mesh,
                 def emit(c):
                     return enc.emit_range(c * n_dev, (c + 1) * n_dev, fmt)
 
+            # Tile geometry + int-accumulation gate + per-bucket sort cost,
+            # resolved exactly as on the single-device path (tile fields
+            # are sort geometry, not wire layout, so the emit closures
+            # above are unaffected by the replace).
+            fmt, int_clip, sort_stats = streaming.finish_wire_plan(
+                fmt, segment_sort, info.max_run,
+                num_partitions=padded_p, row_clip_lo=row_clip_lo,
+                row_clip_hi=row_clip_hi, linf_cap=linf_cap,
+                l1_mode=l1_cap is not None)
             return _run_codec_chunks(mesh, key, emit, counts, n_uniq, fmt,
                                      n_c, n_dev, padded_p, linf_cap, l0_cap,
                                      row_clip_lo, row_clip_hi, middle,
@@ -835,11 +870,18 @@ def stream_bound_and_aggregate(mesh: Mesh,
                                      resilience,
                                      lambda: streaming._input_digest(
                                          pid, pk, value),
-                                     compact_merge=compact_merge)
+                                     compact_merge=compact_merge,
+                                     int_clip=int_clip,
+                                     sort_stats=sort_stats)
     slab, counts, n_uniq, fmt = wirecodec.encode_buckets_numpy(
         pid, pk, value, pid_lo=info.pid_lo, k=k, bytes_pid=info.bytes_pid,
         bits_pk=info.bits_pk, plan=info.plan, pid_mode=info.pid_mode,
         bits_pid=info.bits_pid)
+    fmt, int_clip, sort_stats = streaming.finish_wire_plan(
+        fmt, segment_sort, info.max_run,
+        num_partitions=padded_p, row_clip_lo=row_clip_lo,
+        row_clip_hi=row_clip_hi, linf_cap=linf_cap,
+        l1_mode=l1_cap is not None)
     return _run_codec_chunks(mesh, key,
                              lambda c: slab[c * n_dev:(c + 1) * n_dev],
                              counts, n_uniq, fmt, n_c,
@@ -848,14 +890,16 @@ def stream_bound_and_aggregate(mesh: Mesh,
                              group_clip_hi, l1_cap, tuple(need_flags),
                              has_group_clip, resilience,
                              lambda: streaming._input_digest(pid, pk, value),
-                             compact_merge=compact_merge)
+                             compact_merge=compact_merge,
+                             int_clip=int_clip, sort_stats=sort_stats)
 
 
 def _run_codec_chunks(mesh, key, emit, counts, n_uniq, fmt, n_c, n_dev,
                       padded_p, linf_cap, l0_cap, row_clip_lo, row_clip_hi,
                       middle, group_clip_lo, group_clip_hi, l1_cap,
                       need_flags, has_group_clip, resilience=None,
-                      data_digest_fn=None, compact_merge: bool = True):
+                      data_digest_fn=None, compact_merge: bool = True,
+                      int_clip=None, sort_stats=None):
     """The mesh chunk loop, with the same resilience semantics as the
     single-device slab loop (ops/streaming._run_slab_loop): each chunk is
     one slab window — resumable, checkpointed, retried after transient
@@ -883,15 +927,23 @@ def _run_codec_chunks(mesh, key, emit, counts, n_uniq, fmt, n_c, n_dev,
         max_groups = columnar.compact_group_bound(fmt.cap, fmt.ucap,
                                                   l0_cap)
     compact = max_groups is not None
+    # Plain-int pair so the lru_cached kernel builders key on it.
+    int_clip_key = (None if int_clip is None
+                    else (int(int_clip[0]), int(int_clip[1])))
     if compact:
         kernel = _codec_compact_kernel(mesh, padded_p, fmt, max_groups,
                                        l1_cap is not None, need_flags,
-                                       has_group_clip)
+                                       has_group_clip, int_clip_key)
     else:
         kernel = _codec_scalar_kernel(mesh, padded_p, fmt,
                                       l1_cap is not None, need_flags,
-                                      has_group_clip)
+                                      has_group_clip, int_clip_key)
     scatter_passes = 1 + sum(bool(f) for f in need_flags)
+    # Every device sorts its own bucket, so one chunk executes n_dev
+    # bucket sorts (streaming._count_sort_stats credits the model per
+    # executed chunk, like the single-device slab loop).
+    if sort_stats is not None:
+        sort_stats = {name: v * n_dev for name, v in sort_stats.items()}
     sharding = NamedSharding(mesh, _spec(mesh))
     part_sharding = NamedSharding(mesh, _part_spec(mesh))
     accs = None
@@ -1009,6 +1061,8 @@ def _run_codec_chunks(mesh, key, emit, counts, n_uniq, fmt, n_c, n_dev,
                         profiler.count_event(
                             streaming.EVENT_PARTITION_SCATTERS,
                             scatter_passes)
+                    if sort_stats is not None:
+                        streaming._count_sort_stats(sort_stats)
                     cursor = c + 1
             except Exception as exc:
                 failure_kind = retry_lib.classify(exc)
@@ -1063,12 +1117,22 @@ def bound_and_aggregate_vector(mesh: Mesh,
                                l0_cap,
                                max_norm,
                                norm_ord: int,
-                               l1_cap=None):
-    """Multi-chip VECTOR_SUM path; see bound_and_aggregate."""
+                               l1_cap=None,
+                               pid_sorted: bool = False,
+                               max_segments=None):
+    """Multi-chip VECTOR_SUM path; see bound_and_aggregate.
+
+    pid_sorted: the caller staged rows pre-sorted by pid (host argsort
+    before stage_rows — the stable shard partition keeps every shard's
+    block pid-sorted), so each device runs the packed 3-key bounding
+    sort instead of the general 4-key one; max_segments bounds any one
+    shard's distinct pids."""
     padded_p = padded_num_partitions(mesh, num_partitions)
     dpid, dpk, dval, dvalid = _shard_and_put(mesh, pid, pk, value, valid)
     kernel = _vector_kernel(mesh, padded_p, norm_ord,
-                            has_l1=l1_cap is not None)
+                            has_l1=l1_cap is not None,
+                            pid_sorted=pid_sorted,
+                            max_segments=max_segments)
     args = (key, dpid, dpk, dval, dvalid, linf_cap, l0_cap, float(max_norm))
     if l1_cap is not None:
         args += (l1_cap,)
