@@ -512,10 +512,13 @@ def bench_cpu_baseline() -> float:
 
 def _resilience_counters():
     """Runtime resilience counters (retries, degradations, resumes,
-    checkpoint_bytes, native_fallbacks — pipelinedp_tpu/runtime/). All
-    keys always present; a clean run reports zeros, and a run that had to
-    retry/degrade/resume shows it here instead of hiding it in the
-    timings."""
+    checkpoint_bytes, native_fallbacks, watchdog_timeouts,
+    hangs_detected, journal_recoveries, journal_bytes —
+    pipelinedp_tpu/runtime/). All keys always present; a clean run
+    reports zeros, and a run that had to retry/degrade/resume — or had a
+    hang cut off by the dispatch watchdog, or recovered a durable
+    release journal — shows it here instead of hiding it in the timings,
+    so the chaos trajectory is tracked like perf."""
     from pipelinedp_tpu import runtime
 
     return runtime.resilience_counters()

@@ -51,6 +51,16 @@ ABS_FIELDS = ("exp_l0", "var_l0", "clip_min", "clip_max", "bias", "variance",
 N_ABS = len(ABS_FIELDS)
 N_REPORT_FIELDS = 2 * N_ABS + 4  # abs + rel + (raw, l0, linf, selection)
 
+# The typed failure set of the device sweep path: backend import/
+# initialization failures plus everything XLA raises at trace or execute
+# time (XlaRuntimeError subclasses RuntimeError; device OOM surfaces as
+# RuntimeError or MemoryError depending on the allocator). per_partition's
+# auto-dispatch catches exactly these to fall back to the host path —
+# anything outside this set is a bug, not a device limitation, and must
+# propagate.
+SWEEP_ERRORS = (ImportError, RuntimeError, ValueError, TypeError,
+                MemoryError)
+
 
 def _jnp():
     import jax
@@ -64,7 +74,7 @@ def should_use_device(num_groups: int, n_configs: int) -> bool:
     try:
         import jax
         backend = jax.default_backend()
-    except Exception:  # pragma: no cover - jax always importable in-repo
+    except SWEEP_ERRORS:  # pragma: no cover - jax always importable in-repo
         return False
     if backend == "cpu":
         return False

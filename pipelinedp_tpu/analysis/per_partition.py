@@ -578,7 +578,7 @@ def compute_per_partition_arrays(pre: PreAggregates,
                 _build_device_sweep(pre, configs, ordered_metrics,
                                     n_partitions, public_partitions,
                                     n_units, mesh=mesh))
-        except Exception:
+        except device_sweep.SWEEP_ERRORS:
             if forced_device:
                 raise
             device_sweep.logger.warning(

@@ -158,11 +158,25 @@ class BudgetAccountantScope:
 
 
 class BudgetAccountant(abc.ABC):
-    """Base class: mechanism registry, scopes, aggregation restrictions."""
+    """Base class: mechanism registry, scopes, aggregation restrictions.
+
+    durable_spend_journal: an optional ``runtime.FileReleaseJournal``
+    (or any object with its ``commit(token, kind=)`` contract) that
+    persists each mechanism's budget spend as it is committed, so the
+    at-most-once spend rule survives process death: a re-exec'd pipeline
+    that reaches ``compute_budgets`` with the same accountant
+    configuration and journal file raises ``BudgetAccountantError``
+    instead of silently re-spending the same epsilon (RESILIENCE.md).
+    The spend token is the accountant-relative mechanism identity —
+    (totals, index, mechanism type, sensitivity, weight, count) — so two
+    runs of the same pipeline collide and two genuinely different
+    pipelines sharing one journal file do not.
+    """
 
     def __init__(self, total_epsilon: float, total_delta: float,
                  num_aggregations: Optional[int],
-                 aggregation_weights: Optional[list]):
+                 aggregation_weights: Optional[list],
+                 durable_spend_journal=None):
         input_validators.validate_epsilon_delta(total_epsilon, total_delta,
                                                 type(self).__name__)
         self._total_epsilon = total_epsilon
@@ -182,6 +196,7 @@ class BudgetAccountant(abc.ABC):
         self._expected_aggregation_weights = aggregation_weights
         self._actual_aggregation_weights: List[float] = []
         self._spend_journal: List[SpendRecord] = []
+        self._durable_spend_journal = durable_spend_journal
 
     @property
     def spend_journal(self) -> tuple:
@@ -193,6 +208,27 @@ class BudgetAccountant(abc.ABC):
     def _commit_spend(self, index: int,
                       mechanism: "MechanismSpecInternal") -> None:
         spec = mechanism.mechanism_spec
+        if self._durable_spend_journal is not None:
+            # Durable at-most-once: persist the spend identity (fsync'd
+            # WAL append) before acknowledging it in the in-memory
+            # journal; a re-exec replaying this spend refuses here.
+            from pipelinedp_tpu.runtime import journal as journal_lib
+            token = ("budget_spend", float(self._total_epsilon),
+                     float(self._total_delta), int(index),
+                     str(spec.mechanism_type.value),
+                     float(mechanism.sensitivity), float(mechanism.weight),
+                     int(spec.count))
+            try:
+                self._durable_spend_journal.commit(token,
+                                                   kind="budget_spend")
+            except journal_lib.DoubleReleaseError as e:
+                raise BudgetAccountantError(
+                    f"mechanism {index} ({spec.mechanism_type.value}) "
+                    f"already committed its budget spend in the durable "
+                    f"spend journal — a re-executed run is about to "
+                    f"replay a committed epsilon/delta spend. Use a "
+                    f"fresh journal if a second, separately-accounted "
+                    f"run is intended.") from e
         self._spend_journal.append(
             SpendRecord(index=index,
                         mechanism_type=spec.mechanism_type,
@@ -324,9 +360,11 @@ class NaiveBudgetAccountant(BudgetAccountant):
                  total_epsilon: float,
                  total_delta: float,
                  num_aggregations: Optional[int] = None,
-                 aggregation_weights: Optional[list] = None):
+                 aggregation_weights: Optional[list] = None,
+                 durable_spend_journal=None):
         super().__init__(total_epsilon, total_delta, num_aggregations,
-                         aggregation_weights)
+                         aggregation_weights,
+                         durable_spend_journal=durable_spend_journal)
 
     def request_budget(self,
                        mechanism_type: MechanismType,
@@ -385,9 +423,11 @@ class PLDBudgetAccountant(BudgetAccountant):
                  total_delta: float,
                  pld_discretization: float = 1e-4,
                  num_aggregations: Optional[int] = None,
-                 aggregation_weights: Optional[list] = None):
+                 aggregation_weights: Optional[list] = None,
+                 durable_spend_journal=None):
         super().__init__(total_epsilon, total_delta, num_aggregations,
-                         aggregation_weights)
+                         aggregation_weights,
+                         durable_spend_journal=durable_spend_journal)
         self.minimum_noise_std: Optional[float] = None
         self._pld_discretization = pld_discretization
 

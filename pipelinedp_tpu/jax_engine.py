@@ -274,7 +274,8 @@ class JaxDPEngine:
                  checkpoint_policy=None,
                  retry_policy=None,
                  release_journal=None,
-                 fault_injector=None):
+                 fault_injector=None,
+                 watchdog_timeout_s=None):
         self._budget_accountant = budget_accountant
         self._report_generators = []
         self._key_stream = KeyStream(jax.random.PRNGKey(seed))
@@ -335,10 +336,16 @@ class JaxDPEngine:
         #     raises DoubleReleaseError instead.
         #   fault_injector: runtime.FaultInjector — deterministic fault
         #     scripting for tests (never set in production).
+        #   watchdog_timeout_s: bounded timeouts around device transfer/
+        #     dispatch in the streamed slab loop — a wedged operation
+        #     surfaces as a retryable runtime.DispatchHangError within
+        #     the timeout instead of hanging forever. None defers to
+        #     PIPELINEDP_TPU_WATCHDOG_S (0 = disabled, the default).
         self._checkpoint_policy = checkpoint_policy
         self._retry_policy = retry_policy
         self._release_journal = release_journal
         self._fault_injector = fault_injector
+        self._watchdog_timeout_s = watchdog_timeout_s
 
     def _next_key(self):
         return self._key_stream.next_key()
@@ -1318,7 +1325,8 @@ class JaxDPEngine:
         or None when no resilience knob is set (fail-fast, zero
         overhead — the historical behavior)."""
         if (self._checkpoint_policy is None and self._retry_policy is None
-                and self._fault_injector is None):
+                and self._fault_injector is None
+                and self._watchdog_timeout_s is None):
             return None
         from pipelinedp_tpu import runtime as runtime_lib
         return runtime_lib.StreamResilience(
@@ -1326,7 +1334,8 @@ class JaxDPEngine:
                           else runtime_lib.RetryPolicy()),
             fault_injector=self._fault_injector,
             checkpoint_policy=self._checkpoint_policy,
-            key_counter=key_counter)
+            key_counter=key_counter,
+            watchdog_timeout_s=self._watchdog_timeout_s)
 
     def _presort_vector_rows(self, pid, pk, value, n_rows: int,
                              num_partitions: int, l1_cap):

@@ -189,10 +189,14 @@ def _try_native_install() -> None:
     if _native_attempted:
         return
     _native_attempted = True
+    from pipelinedp_tpu.native import loader as native_loader
     try:
-        from pipelinedp_tpu.native import loader as native_loader
         ok = native_loader.install()
-    except Exception as e:  # noqa: BLE001 — native failure must not break noise
+    except native_loader.LOADER_ERRORS + (ValueError,) as e:
+        # Build/load/ctypes failures fall back to the numpy samplers;
+        # NativeRequiredError (and anything else) propagates — under
+        # PIPELINEDP_TPU_REQUIRE_NATIVE a toolchain regression must be a
+        # hard error, not a silent downgrade of the bit-level guarantees.
         _logging.warning(
             "pipelinedp_tpu: native secure-noise install raised %r; "
             "falling back to the seedable numpy samplers "

@@ -40,6 +40,7 @@ import numpy as np
 
 from pipelinedp_tpu.ops import columnar, wirecodec
 from pipelinedp_tpu import profiler
+from pipelinedp_tpu.runtime import driver as driver_lib
 
 # Knuth multiplicative hash so that structured pid spaces (all-even ids,
 # contiguous ranges handed out per site, ...) still shard evenly.
@@ -77,17 +78,18 @@ SLAB_BYTES_ENV = "PIPELINEDP_TPU_SLAB_BYTES"
 PREFETCH_ENV = "PIPELINEDP_TPU_PREFETCH_SLABS"
 
 # Profiler event counters (profiler.count_event / event_count), counted
-# per EXECUTED pass by the slab drivers:
+# per EXECUTED pass by the unified slab driver (runtime/driver.py, where
+# the per-chunk counters are canonical):
 #   EVENT_PARTITION_SCATTERS — full-[num_partitions] scatter passes whose
 #     input is row/group scale (the expensive kind: one per accumulator
 #     per chunk on the legacy path);
 #   EVENT_COMPACT_MERGE_SCATTERS — [num_partitions] scatters whose input
 #     is the compact per-chunk subtotal columns (once per accumulator per
-#     MERGE, not per chunk);
+#     MERGE, not per chunk; counted by the merge closures here);
 #   EVENT_COMPACT_CHUNKS — chunks that emitted compact group columns.
-EVENT_PARTITION_SCATTERS = "ops/partition_scatter_passes"
+EVENT_PARTITION_SCATTERS = driver_lib.EVENT_PARTITION_SCATTERS
 EVENT_COMPACT_MERGE_SCATTERS = "ops/compact_merge_scatter_passes"
-EVENT_COMPACT_CHUNKS = "ops/compact_chunk_emits"
+EVENT_COMPACT_CHUNKS = driver_lib.EVENT_COMPACT_CHUNKS
 
 # compact_merge="auto" engages the compact chunk merge at this partition
 # count and above. The merge trades the per-chunk full-[num_partitions]
@@ -725,7 +727,7 @@ def stream_bound_and_aggregate(
                     return enc.emit_range(s0, s1, fmt)
 
                 compact_step, merge_fn = compact_plan(fmt)
-                accs, qhist = _run_slab_loop(
+                accs, qhist = _drive_slab_windows(
                     key, k, counts, n_uniq, fmt, prepare_slab, step_chunk,
                     n_t, num_partitions, quantile_spec, resilience,
                     lambda: _input_digest(pid, pk, value),
@@ -741,7 +743,7 @@ def stream_bound_and_aggregate(
             fmt, int_clip, sort_stats = _finish_wire_plan(fmt)
             n_t = n_transfers or _num_transfers(slab.nbytes, k)
             compact_step, merge_fn = compact_plan(fmt)
-            accs, qhist = _run_slab_loop(
+            accs, qhist = _drive_slab_windows(
                 key, k, counts, n_uniq, fmt,
                 lambda s0, s1: slab[s0:s1], step_chunk,
                 n_t, num_partitions, quantile_spec, resilience,
@@ -795,7 +797,7 @@ def stream_bound_and_aggregate(
     bytes_cost = columnar.sort_cost(int(buckets.shape[1]),
                                     num_partitions=num_partitions,
                                     l1_mode=l1_cap is not None)
-    accs, _ = _run_slab_loop(
+    accs, _ = _drive_slab_windows(
         key, k, counts, None,
         ("bytes", bytes_pid, bytes_pk, value_f16, width),
         lambda s0, s1: buckets[s0:s1], step_chunk_bytes,
@@ -813,251 +815,125 @@ def _input_digest(pid, pk, value) -> str:
     return checkpoint_lib.array_digest(pid, pk, value)
 
 
-def _run_slab_loop(key, k, counts, n_uniq, fmt_desc, prepare_slab,
-                   step_chunk, n_transfers, num_partitions, quantile_spec,
-                   resilience, data_digest_fn=None, *,
-                   compact_step=None, merge_fn=None, scatter_passes=5,
-                   sort_stats=None):
-    """The resilient slab loop shared by every streaming encode path.
+def _snapshot_host(accs, qhist):
+    """Host copies of the slab-loop accumulator state for a checkpoint
+    snapshot (shared by the single-device and mesh placements)."""
+    # dplint: disable=DPL007 — checkpoint snapshot of pre-noise accumulators: never released, consumed only by fingerprint-validated resume (RESILIENCE.md)
+    host_accs, host_q = jax.device_get((tuple(accs), qhist))
+    return (tuple(np.asarray(a) for a in host_accs),
+            None if host_q is None else np.asarray(host_q))
 
-    Iterates chunks [0, k) in slab windows: ``prepare_slab(s0, s1)``
-    produces the host slab (sort+emit for the native codec, an array
-    slice otherwise), one async ``device_put`` ships it, and
-    ``step_chunk(c, row, accs, qhist, n_valid, n_uniq_c)`` folds each
-    chunk into the running accumulators with its ``fold_in(key, c)`` key.
 
-    Lookahead prefetch: a bounded background pool (``prefetch_depth()``
-    windows ahead, default 1) runs ``prepare_slab`` for upcoming windows
-    on host threads while the current window's device_put + kernels are
-    in flight — so the host sort+emit overlaps device work even through
-    the loop's synchronous tail. ``prepare_slab`` is a pure function of
-    its range (the native sort is idempotent per bucket), so a prefetched
-    slab that is discarded — fault, OOM window degradation, resume — is
-    simply recomputed; released values never depend on prefetch state.
-    The pool is drained before the loop returns or raises, so no
-    background encode can touch a closed native encoder.
+class _SingleDevicePlacement(driver_lib.DevicePlacement):
+    """Single-device strategy for the unified slab driver
+    (runtime/driver.py owns the loop; this class owns how slabs land on
+    the one device and how chunk steps fold).
 
-    Compact-merge mode (``compact_step``/``merge_fn`` set): each chunk's
-    kernel returns compact per-group subtotal columns instead of
-    scattering into the full [num_partitions] accumulators; the pending
-    columns fold into ``accs`` only at checkpoint time and once at the
-    end (columnar.merge_compact_chunks — one scatter per accumulator for
-    ALL chunks). Nothing is donated in this mode, so a failed dispatch
-    can never poison the running state and retries simply re-issue.
-    Checkpoint format and resume semantics are unchanged: a checkpoint
-    always stores dense accumulators, and a resumed run folds its
-    remaining chunks onto them in the same per-partition order as an
-    uninterrupted run (bit-identical).
-
-    With a ``runtime.StreamResilience`` attached the loop additionally:
-
-      * resumes from a fingerprint-validated ``StreamCheckpoint``
-        (explicit ``resume_from`` or the policy store) — bit-identical to
-        an uninterrupted run because the chunk key schedule and the host
-        encode are pure functions of ``(input, key)``;
-      * snapshots ``(accs, qhist, next_chunk)`` to the checkpoint store
-        after every ``every_slabs`` completed windows;
-      * classifies failures (runtime/retry.py): ``RESOURCE_EXHAUSTED``
-        halves the slab window and re-issues from the failed chunk (the
-        chunk keys don't depend on the slab grouping, so released values
-        are unchanged); transient faults re-issue after bounded
-        exponential backoff; anything else — including HostCrash —
-        propagates.
-
-    A failure raised *inside* a chunk step may have consumed the donated
-    accumulator buffers, so those retries restore state from the last
-    checkpoint (and re-raise when no checkpoint exists — resuming from
-    possibly-poisoned buffers would risk double-counting a chunk).
-
-    Returns (accs, qhist); qhist is None when quantile_spec is None.
+    The chunk steps (``_chunk_step*``) donate the accumulator buffers
+    into the kernel — five distinct zero buffers at init, fresh host
+    copies on restore, so donated buffers are never aliased — and device
+    OOM is recoverable by halving the slab window (the slab byte budget
+    is ours to choose, unlike the mesh's fixed chunk granularity).
     """
-    from pipelinedp_tpu import runtime as runtime_lib
-    from pipelinedp_tpu.runtime import checkpoint as checkpoint_lib
-    from pipelinedp_tpu.runtime import retry as retry_lib
 
-    # Five distinct buffers: the accumulators are donated into each chunk
-    # step, and a donated buffer must not be aliased.
-    accs = columnar.PartitionAccumulators(
-        *(jnp.zeros((num_partitions,), dtype=jnp.float32) for _ in range(5)))
-    qhist = (jnp.zeros((num_partitions, quantile_spec[0]),
-                       dtype=jnp.float32)
-             if quantile_spec is not None else None)
-    policy = injector = cp_policy = None
-    key_fp = wire_fp = None
-    cursor = 0
-    if resilience is not None:
-        policy = resilience.retry_policy
-        injector = resilience.fault_injector
-        cp_policy = resilience.checkpoint_policy
-        if cp_policy is not None or resilience.resume_from is not None:
-            key_fp = checkpoint_lib.key_fingerprint(key)
-            wire_fp = checkpoint_lib.wire_fingerprint(
-                k, repr(fmt_desc), counts, n_uniq,
-                data_digest=data_digest_fn() if data_digest_fn else "")
-            cp = resilience.resume_from
-            if cp is None and cp_policy is not None:
-                cp = cp_policy.store.load(cp_policy.run_id)
-            if cp is not None:
-                cp.validate(key_fp=key_fp, wire_fp=wire_fp, n_chunks=k,
-                            key_counter=resilience.key_counter)
-                accs, qhist, cursor = _restore_checkpoint(
-                    cp, expects_qhist=quantile_spec is not None)
-                profiler.count_event(runtime_lib.EVENT_RESUMES)
+    stage_prefix = "dp/stream_slab_"
+    prefetch_prefix = "pdp-slab-prefetch"
+    degradable = True
+    donates = True
 
-    def save_checkpoint(next_chunk, accs, qhist):
-        # dplint: disable=DPL007 — checkpoint snapshot of pre-noise accumulators: never released, consumed only by fingerprint-validated resume (RESILIENCE.md)
-        host_accs, host_q = jax.device_get((tuple(accs), qhist))
-        cp = checkpoint_lib.StreamCheckpoint(
-            run_id=cp_policy.run_id, next_chunk=next_chunk, n_chunks=k,
-            accs=tuple(np.asarray(a) for a in host_accs),
-            qhist=None if host_q is None else np.asarray(host_q),
-            key_fingerprint=key_fp, wire_fingerprint=wire_fp,
-            key_counter=resilience.key_counter)
-        cp_policy.store.save(cp)
-        profiler.count_event(runtime_lib.EVENT_CHECKPOINT_BYTES,
-                             cp.nbytes())
+    def __init__(self, *, num_partitions, counts, n_uniq, step_chunk,
+                 compact_step=None, merge_fn=None, quantile_leaves=None):
+        self._num_partitions = num_partitions
+        self._counts = counts
+        self._n_uniq = n_uniq
+        self._step_chunk = step_chunk
+        self._compact_fn = compact_step
+        self._merge_fn = merge_fn
+        self._quantile_leaves = quantile_leaves
+        self.compact = compact_step is not None and merge_fn is not None
 
-    compact = compact_step is not None and merge_fn is not None
-    pending = []  # compact mode: per-chunk CompactGroups since last merge
+    def init_state(self):
+        # Five distinct buffers: the accumulators are donated into each
+        # chunk step, and a donated buffer must not be aliased.
+        accs = columnar.PartitionAccumulators(
+            *(jnp.zeros((self._num_partitions,), dtype=jnp.float32)
+              for _ in range(5)))
+        qhist = (jnp.zeros((self._num_partitions, self._quantile_leaves),
+                           dtype=jnp.float32)
+                 if self._quantile_leaves is not None else None)
+        return accs, qhist
 
-    slab_buckets = max(1, (k + n_transfers - 1) // n_transfers)
-    ordinal = 0  # slab-window starts incl. re-issues (fault script index)
-    failures = 0  # consecutive failed attempts of the current window
-    since_checkpoint = 0
+    def transfer(self, slab, s0, s1):
+        return jax.device_put(slab)
 
-    # Lookahead prefetch pool (see docstring). Window keys are the exact
-    # (s0, s1) ranges, so a budget degradation naturally invalidates
-    # stale prefetches; stage times recorded by pool threads merge into
-    # this thread's collectors via the adopted sinks.
-    depth = prefetch_depth()
-    executor = None
-    inflight = {}
-    parent_sinks = profiler.current_sinks()
+    def _chunk_meta(self, c):
+        n_valid = int(self._counts[c])
+        n_uniq_c = int(self._n_uniq[c]) if self._n_uniq is not None else 0
+        return n_valid, n_uniq_c
 
-    def _prefetch_call(a, b):
-        with profiler.adopt_sinks(parent_sinks):
-            with profiler.stage("dp/wire_sort_parallel"):
-                return prepare_slab(a, b)
+    def step(self, c, payload, offset, accs, qhist):
+        n_valid, n_uniq_c = self._chunk_meta(c)
+        return self._step_chunk(c, payload[offset], accs, qhist, n_valid,
+                                n_uniq_c)
 
-    def _discard_inflight():
-        for fut in inflight.values():
-            fut.cancel()
-        inflight.clear()
+    def compact_step(self, c, payload, offset):
+        n_valid, n_uniq_c = self._chunk_meta(c)
+        return self._compact_fn(c, payload[offset], n_valid, n_uniq_c)
 
-    try:
-        if depth > 0 and k > 1:
-            import concurrent.futures
-            executor = concurrent.futures.ThreadPoolExecutor(
-                max_workers=depth, thread_name_prefix="pdp-slab-prefetch")
-        while cursor < k:
-            s1 = min(cursor + slab_buckets, k)
-            window = ordinal
-            ordinal += 1
-            in_dispatch = False
-            try:
-                with profiler.stage(f"dp/stream_slab_{cursor}"):
-                    fut = inflight.pop((cursor, s1), None)
-                    slab = (fut.result() if fut is not None
-                            else prepare_slab(cursor, s1))
-                    if executor is not None:
-                        nxt0 = s1
-                        while len(inflight) < depth and nxt0 < k:
-                            nxt1 = min(nxt0 + slab_buckets, k)
-                            if (nxt0, nxt1) not in inflight:
-                                inflight[(nxt0, nxt1)] = executor.submit(
-                                    _prefetch_call, nxt0, nxt1)
-                            nxt0 = nxt1
-                    if injector is not None:
-                        injector.check("transfer", window)
-                    dslab = jax.device_put(slab)
-                    if injector is not None:
-                        injector.check("kernel", window)
-                    s0 = cursor
-                    for c in range(s0, s1):
-                        n_valid = int(counts[c])
-                        n_uniq_c = (int(n_uniq[c])
-                                    if n_uniq is not None else 0)
-                        if compact:
-                            pending.append(
-                                compact_step(c, dslab[c - s0], n_valid,
-                                             n_uniq_c))
-                            profiler.count_event(EVENT_COMPACT_CHUNKS)
-                        else:
-                            in_dispatch = True
-                            accs, qhist = step_chunk(c, dslab[c - s0],
-                                                     accs, qhist, n_valid,
-                                                     n_uniq_c)
-                            in_dispatch = False
-                            profiler.count_event(EVENT_PARTITION_SCATTERS,
-                                                 scatter_passes)
-                        if sort_stats is not None:
-                            _count_sort_stats(sort_stats)
-                        cursor = c + 1
-            except Exception as exc:
-                failure_kind = retry_lib.classify(exc)
-                if policy is None or failure_kind == retry_lib.FATAL:
-                    raise
-                if in_dispatch:
-                    # The failing chunk step may have consumed its donated
-                    # accumulator buffers; only a checkpoint restores a
-                    # trustworthy state. (Compact mode never donates, so
-                    # it never lands here.)
-                    cp = (cp_policy.store.load(cp_policy.run_id)
-                          if cp_policy is not None else None)
-                    if cp is None:
-                        raise
-                    cp.validate(key_fp=key_fp, wire_fp=wire_fp, n_chunks=k,
-                                key_counter=resilience.key_counter)
-                    accs, qhist, cursor = _restore_checkpoint(
-                        cp, expects_qhist=quantile_spec is not None)
-                    pending.clear()
-                    profiler.count_event(runtime_lib.EVENT_RESUMES)
-                if failure_kind == retry_lib.OOM:
-                    smaller = policy.degrade_slab_buckets(slab_buckets)
-                    if smaller < slab_buckets:
-                        # Re-issue from the failed chunk with a halved
-                        # slab byte budget; the per-chunk key schedule is
-                        # untouched, so results are unchanged. Window
-                        # boundaries move — in-flight prefetches for the
-                        # old boundaries are discarded (pure recompute).
-                        slab_buckets = smaller
-                        _discard_inflight()
-                        profiler.count_event(
-                            runtime_lib.EVENT_DEGRADATIONS)
-                        continue
-                failures += 1
-                if failures > policy.max_retries:
-                    raise
-                profiler.count_event(runtime_lib.EVENT_RETRIES)
-                policy.sleep(policy.backoff_s(failures - 1))
-                continue
-            failures = 0
-            since_checkpoint += 1
-            if (cp_policy is not None and cursor < k
-                    and since_checkpoint >= cp_policy.every_slabs):
-                if compact and pending:
-                    # Fold pending compact chunks into the dense base so
-                    # the checkpoint format stays dense accumulators.
-                    accs = merge_fn(accs, pending)
-                    pending = []
-                save_checkpoint(cursor, accs, qhist)
-                since_checkpoint = 0
-    finally:
-        _discard_inflight()
-        if executor is not None:
-            executor.shutdown(wait=True)
-    if compact and pending:
-        accs = merge_fn(accs, pending)
-        pending = []
-    if cp_policy is not None and cp_policy.delete_on_success:
-        cp_policy.store.delete(cp_policy.run_id)
-    return accs, qhist
+    def merge_pending(self, accs, pending):
+        return self._merge_fn(accs, pending)
+
+    def snapshot(self, accs, qhist):
+        # dplint: disable=DPL007 — checkpoint snapshot of pre-noise accumulators: never released, consumed only by fingerprint-validated resume (RESILIENCE.md; same by-design transfer _snapshot_host suppresses)
+        return _snapshot_host(accs, qhist)
+
+    def restore(self, cp, expects_qhist):
+        return _restore_checkpoint(cp, expects_qhist=expects_qhist)
+
+
+def _drive_slab_windows(key, k, counts, n_uniq, fmt_desc, prepare_slab,
+                        step_chunk, n_transfers, num_partitions,
+                        quantile_spec, resilience, data_digest_fn=None, *,
+                        compact_step=None, merge_fn=None, scatter_passes=5,
+                        sort_stats=None):
+    """Runs the single-device streaming schedule on the unified slab
+    driver (runtime.SlabDriver — checkpoint/resume, retry + OOM window
+    degradation, lookahead prefetch, compact merge, fault injection and
+    the dispatch watchdog all live there, shared with the mesh path).
+
+    ``prepare_slab(s0, s1)`` produces the host slab (sort+emit for the
+    native codec, an array slice otherwise) and
+    ``step_chunk(c, row, accs, qhist, n_valid, n_uniq_c)`` folds each
+    chunk into the running accumulators with its ``fold_in(key, c)``
+    key. Returns (accs, qhist); qhist is None when quantile_spec is
+    None.
+    """
+    placement = _SingleDevicePlacement(
+        num_partitions=num_partitions, counts=counts, n_uniq=n_uniq,
+        step_chunk=step_chunk, compact_step=compact_step,
+        merge_fn=merge_fn,
+        quantile_leaves=(quantile_spec[0] if quantile_spec is not None
+                         else None))
+    plan = driver_lib.SlabPlan(
+        n_chunks=k,
+        window_chunks=max(1, (k + n_transfers - 1) // n_transfers),
+        fmt_desc=repr(fmt_desc),
+        counts=counts,
+        n_uniq=n_uniq,
+        scatter_passes=scatter_passes,
+        quantile=quantile_spec is not None,
+        data_digest_fn=data_digest_fn,
+        on_chunk=((lambda: _count_sort_stats(sort_stats))
+                  if sort_stats is not None else None),
+        prefetch_depth=prefetch_depth())
+    return driver_lib.SlabDriver(placement, plan, prepare_slab, key,
+                                 resilience).run()
 
 
 def _restore_checkpoint(cp, expects_qhist: bool = False):
-    """(accs, qhist, cursor) device state from a validated checkpoint.
-    Fresh host copies, so restored buffers never alias store state even
-    after the chunk steps donate them."""
+    """(accs, qhist) device state from a validated checkpoint. Fresh
+    host copies, so restored buffers never alias store state even after
+    the chunk steps donate them."""
     from pipelinedp_tpu.runtime import checkpoint as checkpoint_lib
 
     if expects_qhist and cp.qhist is None:
@@ -1067,7 +943,7 @@ def _restore_checkpoint(cp, expects_qhist: bool = False):
     accs = columnar.PartitionAccumulators(
         *(jnp.asarray(np.array(a)) for a in cp.accs))
     qhist = None if cp.qhist is None else jnp.asarray(np.array(cp.qhist))
-    return accs, qhist, int(cp.next_chunk)
+    return accs, qhist
 
 
 # Log the native-packer fallback once per process, not once per call

@@ -47,6 +47,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pipelinedp_tpu import profiler
+
 # Same Knuth multiplicative hash as streaming.py's bucketing (buckets must
 # stay pid-disjoint and identical across the codec and the legacy packer).
 _HASH_MULT = np.uint32(2654435761)
@@ -494,11 +496,18 @@ def decode_bucket(
 
 
 def _load_packer():
-    """The native row-packer library, or None (cached by the loader)."""
+    """The native row-packer library, or None (cached by the loader).
+
+    Only loader/build failures fall back (the codec is an optimization;
+    loader.LOADER_ERRORS is the typed set) — anything else, including
+    NativeRequiredError under PIPELINEDP_TPU_REQUIRE_NATIVE=1, must
+    propagate rather than silently downgrade to the numpy encoder (the
+    `_pack_native` pattern, ops/streaming.py)."""
+    from pipelinedp_tpu.native import loader
     try:
-        from pipelinedp_tpu.native import loader
         lib = loader.load_row_packer()
-    except Exception:  # noqa: BLE001 — codec is an optimization only
+    except loader.LOADER_ERRORS:
+        profiler.count_event("runtime/native_fallback")
         return None
     if lib is None or not hasattr(lib, "pdp_rle_prep"):
         return None

@@ -37,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pipelinedp_tpu.ops import columnar
 from pipelinedp_tpu.ops import quantiles as quantile_ops
+from pipelinedp_tpu.runtime import driver as driver_lib
 
 
 def shard_map(fn, *, mesh, in_specs, out_specs, check_vma=False):
@@ -862,7 +863,7 @@ def stream_bound_and_aggregate(mesh: Mesh,
                 num_partitions=padded_p, row_clip_lo=row_clip_lo,
                 row_clip_hi=row_clip_hi, linf_cap=linf_cap,
                 l1_mode=l1_cap is not None)
-            return _run_codec_chunks(mesh, key, emit, counts, n_uniq, fmt,
+            return _drive_codec_chunks(mesh, key, emit, counts, n_uniq, fmt,
                                      n_c, n_dev, padded_p, linf_cap, l0_cap,
                                      row_clip_lo, row_clip_hi, middle,
                                      group_clip_lo, group_clip_hi, l1_cap,
@@ -882,7 +883,7 @@ def stream_bound_and_aggregate(mesh: Mesh,
         num_partitions=padded_p, row_clip_lo=row_clip_lo,
         row_clip_hi=row_clip_hi, linf_cap=linf_cap,
         l1_mode=l1_cap is not None)
-    return _run_codec_chunks(mesh, key,
+    return _drive_codec_chunks(mesh, key,
                              lambda c: slab[c * n_dev:(c + 1) * n_dev],
                              counts, n_uniq, fmt, n_c,
                              n_dev, padded_p, linf_cap, l0_cap, row_clip_lo,
@@ -894,32 +895,83 @@ def stream_bound_and_aggregate(mesh: Mesh,
                              int_clip=int_clip, sort_stats=sort_stats)
 
 
-def _run_codec_chunks(mesh, key, emit, counts, n_uniq, fmt, n_c, n_dev,
-                      padded_p, linf_cap, l0_cap, row_clip_lo, row_clip_hi,
-                      middle, group_clip_lo, group_clip_hi, l1_cap,
-                      need_flags, has_group_clip, resilience=None,
-                      data_digest_fn=None, compact_merge: bool = True,
-                      int_clip=None, sort_stats=None):
-    """The mesh chunk loop, with the same resilience semantics as the
-    single-device slab loop (ops/streaming._run_slab_loop): each chunk is
-    one slab window — resumable, checkpointed, retried after transient
-    faults. Chunk accumulators are summed (never donated) and injected
-    faults fire before dispatch, so retrying a chunk is always safe; OOM
-    re-issues the chunk after backoff (the chunk granularity is fixed by
-    the mesh shape, so there is no slab budget to degrade).
+class _MeshPlacement(driver_lib.DevicePlacement):
+    """Mesh strategy for the unified slab driver (runtime/driver.py owns
+    the loop; this class owns how a chunk's sharded slab lands on the
+    mesh and how chunk partials fold).
 
-    Like the single-device loop it prefetches upcoming chunks' host
-    encode on a bounded background pool (streaming.prefetch_depth
-    windows, discarded safely on any failure — emit is pure), and in
-    compact-merge mode collects per-device compact group columns per
-    chunk, folding them into the dense sharded accumulators only at
-    checkpoints and once at the end (_compact_merge_kernel, which keeps
-    the legacy per-partition fold order for bit parity)."""
+    One chunk per slab window: the chunk granularity is fixed by the
+    mesh shape (n_dev codec buckets per chunk), so device OOM has no
+    slab budget to degrade — it re-issues like a transient fault.
+    Chunk accumulators are summed, never donated, so retrying a chunk
+    can never read poisoned state.
+    """
+
+    stage_prefix = "dp/mesh_stream_chunk_"
+    prefetch_prefix = "pdp-chunk-prefetch"
+    degradable = False
+    donates = False
+
+    def __init__(self, *, transfer_fn, run_chunk, part_sharding, merge_fn,
+                 compact, snapshot_fn):
+        self._transfer_fn = transfer_fn
+        self._run_chunk = run_chunk
+        self._part_sharding = part_sharding
+        self._merge_fn = merge_fn
+        self._snapshot_fn = snapshot_fn
+        self.compact = compact
+
+    def init_state(self):
+        # None until the first chunk: the first chunk's partials ARE the
+        # accumulators (no zeros + add), exactly as the legacy loop.
+        return None, None
+
+    def transfer(self, slab, s0, s1):
+        return self._transfer_fn(slab, s0)
+
+    def step(self, c, payload, offset, accs, qhist):
+        chunk_accs = self._run_chunk(c, payload)
+        if accs is None:
+            return chunk_accs, None
+        return columnar.PartitionAccumulators(
+            *(a + b for a, b in zip(accs, chunk_accs))), None
+
+    def compact_step(self, c, payload, offset):
+        return self._run_chunk(c, payload)
+
+    def merge_pending(self, accs, pending):
+        return self._merge_fn(accs, pending)
+
+    def snapshot(self, accs, qhist):
+        return self._snapshot_fn(accs, qhist)
+
+    def restore(self, cp, expects_qhist):
+        accs = columnar.PartitionAccumulators(
+            *(jax.device_put(np.array(a), self._part_sharding)
+              for a in cp.accs))
+        return accs, None
+
+def _drive_codec_chunks(mesh, key, emit, counts, n_uniq, fmt, n_c, n_dev,
+                        padded_p, linf_cap, l0_cap, row_clip_lo,
+                        row_clip_hi, middle, group_clip_lo, group_clip_hi,
+                        l1_cap, need_flags, has_group_clip, resilience=None,
+                        data_digest_fn=None, compact_merge: bool = True,
+                        int_clip=None, sort_stats=None):
+    """Runs the mesh chunk schedule on the unified slab driver
+    (runtime.SlabDriver — the same loop body as the single-device path,
+    so checkpoint/resume, retry, prefetch, compact merge, fault
+    injection and the dispatch watchdog are shared, not twinned).
+
+    Each chunk is one slab window: ``emit(c)`` is the pure host encode
+    (prefetchable, discardable), the transfer ships the chunk's sharded
+    [n_dev, W] slab plus its count/entry-count rows, and the chunk
+    kernel folds the reduce-scattered partials into the running sharded
+    accumulators. In compact-merge mode per-device compact group columns
+    collect per chunk and fold into the dense sharded accumulators only
+    at checkpoints and once at the end (_compact_merge_kernel, which
+    keeps the legacy per-partition fold order for bit parity)."""
     from pipelinedp_tpu import profiler
-    from pipelinedp_tpu import runtime as runtime_lib
     from pipelinedp_tpu.ops import streaming
-    from pipelinedp_tpu.runtime import checkpoint as checkpoint_lib
-    from pipelinedp_tpu.runtime import retry as retry_lib
 
     max_groups = None
     if (streaming._compact_enabled(compact_merge, padded_p)
@@ -946,10 +998,25 @@ def _run_codec_chunks(mesh, key, emit, counts, n_uniq, fmt, n_c, n_dev,
         sort_stats = {name: v * n_dev for name, v in sort_stats.items()}
     sharding = NamedSharding(mesh, _spec(mesh))
     part_sharding = NamedSharding(mesh, _part_spec(mesh))
-    accs = None
-    pending = []  # compact mode: CompactGroups per chunk since last merge
     counts = np.asarray(counts, dtype=np.int32)
     n_uniq = np.asarray(n_uniq, dtype=np.int32)
+
+    def transfer_chunk(slab, c):
+        dslab = jax.device_put(slab, sharding)
+        dvalid = jax.device_put(counts[c * n_dev:(c + 1) * n_dev],
+                                sharding)
+        duniq = jax.device_put(n_uniq[c * n_dev:(c + 1) * n_dev],
+                               sharding)
+        return dslab, dvalid, duniq
+
+    def run_chunk(c, payload):
+        dslab, dvalid, duniq = payload
+        args = (jax.random.fold_in(key, c), dslab, dvalid, duniq,
+                linf_cap, l0_cap, float(row_clip_lo), float(row_clip_hi),
+                float(middle), float(group_clip_lo), float(group_clip_hi))
+        if l1_cap is not None:
+            args += (l1_cap,)
+        return kernel(*args)
 
     def merge_pending(accs, pending):
         if accs is None:
@@ -971,137 +1038,24 @@ def _run_codec_chunks(mesh, key, emit, counts, n_uniq, fmt, n_c, n_dev,
         flat = [a for p in pending for a in p[:6]]
         return merge(accs, *flat)
 
-    policy = injector = cp_policy = None
-    key_fp = wire_fp = None
-    cursor = 0
-    if resilience is not None:
-        policy = resilience.retry_policy
-        injector = resilience.fault_injector
-        cp_policy = resilience.checkpoint_policy
-        if cp_policy is not None or resilience.resume_from is not None:
-            key_fp = checkpoint_lib.key_fingerprint(key)
-            wire_fp = checkpoint_lib.wire_fingerprint(
-                n_c, repr(("mesh", n_dev, fmt)), counts, n_uniq,
-                data_digest=data_digest_fn() if data_digest_fn else "")
-            cp = resilience.resume_from
-            if cp is None and cp_policy is not None:
-                cp = cp_policy.store.load(cp_policy.run_id)
-            if cp is not None:
-                cp.validate(key_fp=key_fp, wire_fp=wire_fp, n_chunks=n_c,
-                            key_counter=resilience.key_counter)
-                accs = columnar.PartitionAccumulators(
-                    *(jax.device_put(np.array(a), part_sharding)
-                      for a in cp.accs))
-                cursor = int(cp.next_chunk)
-                profiler.count_event(runtime_lib.EVENT_RESUMES)
-
-    ordinal = 0
-    failures = 0
-    since_checkpoint = 0
-
-    depth = streaming.prefetch_depth()
-    executor = None
-    inflight = {}
-    parent_sinks = profiler.current_sinks()
-
-    def _prefetch_call(c):
-        with profiler.adopt_sinks(parent_sinks):
-            with profiler.stage("dp/wire_sort_parallel"):
-                return emit(c)
-
-    def _discard_inflight():
-        for fut in inflight.values():
-            fut.cancel()
-        inflight.clear()
-
-    try:
-        if depth > 0 and n_c > 1:
-            import concurrent.futures
-            executor = concurrent.futures.ThreadPoolExecutor(
-                max_workers=depth, thread_name_prefix="pdp-chunk-prefetch")
-        while cursor < n_c:
-            c = cursor
-            window = ordinal
-            ordinal += 1
-            try:
-                with profiler.stage(f"dp/mesh_stream_chunk_{c}"):
-                    fut = inflight.pop(c, None)
-                    slab = fut.result() if fut is not None else emit(c)
-                    if executor is not None:
-                        nxt = c + 1
-                        while len(inflight) < depth and nxt < n_c:
-                            if nxt not in inflight:
-                                inflight[nxt] = executor.submit(
-                                    _prefetch_call, nxt)
-                            nxt += 1
-                    if injector is not None:
-                        injector.check("transfer", window)
-                    dslab = jax.device_put(slab, sharding)
-                    dvalid = jax.device_put(
-                        counts[c * n_dev:(c + 1) * n_dev], sharding)
-                    duniq = jax.device_put(
-                        n_uniq[c * n_dev:(c + 1) * n_dev], sharding)
-                    if injector is not None:
-                        injector.check("kernel", window)
-                    args = (jax.random.fold_in(key, c), dslab, dvalid,
-                            duniq, linf_cap, l0_cap, float(row_clip_lo),
-                            float(row_clip_hi), float(middle),
-                            float(group_clip_lo), float(group_clip_hi))
-                    if l1_cap is not None:
-                        args += (l1_cap,)
-                    if compact:
-                        pending.append(kernel(*args))
-                        profiler.count_event(streaming.EVENT_COMPACT_CHUNKS)
-                    else:
-                        chunk_accs = kernel(*args)
-                        accs = chunk_accs if accs is None else (
-                            columnar.PartitionAccumulators(
-                                *(a + b for a, b in zip(accs,
-                                                        chunk_accs))))
-                        profiler.count_event(
-                            streaming.EVENT_PARTITION_SCATTERS,
-                            scatter_passes)
-                    if sort_stats is not None:
-                        streaming._count_sort_stats(sort_stats)
-                    cursor = c + 1
-            except Exception as exc:
-                failure_kind = retry_lib.classify(exc)
-                if policy is None or failure_kind == retry_lib.FATAL:
-                    raise
-                failures += 1
-                if failures > policy.max_retries:
-                    raise
-                profiler.count_event(runtime_lib.EVENT_RETRIES)
-                policy.sleep(policy.backoff_s(failures - 1))
-                continue
-            failures = 0
-            since_checkpoint += 1
-            if (cp_policy is not None and cursor < n_c
-                    and since_checkpoint >= cp_policy.every_slabs):
-                if compact and pending:
-                    accs = merge_pending(accs, pending)
-                    pending = []
-                host_accs = jax.device_get(tuple(accs))
-                cp = checkpoint_lib.StreamCheckpoint(
-                    run_id=cp_policy.run_id, next_chunk=cursor,
-                    n_chunks=n_c,
-                    accs=tuple(np.asarray(a) for a in host_accs),
-                    qhist=None,
-                    key_fingerprint=key_fp, wire_fingerprint=wire_fp,
-                    key_counter=resilience.key_counter)
-                cp_policy.store.save(cp)
-                profiler.count_event(runtime_lib.EVENT_CHECKPOINT_BYTES,
-                                     cp.nbytes())
-                since_checkpoint = 0
-    finally:
-        _discard_inflight()
-        if executor is not None:
-            executor.shutdown(wait=True)
-    if compact and pending:
-        accs = merge_pending(accs, pending)
-        pending = []
-    if cp_policy is not None and cp_policy.delete_on_success:
-        cp_policy.store.delete(cp_policy.run_id)
+    placement = _MeshPlacement(
+        transfer_fn=transfer_chunk, run_chunk=run_chunk,
+        part_sharding=part_sharding, merge_fn=merge_pending,
+        compact=compact, snapshot_fn=streaming._snapshot_host)
+    plan = driver_lib.SlabPlan(
+        n_chunks=n_c,
+        window_chunks=1,  # chunk granularity is fixed by the mesh shape
+        fmt_desc=repr(("mesh", n_dev, fmt)),
+        counts=counts,
+        n_uniq=n_uniq,
+        scatter_passes=scatter_passes,
+        quantile=False,
+        data_digest_fn=data_digest_fn,
+        on_chunk=((lambda: streaming._count_sort_stats(sort_stats))
+                  if sort_stats is not None else None),
+        prefetch_depth=streaming.prefetch_depth())
+    accs, _ = driver_lib.SlabDriver(
+        placement, plan, lambda s0, s1: emit(s0), key, resilience).run()
     return accs
 
 

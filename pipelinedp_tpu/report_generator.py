@@ -14,6 +14,7 @@ from __future__ import annotations
 from typing import Callable, List, Optional, Union
 
 from pipelinedp_tpu import aggregate_params as agg
+from pipelinedp_tpu import budget_accounting
 
 
 class ReportGenerator:
@@ -62,7 +63,13 @@ class ExplainComputationReport:
                 "an argument to a DP aggregation method?")
         try:
             return self._report_generator.report()
-        except Exception as e:
+        except (AssertionError, AttributeError, TypeError, ValueError,
+                budget_accounting.BudgetAccountantError) as e:
+            # The lazy stage callables read budget numbers off the
+            # MechanismSpecs; before compute_budgets() those reads raise
+            # AssertionError ("not calculated yet" — the reference's
+            # pinned contract) or one of these typed errors. Anything
+            # else is a bug in a stage renderer and must propagate as-is.
             raise ValueError(
                 "Explain computation report failed to be generated.\nWas "
                 "BudgetAccountant.compute_budgets() called?") from e

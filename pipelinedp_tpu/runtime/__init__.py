@@ -1,5 +1,6 @@
-"""Resilient streaming runtime: checkpoint/resume, OOM-adaptive retry,
-and at-most-once DP release.
+"""Resilient streaming runtime: the unified slab driver, checkpoint/
+resume, OOM-adaptive retry, dispatch watchdog, and durable at-most-once
+DP release.
 
 The reference inherits fault tolerance from its backends (Beam and Spark
 re-execute lost work transparently); the TPU-native runtime gets the
@@ -15,27 +16,41 @@ already has:
 
 What lives where:
 
+  * :mod:`driver` — ``SlabDriver``: THE slab loop, written once, driving
+    both streaming entry points (single-device ``ops/streaming`` and
+    mesh ``parallel/sharded``) through a ``DevicePlacement`` strategy;
+    checkpointing, retry, prefetch, compact merge, fault injection and
+    the watchdog each exist exactly once here.
   * :mod:`checkpoint` — ``StreamCheckpoint`` snapshots
     ``(accs, qhist, next_chunk, wire/rng fingerprints, KeyStream
     counter)`` after each slab into a ``CheckpointStore`` (in-memory or
-    file-backed); a resumed run is bit-identical to an uninterrupted one.
+    file-backed with payload digests + keep-last-K retention); a resumed
+    run is bit-identical to an uninterrupted one.
   * :mod:`retry` — ``RetryPolicy``: bounded exponential backoff for
-    transient transfer/kernel errors; on ``RESOURCE_EXHAUSTED`` the slab
-    byte budget is halved and the failed slab re-issued (the per-chunk
-    key schedule never changes, so results stay distribution-identical —
-    bit-identical for a seeded run).
-  * :mod:`journal` — ``ReleaseJournal``: at-most-once noise release. A
-    resumed or retried run that would re-draw already-released noise
-    raises instead of silently degrading the DP guarantee (the budget
-    side lives in ``budget_accounting`` as the spend journal).
+    transient transfer/kernel errors and watchdog hangs; on
+    ``RESOURCE_EXHAUSTED`` the slab byte budget is halved and the failed
+    slab re-issued (the per-chunk key schedule never changes, so results
+    stay distribution-identical — bit-identical for a seeded run).
+  * :mod:`watchdog` — ``DispatchWatchdog``: bounded timeouts around the
+    transfer/dispatch/sync points so a wedged device operation surfaces
+    as a typed, retryable ``DispatchHangError`` instead of hanging the
+    loop forever.
+  * :mod:`journal` — ``ReleaseJournal`` / ``FileReleaseJournal``:
+    at-most-once noise release, in-memory or durable (fsync'd WAL with
+    per-record digests, torn-tail-tolerant recovery, atomic compaction)
+    so even a re-exec'd process refuses to re-draw released noise (the
+    budget side lives in ``budget_accounting`` as the spend journal,
+    durable through the same WAL via ``durable_spend_journal=``).
   * :mod:`faults` — ``FaultInjector``: scripted OOM / transfer / kernel /
-    host-crash faults at slab N, driving ``tests/resilience_test.py``.
+    hang / host-crash / SIGKILL faults at slab N, driving
+    ``tests/resilience_test.py`` and the cross-process kill harness.
 
 ``JaxDPEngine`` exposes all of it via the ``checkpoint_policy=``,
-``retry_policy=``, ``release_journal=`` and ``fault_injector=`` knobs;
-``ops/streaming.stream_bound_and_aggregate`` and the mesh twin take a
-``resilience=`` bundle plus an explicit ``resume_from=`` hook. See
-RESILIENCE.md for the failure model and recovery semantics.
+``retry_policy=``, ``release_journal=``, ``fault_injector=`` and
+``watchdog_timeout_s=`` knobs; ``ops/streaming.stream_bound_and_aggregate``
+and the mesh twin take a ``resilience=`` bundle plus an explicit
+``resume_from=`` hook. See RESILIENCE.md for the failure model and
+recovery semantics.
 """
 
 from __future__ import annotations
@@ -52,14 +67,19 @@ from pipelinedp_tpu.runtime.faults import (  # noqa: F401
     FaultInjector, FaultSpec, HostCrash, InjectedFault, InjectedKernelError,
     InjectedOom, InjectedTransferError)
 from pipelinedp_tpu.runtime.journal import (  # noqa: F401
-    DoubleReleaseError, ReleaseJournal, ReleaseRecord)
+    EVENT_JOURNAL_BYTES, EVENT_JOURNAL_RECOVERIES, DoubleReleaseError,
+    FileReleaseJournal, JournalCorruptError, ReleaseJournal, ReleaseRecord)
 from pipelinedp_tpu.runtime.retry import RetryPolicy, classify  # noqa: F401
+from pipelinedp_tpu.runtime.watchdog import (  # noqa: F401
+    EVENT_WATCHDOG_TIMEOUTS, DispatchHangError, DispatchWatchdog)
+from pipelinedp_tpu.runtime.driver import (  # noqa: F401
+    EVENT_CHECKPOINT_BYTES, EVENT_DEGRADATIONS, EVENT_HANGS, EVENT_RESUMES,
+    EVENT_RETRIES, DevicePlacement, SlabDriver, SlabPlan)
 
 # Profiler event-counter names (profiler.count_event / event_count).
-EVENT_RETRIES = "runtime/retries"
-EVENT_DEGRADATIONS = "runtime/degradations"
-EVENT_RESUMES = "runtime/resumes"
-EVENT_CHECKPOINT_BYTES = "runtime/checkpoint_bytes"
+# Loop-owned counters live in runtime/driver.py, watchdog/journal
+# counters in their modules; the native-fallback counter is credited by
+# ops/streaming._pack_native.
 EVENT_NATIVE_FALLBACK = "runtime/native_fallback"
 
 
@@ -71,12 +91,20 @@ class StreamResilience:
     key was drawn at; checkpoints record it so a resume under a different
     key schedule (which could never be bit-identical) is refused instead
     of silently diverging. -1 = unknown (direct streaming-API callers).
+
+    ``watchdog_timeout_s`` bounds every device transfer/dispatch and adds
+    one per-window sync: a wedged operation surfaces as a retryable
+    ``DispatchHangError`` within the timeout instead of hanging forever.
+    None defers to ``PIPELINEDP_TPU_WATCHDOG_S`` (0 = disabled, the
+    default — enabling it trades a little cross-window pipelining for
+    bounded hang detection).
     """
     retry_policy: RetryPolicy = dataclasses.field(default_factory=RetryPolicy)
     fault_injector: Optional[FaultInjector] = None
     checkpoint_policy: Optional[CheckpointPolicy] = None
     resume_from: Optional[StreamCheckpoint] = None
     key_counter: int = -1
+    watchdog_timeout_s: Optional[float] = None
 
 
 def resilience_counters() -> Dict[str, int]:
@@ -88,4 +116,8 @@ def resilience_counters() -> Dict[str, int]:
         "resumes": profiler.event_count(EVENT_RESUMES),
         "checkpoint_bytes": profiler.event_count(EVENT_CHECKPOINT_BYTES),
         "native_fallbacks": profiler.event_count(EVENT_NATIVE_FALLBACK),
+        "watchdog_timeouts": profiler.event_count(EVENT_WATCHDOG_TIMEOUTS),
+        "hangs_detected": profiler.event_count(EVENT_HANGS),
+        "journal_recoveries": profiler.event_count(EVENT_JOURNAL_RECOVERIES),
+        "journal_bytes": profiler.event_count(EVENT_JOURNAL_BYTES),
     }

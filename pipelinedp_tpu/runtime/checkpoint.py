@@ -31,9 +31,11 @@ import abc
 import dataclasses
 import hashlib
 import json
+import logging
 import os
 import re
 import tempfile
+import zipfile
 from typing import Optional, Tuple
 
 import numpy as np
@@ -179,21 +181,66 @@ class InMemoryCheckpointStore(CheckpointStore):
         self._checkpoints.pop(run_id, None)
 
 
-class FileCheckpointStore(CheckpointStore):
-    """File-backed store: one ``<run_id>.npz`` per run under ``root``,
-    written atomically (tmp file + rename) so a crash mid-save leaves the
-    previous checkpoint intact."""
+def _payload_digest(accs, qhist, meta_core: str) -> str:
+    """Full-content digest of one snapshot (dtype/shape/bytes of every
+    array + the core metadata). Unlike ``array_digest`` this hashes every
+    byte: a checkpoint is small (the accumulators, not the input), and a
+    torn/bit-rotted snapshot must be *distinguishable* from a legitimate
+    fingerprint mismatch so recovery can fall back to an older snapshot
+    instead of refusing the resume outright."""
+    digest = hashlib.sha256()
+    digest.update(meta_core.encode())
+    for arr in accs + ((qhist,) if qhist is not None else ()):
+        arr = np.asarray(arr)
+        digest.update(str((arr.dtype, arr.shape)).encode())
+        digest.update(np.ascontiguousarray(arr).tobytes())
+    return digest.hexdigest()[:32]
 
-    def __init__(self, root: str):
+
+class FileCheckpointStore(CheckpointStore):
+    """File-backed store surviving the process.
+
+    One ``<run_id>.<seq>.npz`` per snapshot under ``root``, written
+    atomically (tmp file + rename) so a crash mid-save leaves the
+    previous snapshot intact. Every snapshot embeds a full payload
+    digest, so ``load`` can tell a torn/corrupted file (skipped, with a
+    warning, falling back to the previous good snapshot) from a
+    checkpoint that simply belongs to a different run (surfaced as a
+    ``CheckpointMismatchError`` at validation). ``keep`` bounds how many
+    snapshots per run survive on disk: after each successful save, older
+    snapshots beyond the newest ``keep`` are pruned (each prune is a
+    single unlink after the new snapshot's rename, so no crash window
+    ever leaves fewer than ``keep - 1`` good snapshots).
+    """
+
+    def __init__(self, root: str, keep: int = 2):
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
         self._root = root
+        self._keep = keep
         os.makedirs(root, exist_ok=True)
 
-    def _path(self, run_id: str) -> str:
-        safe = re.sub(r"[^A-Za-z0-9._-]", "_", run_id)
-        return os.path.join(self._root, f"{safe}.npz")
+    def _safe(self, run_id: str) -> str:
+        return re.sub(r"[^A-Za-z0-9._-]", "_", run_id)
+
+    def _snapshots(self, run_id: str):
+        """[(seq, path)] for run_id, newest first. Legacy single-file
+        checkpoints (``<run_id>.npz``, written before retention existed)
+        participate as seq -1."""
+        safe = self._safe(run_id)
+        pattern = re.compile(re.escape(safe) + r"\.(\d{8})\.npz$")
+        out = []
+        for name in os.listdir(self._root):
+            m = pattern.fullmatch(name)
+            if m:
+                out.append((int(m.group(1)),
+                            os.path.join(self._root, name)))
+            elif name == f"{safe}.npz":
+                out.append((-1, os.path.join(self._root, name)))
+        return sorted(out, reverse=True)
 
     def save(self, checkpoint: StreamCheckpoint) -> None:
-        meta = json.dumps({
+        meta_fields = {
             "run_id": checkpoint.run_id,
             "next_chunk": int(checkpoint.next_chunk),
             "n_chunks": int(checkpoint.n_chunks),
@@ -201,13 +248,22 @@ class FileCheckpointStore(CheckpointStore):
             "wire_fingerprint": checkpoint.wire_fingerprint,
             "key_counter": int(checkpoint.key_counter),
             "has_qhist": checkpoint.qhist is not None,
-        })
-        arrays = {f"accs_{i}": np.asarray(a)
-                  for i, a in enumerate(checkpoint.accs)}
-        if checkpoint.qhist is not None:
-            arrays["qhist"] = np.asarray(checkpoint.qhist)
-        arrays["meta"] = np.frombuffer(meta.encode(), dtype=np.uint8)
-        path = self._path(checkpoint.run_id)
+        }
+        meta_core = json.dumps(meta_fields, sort_keys=True)
+        accs = tuple(np.asarray(a) for a in checkpoint.accs)
+        qhist = (None if checkpoint.qhist is None
+                 else np.asarray(checkpoint.qhist))
+        meta_fields["payload_digest"] = _payload_digest(accs, qhist,
+                                                        meta_core)
+        arrays = {f"accs_{i}": a for i, a in enumerate(accs)}
+        if qhist is not None:
+            arrays["qhist"] = qhist
+        arrays["meta"] = np.frombuffer(
+            json.dumps(meta_fields).encode(), dtype=np.uint8)
+        snapshots = self._snapshots(checkpoint.run_id)
+        seq = (snapshots[0][0] + 1) if snapshots else 0
+        path = os.path.join(self._root,
+                            f"{self._safe(checkpoint.run_id)}.{seq:08d}.npz")
         fd, tmp = tempfile.mkstemp(dir=self._root, suffix=".tmp")
         try:
             with os.fdopen(fd, "wb") as f:
@@ -217,16 +273,32 @@ class FileCheckpointStore(CheckpointStore):
             if os.path.exists(tmp):
                 os.unlink(tmp)
             raise
+        # Retention: prune beyond the newest `keep` only after the new
+        # snapshot is durably in place.
+        for _, old_path in self._snapshots(checkpoint.run_id)[self._keep:]:
+            try:
+                os.unlink(old_path)
+            except FileNotFoundError:
+                pass
 
-    def load(self, run_id: str) -> Optional[StreamCheckpoint]:
-        path = self._path(run_id)
-        if not os.path.exists(path):
+    def _load_snapshot(self, path: str) -> Optional[StreamCheckpoint]:
+        """One snapshot file, or None when torn/corrupt (digest or
+        container failure)."""
+        try:
+            with np.load(path, allow_pickle=False) as data:
+                meta = json.loads(bytes(data["meta"]).decode())
+                n_accs = sum(1 for name in data.files
+                             if name.startswith("accs_"))
+                accs = tuple(data[f"accs_{i}"] for i in range(n_accs))
+                qhist = data["qhist"] if meta["has_qhist"] else None
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile):
             return None
-        with np.load(path, allow_pickle=False) as data:
-            meta = json.loads(bytes(data["meta"]).decode())
-            n_accs = sum(1 for name in data.files if name.startswith("accs_"))
-            accs = tuple(data[f"accs_{i}"] for i in range(n_accs))
-            qhist = data["qhist"] if meta["has_qhist"] else None
+        expected = meta.pop("payload_digest", None)
+        if expected is not None:
+            meta_core = json.dumps(meta, sort_keys=True)
+            if _payload_digest(accs, qhist, meta_core) != expected:
+                return None
+        # expected None: legacy pre-digest snapshot — accepted as-is.
         return StreamCheckpoint(
             run_id=meta["run_id"],
             next_chunk=meta["next_chunk"],
@@ -237,10 +309,23 @@ class FileCheckpointStore(CheckpointStore):
             wire_fingerprint=meta["wire_fingerprint"],
             key_counter=meta["key_counter"])
 
+    def load(self, run_id: str) -> Optional[StreamCheckpoint]:
+        for seq, path in self._snapshots(run_id):
+            checkpoint = self._load_snapshot(path)
+            if checkpoint is not None:
+                return checkpoint
+            logging.warning(
+                "pipelinedp_tpu checkpoint: snapshot %s is torn or "
+                "corrupt (payload digest mismatch); falling back to the "
+                "previous snapshot", path)
+        return None
+
     def delete(self, run_id: str) -> None:
-        path = self._path(run_id)
-        if os.path.exists(path):
-            os.unlink(path)
+        for _, path in self._snapshots(run_id):
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
 
 
 @dataclasses.dataclass

@@ -21,12 +21,27 @@ Kinds:
   * ``host_crash`` — raises :class:`HostCrash` at the transfer point; the
     retry layer never catches it (it simulates process death — the test
     harness "restarts" by building a fresh engine and resuming).
+  * ``hang`` — raises nothing: ``check`` *blocks* for ``hang_s`` seconds
+    at the transfer point, simulating a wedged transfer. The slab driver
+    runs the transfer-point check inside its dispatch watchdog
+    (runtime/watchdog.py), so a configured watchdog surfaces the hang as
+    a typed, retryable ``DispatchHangError`` within its timeout; without
+    a watchdog the stall is simply endured — exactly the failure mode
+    the watchdog exists for. ``hang_s`` bounds the simulated wedge so an
+    unguarded test still terminates.
+  * ``sigkill`` — ``os.kill(getpid(), SIGKILL)`` at the transfer point:
+    *real* process death, no interpreter cleanup, for the cross-process
+    kill/re-exec/resume harness (tests/kill_harness.py). Unlike
+    ``host_crash`` nothing propagates — the process is simply gone.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import hashlib
+import os
+import signal
+import time
 from typing import List, Sequence, Tuple
 
 
@@ -70,13 +85,18 @@ KIND_OOM = "oom"
 KIND_TRANSFER = "transfer"
 KIND_KERNEL = "kernel"
 KIND_HOST_CRASH = "host_crash"
+KIND_HANG = "hang"
+KIND_SIGKILL = "sigkill"
 
-# Which driver callpoint each fault kind fires at, and what it raises.
+# Which driver callpoint each fault kind fires at, and what it raises
+# (hang blocks and sigkill kills instead of raising).
 _POINT_OF_KIND = {
     KIND_OOM: "transfer",
     KIND_TRANSFER: "transfer",
     KIND_HOST_CRASH: "transfer",
     KIND_KERNEL: "kernel",
+    KIND_HANG: "transfer",
+    KIND_SIGKILL: "transfer",
 }
 _EXC_OF_KIND = {
     KIND_OOM: InjectedOom,
@@ -88,10 +108,15 @@ _EXC_OF_KIND = {
 
 @dataclasses.dataclass
 class FaultSpec:
-    """Fire ``kind`` starting at slab-window ``at_slab``, ``times`` times."""
+    """Fire ``kind`` starting at slab-window ``at_slab``, ``times`` times.
+
+    hang_s: how long a ``hang`` firing blocks (its consumption is
+    recorded *before* the stall, so a watchdog-aborted attempt does not
+    re-fire on retry)."""
     kind: str
     at_slab: int
     times: int = 1
+    hang_s: float = 30.0
 
     def __post_init__(self):
         if self.kind not in _POINT_OF_KIND:
@@ -107,13 +132,19 @@ class FaultInjector:
         self.fired: List[Tuple[str, int]] = []  # (kind, slab_ordinal) log
 
     def check(self, point: str, slab_ordinal: int) -> None:
-        """Raises the scripted fault if any armed spec matches ``point``
-        at this window; consumes one firing from the spec."""
+        """Raises (or blocks, or kills — see the kind catalog above) the
+        scripted fault if any armed spec matches ``point`` at this
+        window; consumes one firing from the spec."""
         for spec in self._specs:
             if (spec.times > 0 and _POINT_OF_KIND[spec.kind] == point
                     and slab_ordinal >= spec.at_slab):
                 spec.times -= 1
                 self.fired.append((spec.kind, slab_ordinal))
+                if spec.kind == KIND_HANG:
+                    time.sleep(spec.hang_s)
+                    return
+                if spec.kind == KIND_SIGKILL:
+                    os.kill(os.getpid(), signal.SIGKILL)
                 raise _EXC_OF_KIND[spec.kind](slab_ordinal)
 
     @property
@@ -122,15 +153,20 @@ class FaultInjector:
         return sum(max(spec.times, 0) for spec in self._specs)
 
     @classmethod
-    def chaos(cls, seed: int, n_slabs: int,
-              fire_percent: int = 25) -> "FaultInjector":
+    def chaos(cls, seed: int, n_slabs: int, fire_percent: int = 25,
+              include_hang: bool = False,
+              hang_s: float = 1.0) -> "FaultInjector":
         """A deterministic pseudo-random script over ``n_slabs`` windows.
 
         Hash-derived (no RNG state, identical across platforms and
         calls): each window fires one transient fault kind with
-        ``fire_percent`` probability. host_crash is excluded — a chaos
-        run must be completable by retries alone; crash-and-resume has
-        its own scripted tests.
+        ``fire_percent`` probability. host_crash and sigkill are
+        excluded — a chaos run must be completable by retries alone;
+        crash-and-resume has its own scripted tests. include_hang adds
+        the blocking ``hang`` kind to the rotation (same seed => same
+        oom/transfer/kernel placement as without it, hangs layered on a
+        distinct hash byte) — run those scripts with a dispatch watchdog
+        shorter than ``hang_s`` so every hang is detected and retried.
         """
         retryable = (KIND_OOM, KIND_TRANSFER, KIND_KERNEL)
         specs = []
@@ -140,4 +176,7 @@ class FaultInjector:
                 specs.append(
                     FaultSpec(kind=retryable[digest[1] % len(retryable)],
                               at_slab=slab))
+            elif include_hang and digest[2] % 100 < fire_percent:
+                specs.append(FaultSpec(kind=KIND_HANG, at_slab=slab,
+                                       hang_s=hang_s))
         return cls(specs)

@@ -1,4 +1,4 @@
-"""At-most-once release journal.
+"""At-most-once release journal (in-memory and durable file-backed).
 
 DP correctness survives crashes only if recovery is at-most-once with
 respect to randomness release: a retry that re-draws already-released
@@ -10,25 +10,61 @@ twice raises :class:`DoubleReleaseError` instead of silently leaking.
 
 The budget side (each mechanism's epsilon/delta spend committed exactly
 once) lives on the accountant itself: ``BudgetAccountant.spend_journal``
-plus the one-shot ``MechanismSpec`` setters in budget_accounting.py.
+plus the one-shot ``MechanismSpec`` setters in budget_accounting.py — and
+the accountant's ``durable_spend_journal=`` knob persists those spends
+through this module's file journal, so a re-exec'd pipeline refuses to
+replay a committed spend too.
+
+Durability: the in-memory :class:`ReleaseJournal` dies with the process —
+which is exactly the failure the resilient runtime exists to survive, so
+production runs use :class:`FileReleaseJournal`: a WAL-style append-only
+file, one fsync'd JSON record per commit with a per-record digest. The
+commit ordering guarantee is *write-ahead*: the record is durable on disk
+before ``commit`` returns, and ``commit`` returns before any noise is
+drawn, so a crash at any point errs toward zero releases, never two.
+Recovery tolerates a torn tail (a crash mid-append leaves a partial last
+line, which by the write-ahead rule was never acknowledged — it is
+truncated away); any other malformed record is real corruption and raises
+:class:`JournalCorruptError` rather than silently forgetting a committed
+release. ``compact()`` rewrites the file atomically (tmp + fsync +
+rename).
 
 The journal is deliberately an explicit, caller-owned object (engine knob
 ``release_journal=``): its scope defines what "the same release" means.
-Share one journal across the retries/resumes of a production run; give
-independent experiments independent journals (or None — the default — for
-the reference's semantics, where re-release is the caller's accounting
-decision).
+Share one journal across the retries/resumes/re-execs of a production run
+(a file path for FileReleaseJournal); give independent experiments
+independent journals (or None — the default — for the reference's
+semantics, where re-release is the caller's accounting decision).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
+import os
+import tempfile
 import threading
 from typing import List, Tuple
+
+from pipelinedp_tpu import profiler
+
+# Profiler event counters (profiler.count_event / event_count):
+#   journal_recoveries — durable journals opened with committed records
+#     recovered from disk (i.e. a re-exec picked up prior releases);
+#   journal_bytes — bytes appended to durable journals.
+EVENT_JOURNAL_RECOVERIES = "runtime/journal_recoveries"
+EVENT_JOURNAL_BYTES = "runtime/journal_bytes"
 
 
 class DoubleReleaseError(RuntimeError):
     """A committed release (or spend) was about to be replayed."""
+
+
+class JournalCorruptError(RuntimeError):
+    """A durable journal holds a malformed interior record — committed
+    release history cannot be trusted, so recovery refuses rather than
+    silently forgetting a release."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -40,7 +76,7 @@ class ReleaseRecord:
 
 
 class ReleaseJournal:
-    """Append-only set of committed release tokens."""
+    """Append-only set of committed release tokens (process-local)."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -55,6 +91,7 @@ class ReleaseJournal:
         failure mode is "refused to re-release", never "released twice".
         """
         with self._lock:
+            token = _canonical_token(token)
             if token in self._committed:
                 prior = self._committed[token]
                 raise DoubleReleaseError(
@@ -66,13 +103,19 @@ class ReleaseJournal:
                     f"release is intended.")
             record = ReleaseRecord(seq=len(self._records), kind=kind,
                                    token=token)
+            # Write-ahead: durable journals persist (fsync) before the
+            # commit is acknowledged in memory.
+            self._persist(record)
             self._committed[token] = record
             self._records.append(record)
             return record
 
+    def _persist(self, record: ReleaseRecord) -> None:
+        """Durability hook; the in-memory journal keeps nothing."""
+
     def has(self, token: Tuple) -> bool:
         with self._lock:
-            return token in self._committed
+            return _canonical_token(token) in self._committed
 
     @property
     def records(self) -> Tuple[ReleaseRecord, ...]:
@@ -82,3 +125,144 @@ class ReleaseJournal:
     def __len__(self) -> int:
         with self._lock:
             return len(self._records)
+
+
+def _canonical_token(token):
+    """Tokens in a canonical, JSON-round-trippable form: sequences become
+    tuples, numpy scalars become their Python twins — so a token read
+    back from disk compares equal to the live one that wrote it."""
+    if isinstance(token, (tuple, list)):
+        return tuple(_canonical_token(t) for t in token)
+    if hasattr(token, "item") and not isinstance(
+            token, (str, bytes, bool, int, float)):
+        return token.item()
+    return token
+
+
+def _record_payload(record: ReleaseRecord) -> str:
+    """The canonical serialized form of one record (digest input)."""
+    return json.dumps(
+        {"seq": record.seq, "kind": record.kind, "token": record.token},
+        sort_keys=True, separators=(",", ":"))
+
+
+def _record_digest(payload: str) -> str:
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+class FileReleaseJournal(ReleaseJournal):
+    """WAL-backed journal surviving process death (module docstring)."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self._path = path
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._fh = None
+        self.recovered_records = self._recover()
+        self._fh = open(self._path, "ab")
+
+    # -- recovery ---------------------------------------------------------
+
+    def _recover(self) -> int:
+        if not os.path.exists(self._path):
+            return 0
+        with open(self._path, "rb") as f:
+            data = f.read()
+        records: List[ReleaseRecord] = []
+        good_end = 0
+        lines = data.split(b"\n")
+        # A trailing b"" element means the file ends with a complete
+        # newline-terminated record; anything else is a tail candidate.
+        for i, raw in enumerate(lines):
+            if raw == b"" and i == len(lines) - 1:
+                break
+            record = self._parse_line(raw, expected_seq=len(records))
+            if record is None:
+                if i == len(lines) - 1 or (i == len(lines) - 2
+                                           and lines[-1] == b""):
+                    # Torn tail: the crash happened mid-append, so this
+                    # record was never acknowledged — drop it.
+                    break
+                raise JournalCorruptError(
+                    f"{self._path}: record {len(records)} is malformed "
+                    f"but later records follow — the journal is "
+                    f"corrupted, not torn; refusing to guess at release "
+                    f"history")
+            records.append(record)
+            good_end += len(raw) + 1
+        if good_end != len(data):
+            # Truncate the torn tail so the next append starts a clean
+            # line (a partial line would otherwise fuse with it).
+            with open(self._path, "r+b") as f:
+                f.truncate(good_end)
+        for record in records:
+            self._committed[record.token] = record
+            self._records.append(record)
+        if records:
+            profiler.count_event(EVENT_JOURNAL_RECOVERIES)
+        return len(records)
+
+    @staticmethod
+    def _parse_line(raw: bytes, expected_seq: int):
+        """ReleaseRecord from one WAL line, or None when malformed."""
+        try:
+            obj = json.loads(raw.decode())
+            digest = obj.pop("digest")
+            record = ReleaseRecord(seq=int(obj["seq"]), kind=obj["kind"],
+                                   token=_canonical_token(obj["token"]))
+        except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+            return None
+        if _record_digest(_record_payload(record)) != digest:
+            return None
+        if record.seq != expected_seq:
+            return None
+        return record
+
+    # -- durability -------------------------------------------------------
+
+    def _persist(self, record: ReleaseRecord) -> None:
+        payload = _record_payload(record)
+        line = (payload[:-1] + f',"digest":"{_record_digest(payload)}"}}'
+                + "\n").encode()
+        self._fh.write(line)
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        profiler.count_event(EVENT_JOURNAL_BYTES, len(line))
+
+    def compact(self) -> None:
+        """Atomically rewrites the WAL from the in-memory records (drops
+        any truncated torn-tail bytes for good; tmp + fsync + rename, so
+        a crash mid-compaction leaves the previous file intact)."""
+        with self._lock:
+            parent = os.path.dirname(self._path) or "."
+            fd, tmp = tempfile.mkstemp(dir=parent, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    for record in self._records:
+                        payload = _record_payload(record)
+                        f.write((payload[:-1] +
+                                 f',"digest":"{_record_digest(payload)}"}}'
+                                 + "\n").encode())
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self._path)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
+            if self._fh is not None:
+                self._fh.close()
+            self._fh = open(self._path, "ab")
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "FileReleaseJournal":
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb) -> None:
+        self.close()

@@ -11,7 +11,11 @@ Failure taxonomy (what the slab drivers do with a caught exception):
     the released values are distribution-identical (bit-identical for a
     seeded run).
   * ``transient`` — transfer hiccups, preempted dispatches, injected
-    transfer/kernel faults. Re-issued after bounded exponential backoff.
+    transfer/kernel faults, and dispatch-watchdog timeouts
+    (:class:`watchdog.DispatchHangError` — a hang is retried with
+    backoff like any transient fault, and retry exhaustion surfaces the
+    typed error instead of an indefinite hang). Re-issued after bounded
+    exponential backoff.
   * ``fatal`` — everything else (including :class:`faults.HostCrash` and
     privacy-relevant guards like the wirecodec corrupted-input
     RuntimeError). Propagates; recovery is restart + checkpoint resume.
@@ -28,6 +32,7 @@ import time
 from typing import Callable
 
 from pipelinedp_tpu.runtime import faults
+from pipelinedp_tpu.runtime import watchdog as watchdog_lib
 
 OOM = "oom"
 TRANSIENT = "transient"
@@ -46,6 +51,8 @@ def classify(exc: BaseException) -> str:
     if isinstance(exc, faults.InjectedOom) or "RESOURCE_EXHAUSTED" in message:
         return OOM
     if isinstance(exc, faults.InjectedFault):
+        return TRANSIENT
+    if isinstance(exc, watchdog_lib.DispatchHangError):
         return TRANSIENT
     if isinstance(exc, RuntimeError) and any(code in message
                                              for code in _TRANSIENT_CODES):

@@ -1,0 +1,165 @@
+"""Bounded-timeout watchdog for device dispatch operations.
+
+A wedged host->device transfer or a device that stops making progress
+does not raise — it *blocks*. Without a watchdog the slab loop inherits
+that behavior and hangs forever, which is the one failure mode the
+retry/checkpoint layer cannot even see (there is no exception to
+classify). The watchdog turns "blocked longer than the budget" into a
+typed :class:`DispatchHangError` that the retry layer handles like any
+other transient fault: bounded backoff re-issues, and exhaustion
+surfaces the typed error instead of an indefinite hang.
+
+Mechanics: the guarded operation runs on a dedicated *daemon* worker
+thread and the caller waits ``timeout_s`` for its result. On timeout the
+worker is *abandoned* (a truly wedged low-level call cannot be
+interrupted from Python; the daemon thread parks until the runtime
+unwedges or the process exits — daemon so it can never block interpreter
+shutdown the way a pooled thread's atexit join would) and a fresh worker
+serves the next attempt. An abandoned operation's eventual result is
+discarded, so the driver must treat a timed-out step as state-poisoning
+and restore from a checkpoint before re-dispatching anything that
+donated buffers (runtime/driver.py does).
+
+The watchdog is OFF by default (``StreamResilience.watchdog_timeout_s``
+is None and ``PIPELINEDP_TPU_WATCHDOG_S`` is 0): enabling it adds one
+``block_until_ready`` sync per slab window — bounded hang detection is
+bought with a little cross-window pipelining (RESILIENCE.md).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Callable, Optional, TypeVar
+
+from pipelinedp_tpu import profiler
+
+# Profiler event counter: one per timed-out guarded operation (the
+# runtime/hangs_detected counter — one per hang the driver acted on —
+# lives in runtime/driver.py and is credited by the slab driver).
+EVENT_WATCHDOG_TIMEOUTS = "runtime/watchdog_timeouts"
+
+# Validated env default for the timeout (seconds; 0 = disabled) when
+# StreamResilience.watchdog_timeout_s is None. See README "Tuning knobs".
+WATCHDOG_ENV = "PIPELINEDP_TPU_WATCHDOG_S"
+
+T = TypeVar("T")
+
+
+class DispatchHangError(RuntimeError):
+    """A guarded device operation exceeded the watchdog budget.
+
+    Classified as ``transient`` by runtime/retry.py: bounded retries
+    re-issue the slab window, and retry exhaustion propagates this typed
+    error — either way the slab loop never hangs indefinitely.
+    """
+
+    def __init__(self, what: str, timeout_s: float):
+        super().__init__(
+            f"dispatch watchdog: {what} made no progress within "
+            f"{timeout_s:g}s (wedged transfer/dispatch abandoned; the "
+            f"operation will be re-issued or surfaced by the retry "
+            f"policy)")
+        self.what = what
+        self.timeout_s = timeout_s
+
+
+def env_timeout_s() -> Optional[float]:
+    """The PIPELINEDP_TPU_WATCHDOG_S default (None when 0/unset)."""
+    from pipelinedp_tpu.native import loader
+    seconds = loader.env_int(WATCHDOG_ENV, 0, 0, 24 * 3600)
+    return float(seconds) if seconds > 0 else None
+
+
+class _ResultBox:
+    """One guarded call's completion handoff (condition-guarded)."""
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.done = False
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+    def finish(self, result, error) -> None:
+        with self.cond:
+            self.result = result
+            self.error = error
+            self.done = True
+            self.cond.notify_all()
+
+    def wait(self, timeout_s: float) -> bool:
+        with self.cond:
+            return self.cond.wait_for(lambda: self.done, timeout=timeout_s)
+
+
+class _Worker:
+    """A daemon thread executing guarded calls in submission order."""
+
+    def __init__(self, name: str):
+        self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._thread = threading.Thread(target=self._loop, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            fn, box = item
+            try:
+                result, error = fn(), None
+            except BaseException as exc:  # handed to the waiter verbatim
+                result, error = None, exc
+            box.finish(result, error)
+
+    def submit(self, fn: Callable[[], T]) -> _ResultBox:
+        box = _ResultBox()
+        self._queue.put((fn, box))
+        return box
+
+    def stop(self) -> None:
+        self._queue.put(None)
+
+
+class DispatchWatchdog:
+    """Runs device operations under a bounded timeout.
+
+    One worker thread serves all guarded calls of a slab loop in order
+    (device dispatch is serialized per loop anyway, so a pool would buy
+    nothing); after a timeout the wedged worker is abandoned and
+    replaced. ``close()`` stops the current worker; abandoned workers
+    are daemons and exit with the process at the latest.
+    """
+
+    _ids = itertools.count()
+
+    def __init__(self, timeout_s: float):
+        if timeout_s <= 0:
+            raise ValueError(f"watchdog timeout must be positive, got "
+                             f"{timeout_s}")
+        self.timeout_s = float(timeout_s)
+        self._worker: Optional[_Worker] = None
+
+    def call(self, what: str, fn: Callable[[], T]) -> T:
+        """Runs ``fn`` with the timeout; raises DispatchHangError on
+        expiry (fn's own exceptions propagate unchanged)."""
+        if self._worker is None:
+            self._worker = _Worker(f"pdp-watchdog-{next(self._ids)}")
+        box = self._worker.submit(fn)
+        if not box.wait(self.timeout_s):
+            # Abandon the wedged worker: its blocked call cannot be
+            # interrupted, but the next attempt must not queue behind it.
+            self._worker.stop()
+            self._worker = None
+            profiler.count_event(EVENT_WATCHDOG_TIMEOUTS)
+            raise DispatchHangError(what, self.timeout_s)
+        if box.error is not None:
+            raise box.error
+        return box.result
+
+    def close(self) -> None:
+        if self._worker is not None:
+            self._worker.stop()
+            self._worker = None

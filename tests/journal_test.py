@@ -1,0 +1,357 @@
+"""Durable at-most-once journal tests (runtime/journal.py).
+
+The contracts pinned here (RESILIENCE.md):
+
+  * WAL round-trip — a FileReleaseJournal reopened from its file knows
+    every committed token and refuses replays (DoubleReleaseError), so
+    at-most-once survives process death;
+  * write-ahead ordering — the record is fsync'd before commit returns,
+    so a crash between commit and publication errs toward zero releases;
+  * torn-tail tolerance — a crash mid-append leaves a partial final
+    record, which was never acknowledged and is dropped (and truncated)
+    on recovery;
+  * corruption refusal — a malformed *interior* record means the release
+    history cannot be trusted: recovery raises JournalCorruptError
+    instead of silently forgetting a committed release;
+  * compaction — an atomic rewrite preserving the exact record sequence;
+  * the durable spend journal — a re-exec'd accountant replaying a
+    committed epsilon spend raises BudgetAccountantError (the
+    cross-process half lives in tests/process_kill_test.py).
+"""
+
+import json
+import os
+
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import profiler, runtime
+from pipelinedp_tpu.aggregate_params import MechanismType
+from pipelinedp_tpu.budget_accounting import BudgetAccountantError
+from pipelinedp_tpu.runtime import journal as journal_lib
+
+
+@pytest.fixture(autouse=True)
+def _reset_runtime_counters():
+    profiler.reset_events("runtime/")
+    yield
+
+
+def _wal(tmp_path, name="journal.wal"):
+    return str(tmp_path / name)
+
+
+class TestFileJournalRoundTrip:
+
+    def test_clean_recovery_refuses_replay(self, tmp_path):
+        path = _wal(tmp_path)
+        with runtime.FileReleaseJournal(path) as journal:
+            journal.commit(("noise_release", "fp-a", 3))
+            journal.commit(("noise_release", "fp-b", 5),
+                           kind="selection_release")
+        reopened = runtime.FileReleaseJournal(path)
+        assert reopened.recovered_records == 2
+        assert len(reopened) == 2
+        assert reopened.has(("noise_release", "fp-a", 3))
+        assert [r.kind for r in reopened.records] == [
+            "noise_release", "selection_release"]
+        with pytest.raises(runtime.DoubleReleaseError, match="already"):
+            reopened.commit(("noise_release", "fp-a", 3))
+        # A fresh token still commits after recovery.
+        reopened.commit(("noise_release", "fp-c", 7))
+        assert len(reopened) == 3
+        reopened.close()
+
+    def test_recovery_counters(self, tmp_path):
+        path = _wal(tmp_path)
+        journal = runtime.FileReleaseJournal(path)
+        assert profiler.event_count(runtime.EVENT_JOURNAL_BYTES) == 0
+        journal.commit(("t", 1))
+        assert profiler.event_count(runtime.EVENT_JOURNAL_BYTES) > 0
+        assert profiler.event_count(runtime.EVENT_JOURNAL_RECOVERIES) == 0
+        journal.close()
+        runtime.FileReleaseJournal(path).close()
+        assert profiler.event_count(runtime.EVENT_JOURNAL_RECOVERIES) == 1
+        # An empty journal is not a "recovery".
+        runtime.FileReleaseJournal(_wal(tmp_path, "empty.wal")).close()
+        assert profiler.event_count(runtime.EVENT_JOURNAL_RECOVERIES) == 1
+
+    def test_numpy_scalar_tokens_round_trip(self, tmp_path):
+        import numpy as np
+        path = _wal(tmp_path)
+        with runtime.FileReleaseJournal(path) as journal:
+            journal.commit(("spend", np.int64(4), np.float64(0.5)))
+        reopened = runtime.FileReleaseJournal(path)
+        with pytest.raises(runtime.DoubleReleaseError):
+            reopened.commit(("spend", 4, 0.5))
+        reopened.close()
+
+    def test_in_memory_journal_unchanged(self):
+        journal = runtime.ReleaseJournal()
+        journal.commit(("t", 1))
+        with pytest.raises(runtime.DoubleReleaseError):
+            journal.commit(("t", 1))
+        assert journal.has(("t", 1)) and not journal.has(("t", 2))
+
+
+class TestTornAndCorrupt:
+
+    def _write_records(self, path, n=3):
+        with runtime.FileReleaseJournal(path) as journal:
+            for i in range(n):
+                journal.commit(("t", i))
+        with open(path, "rb") as f:
+            return f.read()
+
+    def test_torn_tail_partial_line_tolerated(self, tmp_path):
+        path = _wal(tmp_path)
+        data = self._write_records(path)
+        # Crash mid-append: the last record is half-written.
+        with open(path, "wb") as f:
+            f.write(data[:-7])
+        journal = runtime.FileReleaseJournal(path)
+        assert journal.recovered_records == 2
+        assert not journal.has(("t", 2))
+        # The torn bytes were truncated: the token can commit again and
+        # a re-open sees a clean 3-record file.
+        journal.commit(("t", 2))
+        journal.close()
+        assert runtime.FileReleaseJournal(path).recovered_records == 3
+
+    def test_torn_tail_digest_mismatch_tolerated(self, tmp_path):
+        path = _wal(tmp_path)
+        data = self._write_records(path)
+        lines = data.splitlines(keepends=True)
+        # The final record's bytes were garbled by the crash but a
+        # newline survived: still the torn-tail case (only the LAST
+        # record may be bad).
+        bad = lines[2].replace(b'"t"', b'"x"')
+        with open(path, "wb") as f:
+            f.writelines(lines[:2] + [bad])
+        journal = runtime.FileReleaseJournal(path)
+        assert journal.recovered_records == 2
+        journal.close()
+
+    def test_interior_corruption_raises(self, tmp_path):
+        path = _wal(tmp_path)
+        data = self._write_records(path)
+        lines = data.splitlines(keepends=True)
+        bad = lines[1].replace(b'"t"', b'"x"')
+        with open(path, "wb") as f:
+            f.writelines([lines[0], bad, lines[2]])
+        with pytest.raises(runtime.JournalCorruptError, match="malformed"):
+            runtime.FileReleaseJournal(path)
+
+    def test_sequence_gap_is_corruption(self, tmp_path):
+        path = _wal(tmp_path)
+        data = self._write_records(path, n=4)
+        lines = data.splitlines(keepends=True)
+        # Dropping an interior record breaks the seq chain even though
+        # every remaining line is self-consistent; with further records
+        # following, this cannot be a torn tail.
+        with open(path, "wb") as f:
+            f.writelines([lines[0], lines[2], lines[3]])
+        with pytest.raises(runtime.JournalCorruptError):
+            runtime.FileReleaseJournal(path)
+
+    def test_every_record_carries_digest(self, tmp_path):
+        path = _wal(tmp_path)
+        self._write_records(path, n=2)
+        with open(path) as f:
+            for line in f:
+                obj = json.loads(line)
+                assert set(obj) == {"seq", "kind", "token", "digest"}
+                assert len(obj["digest"]) == 16
+
+
+class TestCompaction:
+
+    def test_compact_preserves_records_atomically(self, tmp_path):
+        path = _wal(tmp_path)
+        journal = runtime.FileReleaseJournal(path)
+        for i in range(4):
+            journal.commit(("t", i))
+        size_before = os.path.getsize(path)
+        journal.compact()
+        assert os.path.getsize(path) == size_before  # nothing to drop
+        # Compaction drops truncated garbage for good and keeps the
+        # journal appendable.
+        journal.commit(("t", 99))
+        journal.close()
+        reopened = runtime.FileReleaseJournal(path)
+        assert [r.token for r in reopened.records] == [
+            ("t", 0), ("t", 1), ("t", 2), ("t", 3), ("t", 99)]
+        reopened.close()
+        assert not [n for n in os.listdir(tmp_path)
+                    if n.endswith(".tmp")]
+
+
+class TestEngineDurableRelease:
+    """The engine's release_journal= knob with a durable journal: the
+    same-process half of the cross-process guarantee (the SIGKILL +
+    re-exec half lives in tests/process_kill_test.py)."""
+
+    def _aggregate(self, journal, seed=3):
+        import numpy as np
+        rng = np.random.default_rng(0)
+        pid = rng.integers(0, 500, 5_000)
+        pk = rng.integers(0, 20, 5_000).astype(np.int32)
+        value = rng.uniform(0, 5, 5_000).astype(np.float32)
+        accountant = pdp.NaiveBudgetAccountant(1e9, 1 - 1e-9)
+        engine = pdp.JaxDPEngine(accountant, seed=seed,
+                                 secure_host_noise=False,
+                                 release_journal=journal)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT], max_partitions_contributed=20,
+            max_contributions_per_partition=100, min_value=0.0,
+            max_value=5.0)
+        result = engine.aggregate(
+            pdp.ColumnarData(pid=pid, pk=pk, value=value), params,
+            public_partitions=list(range(20)))
+        accountant.compute_budgets()
+        return result.to_columns()
+
+    def test_fresh_process_refuses_replayed_release(self, tmp_path):
+        path = _wal(tmp_path)
+        with runtime.FileReleaseJournal(path) as journal:
+            self._aggregate(journal)
+        # "Re-exec": a brand-new journal object over the same file.
+        with runtime.FileReleaseJournal(path) as journal2:
+            assert journal2.recovered_records == 1
+            with pytest.raises(runtime.DoubleReleaseError):
+                self._aggregate(journal2)
+            # A different seed is a different release and still commits.
+            self._aggregate(journal2, seed=4)
+
+
+class TestDurableSpendJournal:
+
+    def _spend(self, path):
+        accountant = pdp.NaiveBudgetAccountant(
+            1.0, 1e-6,
+            durable_spend_journal=runtime.FileReleaseJournal(path))
+        accountant.request_budget(MechanismType.LAPLACE)
+        accountant.request_budget(MechanismType.GAUSSIAN)
+        accountant.compute_budgets()
+        return accountant
+
+    def test_replay_after_reopen_refuses(self, tmp_path):
+        path = _wal(tmp_path)
+        accountant = self._spend(path)
+        assert len(accountant.spend_journal) == 2
+        with pytest.raises(BudgetAccountantError, match="replay"):
+            self._spend(path)
+
+    def test_distinct_pipelines_share_a_journal(self, tmp_path):
+        path = _wal(tmp_path)
+        self._spend(path)
+        # A different budget split is a different spend identity.
+        other = pdp.NaiveBudgetAccountant(
+            2.0, 1e-6,
+            durable_spend_journal=runtime.FileReleaseJournal(path))
+        other.request_budget(MechanismType.LAPLACE)
+        other.compute_budgets()
+        assert len(other.spend_journal) == 1
+
+    def test_pld_accountant_supported(self, tmp_path):
+        path = _wal(tmp_path)
+        accountant = pdp.PLDBudgetAccountant(
+            1.0, 1e-6,
+            durable_spend_journal=runtime.FileReleaseJournal(path))
+        accountant.request_budget(MechanismType.GAUSSIAN)
+        accountant.compute_budgets()
+        replay = pdp.PLDBudgetAccountant(
+            1.0, 1e-6,
+            durable_spend_journal=runtime.FileReleaseJournal(path))
+        replay.request_budget(MechanismType.GAUSSIAN)
+        with pytest.raises(BudgetAccountantError, match="replay"):
+            replay.compute_budgets()
+
+    def test_in_memory_spend_journal_unaffected(self):
+        accountant = pdp.NaiveBudgetAccountant(1.0, 1e-6)
+        accountant.request_budget(MechanismType.LAPLACE)
+        accountant.compute_budgets()
+        assert len(accountant.spend_journal) == 1
+
+
+class TestCheckpointStoreDigestRetention:
+    """FileCheckpointStore satellite: payload digests make a torn
+    snapshot distinguishable from a fingerprint mismatch, and retention
+    keeps the last K snapshots (atomic prune)."""
+
+    def _checkpoint(self, next_chunk):
+        import numpy as np
+        rng = np.random.default_rng(next_chunk)
+        return runtime.StreamCheckpoint(
+            run_id="r", next_chunk=next_chunk, n_chunks=8,
+            accs=tuple(rng.random(16).astype(np.float32)
+                       for _ in range(5)),
+            qhist=None, key_fingerprint="kf", wire_fingerprint="wf",
+            key_counter=2)
+
+    def test_retention_keeps_last_k(self, tmp_path):
+        store = runtime.FileCheckpointStore(str(tmp_path), keep=2)
+        for i in range(5):
+            store.save(self._checkpoint(i))
+        snapshots = [n for n in os.listdir(tmp_path) if n.endswith(".npz")]
+        assert len(snapshots) == 2
+        assert store.load("r").next_chunk == 4
+        store.delete("r")
+        assert not [n for n in os.listdir(tmp_path) if n.endswith(".npz")]
+
+    def test_torn_snapshot_falls_back_to_previous(self, tmp_path):
+        store = runtime.FileCheckpointStore(str(tmp_path), keep=3)
+        store.save(self._checkpoint(2))
+        store.save(self._checkpoint(5))
+        newest = max(n for n in os.listdir(tmp_path) if n.endswith(".npz"))
+        path = os.path.join(tmp_path, newest)
+        with open(path, "rb") as f:
+            data = f.read()
+        with open(path, "wb") as f:
+            f.write(data[: len(data) // 2])  # torn write
+        loaded = store.load("r")
+        assert loaded is not None and loaded.next_chunk == 2
+
+    def test_bit_flip_detected_by_digest(self, tmp_path):
+        store = runtime.FileCheckpointStore(str(tmp_path), keep=3)
+        store.save(self._checkpoint(2))
+        store.save(self._checkpoint(5))
+        newest = max(n for n in os.listdir(tmp_path) if n.endswith(".npz"))
+        path = os.path.join(tmp_path, newest)
+        with open(path, "r+b") as f:
+            f.seek(os.path.getsize(path) // 2)
+            byte = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        loaded = store.load("r")
+        # Either the zip container or the payload digest catches it;
+        # the previous snapshot serves the resume.
+        assert loaded is not None and loaded.next_chunk == 2
+
+    def test_keep_one_behaves_like_legacy(self, tmp_path):
+        store = runtime.FileCheckpointStore(str(tmp_path), keep=1)
+        store.save(self._checkpoint(2))
+        store.save(self._checkpoint(5))
+        snapshots = [n for n in os.listdir(tmp_path) if n.endswith(".npz")]
+        assert len(snapshots) == 1
+        assert store.load("r").next_chunk == 5
+
+    def test_keep_validated(self, tmp_path):
+        with pytest.raises(ValueError, match="keep"):
+            runtime.FileCheckpointStore(str(tmp_path), keep=0)
+
+    def test_legacy_unseqed_file_still_loads(self, tmp_path):
+        # A pre-retention checkpoint (`<run_id>.npz`, no digest) written
+        # by an older release participates as the oldest snapshot.
+        import numpy as np
+        store = runtime.FileCheckpointStore(str(tmp_path))
+        store.save(self._checkpoint(3))
+        newest = max(n for n in os.listdir(tmp_path) if n.endswith(".npz"))
+        os.rename(os.path.join(tmp_path, newest),
+                  os.path.join(tmp_path, "r.npz"))
+        loaded = store.load("r")
+        assert loaded is not None and loaded.next_chunk == 3
+        store.save(self._checkpoint(6))
+        assert store.load("r").next_chunk == 6
+        store.delete("r")
+        assert store.load("r") is None

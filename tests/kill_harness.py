@@ -1,0 +1,119 @@
+"""Subprocess harness for true process-kill recovery (SIGKILL, not a
+simulated HostCrash exception).
+
+tests/process_kill_test.py drives this script through fresh Python
+processes, so the recovery path exercised is the real one: the killed
+process gets no chance to flush, close, or hand anything over — the only
+survivors are the fsync'd artifacts (FileCheckpointStore snapshots and
+the FileReleaseJournal WAL), and the re-exec'd process must rebuild
+everything else from scratch.
+
+Modes (one per invocation: ``python kill_harness.py <mode> <workdir>``):
+
+  clean   — an uninterrupted seeded run; prints the released columns.
+  killed  — same run with a scripted ``sigkill`` fault at slab window 1:
+            the process dies mid-stream (SIGKILL — the print never
+            happens; the orchestrator asserts returncode -SIGKILL).
+  resume  — same run again: auto-resumes from the checkpoint store,
+            commits the release to the durable journal, prints the
+            released columns (must be bit-identical to ``clean``).
+  replay  — same run again after ``resume`` released: the durable
+            journal must refuse the replayed release token
+            (DoubleReleaseError) before any noise is drawn.
+  spend   — accountant-only: commits two mechanism spends to a durable
+            spend journal; a second invocation must refuse the replay
+            (BudgetAccountantError).
+
+Marker lines on stdout (prefix ``HARNESS_``) carry the machine-readable
+outcome; everything else is free-form noise (JAX logs etc.).
+"""
+
+import json
+import os
+import sys
+
+
+def _build_inputs():
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    n = 20_000
+    pid = rng.integers(1_000, 5_000, n).astype(np.int64)
+    pk = rng.integers(0, 50, n).astype(np.int32)
+    value = rng.uniform(0, 5, n).astype(np.float32)
+    return pid, pk, value
+
+
+def _run_engine(mode: str, workdir: str) -> None:
+    import numpy as np
+
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import runtime
+
+    pid, pk, value = _build_inputs()
+    injector = None
+    kwargs = {}
+    if mode != "clean":
+        store = runtime.FileCheckpointStore(os.path.join(workdir, "ckpt"))
+        kwargs["checkpoint_policy"] = runtime.CheckpointPolicy(
+            store=store, run_id="kill-harness")
+        kwargs["release_journal"] = runtime.FileReleaseJournal(
+            os.path.join(workdir, "release.wal"))
+    if mode == "killed":
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("sigkill", at_slab=1)])
+    accountant = pdp.NaiveBudgetAccountant(1e9, 1 - 1e-9)
+    engine = pdp.JaxDPEngine(accountant, seed=3, stream_chunks=8,
+                             secure_host_noise=False,
+                             fault_injector=injector, **kwargs)
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+        max_partitions_contributed=50,
+        max_contributions_per_partition=1_000,
+        min_value=0.0,
+        max_value=5.0)
+    try:
+        result = engine.aggregate(
+            pdp.ColumnarData(pid=pid, pk=pk, value=value), params,
+            public_partitions=list(range(50)))
+        accountant.compute_budgets()
+        columns = result.to_columns()
+    except runtime.DoubleReleaseError:
+        print("HARNESS_DOUBLE_RELEASE")
+        return
+    out = {name: np.asarray(col).tobytes().hex()
+           for name, col in sorted(columns.items())}
+    print("HARNESS_RESULT " + json.dumps({"mode": mode, "columns": out}))
+
+
+def _run_spend(workdir: str) -> None:
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import runtime
+    from pipelinedp_tpu.aggregate_params import MechanismType
+    from pipelinedp_tpu.budget_accounting import BudgetAccountantError
+
+    journal = runtime.FileReleaseJournal(
+        os.path.join(workdir, "spend.wal"))
+    accountant = pdp.NaiveBudgetAccountant(
+        1.0, 1e-6, durable_spend_journal=journal)
+    accountant.request_budget(MechanismType.LAPLACE)
+    accountant.request_budget(MechanismType.GAUSSIAN)
+    try:
+        accountant.compute_budgets()
+    except BudgetAccountantError:
+        print("HARNESS_SPEND_REFUSED")
+        return
+    print("HARNESS_SPEND_OK")
+
+
+def main() -> None:
+    mode, workdir = sys.argv[1], sys.argv[2]
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if mode == "spend":
+        _run_spend(workdir)
+    else:
+        _run_engine(mode, workdir)
+
+
+if __name__ == "__main__":
+    main()

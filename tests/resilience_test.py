@@ -18,6 +18,7 @@ The contracts pinned here (RESILIENCE.md):
 """
 
 import os
+import time
 
 import jax
 import numpy as np
@@ -446,6 +447,144 @@ class TestChaosMatrix:
                                                  sleep=lambda s: None))
             _assert_same_release(clean, chaotic)
 
+    def test_chaos_with_hangs_under_watchdog(self, tmp_path):
+        # The hang-extended chaos script (CI matrix `hang` variant): every
+        # scripted stall must be detected by the watchdog within its
+        # timeout and absorbed by retries — bit-identical release, never
+        # an indefinite hang.
+        pid, pk, value = _data()
+        clean = _aggregate(pid, pk, value)
+        fired_hangs_total = 0
+        for seed in self._seeds():
+            injector = runtime.FaultInjector.chaos(
+                seed=seed, n_slabs=16, include_hang=True, hang_s=5.0)
+            store = runtime.FileCheckpointStore(str(tmp_path / str(seed)))
+            profiler.reset_events("runtime/")
+            chaotic = _aggregate(
+                pid, pk, value, fault_injector=injector,
+                watchdog_timeout_s=0.25,
+                checkpoint_policy=runtime.CheckpointPolicy(
+                    store=store, run_id=f"chaos-hang{seed}"),
+                retry_policy=runtime.RetryPolicy(max_retries=20,
+                                                 sleep=lambda s: None))
+            _assert_same_release(clean, chaotic)
+            # Every hang that fired stalled past the 0.25s budget, so
+            # each must show up as exactly one detected timeout.
+            n_fired = sum(1 for kind, _ in injector.fired
+                          if kind == "hang")
+            fired_hangs_total += n_fired
+            assert profiler.event_count(
+                runtime.EVENT_WATCHDOG_TIMEOUTS) == n_fired
+            assert profiler.event_count(runtime.EVENT_HANGS) == n_fired
+        # The hang-extended scripts must actually exercise the watchdog
+        # for the sweep to mean anything (deterministic per seed).
+        assert fired_hangs_total >= 1
+
+
+class TestDispatchWatchdog:
+    """Acceptance: a scripted hang is detected by the watchdog within the
+    configured timeout and either retried (transient) or surfaced as a
+    typed error after retry exhaustion — never an indefinite hang."""
+
+    def test_hang_detected_and_retried(self):
+        pid, pk, value = _data()
+        clean = _aggregate(pid, pk, value)
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("hang", at_slab=1, hang_s=30.0)])
+        t0 = time.monotonic()
+        recovered = _aggregate(pid, pk, value, fault_injector=injector,
+                               retry_policy=NO_SLEEP,
+                               watchdog_timeout_s=0.25)
+        elapsed = time.monotonic() - t0
+        # Far below the 30s stall: the watchdog cut it off at ~0.25s.
+        assert elapsed < 20.0
+        assert injector.pending == 0
+        assert profiler.event_count(runtime.EVENT_WATCHDOG_TIMEOUTS) == 1
+        assert profiler.event_count(runtime.EVENT_HANGS) == 1
+        assert profiler.event_count(runtime.EVENT_RETRIES) == 1
+        _assert_same_release(clean, recovered)
+
+    def test_hang_exhaustion_surfaces_typed_error(self):
+        # Every attempt hangs; bounded retries then the typed error —
+        # the "fatal" arm of the acceptance criterion.
+        pid, pk, value = _data()
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("hang", at_slab=0, times=10, hang_s=30.0)])
+        t0 = time.monotonic()
+        with pytest.raises(runtime.DispatchHangError, match="watchdog"):
+            _aggregate(pid, pk, value, fault_injector=injector,
+                       retry_policy=runtime.RetryPolicy(
+                           max_retries=1, sleep=lambda s: None),
+                       watchdog_timeout_s=0.25)
+        assert time.monotonic() - t0 < 20.0
+        assert profiler.event_count(runtime.EVENT_HANGS) == 2
+
+    def test_hang_without_watchdog_stalls_but_completes(self):
+        # Documents the unguarded behavior the watchdog exists for: the
+        # stall is simply endured (bounded here only because hang_s is).
+        pid, pk, value = _data()
+        clean = _aggregate(pid, pk, value)
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("hang", at_slab=1, hang_s=0.3)])
+        stalled = _aggregate(pid, pk, value, fault_injector=injector,
+                             retry_policy=NO_SLEEP)
+        assert profiler.event_count(runtime.EVENT_WATCHDOG_TIMEOUTS) == 0
+        _assert_same_release(clean, stalled)
+
+    def test_hang_classified_transient(self):
+        assert runtime.classify(
+            runtime.DispatchHangError("transfer", 1.0)) == "transient"
+
+    def test_mesh_hang_detected_and_retried(self, mesh):
+        pid, pk, value = _data()
+        clean = _aggregate(pid, pk, value, mesh=mesh, stream_chunks=4)
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("hang", at_slab=1, hang_s=30.0)])
+        recovered = _aggregate(pid, pk, value, mesh=mesh, stream_chunks=4,
+                               fault_injector=injector,
+                               retry_policy=NO_SLEEP,
+                               watchdog_timeout_s=0.25)
+        assert profiler.event_count(runtime.EVENT_WATCHDOG_TIMEOUTS) == 1
+        _assert_same_release(clean, recovered)
+
+    def test_watchdog_enabled_clean_run_is_bitwise_identical(self):
+        # The watchdog only adds syncs; it must never change released
+        # bits on a fault-free run.
+        pid, pk, value = _data()
+        clean = _aggregate(pid, pk, value)
+        guarded = _aggregate(pid, pk, value, watchdog_timeout_s=30.0)
+        assert profiler.event_count(runtime.EVENT_WATCHDOG_TIMEOUTS) == 0
+        _assert_same_release(clean, guarded)
+
+    def test_env_knob_validated(self, monkeypatch):
+        from pipelinedp_tpu.runtime import watchdog as watchdog_lib
+        monkeypatch.delenv(watchdog_lib.WATCHDOG_ENV, raising=False)
+        assert watchdog_lib.env_timeout_s() is None
+        monkeypatch.setenv(watchdog_lib.WATCHDOG_ENV, "0")
+        assert watchdog_lib.env_timeout_s() is None
+        monkeypatch.setenv(watchdog_lib.WATCHDOG_ENV, "7")
+        assert watchdog_lib.env_timeout_s() == 7.0
+        monkeypatch.setenv(watchdog_lib.WATCHDOG_ENV, "junk")
+        with pytest.raises(ValueError):
+            watchdog_lib.env_timeout_s()
+
+    def test_watchdog_call_passes_through_results_and_errors(self):
+        wd = runtime.DispatchWatchdog(timeout_s=5.0)
+        try:
+            assert wd.call("op", lambda: 42) == 42
+            with pytest.raises(KeyError):
+                wd.call("op", lambda: {}["missing"])
+            t0 = time.monotonic()
+            with pytest.raises(runtime.DispatchHangError, match="op"):
+                wd.call("op", lambda: time.sleep(30.0))
+            assert time.monotonic() - t0 < 20.0
+        finally:
+            wd.close()
+
+    def test_watchdog_rejects_bad_timeout(self):
+        with pytest.raises(ValueError):
+            runtime.DispatchWatchdog(timeout_s=0.0)
+
 
 class TestPrefetchInterplay:
     """ISSUE 5 satellite: a FaultInjector crash / OOM-degrade while a
@@ -754,7 +893,9 @@ class TestCounters:
     def test_resilience_counters_keys_always_present(self):
         counters = runtime.resilience_counters()
         assert set(counters) == {"retries", "degradations", "resumes",
-                                 "checkpoint_bytes", "native_fallbacks"}
+                                 "checkpoint_bytes", "native_fallbacks",
+                                 "watchdog_timeouts", "hangs_detected",
+                                 "journal_recoveries", "journal_bytes"}
         assert all(isinstance(v, int) for v in counters.values())
 
     def test_checkpoint_bytes_counted(self):
