@@ -483,6 +483,105 @@ def bench_utility_sweep():
     return device_sec, host_sec
 
 
+def bench_serving(pid, pk, value):
+    """Resident-dataset serving row (ISSUE 9): cold-query vs warm-query
+    partitions/sec, queries/sec at batch widths {1, 8, 32} of vmapped
+    configs, resident-cache bytes, and per-query epilogue trace counts
+    across a 3-query session.
+
+    Cold = a fresh engine run on raw columns (paying encode + sort +
+    transfer), with the session's chunk count so the comparison is
+    like-for-like. Warm = the same query answered from the resident
+    session: query 1 replays the retained wire (kernel only), queries
+    2..3 repeat the same seed/config and ride the bound cache (epilogue
+    only). The phase dict of the first warm query is the structural
+    evidence that the encode/sort/transfer phase keys are GONE, not just
+    small.
+    """
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import profiler, serving
+    from pipelinedp_tpu.ops import finalize
+
+    params = _params()
+    out = {}
+    data = pdp.ColumnarData(pid=pid, pk=pk, value=value)
+    t0 = time.perf_counter()
+    session = serving.DatasetSession(data)
+    out["ingest_s"] = round(time.perf_counter() - t0, 3)
+
+    def cold_run(seed):
+        with profiler.collect_stage_times() as stages:
+            t0 = time.perf_counter()
+            acc = pdp.NaiveBudgetAccountant(EPS, DELTA)
+            eng = pdp.JaxDPEngine(acc, seed=seed,
+                                  stream_chunks=session.n_chunks)
+            res = eng.aggregate(
+                pdp.ColumnarData(pid=pid, pk=pk, value=value), params)
+            acc.compute_budgets()
+            assert int(np.asarray(res.to_columns()["keep_mask"]).sum()) > 0
+            return time.perf_counter() - t0, dict(stages)
+
+    cold_run(100)  # warmup/compile
+    cold_s, cold_stages = min((cold_run(i) for i in range(2)),
+                              key=lambda r: r[0])
+    out["cold_partitions_per_sec"] = round(N_PARTITIONS / cold_s, 1)
+    out["cold_phases"] = _coarse_phases(cold_stages, cold_s)
+
+    # 3-query session, same seed + config: query 1 replays the wire
+    # through the kernel, queries 2..3 are bound-cache hits (epilogue +
+    # host noise only — the repeat-query serving shape).
+    warm_times, traces = [], []
+    for q in range(3):
+        before = finalize.trace_count()
+        with profiler.collect_stage_times() as stages:
+            t0 = time.perf_counter()
+            cols = session.query(params, epsilon=EPS, delta=DELTA,
+                                 seed=0).to_columns()
+            assert int(np.asarray(cols["keep_mask"]).sum()) > 0
+            warm_times.append(time.perf_counter() - t0)
+        traces.append(finalize.trace_count() - before)
+        if q == 0:
+            out["warm_first_phases"] = _coarse_phases(dict(stages),
+                                                      warm_times[0])
+            # Amortization evidence: these phase keys must be ABSENT.
+            out["warm_encode_sort_phase_keys"] = sorted(
+                k for k in stages
+                if k.startswith(("dp/encode", "dp/wire_",
+                                 "dp/stream_slab_")))
+    out["warm_first_query_partitions_per_sec"] = round(
+        N_PARTITIONS / warm_times[0], 1)
+    out["warm_query_partitions_per_sec"] = round(
+        N_PARTITIONS / min(warm_times), 1)
+    out["warm_vs_cold"] = round(cold_s / min(warm_times), 2)
+    out["per_query_epilogue_traces"] = traces
+
+    def batch_configs(width, base_seed):
+        return [
+            serving.QueryConfig(
+                metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+                epsilon=EPS, delta=DELTA,
+                max_partitions_contributed=L0_CAP,
+                max_contributions_per_partition=LINF_CAP,
+                min_value=0.0, max_value=5.0, seed=base_seed + i)
+            for i in range(width)
+        ]
+
+    out["batched"] = {}
+    for width in (1, 8, 32):
+        session.query_batch(batch_configs(width, 10_000 * width))  # compile
+        t0 = time.perf_counter()
+        session.query_batch(batch_configs(width, 10_000 * width + 500))
+        dt = time.perf_counter() - t0
+        out["batched"][f"width_{width}_queries_per_sec"] = round(
+            width / dt, 2)
+    stats = session.stats()
+    stats.pop("tenants", None)
+    out["resident"] = stats
+    out["serving_counters"] = serving.serving_counters()
+    session.close()
+    return out
+
+
 def bench_cpu_baseline() -> float:
     import pipelinedp_tpu as pdp
 
@@ -581,6 +680,14 @@ def main():
         del uvalue
     except Exception as e:  # noqa: BLE001
         extra["e2e_uniform_float_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        # Serving row (ISSUE 9): warm queries must drop the
+        # encode/sort/transfer phase keys entirely and amortize to >=5x
+        # the cold-query throughput; the trajectory JSON tracks it like
+        # COUNT+SUM.
+        extra["serving"] = bench_serving(pid, pk, value)
+    except Exception as e:  # noqa: BLE001
+        extra["serving_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
         sweep_dev_sec, sweep_host_sec = bench_utility_sweep()
         extra.update({

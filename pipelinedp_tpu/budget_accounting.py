@@ -21,6 +21,7 @@ import collections
 import dataclasses
 import logging
 import math
+import threading
 from typing import List, Optional
 
 from pipelinedp_tpu import input_validators
@@ -37,6 +38,129 @@ class BudgetAccountantError(Exception):
     ``Exception``) so recovery/retry layers can distinguish an accounting
     replay — which must abort, per the at-most-once rule in
     RESILIENCE.md — from transient execution failures."""
+
+
+class BudgetExhaustedError(BudgetAccountantError):
+    """A tenant's cross-query budget ledger cannot cover a new charge."""
+
+
+@dataclasses.dataclass(frozen=True)
+class LedgerCharge:
+    """One committed cross-query budget charge of a TenantBudgetLedger."""
+    index: int
+    epsilon: float
+    delta: float
+    note: str
+
+
+class TenantBudgetLedger:
+    """Cross-query (epsilon, delta) ledger for one tenant of a long-lived
+    serving session (pipelinedp_tpu/serving/, SERVING.md).
+
+    Per-query accounting stays on the per-query ``BudgetAccountant`` (the
+    same request_budget / compute_budgets / spend_journal machinery as a
+    batch run); this ledger sits ABOVE it and answers the serving-layer
+    question the per-query accountant cannot: how much total budget this
+    tenant has left across all the queries it has ever run against the
+    dataset. ``charge`` is all-or-nothing and thread-safe — a charge that
+    would overdraw either epsilon or delta raises
+    :class:`BudgetExhaustedError` and leaves the ledger untouched, so one
+    tenant exhausting its budget can never consume (or block) another
+    tenant's. ``make_accountant`` is the normal entry point: it charges
+    the ledger, then hands back a fresh ``NaiveBudgetAccountant`` scoped
+    to exactly the charged slice.
+    """
+
+    # Relative slack on the exhaustion comparison so a tenant can spend
+    # its budget to exactly zero across many queries despite float
+    # summation error; anything past it is a real overdraw.
+    _REL_SLACK = 1e-9
+
+    def __init__(self, tenant_id: str, total_epsilon: float,
+                 total_delta: float = 0.0):
+        input_validators.validate_epsilon_delta(total_epsilon, total_delta,
+                                                "TenantBudgetLedger")
+        self._tenant_id = str(tenant_id)
+        self._total_epsilon = float(total_epsilon)
+        self._total_delta = float(total_delta)
+        self._lock = threading.Lock()
+        self._charges: List[LedgerCharge] = []
+
+    @property
+    def tenant_id(self) -> str:
+        return self._tenant_id
+
+    @property
+    def total_epsilon(self) -> float:
+        return self._total_epsilon
+
+    @property
+    def total_delta(self) -> float:
+        return self._total_delta
+
+    @property
+    def charges(self) -> tuple:
+        """Committed charges, in commit order (the tenant-level spend
+        journal; each entry's per-mechanism detail lives on that query's
+        accountant spend_journal)."""
+        with self._lock:
+            return tuple(self._charges)
+
+    @property
+    def spent_epsilon(self) -> float:
+        with self._lock:
+            return math.fsum(c.epsilon for c in self._charges)
+
+    @property
+    def spent_delta(self) -> float:
+        with self._lock:
+            return math.fsum(c.delta for c in self._charges)
+
+    @property
+    def remaining_epsilon(self) -> float:
+        return max(0.0, self._total_epsilon - self.spent_epsilon)
+
+    @property
+    def remaining_delta(self) -> float:
+        return max(0.0, self._total_delta - self.spent_delta)
+
+    def charge(self, epsilon: float, delta: float = 0.0,
+               note: str = "") -> LedgerCharge:
+        """Commits a charge, or raises BudgetExhaustedError untouched."""
+        input_validators.validate_epsilon_delta(
+            epsilon, delta, "TenantBudgetLedger.charge")
+        with self._lock:
+            eps_after = math.fsum(
+                [c.epsilon for c in self._charges] + [epsilon])
+            delta_after = math.fsum(
+                [c.delta for c in self._charges] + [delta])
+            slack = 1.0 + self._REL_SLACK
+            if (eps_after > self._total_epsilon * slack
+                    or delta_after > self._total_delta * slack
+                    or (delta_after > 0 and self._total_delta == 0)):
+                raise BudgetExhaustedError(
+                    f"tenant {self._tenant_id!r}: charge (eps={epsilon}, "
+                    f"delta={delta}) would overdraw the ledger "
+                    f"(spent eps={eps_after - epsilon:.6g} of "
+                    f"{self._total_epsilon:.6g}, "
+                    f"delta={delta_after - delta:.6g} of "
+                    f"{self._total_delta:.6g})")
+            record = LedgerCharge(index=len(self._charges),
+                                  epsilon=float(epsilon),
+                                  delta=float(delta), note=note)
+            self._charges.append(record)
+            return record
+
+    def make_accountant(self, epsilon: float, delta: float = 0.0,
+                        note: str = "",
+                        **accountant_kwargs) -> "NaiveBudgetAccountant":
+        """Charges the ledger and returns a fresh per-query accountant
+        over exactly the charged slice. The charge commits BEFORE the
+        accountant exists, so a query that later fails has conservatively
+        spent its slice (never the reverse — the at-most-once stance of
+        RESILIENCE.md applied to tenant budgets)."""
+        self.charge(epsilon, delta, note=note)
+        return NaiveBudgetAccountant(epsilon, delta, **accountant_kwargs)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -156,44 +156,91 @@ class _AggregationSpec:
 
 
 class Query:
-    """A built DP query. Create through QueryBuilder."""
+    """A built DP query. Create through QueryBuilder.
+
+    A Query is REUSABLE: repeat ``run_query`` calls on the same built
+    query are the cheap path. The frame→columns conversion and the
+    converter are computed once and cached on the query (each run still
+    draws fresh noise under its own accountant), and the compiled
+    epilogue executables are shared process-wide
+    (ops/finalize.default_cache), so a repeat run of the same shape pays
+    zero retraces. Session-bound queries (``QueryBuilder.on(session)``)
+    go further and skip encode + sort entirely — see SERVING.md.
+    """
 
     def __init__(self, df, columns: Columns,
                  metrics_output_columns: Dict[Metric, Optional[str]],
                  contribution_bounds: ContributionBounds,
-                 public_partitions: Optional[Iterable]):
+                 public_partitions: Optional[Iterable],
+                 session=None):
         self._df = df
         self._columns = columns
         self._metrics_output_columns = metrics_output_columns
         self._contribution_bounds = contribution_bounds
         self._public_partitions = public_partitions
+        self._session = session
+        # Per-query caches: filled on the first run, reused by repeat
+        # runs of the same built query (the conversion is by far the
+        # dominant host cost of a repeat run on large frames).
+        self._cached_converter: Optional[FrameConverter] = None
+        self._cached_data = None
+        self.conversions = 0  # test/bench hook: frame→columns passes run
 
-    def run_query(self,
-                  budget: Budget,
-                  noise_kind: NoiseKind = NoiseKind.LAPLACE,
-                  engine: str = "jax",
-                  seed: int = 0):
-        """Runs the query and returns a frame of the input's kind.
-
-        engine: "jax" (columnar TPU engine, default) or "local" (host
-          oracle, DPEngine over LocalBackend).
-        """
-        converter = _create_converter(self._df)
-        accountant = budget_accounting.NaiveBudgetAccountant(
-            total_epsilon=budget.epsilon, total_delta=budget.delta)
-        metrics = list(self._metrics_output_columns.keys())
-        params = agg.AggregateParams(
+    def _build_params(self, noise_kind: NoiseKind) -> "agg.AggregateParams":
+        return agg.AggregateParams(
             noise_kind=noise_kind,
-            metrics=metrics,
+            metrics=list(self._metrics_output_columns.keys()),
             max_partitions_contributed=self._contribution_bounds.
             max_partitions_contributed,
             max_contributions_per_partition=self._contribution_bounds.
             max_contributions_per_partition,
             min_value=self._contribution_bounds.min_value,
             max_value=self._contribution_bounds.max_value)
+
+    def run_query(self,
+                  budget: Budget,
+                  noise_kind: NoiseKind = NoiseKind.LAPLACE,
+                  engine: str = "jax",
+                  seed: int = 0,
+                  tenant: Optional[str] = None):
+        """Runs the query and returns a frame of the input's kind.
+
+        engine: "jax" (columnar TPU engine, default) or "local" (host
+          oracle, DPEngine over LocalBackend). Session-bound queries run
+          on the jax engine only.
+        tenant: for session-bound queries, charges the budget to that
+          tenant's ledger and routes the release through its
+          at-most-once journal (DatasetSession.register_tenant).
+        """
+        params = self._build_params(noise_kind)
+        if self._session is not None:
+            if engine != "jax":
+                raise ValueError(
+                    "session-bound queries run on the resident jax "
+                    "engine; engine='local' needs the raw frame")
+            result = self._session.query(params,
+                                         epsilon=budget.epsilon,
+                                         delta=budget.delta,
+                                         seed=seed,
+                                         tenant=tenant)
+            converter = self._session.frame_meta["converter"]
+            return self._rows_to_frame(converter, list(result))
+        if tenant is not None:
+            raise ValueError(
+                "tenant budgets need a session-bound query "
+                "(QueryBuilder.on(session))")
+        converter = self._cached_converter
+        if converter is None:
+            converter = self._cached_converter = _create_converter(self._df)
+        accountant = budget_accounting.NaiveBudgetAccountant(
+            total_epsilon=budget.epsilon, total_delta=budget.delta)
         public = (list(self._public_partitions)
                   if self._public_partitions is not None else None)
-        data = converter.frame_to_columns(self._df, self._columns)
+        data = self._cached_data
+        if data is None:
+            data = self._cached_data = converter.frame_to_columns(
+                self._df, self._columns)
+            self.conversions += 1
 
         if engine == "jax":
             from pipelinedp_tpu import jax_engine
@@ -244,6 +291,18 @@ class Query:
             {name: np.asarray(vals) for name, vals in out.items()})
 
 
+class _SessionColumns:
+    """Column-name view of a resident session for QueryBuilder
+    validation (the session holds no frame to convert — only the names
+    it was ingested with)."""
+
+    def __init__(self, column_names: List[str]):
+        self._column_names = list(column_names)
+
+    def column_names(self, df) -> List[str]:
+        return list(self._column_names)
+
+
 def _metric_output_name(metric: Metric) -> str:
     if metric.is_percentile:
         # Must match QuantileCombiner.metrics_names formatting exactly
@@ -277,11 +336,50 @@ class QueryBuilder:
                 f"Column {privacy_unit_column} is not present in the frame")
         self._df = df
         self._privacy_unit_column = privacy_unit_column
+        self._session = None
         self._by: Optional[Union[str, Sequence[str]]] = None
         self._public_keys = None
         self._aggregations_specs: List[_AggregationSpec] = []
         self._max_partitions_contributed: Optional[int] = None
         self._max_contributions_per_partition: Optional[int] = None
+
+    @classmethod
+    def on(cls, session) -> "QueryBuilder":
+        """Builds queries against a resident DatasetSession instead of a
+        frame (serving.DatasetSession.from_frame; SERVING.md) — L5 user
+        code stays declarative while repeat queries skip the encode +
+        sort + transfer phases:
+
+            session = DatasetSession.from_frame(df, "user_id", "day",
+                                                "spent_money")
+            result = (QueryBuilder.on(session)
+                      .groupby("day", max_groups_contributed=3,
+                               max_contributions_per_group=1)
+                      .count().sum("spent_money", min_value=0,
+                                   max_value=100)
+                      .build_query().run_query(Budget(1.0, 1e-6)))
+
+        The groupby column(s) and the value column must be the ones the
+        session was ingested with (the sorted wire is fixed per
+        session); contribution bounds and budgets stay per-query.
+        """
+        meta = session.frame_meta
+        if meta is None:
+            raise ValueError(
+                "QueryBuilder.on needs a session created with "
+                "DatasetSession.from_frame (the frame column binding is "
+                "fixed at ingest)")
+        builder = cls.__new__(cls)
+        builder._converter = _SessionColumns(meta["column_names"])
+        builder._df = None
+        builder._privacy_unit_column = meta["privacy_unit_column"]
+        builder._session = session
+        builder._by = None
+        builder._public_keys = None
+        builder._aggregations_specs = []
+        builder._max_partitions_contributed = None
+        builder._max_contributions_per_partition = None
+        return builder
 
     def groupby(self,
                 by: Union[str, Sequence[str]],
@@ -302,6 +400,25 @@ class QueryBuilder:
             if column not in names:
                 raise ValueError(
                     f"Column {column} is not present in the frame")
+        if self._session is not None:
+            meta = self._session.frame_meta
+            if _as_list(by) != meta["partition_key"]:
+                raise ValueError(
+                    f"session was ingested grouped by "
+                    f"{meta['partition_key']}; a different groupby "
+                    f"({_as_list(by)}) cannot reuse its sorted wire — "
+                    f"ingest a second session for it")
+            session_public = self._session.public_partitions
+            if public_keys is not None:
+                if (session_public is None
+                        or list(public_keys) != session_public):
+                    raise ValueError(
+                        "public_keys differ from the session's: the "
+                        "public filter is fixed at ingest")
+            elif session_public is not None:
+                raise ValueError(
+                    "the session was ingested with public keys; pass the "
+                    "same public_keys to groupby")
         self._by = by
         self._max_partitions_contributed = max_groups_contributed
         self._max_contributions_per_partition = max_contributions_per_group
@@ -389,13 +506,21 @@ class QueryBuilder:
             _max_contributions_per_partition,
             min_value=min_value,
             max_value=max_value)
+        if self._session is not None and input_column is not None:
+            session_value = self._session.frame_meta["value_column"]
+            if input_column != session_value:
+                raise ValueError(
+                    f"session was ingested with value column "
+                    f"{session_value!r}; aggregating {input_column!r} "
+                    f"needs a session ingested over that column")
         metric_to_output_column = dict(
             (spec.metric, spec.output_column)
             for spec in self._aggregations_specs)
         return Query(self._df,
                      Columns(self._privacy_unit_column, self._by,
                              input_column), metric_to_output_column,
-                     contribution_bounds, self._public_keys)
+                     contribution_bounds, self._public_keys,
+                     session=self._session)
 
     def _add_aggregation(self, spec: _AggregationSpec) -> "QueryBuilder":
         self._check_by()

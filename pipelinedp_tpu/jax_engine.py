@@ -62,6 +62,82 @@ def _mechanism_noise_params(spec: budget_accounting.MechanismSpec,
         mech.noise_parameter)
 
 
+def derive_contribution_caps(params: AggregateParams, compound, n_rows: int,
+                             num_partitions: int):
+    """(linf_cap, l0_cap, l1_cap) for the bounding kernels.
+
+    The single derivation of the engine's contribution-bound caps from
+    the aggregation params + compound combiner (parity:
+    DPEngine._create_contribution_bounder, dp_engine.py:285-400), shared
+    by the standard aggregate path, the custom-combiner path, and the
+    serving layer's batched resident queries — so a batched config's caps
+    can never drift from what its sequential run would use.
+    """
+    if (compound.expects_per_partition_sampling()
+            and params.max_contributions_per_partition):
+        linf_cap = params.max_contributions_per_partition
+    else:
+        linf_cap = max(n_rows, 1)
+    l0_cap = (params.max_partitions_contributed
+              if params.max_partitions_contributed else num_partitions)
+    if not params.perform_cross_partition_contribution_bounding:
+        # Linf-only bounding (utility-analysis mode): noise stays
+        # calibrated to the declared L0 bound, but no partitions drop.
+        l0_cap = num_partitions
+    l1_cap = None
+    if params.max_contributions is not None:
+        # L1 bounding: a uniform sample of max_contributions rows per
+        # privacy unit across all partitions; Linf/L0 caps disabled.
+        l1_cap = params.max_contributions
+        linf_cap = max(n_rows, 1)
+        l0_cap = num_partitions
+    if params.contribution_bounds_already_enforced:
+        # The input already satisfies the bounds; apply none.
+        linf_cap = max(n_rows, 1)
+        l0_cap = num_partitions
+    return linf_cap, l0_cap, l1_cap
+
+
+def derive_need_flags(compound) -> Tuple[bool, bool, bool, bool]:
+    """(need_count, need_sum, need_norm, need_norm_sq) — which accumulator
+    columns the compound's combiners actually read. Dropped columns save
+    two full-HBM segment passes each in the kernel. Shared by _execute
+    and the serving layer's batched resident queries."""
+    return (
+        any(isinstance(c, (combiners_lib.CountCombiner,
+                           combiners_lib.MeanCombiner,
+                           combiners_lib.VarianceCombiner))
+            for c in compound.combiners),
+        any(isinstance(c, combiners_lib.SumCombiner)
+            for c in compound.combiners),
+        any(isinstance(c, (combiners_lib.MeanCombiner,
+                           combiners_lib.VarianceCombiner))
+            for c in compound.combiners),
+        any(isinstance(c, combiners_lib.VarianceCombiner)
+            for c in compound.combiners),
+    )
+
+
+def derive_clip_bounds(params: AggregateParams):
+    """(row_lo, row_hi, group_lo, group_hi, middle) for the bounding
+    kernels, from the params' bounds mode. Shared by _execute and the
+    serving layer's batched resident queries."""
+    if params.bounds_per_partition_are_set:
+        row_lo, row_hi = -np.inf, np.inf
+        glo, ghi = (params.min_sum_per_partition,
+                    params.max_sum_per_partition)
+    elif params.bounds_per_contribution_are_set:
+        row_lo, row_hi = params.min_value, params.max_value
+        glo, ghi = -np.inf, np.inf
+    else:
+        row_lo, row_hi = -np.inf, np.inf
+        glo, ghi = -np.inf, np.inf
+    middle = (dp_computations.compute_middle(params.min_value,
+                                             params.max_value)
+              if params.bounds_per_contribution_are_set else 0.0)
+    return row_lo, row_hi, glo, ghi, middle
+
+
 class KeyTag(enum.IntEnum):
     """Reserved ``fold_in`` tags for the engine's PRNG substreams.
 
@@ -371,8 +447,9 @@ class JaxDPEngine:
                   public_partitions: Optional[Sequence[Any]] = None,
                   out_explain_computation_report: Optional[
                       ExplainComputationReport] = None) -> LazyJaxResult:
-        is_columnar = isinstance(
+        is_columnar = (isinstance(
             col, (encoding.ColumnarData, encoding.EncodedColumns))
+            or getattr(col, "is_resident_dataset", False))
         dp_engine_lib.DPEngine._check_aggregate_params(
             self, col, params, data_extractors,
             check_data_extractors=not is_columnar)
@@ -635,7 +712,14 @@ class JaxDPEngine:
                     f"[{params.min_value}, {params.max_value}]).")
 
     def _aggregate(self, col, params, data_extractors, public_partitions):
+        resident = (col if getattr(col, "is_resident_dataset", False)
+                    else None)
         if params.custom_combiners:
+            if resident is not None:
+                raise NotImplementedError(
+                    "custom combiners are not supported on resident "
+                    "dataset sessions (host combiner logic needs the raw "
+                    "rows the session no longer holds)")
             return self._aggregate_custom(col, params, data_extractors,
                                           public_partitions)
         # Same budget requests as the reference graph.
@@ -648,61 +732,56 @@ class JaxDPEngine:
             selection_spec = self._budget_accountant.request_budget(
                 mechanism_type=MechanismType.GENERIC)
 
-        # Host-side columnar encoding (the extract + public-filter stages).
-        # With contribution_bounds_already_enforced each row is its own
-        # privacy unit and no bounding is applied (parity: dp_engine.py:122).
-        # Columnar inputs carry their own pid column; any non-None marker
-        # tells encode_rows to use it.
-        pid_extractor = (data_extractors.privacy_id_extractor
-                         if data_extractors is not None else True)
-        if params.contribution_bounds_already_enforced:
-            pid_extractor = None  # encode_rows assigns a unique id per row
-        with profiler.stage("dp/encode"):
-            pid, pk, value, pid_vocab, pk_vocab = encoding.encode_rows(
-                col,
-                pid_extractor,
-                data_extractors.partition_extractor
-                if data_extractors else None,
-                data_extractors.value_extractor if data_extractors else None,
-                public_partitions=public_partitions,
-                vector_size=params.vector_size if is_vector else None,
-                factorize_pid=False)
+        if resident is not None:
+            # Resident-dataset fast path (pipelinedp_tpu/serving/): the
+            # encode + sort + transfer phases were paid at ingest; the
+            # session hands back the retained wire and the partition
+            # vocabulary it was built with.
+            if is_vector:
+                raise NotImplementedError(
+                    "VECTOR_SUM is not supported on resident dataset "
+                    "sessions (the vector path has no wire codec to "
+                    "retain)")
+            if params.contribution_bounds_already_enforced:
+                raise NotImplementedError(
+                    "contribution_bounds_already_enforced re-interprets "
+                    "every row as its own privacy unit, which changes the "
+                    "wire; ingest the dataset that way instead")
+            resident._check_engine_compat(self, public_partitions)
+            pid = pk = value = None
+            pk_vocab = resident.pk_vocab
+            n_rows = resident.n_rows
+        else:
+            # Host-side columnar encoding (the extract + public-filter
+            # stages). With contribution_bounds_already_enforced each row
+            # is its own privacy unit and no bounding is applied (parity:
+            # dp_engine.py:122). Columnar inputs carry their own pid
+            # column; any non-None marker tells encode_rows to use it.
+            pid_extractor = (data_extractors.privacy_id_extractor
+                             if data_extractors is not None else True)
+            if params.contribution_bounds_already_enforced:
+                pid_extractor = None  # a unique id per row
+            with profiler.stage("dp/encode"):
+                pid, pk, value, pid_vocab, pk_vocab = encoding.encode_rows(
+                    col,
+                    pid_extractor,
+                    data_extractors.partition_extractor
+                    if data_extractors else None,
+                    data_extractors.value_extractor
+                    if data_extractors else None,
+                    public_partitions=public_partitions,
+                    vector_size=params.vector_size if is_vector else None,
+                    factorize_pid=False)
+            n_rows = len(pid)
         num_partitions = max(len(pk_vocab), 1)
 
         # When no child combiner expects per-partition sampling (e.g. the
         # per-partition-sum clipping mode), Linf bounding is the combiner's
         # job — disable the sampler (parity:
         # DPEngine._create_contribution_bounder, dp_engine.py:380-400).
-        if (compound.expects_per_partition_sampling() and
-                params.max_contributions_per_partition):
-            linf_cap = params.max_contributions_per_partition
-        else:
-            linf_cap = max(len(pid), 1)
-        l0_cap = (params.max_partitions_contributed
-                  if params.max_partitions_contributed else num_partitions)
-        if not params.perform_cross_partition_contribution_bounding:
-            # Linf-only bounding (utility-analysis mode): noise stays
-            # calibrated to the declared L0 bound, but no partitions are
-            # dropped (parity: DPEngine._create_contribution_bounder,
-            # dp_engine.py:285-293).
-            l0_cap = num_partitions
-        l1_cap = None
-        if params.max_contributions is not None:
-            # L1 bounding: a uniform sample of max_contributions rows per
-            # privacy unit, total across all partitions — the same
-            # semantics as the reference's
-            # SamplingPerPrivacyIdContributionBounder
-            # (contribution_bounders.py:114-156), and the bound the L1
-            # noise sensitivity is calibrated to. Linf/L0 caps are
-            # disabled; the kernels apply the L1 sample first. Pinned by
-            # tests/jax_engine_test.py TestL1ModeParity.
-            l1_cap = params.max_contributions
-            linf_cap = max(len(pid), 1)
-            l0_cap = num_partitions
+        linf_cap, l0_cap, l1_cap = derive_contribution_caps(
+            params, compound, n_rows, num_partitions)
         if params.contribution_bounds_already_enforced:
-            # The input already satisfies the bounds; apply none.
-            linf_cap = max(len(pid), 1)
-            l0_cap = num_partitions
             self._add_report_stage(
                 "Contribution bounding: skipped (already enforced by the "
                 "caller)")
@@ -739,7 +818,8 @@ class JaxDPEngine:
                                        num_partitions, linf_cap, l0_cap,
                                        public_partitions is not None,
                                        is_vector, l1_cap=l1_cap,
-                                       key_counter=key_counter)
+                                       key_counter=key_counter,
+                                       resident=resident)
 
         return LazyJaxResult(compute, pk_vocab)
 
@@ -827,28 +907,13 @@ class JaxDPEngine:
             pid, _ = encoding._factorize(pid_col)
         num_partitions = max(len(pk_vocab), 1)
 
-        # Cap derivation mirrors the standard path (jax_engine._aggregate):
-        # Linf sampling only when the compound expects it; L1 mode samples
-        # per privacy unit; perform_cross_partition_contribution_bounding
-        # =False disables L0 dropping (noise stays calibrated to the
-        # declared bound).
-        if (compound.expects_per_partition_sampling() and
-                params.max_contributions_per_partition):
-            linf_cap = params.max_contributions_per_partition
-        else:
-            linf_cap = max(len(pid), 1)
-        l0_cap = (params.max_partitions_contributed
-                  if params.max_partitions_contributed else num_partitions)
-        if not params.perform_cross_partition_contribution_bounding:
-            l0_cap = num_partitions
-        l1_cap = None
-        if params.max_contributions is not None:
-            l1_cap = params.max_contributions
-            linf_cap = max(len(pid), 1)
-            l0_cap = num_partitions
+        # Shared cap derivation with the standard path (the compound
+        # gates Linf sampling; L1 mode samples per privacy unit;
+        # perform_cross_partition_contribution_bounding=False disables L0
+        # dropping while noise stays calibrated to the declared bound).
+        linf_cap, l0_cap, l1_cap = derive_contribution_caps(
+            params, compound, len(pid), num_partitions)
         if params.contribution_bounds_already_enforced:
-            linf_cap = max(len(pid), 1)
-            l0_cap = num_partitions
             self._add_report_stage(
                 "Contribution bounding: skipped (already enforced by the "
                 "caller)")
@@ -971,45 +1036,20 @@ class JaxDPEngine:
     def _execute(self, compound, params: AggregateParams, selection_spec,
                  key, pid, pk, value, num_partitions, linf_cap, l0_cap,
                  is_public: bool, is_vector: bool, l1_cap=None,
-                 key_counter: int = -1) -> dict:
+                 key_counter: int = -1, resident=None) -> dict:
         k_kernel, k_select, k_noise = jax.random.split(key, 3)
-        n_rows = len(pid)
+        n_rows = len(pid) if pid is not None else resident.n_rows
         has_quantile = any(
             isinstance(c, combiners_lib.QuantileCombiner)
             for c in compound.combiners)
         # Accumulators no combiner reads are never computed: each dropped
         # column saves two full-HBM segment passes in the kernel
         # (columnar.bound_and_aggregate need_* flags).
-        need_flags = (
-            any(isinstance(c, (combiners_lib.CountCombiner,
-                               combiners_lib.MeanCombiner,
-                               combiners_lib.VarianceCombiner))
-                for c in compound.combiners),
-            any(isinstance(c, combiners_lib.SumCombiner)
-                for c in compound.combiners),
-            any(isinstance(c, (combiners_lib.MeanCombiner,
-                               combiners_lib.VarianceCombiner))
-                for c in compound.combiners),
-            any(isinstance(c, combiners_lib.VarianceCombiner)
-                for c in compound.combiners),
-        )
+        need_flags = derive_need_flags(compound)
         # Group-level sum clipping exists only in the per-partition-bounds
         # mode; without it the kernel scatters rows straight to partitions.
         has_group_clip = bool(params.bounds_per_partition_are_set)
-
-        if params.bounds_per_partition_are_set:
-            row_lo, row_hi = -np.inf, np.inf
-            glo, ghi = (params.min_sum_per_partition,
-                        params.max_sum_per_partition)
-        elif params.bounds_per_contribution_are_set:
-            row_lo, row_hi = params.min_value, params.max_value
-            glo, ghi = -np.inf, np.inf
-        else:
-            row_lo, row_hi = -np.inf, np.inf
-            glo, ghi = -np.inf, np.inf
-        middle = (dp_computations.compute_middle(params.min_value,
-                                                 params.max_value)
-                  if params.bounds_per_contribution_are_set else 0.0)
+        row_lo, row_hi, glo, ghi, middle = derive_clip_bounds(params)
 
         vector_sums = None
         streamed_qhist = None
@@ -1019,7 +1059,45 @@ class JaxDPEngine:
         if is_vector:
             pid, pk, value, vec_sorted_kw = self._presort_vector_rows(
                 pid, pk, value, n_rows, num_partitions, l1_cap)
-        if self._mesh is not None:
+        if resident is not None:
+            # Resident-dataset replay: the session folds its retained
+            # wire under this query's kernel key — no encode, no sort,
+            # and (for device-resident handles / warm bound-cache hits)
+            # no transfer or kernel either. Bit-identical to streaming
+            # the source columns cold with the same key and chunk count.
+            quantile_spec = None
+            if has_quantile:
+                if (self._mesh is not None
+                        or not self._can_stream(True, num_partitions)):
+                    raise NotImplementedError(
+                        "PERCENTILE on a resident session needs the "
+                        "streamed quantile path (single device, dense "
+                        "[partitions, leaves] histogram within the "
+                        "device budget)")
+                quantile_spec = (
+                    quantile_tree_lib.DEFAULT_BRANCHING_FACTOR
+                    ** quantile_tree_lib.DEFAULT_TREE_HEIGHT,
+                    params.min_value, params.max_value)
+            accs = resident._accumulate(
+                k_kernel,
+                mesh=self._mesh,
+                linf_cap=linf_cap,
+                l0_cap=l0_cap,
+                row_clip_lo=row_lo,
+                row_clip_hi=row_hi,
+                middle=middle,
+                group_clip_lo=glo,
+                group_clip_hi=ghi,
+                l1_cap=l1_cap,
+                need_flags=need_flags,
+                has_group_clip=has_group_clip,
+                quantile_spec=quantile_spec,
+                segment_sort=self._segment_sort,
+                compact_merge=self._compact_merge,
+                resilience=self._stream_resilience(key_counter))
+            if quantile_spec is not None:
+                accs, streamed_qhist = accs
+        elif self._mesh is not None:
             from pipelinedp_tpu.parallel import sharded
             if (not is_vector and not has_quantile and
                     self._stream_chunks != 1 and

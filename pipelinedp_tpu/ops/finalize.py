@@ -44,6 +44,8 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+import threading
+from collections import OrderedDict
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -78,6 +80,21 @@ MODE_NONE = "none"
 _TRACE_EVENT = "dp/finalize_traces"
 _CACHE_HIT_EVENT = "dp/finalize_cache_hits"
 _CACHE_MISS_EVENT = "dp/finalize_cache_misses"
+_CACHE_EVICT_EVENT = "dp/finalize_cache_evictions"
+
+# Max compiled executables the (default) EpilogueCache retains; LRU
+# beyond it. Env knob PIPELINEDP_TPU_EPILOGUE_CACHE (README "Tuning
+# knobs") — a serving deployment cycling through more than this many
+# distinct query plans should raise it.
+DEFAULT_CACHE_ENTRIES = 64
+CACHE_ENTRIES_ENV = "PIPELINEDP_TPU_EPILOGUE_CACHE"
+
+
+def cache_max_entries() -> int:
+    """Validated PIPELINEDP_TPU_EPILOGUE_CACHE (default 64)."""
+    from pipelinedp_tpu.native import loader
+    return loader.env_int(CACHE_ENTRIES_ENV, DEFAULT_CACHE_ENTRIES, 1,
+                          1 << 16)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -668,44 +685,91 @@ class EpilogueCache:
     jitted callable per (plan, mesh) so the callable identity — and with
     it the jit cache — survives across engines). Hit/miss counts are
     exposed for the bench and mirrored into profiler event counters.
+
+    Bounded and thread-safe: concurrent session queries
+    (pipelinedp_tpu/serving/) share one cache, so lookups and insertions
+    run under a lock, and the executable map LRU-evicts past
+    ``max_entries`` (PIPELINEDP_TPU_EPILOGUE_CACHE; evicting an
+    executable drops its jit cache with it — the next use of that plan
+    recompiles). Evictions are counted (``evictions`` attribute and the
+    dp/finalize_cache_evictions profiler counter). The seen-signature
+    set behind the hit/miss counters is bounded to a multiple of
+    max_entries, so the counters are approximate only once a plan has
+    been evicted and returns.
     """
 
-    def __init__(self):
-        self._executables: Dict[tuple, Any] = {}
-        self._seen_signatures = set()
+    # Signature-set bound per executable entry: each (plan, mesh) is
+    # typically exercised at a handful of shapes.
+    _SIGS_PER_ENTRY = 8
+
+    def __init__(self, max_entries: Optional[int] = None):
+        self._max_entries = (int(max_entries) if max_entries is not None
+                             else cache_max_entries())
+        if self._max_entries < 1:
+            raise ValueError("max_entries must be >= 1")
+        self._lock = threading.Lock()
+        self._executables: "OrderedDict[tuple, Any]" = OrderedDict()
+        self._seen_signatures: "OrderedDict[tuple, None]" = OrderedDict()
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
+
+    @property
+    def max_entries(self) -> int:
+        return self._max_entries
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._executables)
 
     def get(self, plan: FinalizePlan, mesh, operands, builder=None):
         """The compiled epilogue for (plan, mesh); counts whether this
         exact operand signature was seen before. builder(plan) supplies a
         mesh-aware jit (parallel/sharded.build_finalize_epilogue)."""
         signature = (plan, mesh, _abstract_signature(operands))
-        if signature in self._seen_signatures:
-            self.hits += 1
-            profiler.count_event(_CACHE_HIT_EVENT)
-        else:
-            self.misses += 1
-            self._seen_signatures.add(signature)
-            profiler.count_event(_CACHE_MISS_EVENT)
         key = (plan, mesh)
-        fn = self._executables.get(key)
-        if fn is None:
-            if builder is not None:
-                fn = builder(plan)
+        with self._lock:
+            if signature in self._seen_signatures:
+                self._seen_signatures.move_to_end(signature)
+                self.hits += 1
+                profiler.count_event(_CACHE_HIT_EVENT)
             else:
-                fn = jax.jit(functools.partial(_jit_entry, plan))
-            self._executables[key] = fn
-        return fn
+                self.misses += 1
+                self._seen_signatures[signature] = None
+                while len(self._seen_signatures) > (
+                        self._max_entries * self._SIGS_PER_ENTRY):
+                    self._seen_signatures.popitem(last=False)
+                profiler.count_event(_CACHE_MISS_EVENT)
+            fn = self._executables.get(key)
+            if fn is None:
+                if builder is not None:
+                    fn = builder(plan)
+                else:
+                    fn = jax.jit(functools.partial(_jit_entry, plan))
+                self._executables[key] = fn
+                while len(self._executables) > self._max_entries:
+                    self._executables.popitem(last=False)
+                    self.evictions += 1
+                    profiler.count_event(_CACHE_EVICT_EVENT)
+            else:
+                self._executables.move_to_end(key)
+            return fn
 
 
-_DEFAULT_CACHE = EpilogueCache()
+_DEFAULT_CACHE: Optional[EpilogueCache] = None
+_DEFAULT_CACHE_LOCK = threading.Lock()
 
 
 def default_cache() -> EpilogueCache:
     """The process-wide cache engines share by default (so repeated
-    queries from fresh engine instances still hit warm executables)."""
-    return _DEFAULT_CACHE
+    queries from fresh engine instances still hit warm executables).
+    Built lazily so the PIPELINEDP_TPU_EPILOGUE_CACHE knob is read (and
+    validated) on first use, not at import."""
+    global _DEFAULT_CACHE
+    with _DEFAULT_CACHE_LOCK:
+        if _DEFAULT_CACHE is None:
+            _DEFAULT_CACHE = EpilogueCache()
+        return _DEFAULT_CACHE
 
 
 def trace_count() -> int:

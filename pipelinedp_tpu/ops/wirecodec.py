@@ -888,6 +888,24 @@ def make_encoder(pid: np.ndarray, pk, value, *, num_partitions: int, k: int,
     return None, fallback_info()
 
 
+def resident_fingerprint(k: int, fmt: WireFormat, counts: np.ndarray,
+                         n_uniq: Optional[np.ndarray],
+                         data_digest: str = "") -> str:
+    """Identity of a retained wire handle (streaming.ResidentWire).
+
+    Reuses the checkpoint wire-fingerprint path — chunk count, format,
+    per-bucket row/entry counts, plus the source-column digest
+    (runtime.checkpoint.array_digest) — so a resident-dataset session
+    names its handle exactly the way a resumed slab loop names its wire,
+    and a source dataset mutated after ingest is refused on the same
+    evidence a mutated checkpoint input is.
+    """
+    from pipelinedp_tpu.runtime import checkpoint as checkpoint_lib
+
+    return checkpoint_lib.wire_fingerprint(k, repr(fmt), counts, n_uniq,
+                                           data_digest=data_digest)
+
+
 def round_ucap(umax: int) -> int:
     """Rounds an RLE entry count up with ~12.5% granularity so slab shapes
     recur across slabs/runs (each distinct shape is a fresh XLA compile)."""

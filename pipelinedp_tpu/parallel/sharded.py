@@ -895,6 +895,58 @@ def stream_bound_and_aggregate(mesh: Mesh,
                              int_clip=int_clip, sort_stats=sort_stats)
 
 
+def replay_resident_wire(mesh: Mesh,
+                         key: jax.Array,
+                         wire,
+                         *,
+                         linf_cap,
+                         l0_cap,
+                         row_clip_lo,
+                         row_clip_hi,
+                         middle,
+                         group_clip_lo,
+                         group_clip_hi,
+                         l1_cap=None,
+                         need_flags=(True, True, True, True),
+                         has_group_clip: bool = True,
+                         segment_sort="auto",
+                         compact_merge="auto",
+                         resilience=None) -> columnar.PartitionAccumulators:
+    """Answers one query from a mesh-ingested ResidentWire: the retained
+    chunks ship sharded (one bucket per device) and fold through the
+    same codec chunk kernels as the cold mesh stream — no encode and no
+    host sort are re-paid. Bit-identical to
+    stream_bound_and_aggregate(mesh, key, <source columns>,
+    n_chunks=wire.n_chunks, ...) with the same knobs.
+    """
+    from pipelinedp_tpu import profiler
+    from pipelinedp_tpu.ops import streaming
+
+    n_dev = mesh.devices.size
+    if wire.n_dev != n_dev:
+        raise ValueError(
+            f"handle was ingested for {wire.n_dev} devices; this mesh has "
+            f"{n_dev}")
+    padded_p = padded_num_partitions(mesh, wire.num_partitions)
+    if wire.n_rows == 0:
+        part_sharding = NamedSharding(mesh, _part_spec(mesh))
+        return columnar.PartitionAccumulators(
+            *(jax.device_put(np.zeros(padded_p, np.float32), part_sharding)
+              for _ in range(5)))
+    profiler.count_event(streaming.EVENT_SERVING_REPLAYS)
+    fmt, int_clip, sort_stats = streaming.finish_wire_plan(
+        wire.fmt, segment_sort, wire.max_run, num_partitions=padded_p,
+        row_clip_lo=row_clip_lo, row_clip_hi=row_clip_hi,
+        linf_cap=linf_cap, l1_mode=l1_cap is not None)
+    return _drive_codec_chunks(
+        mesh, key, lambda c: wire.slab[c * n_dev:(c + 1) * n_dev],
+        wire.counts, wire.n_uniq, fmt, wire.n_chunks, n_dev, padded_p,
+        linf_cap, l0_cap, row_clip_lo, row_clip_hi, middle, group_clip_lo,
+        group_clip_hi, l1_cap, tuple(need_flags), has_group_clip,
+        resilience, None, compact_merge=compact_merge, int_clip=int_clip,
+        sort_stats=sort_stats)
+
+
 class _MeshPlacement(driver_lib.DevicePlacement):
     """Mesh strategy for the unified slab driver (runtime/driver.py owns
     the loop; this class owns how a chunk's sharded slab lands on the

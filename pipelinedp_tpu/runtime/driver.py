@@ -163,6 +163,15 @@ class SlabPlan:
     called once per executed chunk (the sort-cost counter crediting the
     jitted kernels cannot do per execution). prefetch_depth bounds the
     background host-encode lookahead (0 disables).
+
+    retain_sink, when set, is the driver's retain-wire mode: it is
+    called with ``(s0, s1, slab)`` for every successfully prepared host
+    slab window, letting a resident-dataset session keep the sorted wire
+    chunks instead of discarding them after the fold
+    (ops/streaming.ingest_resident_wire; SERVING.md). It must be
+    idempotent per ``(s0, s1)`` range — retries, OOM-degraded windows
+    and resumes may prepare (and therefore retain) a range more than
+    once, and degradations change the window boundaries.
     """
     n_chunks: int
     window_chunks: int
@@ -174,6 +183,7 @@ class SlabPlan:
     data_digest_fn: Optional[Callable[[], str]] = None
     on_chunk: Optional[Callable[[], None]] = None
     prefetch_depth: int = 0
+    retain_sink: Optional[Callable[[int, int, Any], None]] = None
 
 
 class SlabDriver:
@@ -295,6 +305,12 @@ class SlabDriver:
                         fut = inflight.pop((cursor, s1), None)
                         slab = (fut.result() if fut is not None
                                 else self._prepare_slab(cursor, s1))
+                        if plan.retain_sink is not None:
+                            # Retain-wire mode: hand the validated host
+                            # slab to the session before it is consumed
+                            # (the corruption guard already ran inside
+                            # prepare_slab).
+                            plan.retain_sink(cursor, s1, slab)
                         if executor is not None:
                             nxt0 = s1
                             while len(inflight) < depth and nxt0 < k:

@@ -1,0 +1,38 @@
+"""Resident-dataset query serving: pay encode + sort once, serve many
+DP queries per launch.
+
+The production shape for "millions of users" (ROADMAP north star) is not
+one batch job but many DP queries per day against the same dataset. This
+package is the long-lived serving layer over the columnar engine:
+
+  * :class:`DatasetSession` runs the wire pipeline ONCE — the SlabDriver
+    streams the dataset through encode / per-bucket radix sort /
+    transfer in retain-wire mode — and keeps the sorted wire chunks as a
+    reusable handle (device-resident when they fit the placement's byte
+    budget, host slab cache otherwise; ``PIPELINEDP_TPU_RESIDENT_BYTES``).
+    Every subsequent query is kernel + fused epilogue only, bit-identical
+    to the same query run cold.
+  * :meth:`DatasetSession.query_batch` packs concurrent queries that
+    share the sorted wire but differ in metric set / epsilon / clip
+    bounds into ONE vmapped launch per chunk
+    (``PIPELINEDP_TPU_SERVING_BATCH`` bounds the width), matching the
+    sequential runs' released values config-for-config.
+  * per-tenant budgets: :class:`~pipelinedp_tpu.budget_accounting
+    .TenantBudgetLedger` + a per-tenant ReleaseJournal thread the
+    existing spend-journal / at-most-once machinery through the session,
+    so two tenants query one resident dataset without sharing budget.
+
+L5 user code stays declarative: ``dataframes.QueryBuilder.on(session)``
+builds queries against a session exactly like against a frame.
+
+See SERVING.md for the session lifecycle, memory/eviction knobs, tenant
+budget semantics and the interaction with checkpoint/resume.
+"""
+
+from pipelinedp_tpu.serving.session import (  # noqa: F401
+    EVENT_BOUND_EVICTIONS, EVENT_BOUND_HITS, EVENT_BOUND_MISSES,
+    EVENT_QUERIES, BATCH_WIDTH_ENV, RESIDENT_BYTES_ENV, DatasetSession,
+    QueryConfig, SessionClosedError, StaleDatasetError, TenantState,
+    batch_width, resident_byte_budget, serving_counters)
+from pipelinedp_tpu.budget_accounting import (  # noqa: F401
+    BudgetExhaustedError, TenantBudgetLedger)

@@ -1,0 +1,721 @@
+"""DatasetSession: the resident-dataset serving layer (SERVING.md).
+
+One session = one ingested dataset + any number of DP queries against
+it. Ingest pays the dominant e2e phases (host encode, per-bucket radix
+sort, and — for device-resident handles — the host->device transfer)
+exactly once; queries replay the retained wire through the chunk
+kernels, and repeat queries with identical bounding configuration skip
+even the kernel via the session's accumulator ("bound") cache.
+
+Exactness contract: a query answered from a session is BIT-IDENTICAL —
+released values and kept partitions — to the same query run cold through
+``JaxDPEngine(accountant, seed=s, stream_chunks=session.n_chunks, ...)``
+on the source columns, on single-device and on a mesh. The bound cache
+preserves this automatically: its key includes the kernel-key
+fingerprint, so a hit replays exactly the accumulators that key would
+have produced.
+
+Thread-safety: ``query`` may be called concurrently from many threads.
+Shared state (the bound cache, tenant ledgers and journals, the
+epilogue cache, profiler counters) is lock-guarded; everything else
+(engine, accountant, result) is per-query local. Two racing misses of
+the same bound-cache key may both compute — they produce identical
+arrays, so the race costs work, never correctness.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from pipelinedp_tpu import budget_accounting
+from pipelinedp_tpu import combiners as combiners_lib
+from pipelinedp_tpu import jax_engine
+from pipelinedp_tpu import profiler
+from pipelinedp_tpu.aggregate_params import (AggregateParams, MechanismType,
+                                             Metric, Metrics, NoiseKind)
+from pipelinedp_tpu.ops import columnar, encoding, finalize as finalize_ops
+from pipelinedp_tpu.ops import streaming
+from pipelinedp_tpu.runtime import checkpoint as checkpoint_lib
+from pipelinedp_tpu.runtime import journal as journal_lib
+
+# Tuning knobs (validated via native.loader.env_int; README "Tuning
+# knobs" + SERVING.md):
+#   PIPELINEDP_TPU_RESIDENT_BYTES — per-session resident byte budget:
+#     the wire slab goes device-resident when it fits, and the bound
+#     cache LRU-evicts to stay under what remains (default 1 GiB).
+#   PIPELINEDP_TPU_SERVING_BATCH — max query configs packed into one
+#     vmapped launch by query_batch (default 32).
+RESIDENT_BYTES_ENV = "PIPELINEDP_TPU_RESIDENT_BYTES"
+BATCH_WIDTH_ENV = "PIPELINEDP_TPU_SERVING_BATCH"
+
+# Profiler event counters (profiler.count_event / event_count; the
+# replay-side counters live in ops/streaming.py):
+EVENT_QUERIES = "serving/queries"
+EVENT_BOUND_HITS = "serving/bound_cache_hits"
+EVENT_BOUND_MISSES = "serving/bound_cache_misses"
+EVENT_BOUND_EVICTIONS = "serving/bound_cache_evictions"
+
+
+def resident_byte_budget() -> int:
+    """Validated PIPELINEDP_TPU_RESIDENT_BYTES (default 1 GiB)."""
+    from pipelinedp_tpu.native import loader
+    return loader.env_int(RESIDENT_BYTES_ENV, 1 << 30, 1 << 20, 1 << 40)
+
+
+def batch_width() -> int:
+    """Validated PIPELINEDP_TPU_SERVING_BATCH (default 32): the max
+    configs one vmapped launch carries; wider batches split."""
+    from pipelinedp_tpu.native import loader
+    return loader.env_int(BATCH_WIDTH_ENV, 32, 1, 1024)
+
+
+def serving_counters() -> Dict[str, int]:
+    """Snapshot of the serving counters (bench.py surfaces this)."""
+    return {
+        "queries": profiler.event_count(EVENT_QUERIES),
+        "bound_cache_hits": profiler.event_count(EVENT_BOUND_HITS),
+        "bound_cache_misses": profiler.event_count(EVENT_BOUND_MISSES),
+        "bound_cache_evictions": profiler.event_count(
+            EVENT_BOUND_EVICTIONS),
+        "wire_replays": profiler.event_count(
+            streaming.EVENT_SERVING_REPLAYS),
+        "kernel_dispatches": profiler.event_count(
+            streaming.EVENT_SERVING_LAUNCHES),
+    }
+
+
+class StaleDatasetError(RuntimeError):
+    """The source columns were mutated after ingest: the retained wire no
+    longer describes the data the caller is looking at, so the session
+    refuses to answer (re-ingest to serve the new data)."""
+
+
+class SessionClosedError(RuntimeError):
+    """The session was closed; its handle and caches are gone."""
+
+
+@dataclasses.dataclass
+class TenantState:
+    """One tenant's serving-side state: the cross-query budget ledger and
+    the at-most-once release journal. Tenants never share either — one
+    tenant replaying a release or exhausting its epsilon cannot touch
+    another tenant's ledger or journal."""
+    ledger: budget_accounting.TenantBudgetLedger
+    release_journal: journal_lib.ReleaseJournal
+
+
+@dataclasses.dataclass
+class QueryConfig:
+    """One query of a batched launch (DatasetSession.query_batch).
+
+    Configs in one batch share the session's sorted wire and pack into a
+    single vmapped kernel launch per chunk; metrics / epsilon / clip
+    bounds / caps / seed / tenant vary per config.
+    """
+    metrics: List[Metric]
+    epsilon: float
+    delta: float = 0.0
+    noise_kind: NoiseKind = NoiseKind.LAPLACE
+    max_partitions_contributed: Optional[int] = None
+    max_contributions_per_partition: Optional[int] = None
+    min_value: Optional[float] = None
+    max_value: Optional[float] = None
+    min_sum_per_partition: Optional[float] = None
+    max_sum_per_partition: Optional[float] = None
+    seed: int = 0
+    tenant: Optional[str] = None
+
+    def to_params(self) -> AggregateParams:
+        return AggregateParams(
+            metrics=list(self.metrics),
+            noise_kind=self.noise_kind,
+            max_partitions_contributed=self.max_partitions_contributed,
+            max_contributions_per_partition=self.
+            max_contributions_per_partition,
+            min_value=self.min_value,
+            max_value=self.max_value,
+            min_sum_per_partition=self.min_sum_per_partition,
+            max_sum_per_partition=self.max_sum_per_partition)
+
+
+@dataclasses.dataclass
+class _BoundCacheEntry:
+    result: Any  # accs, or (accs, qhist)
+    nbytes: int
+
+
+@dataclasses.dataclass
+class _PreparedQuery:
+    """One config's engine-side state, prepared before the batched
+    accumulate (budget requests registered, keys drawn, caps derived)."""
+    index: int
+    engine: Any
+    accountant: Any
+    compound: Any
+    sel_spec: Any
+    params: AggregateParams
+    k_kernel: Any
+    k_select: Any
+    k_noise: Any
+    key_counter: int
+    linf_cap: int
+    l0_cap: int
+    row_lo: float
+    row_hi: float
+    glo: float
+    ghi: float
+    middle: float
+    need_flags: tuple
+    has_group_clip: bool
+
+
+class DatasetSession:
+    """A resident dataset serving many DP queries (module docstring).
+
+    data: ColumnarData or EncodedColumns (use :meth:`from_frame` for
+      pandas / dict frames).
+    public_partitions: fixed at ingest — the public filter and the
+      partition vocabulary shape the wire, so every query of the session
+      shares them.
+    mesh: a ``parallel.sharded.make_mesh`` mesh; the wire is ingested in
+      the mesh's bucket layout and queries replay sharded. Device
+      residency (skipping per-query transfer) is single-device only.
+    n_chunks: wire chunk count; defaults to the streaming path's own
+      choice for this row count, so cold-parity engines need
+      ``stream_chunks=session.n_chunks``.
+    resident_bytes: overrides PIPELINEDP_TPU_RESIDENT_BYTES.
+    verify_source: keep a reference to the source columns and refuse
+      queries (StaleDatasetError) if their digest no longer matches the
+      ingest-time fingerprint. Costs one O(n) column-sum per query.
+    """
+
+    # Duck-typed marker JaxDPEngine.aggregate dispatches on (keeps the
+    # engine free of serving imports).
+    is_resident_dataset = True
+
+    def __init__(self,
+                 data,
+                 *,
+                 public_partitions: Optional[Sequence[Any]] = None,
+                 mesh=None,
+                 n_chunks: Optional[int] = None,
+                 resident_bytes: Optional[int] = None,
+                 value_transfer_dtype=None,
+                 secure_host_noise: bool = True,
+                 segment_sort="auto",
+                 compact_merge="auto",
+                 epilogue_cache: Optional[
+                     finalize_ops.EpilogueCache] = None,
+                 verify_source: bool = True,
+                 name: str = "dataset"):
+        self._name = name
+        self._mesh = mesh
+        self._public = (list(public_partitions)
+                        if public_partitions is not None else None)
+        self._secure_host_noise = secure_host_noise
+        self._segment_sort = segment_sort
+        self._compact_merge = compact_merge
+        self._epilogue_cache = (epilogue_cache if epilogue_cache is not None
+                                else finalize_ops.default_cache())
+        self._byte_budget = (int(resident_bytes) if resident_bytes is not None
+                             else resident_byte_budget())
+        self._closed = False
+        self._lock = threading.Lock()
+        self._bound_cache: "collections.OrderedDict[tuple, _BoundCacheEntry]"
+        self._bound_cache = collections.OrderedDict()
+        self._cache_bytes = 0
+        self._tenants: Dict[str, TenantState] = {}
+        self._queries = 0
+        self._frame_meta = None  # set by from_frame
+
+        with profiler.stage("dp/ingest"):
+            pid, pk, value, _, pk_vocab = encoding.encode_rows(
+                data, True, None, None,
+                public_partitions=self._public, factorize_pid=False)
+            self._pk_vocab = pk_vocab
+            n_dev = mesh.devices.size if mesh is not None else 1
+            self._wire = streaming.ingest_resident_wire(
+                pid, pk, value,
+                num_partitions=max(len(pk_vocab), 1),
+                n_chunks=n_chunks, n_dev=n_dev,
+                value_transfer_dtype=value_transfer_dtype)
+        if verify_source:
+            self._source = data
+            self._source_digest = checkpoint_lib.array_digest(
+                np.asarray(data.pid), np.asarray(data.pk),
+                None if data.value is None else np.asarray(data.value))
+        else:
+            self._source = self._source_digest = None
+        # Device residency: the sorted wire moves onto the device when it
+        # fits the byte budget, so warm queries skip the host->device
+        # transfer too. Mesh handles stay host-side (each chunk ships
+        # sharded per query).
+        if (mesh is None and self._wire.n_rows > 0
+                and self._wire.host_nbytes <= self._byte_budget):
+            self._wire.ensure_device()
+
+    # -- construction from L5 frames ------------------------------------
+
+    @classmethod
+    def from_frame(cls, df, privacy_unit_column: str, partition_key,
+                   value_column: Optional[str] = None, *,
+                   public_keys: Optional[Sequence[Any]] = None,
+                   **session_kwargs) -> "DatasetSession":
+        """Ingests a pandas DataFrame or dict-of-arrays frame, fixing the
+        (privacy unit, partition key, value) columns for the session's
+        lifetime. ``QueryBuilder.on(session)`` then builds declarative
+        queries against it (dataframes.py)."""
+        from pipelinedp_tpu import dataframes
+
+        converter = dataframes._create_converter(df)
+        names = converter.column_names(df)
+        for col in ([privacy_unit_column] + dataframes._as_list(
+                partition_key) + ([value_column] if value_column else [])):
+            if col not in names:
+                raise ValueError(f"Column {col} is not present in the frame")
+        columns = dataframes.Columns(privacy_unit_column, partition_key,
+                                     value_column)
+        data = converter.frame_to_columns(df, columns)
+        session = cls(data, public_partitions=public_keys,
+                      **session_kwargs)
+        session._frame_meta = {
+            "converter": converter,
+            "column_names": list(names),
+            "privacy_unit_column": privacy_unit_column,
+            "partition_key": dataframes._as_list(partition_key),
+            "value_column": value_column,
+        }
+        return session
+
+    # -- introspection ---------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def pk_vocab(self) -> encoding.Vocabulary:
+        return self._pk_vocab
+
+    @property
+    def n_rows(self) -> int:
+        return self._wire.n_rows
+
+    @property
+    def num_partitions(self) -> int:
+        return self._wire.num_partitions
+
+    @property
+    def n_chunks(self) -> int:
+        """Chunk count of the retained wire — the ``stream_chunks=`` a
+        cold engine needs for bit-parity with this session."""
+        return self._wire.n_chunks
+
+    @property
+    def fingerprint(self) -> str:
+        """Wire-handle identity (wirecodec.resident_fingerprint)."""
+        return self._wire.fingerprint
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def public_partitions(self):
+        return list(self._public) if self._public is not None else None
+
+    @property
+    def frame_meta(self) -> Optional[dict]:
+        """Frame binding of a from_frame session (None otherwise)."""
+        return self._frame_meta
+
+    def stats(self) -> dict:
+        """Resident-memory and cache accounting of this session."""
+        with self._lock:
+            return {
+                "wire_host_bytes": self._wire.host_nbytes,
+                "wire_device_bytes": self._wire.device_nbytes,
+                "bound_cache_bytes": self._cache_bytes,
+                "bound_cache_entries": len(self._bound_cache),
+                "resident_bytes": (self._wire.host_nbytes
+                                   + self._wire.device_nbytes
+                                   + self._cache_bytes),
+                "byte_budget": self._byte_budget,
+                "queries": self._queries,
+                "n_chunks": self._wire.n_chunks,
+                "tenants": {
+                    tid: {
+                        "spent_epsilon": st.ledger.spent_epsilon,
+                        "remaining_epsilon": st.ledger.remaining_epsilon,
+                        "releases": len(st.release_journal),
+                    }
+                    for tid, st in self._tenants.items()
+                },
+            }
+
+    def close(self) -> None:
+        """Frees the handle (device + host) and every cache; further
+        queries raise SessionClosedError."""
+        with self._lock:
+            self._closed = True
+            self._wire.drop_device()
+            self._bound_cache.clear()
+            self._cache_bytes = 0
+            self._source = None
+
+    def __enter__(self) -> "DatasetSession":
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb) -> None:
+        self.close()
+
+    # -- integrity -------------------------------------------------------
+
+    def verify_source(self) -> None:
+        """Refuses a mutated source dataset: recomputes the source-column
+        digest and compares it to the ingest-time fingerprint (the same
+        evidence checkpoint resume uses to refuse mutated inputs)."""
+        if self._source is None:
+            return
+        digest = checkpoint_lib.array_digest(
+            np.asarray(self._source.pid), np.asarray(self._source.pk),
+            None if self._source.value is None else np.asarray(
+                self._source.value))
+        if digest != self._source_digest:
+            raise StaleDatasetError(
+                f"session {self._name!r}: the source columns changed "
+                f"after ingest (digest {digest} != ingest "
+                f"{self._source_digest}); the retained wire no longer "
+                f"describes this data — re-ingest to serve it")
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise SessionClosedError(
+                f"session {self._name!r} is closed")
+
+    def _check_engine_compat(self, engine, public_partitions) -> None:
+        """Engine-side gate (called from JaxDPEngine._aggregate)."""
+        self._check_open()
+        if engine._mesh is not self._mesh:
+            raise ValueError(
+                "engine mesh does not match the session's ingest mesh; "
+                "a resident wire replays only on the topology it was "
+                "ingested for")
+        pub = (list(public_partitions)
+               if public_partitions is not None else None)
+        if pub != self._public:
+            raise ValueError(
+                "public_partitions differ from the session's: the public "
+                "filter and partition vocabulary are fixed at ingest")
+        self.verify_source()
+
+    # -- tenants ---------------------------------------------------------
+
+    def register_tenant(self, tenant_id: str, total_epsilon: float,
+                        total_delta: float = 0.0,
+                        release_journal: Optional[
+                            journal_lib.ReleaseJournal] = None
+                        ) -> TenantState:
+        """Creates a tenant with its own cross-query budget ledger and
+        at-most-once release journal (a FileReleaseJournal makes the
+        tenant's release history survive process death)."""
+        with self._lock:
+            self._check_open()
+            if tenant_id in self._tenants:
+                raise ValueError(f"tenant {tenant_id!r} already registered")
+            state = TenantState(
+                ledger=budget_accounting.TenantBudgetLedger(
+                    tenant_id, total_epsilon, total_delta),
+                release_journal=(release_journal if release_journal
+                                 is not None else
+                                 journal_lib.ReleaseJournal()))
+            self._tenants[tenant_id] = state
+            return state
+
+    def tenant(self, tenant_id: str) -> TenantState:
+        with self._lock:
+            if tenant_id not in self._tenants:
+                raise ValueError(
+                    f"tenant {tenant_id!r} is not registered; call "
+                    f"register_tenant first")
+            return self._tenants[tenant_id]
+
+    # -- the bound (accumulator) cache -----------------------------------
+
+    @staticmethod
+    def _canonical(v):
+        if isinstance(v, (tuple, list)):
+            return tuple(DatasetSession._canonical(x) for x in v)
+        if isinstance(v, np.generic):
+            return v.item()
+        return v
+
+    def _cache_key(self, key_fp: str, kw: dict) -> tuple:
+        return (key_fp,) + tuple(
+            (k, self._canonical(kw[k])) for k in sorted(kw))
+
+    @staticmethod
+    def _result_nbytes(result) -> int:
+        arrays = []
+        if isinstance(result, tuple) and not hasattr(result, "_fields"):
+            accs, qhist = result
+            arrays.extend(accs)
+            if qhist is not None:
+                arrays.append(qhist)
+        else:
+            arrays.extend(result)
+        return int(sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                       for a in arrays))
+
+    def _accumulate(self, k_kernel, *, mesh, resilience=None, **kw):
+        """Accumulators for one query config — from the bound cache when
+        this exact (kernel key, caps, clips, flags) was computed before
+        (a hit is bitwise-exact by construction: the key includes the
+        kernel-key fingerprint), replaying the retained wire otherwise.
+        Called by JaxDPEngine._execute on the resident path."""
+        key_fp = checkpoint_lib.key_fingerprint(k_kernel)
+        cache_key = self._cache_key(key_fp, kw)
+        with self._lock:
+            self._check_open()
+            entry = self._bound_cache.get(cache_key)
+            if entry is not None:
+                self._bound_cache.move_to_end(cache_key)
+                profiler.count_event(EVENT_BOUND_HITS)
+                return entry.result
+        profiler.count_event(EVENT_BOUND_MISSES)
+        if mesh is not None:
+            from pipelinedp_tpu.parallel import sharded
+            mesh_kw = dict(kw)
+            if mesh_kw.pop("quantile_spec", None) is not None:
+                raise NotImplementedError(
+                    "quantile replay is single-device only")
+            result = sharded.replay_resident_wire(
+                mesh, k_kernel, self._wire, resilience=resilience,
+                **mesh_kw)
+        else:
+            result = streaming.replay_resident_wire(
+                k_kernel, self._wire, resilience=resilience, **kw)
+        self._cache_insert(cache_key, result)
+        return result
+
+    def _cache_insert(self, cache_key: tuple, result) -> None:
+        nbytes = self._result_nbytes(result)
+        with self._lock:
+            if self._closed or cache_key in self._bound_cache:
+                return
+            room = self._byte_budget - self._wire.device_nbytes
+            if nbytes > room:
+                return  # never evict the whole cache for one giant entry
+            while self._cache_bytes + nbytes > room and self._bound_cache:
+                _, evicted = self._bound_cache.popitem(last=False)
+                self._cache_bytes -= evicted.nbytes
+                profiler.count_event(EVENT_BOUND_EVICTIONS)
+            self._bound_cache[cache_key] = _BoundCacheEntry(
+                result=result, nbytes=nbytes)
+            self._cache_bytes += nbytes
+
+    # -- queries ---------------------------------------------------------
+
+    def query(self,
+              params: AggregateParams,
+              *,
+              epsilon: Optional[float] = None,
+              delta: float = 0.0,
+              seed: int = 0,
+              tenant: Optional[str] = None,
+              accountant: Optional[
+                  budget_accounting.BudgetAccountant] = None,
+              secure_host_noise: Optional[bool] = None,
+              release_journal: Optional[
+                  journal_lib.ReleaseJournal] = None,
+              out_explain_computation_report=None
+              ) -> jax_engine.LazyJaxResult:
+        """Answers one DP query from the resident dataset.
+
+        Budget comes from ``tenant=`` (charged against the tenant's
+        ledger; releases go through the tenant's at-most-once journal),
+        an explicit ``accountant=``, or a fresh NaiveBudgetAccountant
+        over (epsilon, delta). The accountant's compute_budgets is called
+        here, so the returned LazyJaxResult is ready to consume.
+        """
+        self._check_open()
+        journal = release_journal
+        if tenant is not None:
+            if accountant is not None:
+                raise ValueError(
+                    "pass either tenant= or accountant=, not both")
+            if epsilon is None:
+                raise ValueError("tenant queries need epsilon= (the "
+                                 "slice charged to the tenant's ledger)")
+            state = self.tenant(tenant)
+            accountant = state.ledger.make_accountant(
+                epsilon, delta, note=f"query seed={seed}")
+            if journal is None:
+                journal = state.release_journal
+        elif accountant is None:
+            if epsilon is None:
+                raise ValueError(
+                    "pass epsilon= (and delta=), an accountant=, or a "
+                    "tenant=")
+            accountant = budget_accounting.NaiveBudgetAccountant(
+                epsilon, delta)
+        shn = (self._secure_host_noise
+               if secure_host_noise is None else secure_host_noise)
+        engine = jax_engine.JaxDPEngine(
+            accountant,
+            seed=seed,
+            secure_host_noise=shn,
+            mesh=self._mesh,
+            stream_chunks=self._wire.n_chunks,
+            segment_sort=self._segment_sort,
+            compact_merge=self._compact_merge,
+            epilogue_cache=self._epilogue_cache,
+            release_journal=journal)
+        result = engine.aggregate(
+            self, params, public_partitions=self._public,
+            out_explain_computation_report=out_explain_computation_report)
+        accountant.compute_budgets()
+        with self._lock:
+            self._queries += 1
+        profiler.count_event(EVENT_QUERIES)
+        return result
+
+    # -- batched queries -------------------------------------------------
+
+    _BATCH_UNSUPPORTED = (
+        "batched resident queries support the scalar metrics "
+        "(COUNT/PRIVACY_ID_COUNT/SUM/MEAN/VARIANCE) without "
+        "max_contributions; run {} through session.query instead")
+
+    def _prepare_query(self, index: int, cfg: QueryConfig,
+                       secure_host_noise: Optional[bool]) -> _PreparedQuery:
+        params = cfg.to_params()
+        if any(m.is_percentile for m in params.metrics):
+            raise NotImplementedError(
+                self._BATCH_UNSUPPORTED.format("PERCENTILE"))
+        if Metrics.VECTOR_SUM in params.metrics:
+            raise NotImplementedError(
+                self._BATCH_UNSUPPORTED.format("VECTOR_SUM"))
+        journal = None
+        if cfg.tenant is not None:
+            state = self.tenant(cfg.tenant)
+            accountant = state.ledger.make_accountant(
+                cfg.epsilon, cfg.delta,
+                note=f"batch query #{index} seed={cfg.seed}")
+            journal = state.release_journal
+        else:
+            accountant = budget_accounting.NaiveBudgetAccountant(
+                cfg.epsilon, cfg.delta)
+        shn = (self._secure_host_noise
+               if secure_host_noise is None else secure_host_noise)
+        engine = jax_engine.JaxDPEngine(
+            accountant, seed=cfg.seed, secure_host_noise=shn,
+            epilogue_cache=self._epilogue_cache, release_journal=journal)
+        # Budget-request order replays engine.aggregate exactly, so the
+        # per-mechanism (eps, delta) splits are identical to a sequential
+        # run of the same config.
+        with accountant.scope(weight=params.budget_weight):
+            compound = combiners_lib.create_compound_combiner(
+                params, accountant)
+            sel_spec = None
+            if (self._public is None
+                    and not params.post_aggregation_thresholding):
+                sel_spec = accountant.request_budget(
+                    mechanism_type=MechanismType.GENERIC)
+            accountant._compute_budget_for_aggregation(params.budget_weight)
+        key = engine._key_stream.next_key()
+        key_counter = engine._key_stream.counter
+        k_kernel, k_select, k_noise = jax.random.split(key, 3)
+        linf_cap, l0_cap, l1_cap = jax_engine.derive_contribution_caps(
+            params, compound, self.n_rows, self.num_partitions)
+        if l1_cap is not None:
+            raise NotImplementedError(
+                self._BATCH_UNSUPPORTED.format("max_contributions"))
+        row_lo, row_hi, glo, ghi, middle = jax_engine.derive_clip_bounds(
+            params)
+        return _PreparedQuery(
+            index=index, engine=engine, accountant=accountant,
+            compound=compound, sel_spec=sel_spec, params=params,
+            k_kernel=k_kernel, k_select=k_select, k_noise=k_noise,
+            key_counter=key_counter, linf_cap=linf_cap, l0_cap=l0_cap,
+            row_lo=row_lo, row_hi=row_hi, glo=glo, ghi=ghi, middle=middle,
+            need_flags=jax_engine.derive_need_flags(compound),
+            has_group_clip=bool(params.bounds_per_partition_are_set))
+
+    def query_batch(self,
+                    configs: Sequence[QueryConfig],
+                    *,
+                    secure_host_noise: Optional[bool] = None,
+                    max_width: Optional[int] = None) -> List[dict]:
+        """Answers a batch of queries that share the sorted wire in as
+        few launches as possible: configs with the same kernel statics
+        pack into one vmapped bounding launch per wire chunk (at most
+        ``max_width`` / PIPELINEDP_TPU_SERVING_BATCH configs per launch);
+        each config then finalizes through its own fused epilogue under
+        its own keys and budget.
+
+        Returns one released column dict per config, in input order —
+        value-for-value what ``session.query`` (and therefore a cold
+        engine run) releases for that config alone.
+        """
+        self._check_open()
+        if self._mesh is not None:
+            raise NotImplementedError(
+                "query_batch is single-device; mesh sessions run queries "
+                "through session.query")
+        self.verify_source()
+        width = max_width or batch_width()
+        prepared = [self._prepare_query(i, cfg, secure_host_noise)
+                    for i, cfg in enumerate(configs)]
+        results: List[Optional[dict]] = [None] * len(prepared)
+        # Launch groups: configs sharing the kernel statics
+        # (has_group_clip — the group-stage topology) batch together.
+        groups: Dict[bool, List[_PreparedQuery]] = {}
+        for p in prepared:
+            groups.setdefault(p.has_group_clip, []).append(p)
+        for has_group_clip, group in groups.items():
+            for s in range(0, len(group), width):
+                self._run_batch_group(group[s:s + width], has_group_clip,
+                                      results)
+        with self._lock:
+            self._queries += len(prepared)
+        profiler.count_event(EVENT_QUERIES, len(prepared))
+        return results  # type: ignore[return-value]
+
+    def _run_batch_group(self, group: List[_PreparedQuery],
+                         has_group_clip: bool,
+                         results: List[Optional[dict]]) -> None:
+        # The union of the group's need flags: computing a column an
+        # individual config would skip never changes the columns it does
+        # read (the sampling sorts are flag-independent), so per-config
+        # lanes still match that config's solo run bit-for-bit.
+        union_flags = tuple(
+            any(p.need_flags[i] for p in group) for i in range(4))
+        accs_b = streaming.replay_resident_wire_batched(
+            [p.k_kernel for p in group], self._wire,
+            linf_caps=[p.linf_cap for p in group],
+            l0_caps=[p.l0_cap for p in group],
+            row_clip_los=[p.row_lo for p in group],
+            row_clip_his=[p.row_hi for p in group],
+            middles=[p.middle for p in group],
+            group_clip_los=[p.glo for p in group],
+            group_clip_his=[p.ghi for p in group],
+            need_flags=union_flags,
+            has_group_clip=has_group_clip)
+        for b, p in enumerate(group):
+            p.accountant.compute_budgets()
+            # At-most-once: the release token commits before any noise
+            # draw, through this config's (tenant) journal.
+            p.engine._commit_release(p.key_counter)
+            accs = columnar.PartitionAccumulators(
+                *(a[b] for a in accs_b))
+            results[p.index] = p.engine._fused_finalize(
+                p.compound, p.params, p.sel_spec, p.k_select, p.k_noise,
+                accs, None, None, self.num_partitions,
+                self._public is not None)

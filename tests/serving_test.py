@@ -1,0 +1,629 @@
+"""Resident-dataset serving tests (pipelinedp_tpu/serving/, SERVING.md).
+
+Contracts:
+  * Warm-path parity — a query answered from a DatasetSession is
+    BIT-identical (released values, kept partitions) to the same query
+    run cold through JaxDPEngine with stream_chunks=session.n_chunks,
+    on single-device and mesh8, for device noise and for seeded host
+    noise.
+  * Batched launch — configs sharing the sorted wire execute as ONE
+    vmapped launch per chunk (kernel dispatch counter), matching the
+    sequential runs' released values config-for-config.
+  * Tenant isolation — independent epsilon ledgers, at-most-once release
+    per tenant, exhaustion never blocks another tenant.
+  * Integrity — a mutated source dataset is refused; a closed session
+    refuses queries; incompatible engines are refused.
+  * Concurrency — threaded queries against one session race only on
+    caches, never on released bits.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import profiler, serving
+from pipelinedp_tpu.ops import finalize, streaming
+from pipelinedp_tpu.parallel import sharded
+from pipelinedp_tpu.runtime import journal as journal_lib
+
+M = pdp.Metrics
+
+N_ROWS = 40_000
+N_USERS = 3_000
+N_PARTS = 64  # divides 8: the mesh pads nothing, mesh == single-device
+N_CHUNKS = 3
+
+
+@pytest.fixture(params=["single_device", "mesh8"], scope="module")
+def engine_mesh(request):
+    if request.param == "single_device":
+        return None
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return sharded.make_mesh(8)
+
+
+def make_columns(seed=0, n=N_ROWS, nparts=N_PARTS):
+    rng = np.random.default_rng(seed)
+    return pdp.ColumnarData(
+        pid=rng.integers(0, N_USERS, n).astype(np.int32),
+        pk=rng.integers(0, nparts, n).astype(np.int32),
+        value=rng.integers(1, 6, n).astype(np.float32))
+
+
+def count_sum_params(l0=8, linf=4, noise_kind=pdp.NoiseKind.LAPLACE):
+    return pdp.AggregateParams(metrics=[M.COUNT, M.SUM],
+                               noise_kind=noise_kind,
+                               max_partitions_contributed=l0,
+                               max_contributions_per_partition=linf,
+                               min_value=0.0,
+                               max_value=5.0)
+
+
+def run_cold(data, params, *, seed, mesh=None, secure=False, host_seed=None,
+             public=None, n_chunks=N_CHUNKS, epsilon=1.0, delta=1e-6):
+    if host_seed is not None:
+        pdp.noise_core.seed_fallback_rng(host_seed)
+        pdp.partition_selection.seed_rng(host_seed)
+    accountant = pdp.NaiveBudgetAccountant(epsilon, delta)
+    engine = pdp.JaxDPEngine(accountant, seed=seed, secure_host_noise=secure,
+                             mesh=mesh, stream_chunks=n_chunks)
+    result = engine.aggregate(data, params, public_partitions=public)
+    accountant.compute_budgets()
+    return result.to_columns()
+
+
+def assert_columns_identical(a: dict, b: dict):
+    assert list(a) == list(b)
+    for name in a:
+        np.testing.assert_array_equal(np.asarray(a[name]),
+                                      np.asarray(b[name]), err_msg=name)
+
+
+class TestWarmColdParity:
+    """Warm queries are bit-identical to cold runs of the same seed."""
+
+    def test_device_noise_parity(self, engine_mesh):
+        data = make_columns()
+        params = count_sum_params()
+        session = serving.DatasetSession(data, mesh=engine_mesh,
+                                         n_chunks=N_CHUNKS)
+        for seed in (3, 4):
+            warm = session.query(params, epsilon=1.0, delta=1e-6,
+                                 seed=seed,
+                                 secure_host_noise=False).to_columns()
+            cold = run_cold(make_columns(), params, seed=seed,
+                            mesh=engine_mesh)
+            assert_columns_identical(cold, warm)
+
+    def test_host_noise_parity_seeded(self, engine_mesh):
+        data = make_columns()
+        params = count_sum_params()
+        session = serving.DatasetSession(data, mesh=engine_mesh,
+                                         n_chunks=N_CHUNKS)
+        pdp.noise_core.seed_fallback_rng(11)
+        pdp.partition_selection.seed_rng(11)
+        warm = session.query(params, epsilon=1.0, delta=1e-6, seed=5,
+                             secure_host_noise=True).to_columns()
+        cold = run_cold(make_columns(), params, seed=5, mesh=engine_mesh,
+                        secure=True, host_seed=11)
+        assert_columns_identical(cold, warm)
+
+    def test_public_partitions_parity(self):
+        data = make_columns()
+        public = list(range(10, 30))
+        params = count_sum_params()
+        session = serving.DatasetSession(data, public_partitions=public,
+                                         n_chunks=N_CHUNKS)
+        warm = session.query(params, epsilon=1.0, delta=1e-6, seed=2,
+                             secure_host_noise=False).to_columns()
+        cold = run_cold(make_columns(), params, seed=2, public=public)
+        assert_columns_identical(cold, warm)
+
+    def test_percentile_parity(self):
+        data = make_columns()
+        params = pdp.AggregateParams(
+            metrics=[M.COUNT, M.PERCENTILE(50), M.PERCENTILE(90)],
+            max_partitions_contributed=8,
+            max_contributions_per_partition=4,
+            min_value=0.0, max_value=5.0)
+        session = serving.DatasetSession(data, n_chunks=N_CHUNKS)
+        warm = session.query(params, epsilon=1.0, delta=1e-6, seed=7,
+                             secure_host_noise=False).to_columns()
+        cold = run_cold(make_columns(), params, seed=7)
+        assert_columns_identical(cold, warm)
+
+    def test_count_only_no_value_column(self):
+        rng = np.random.default_rng(5)
+        data = pdp.ColumnarData(
+            pid=rng.integers(0, 500, 5000).astype(np.int32),
+            pk=rng.integers(0, 20, 5000).astype(np.int32), value=None)
+        params = pdp.AggregateParams(metrics=[M.COUNT],
+                                     max_partitions_contributed=4,
+                                     max_contributions_per_partition=2)
+        session = serving.DatasetSession(data, n_chunks=2)
+        warm = session.query(params, epsilon=1.0, delta=1e-6, seed=1,
+                             secure_host_noise=False).to_columns()
+        rng = np.random.default_rng(5)
+        cold = run_cold(
+            pdp.ColumnarData(
+                pid=rng.integers(0, 500, 5000).astype(np.int32),
+                pk=rng.integers(0, 20, 5000).astype(np.int32), value=None),
+            params, seed=1, n_chunks=2)
+        assert_columns_identical(cold, warm)
+
+    def test_empty_dataset(self):
+        data = pdp.ColumnarData(pid=np.zeros(0, np.int32),
+                                pk=np.zeros(0, np.int32),
+                                value=np.zeros(0, np.float32))
+        session = serving.DatasetSession(
+            data, public_partitions=[0, 1, 2], n_chunks=2)
+        cols = session.query(count_sum_params(), epsilon=1.0, delta=1e-6,
+                             seed=1).to_columns()
+        assert len(cols["partition_id"]) == 3
+        assert cols["keep_mask"].all()
+
+    def test_warm_queries_skip_encode_sort_phases(self):
+        session = serving.DatasetSession(make_columns(), n_chunks=N_CHUNKS)
+        with profiler.collect_stage_times() as stages:
+            session.query(count_sum_params(), epsilon=1.0, delta=1e-6,
+                          seed=1).to_columns()
+        assert "dp/encode" not in stages
+        assert not any(k.startswith("dp/wire_") for k in stages), stages
+        assert not any(k.startswith("dp/stream_slab_") for k in stages)
+
+
+class TestBoundCache:
+    """Repeat queries with the same bounding config skip the kernel; the
+    cache key includes the kernel-key fingerprint, so hits are exact."""
+
+    def test_hit_is_bitwise_and_skips_replay(self):
+        session = serving.DatasetSession(make_columns(), n_chunks=N_CHUNKS)
+        params = count_sum_params()
+        r0 = profiler.event_count(streaming.EVENT_SERVING_REPLAYS)
+        first = session.query(params, epsilon=1.0, delta=1e-6, seed=9,
+                              secure_host_noise=False).to_columns()
+        assert profiler.event_count(streaming.EVENT_SERVING_REPLAYS) == r0 + 1
+        h0 = profiler.event_count(serving.EVENT_BOUND_HITS)
+        second = session.query(params, epsilon=1.0, delta=1e-6, seed=9,
+                               secure_host_noise=False).to_columns()
+        assert profiler.event_count(serving.EVENT_BOUND_HITS) == h0 + 1
+        # No new replay ran for the hit.
+        assert profiler.event_count(streaming.EVENT_SERVING_REPLAYS) == r0 + 1
+        assert_columns_identical(first, second)
+
+    def test_different_seed_misses(self):
+        session = serving.DatasetSession(make_columns(), n_chunks=N_CHUNKS)
+        params = count_sum_params()
+        m0 = profiler.event_count(serving.EVENT_BOUND_MISSES)
+        session.query(params, epsilon=1.0, delta=1e-6, seed=1).to_columns()
+        session.query(params, epsilon=1.0, delta=1e-6, seed=2).to_columns()
+        assert profiler.event_count(serving.EVENT_BOUND_MISSES) == m0 + 2
+
+    def test_lru_eviction_under_byte_budget(self):
+        data = make_columns(n=8000, nparts=32)
+        # Budget sized so the wire fits but at most ~2 cached accumulator
+        # sets do (5 arrays x 32 partitions x 4B each = 640B per entry).
+        session = serving.DatasetSession(data, n_chunks=2,
+                                         resident_bytes=1 << 20)
+        room = (1 << 20) - session.stats()["wire_device_bytes"]
+        per_entry = 5 * 32 * 4
+        fits = room // per_entry
+        e0 = profiler.event_count(serving.EVENT_BOUND_EVICTIONS)
+        params = count_sum_params()
+        for seed in range(int(fits) + 3):
+            session.query(params, epsilon=1.0, delta=1e-6,
+                          seed=seed).to_columns()
+        stats = session.stats()
+        assert stats["bound_cache_bytes"] <= room
+        assert profiler.event_count(serving.EVENT_BOUND_EVICTIONS) > e0
+
+
+class TestBatchedQueries:
+    """Configs sharing the wire pack into one vmapped launch per chunk."""
+
+    def test_eight_configs_one_launch_per_chunk(self):
+        session = serving.DatasetSession(make_columns(), n_chunks=N_CHUNKS)
+        configs = [
+            serving.QueryConfig(metrics=[M.COUNT, M.SUM], epsilon=1.0,
+                                delta=1e-6, max_partitions_contributed=l0,
+                                max_contributions_per_partition=linf,
+                                min_value=0.0, max_value=float(hi),
+                                seed=100 + i)
+            for i, (l0, linf, hi) in enumerate([
+                (8, 4, 5), (4, 2, 5), (2, 1, 3), (16, 8, 5),
+                (8, 2, 4), (1, 1, 5), (8, 4, 2), (3, 3, 5)])
+        ]
+        d0 = profiler.event_count(streaming.EVENT_SERVING_LAUNCHES)
+        outs = session.query_batch(configs, secure_host_noise=False)
+        launches = profiler.event_count(
+            streaming.EVENT_SERVING_LAUNCHES) - d0
+        # ONE launch per wire chunk covers all 8 configs.
+        assert launches == session.n_chunks
+        data = make_columns()
+        for i, cfg in enumerate(configs):
+            cold = run_cold(data, cfg.to_params(), seed=cfg.seed)
+            assert_columns_identical(cold, outs[i])
+
+    def test_mixed_metric_sets_batch_together(self):
+        session = serving.DatasetSession(make_columns(), n_chunks=2)
+        configs = [
+            serving.QueryConfig(metrics=[M.COUNT], epsilon=1.0, delta=1e-6,
+                                max_partitions_contributed=4,
+                                max_contributions_per_partition=2, seed=1),
+            serving.QueryConfig(metrics=[M.MEAN, M.COUNT, M.SUM],
+                                epsilon=2.0, delta=1e-6,
+                                max_partitions_contributed=8,
+                                max_contributions_per_partition=4,
+                                min_value=0.0, max_value=5.0, seed=2),
+            serving.QueryConfig(metrics=[M.VARIANCE], epsilon=1.5,
+                                delta=1e-6, max_partitions_contributed=2,
+                                max_contributions_per_partition=2,
+                                min_value=0.0, max_value=5.0, seed=3),
+        ]
+        d0 = profiler.event_count(streaming.EVENT_SERVING_LAUNCHES)
+        outs = session.query_batch(configs, secure_host_noise=False)
+        assert (profiler.event_count(streaming.EVENT_SERVING_LAUNCHES)
+                - d0) == session.n_chunks
+        data = make_columns()
+        for i, cfg in enumerate(configs):
+            cold = run_cold(data, cfg.to_params(), seed=cfg.seed,
+                            n_chunks=2, epsilon=cfg.epsilon,
+                            delta=cfg.delta)
+            assert_columns_identical(cold, outs[i])
+
+    def test_width_splits_launches(self):
+        session = serving.DatasetSession(make_columns(), n_chunks=2)
+        configs = [
+            serving.QueryConfig(metrics=[M.COUNT], epsilon=1.0, delta=1e-6,
+                                max_partitions_contributed=4,
+                                max_contributions_per_partition=2,
+                                seed=i) for i in range(5)
+        ]
+        d0 = profiler.event_count(streaming.EVENT_SERVING_LAUNCHES)
+        session.query_batch(configs, secure_host_noise=False, max_width=2)
+        # ceil(5/2) = 3 launch groups x 2 chunks.
+        assert (profiler.event_count(streaming.EVENT_SERVING_LAUNCHES)
+                - d0) == 3 * session.n_chunks
+
+    def test_unsupported_configs_raise(self):
+        session = serving.DatasetSession(make_columns(), n_chunks=2)
+        pct = serving.QueryConfig(metrics=[M.PERCENTILE(50)], epsilon=1.0,
+                                  delta=1e-6,
+                                  max_partitions_contributed=4,
+                                  max_contributions_per_partition=2,
+                                  min_value=0.0, max_value=5.0)
+        with pytest.raises(NotImplementedError):
+            session.query_batch([pct])
+
+
+class TestTenantIsolation:
+    """Two tenants on one resident dataset never share budget or
+    release history."""
+
+    def test_independent_ledgers(self):
+        session = serving.DatasetSession(make_columns(), n_chunks=2)
+        session.register_tenant("a", total_epsilon=2.0, total_delta=1e-5)
+        session.register_tenant("b", total_epsilon=3.0, total_delta=1e-5)
+        params = count_sum_params()
+        session.query(params, epsilon=1.0, delta=1e-6, seed=1,
+                      tenant="a").to_columns()
+        session.query(params, epsilon=1.5, delta=1e-6, seed=2,
+                      tenant="b").to_columns()
+        assert session.tenant("a").ledger.spent_epsilon == 1.0
+        assert session.tenant("b").ledger.spent_epsilon == 1.5
+        assert session.tenant("a").ledger.remaining_epsilon == 1.0
+
+    def test_release_replay_refused_per_tenant(self):
+        session = serving.DatasetSession(make_columns(), n_chunks=2)
+        session.register_tenant("a", total_epsilon=10.0, total_delta=1e-4)
+        session.register_tenant("b", total_epsilon=10.0, total_delta=1e-4)
+        params = count_sum_params()
+        session.query(params, epsilon=1.0, delta=1e-6, seed=7,
+                      tenant="a").to_columns()
+        # Same seed again for tenant a: same KeyStream state, same token.
+        with pytest.raises(journal_lib.DoubleReleaseError):
+            session.query(params, epsilon=1.0, delta=1e-6, seed=7,
+                          tenant="a").to_columns()
+        # Tenant b's journal is its own: the same seed is fine there.
+        session.query(params, epsilon=1.0, delta=1e-6, seed=7,
+                      tenant="b").to_columns()
+
+    def test_exhaustion_never_blocks_the_other_tenant(self):
+        session = serving.DatasetSession(make_columns(), n_chunks=2)
+        session.register_tenant("small", total_epsilon=1.0,
+                                total_delta=1e-5)
+        session.register_tenant("big", total_epsilon=100.0,
+                                total_delta=1e-3)
+        params = count_sum_params()
+        session.query(params, epsilon=1.0, delta=1e-6, seed=1,
+                      tenant="small").to_columns()
+        with pytest.raises(serving.BudgetExhaustedError):
+            session.query(params, epsilon=0.5, delta=1e-6, seed=2,
+                          tenant="small")
+        # The failed charge left the ledger untouched...
+        assert session.tenant("small").ledger.spent_epsilon == 1.0
+        # ...and the other tenant is unaffected.
+        session.query(params, epsilon=5.0, delta=1e-6, seed=3,
+                      tenant="big").to_columns()
+        assert session.tenant("big").ledger.remaining_epsilon == 95.0
+
+    def test_ledger_charge_is_all_or_nothing_under_threads(self):
+        ledger = serving.TenantBudgetLedger("t", total_epsilon=10.0)
+        errors = []
+
+        def worker():
+            for _ in range(10):
+                try:
+                    ledger.charge(0.5)
+                except serving.BudgetExhaustedError:
+                    errors.append(1)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # 40 attempted x 0.5 = 20 > 10: exactly 20 commits succeed.
+        assert len(ledger.charges) == 20
+        assert abs(ledger.spent_epsilon - 10.0) < 1e-9
+        assert len(errors) == 20
+
+
+class TestIntegrity:
+    def test_mutated_source_refused(self):
+        data = make_columns()
+        session = serving.DatasetSession(data, n_chunks=2)
+        data.value[100] += 1.0
+        with pytest.raises(serving.StaleDatasetError):
+            session.query(count_sum_params(), epsilon=1.0, delta=1e-6,
+                          seed=1)
+
+    def test_closed_session_refuses(self):
+        session = serving.DatasetSession(make_columns(), n_chunks=2)
+        session.close()
+        with pytest.raises(serving.SessionClosedError):
+            session.query(count_sum_params(), epsilon=1.0, delta=1e-6,
+                          seed=1)
+
+    def test_mesh_mismatch_refused(self):
+        session = serving.DatasetSession(make_columns(), n_chunks=2)
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        mesh = sharded.make_mesh(8)
+        accountant = pdp.NaiveBudgetAccountant(1.0, 1e-6)
+        engine = pdp.JaxDPEngine(accountant, mesh=mesh)
+        with pytest.raises(ValueError, match="mesh"):
+            engine.aggregate(session, count_sum_params())
+
+    def test_public_mismatch_refused(self):
+        session = serving.DatasetSession(make_columns(),
+                                         public_partitions=[1, 2, 3],
+                                         n_chunks=2)
+        accountant = pdp.NaiveBudgetAccountant(1.0, 1e-6)
+        engine = pdp.JaxDPEngine(accountant)
+        with pytest.raises(ValueError, match="public"):
+            engine.aggregate(session, count_sum_params(),
+                             public_partitions=[1, 2])
+
+    def test_vector_and_custom_refused(self):
+        session = serving.DatasetSession(make_columns(), n_chunks=2)
+        accountant = pdp.NaiveBudgetAccountant(1.0, 1e-6)
+        engine = pdp.JaxDPEngine(accountant)
+        with pytest.raises(NotImplementedError, match="VECTOR_SUM"):
+            engine.aggregate(
+                session,
+                pdp.AggregateParams(metrics=[M.VECTOR_SUM],
+                                    max_partitions_contributed=2,
+                                    max_contributions_per_partition=2,
+                                    vector_size=3, vector_max_norm=1.0,
+                                    vector_norm_kind=pdp.NormKind.Linf))
+
+    def test_fingerprint_is_stable_and_data_bound(self):
+        a = serving.DatasetSession(make_columns(seed=0), n_chunks=2)
+        b = serving.DatasetSession(make_columns(seed=0), n_chunks=2)
+        c = serving.DatasetSession(make_columns(seed=1), n_chunks=2)
+        assert a.fingerprint == b.fingerprint
+        assert a.fingerprint != c.fingerprint
+
+
+class TestConcurrencyHammer:
+    """Threaded queries against one session: no cache races, bitwise-
+    stable releases (the CI serving job runs this under
+    PIPELINEDP_TPU_REQUIRE_NATIVE=1)."""
+
+    def test_threaded_queries_bitwise_stable(self):
+        session = serving.DatasetSession(make_columns(), n_chunks=2)
+        params = count_sum_params()
+        seeds = list(range(6))
+        expected = {
+            s: session.query(params, epsilon=1.0, delta=1e-6, seed=s,
+                             secure_host_noise=False).to_columns()
+            for s in seeds
+        }
+        results = {}
+        errors = []
+
+        def worker(worker_id):
+            try:
+                for rep in range(3):
+                    for s in seeds:
+                        cols = session.query(
+                            params, epsilon=1.0, delta=1e-6, seed=s,
+                            secure_host_noise=False).to_columns()
+                        results[(worker_id, rep, s)] = cols
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        for (_, _, s), cols in results.items():
+            assert_columns_identical(expected[s], cols)
+
+    def test_threaded_tenants_and_batches(self):
+        session = serving.DatasetSession(make_columns(), n_chunks=2)
+        for i in range(4):
+            session.register_tenant(f"t{i}", total_epsilon=50.0,
+                                    total_delta=1e-3)
+        params = count_sum_params()
+        errors = []
+
+        def worker(i):
+            try:
+                for rep in range(4):
+                    session.query(params, epsilon=1.0, delta=1e-6,
+                                  seed=1000 * i + rep,
+                                  tenant=f"t{i}").to_columns()
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        for i in range(4):
+            assert session.tenant(f"t{i}").ledger.spent_epsilon == 4.0
+
+
+class TestEpilogueCacheBounds:
+    """Satellite: finalize.EpilogueCache is bounded + thread-safe."""
+
+    def test_lru_eviction(self):
+        cache = finalize.EpilogueCache(max_entries=2)
+        plans = []
+        for nparts in (11, 12, 13):
+            plan, scalars = self._plan(nparts)
+            plans.append(plan)
+            cache.get(plan, None, {"x": np.zeros(nparts)})
+        assert len(cache) == 2
+        assert cache.evictions == 1
+
+    @staticmethod
+    def _plan(nparts):
+        accountant = pdp.NaiveBudgetAccountant(1.0, 1e-6)
+        from pipelinedp_tpu import combiners as combiners_lib
+        params = count_sum_params()
+        with accountant.scope(weight=1.0):
+            compound = combiners_lib.create_compound_combiner(params,
+                                                              accountant)
+            spec = accountant.request_budget(pdp.MechanismType.GENERIC)
+        accountant.compute_budgets()
+        return finalize.build_plan(compound.combiners, params, spec,
+                                   is_public=False, num_partitions=nparts)
+
+    def test_hammer_no_races(self):
+        cache = finalize.EpilogueCache(max_entries=4)
+        plan, _ = self._plan(17)
+        errors = []
+
+        def worker(i):
+            try:
+                for rep in range(50):
+                    fn = cache.get(plan, None,
+                                   {"x": np.zeros(17 + (rep % 3))})
+                    assert fn is not None
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert len(cache) == 1
+        assert cache.hits + cache.misses == 8 * 50
+
+    def test_zero_new_traces_after_first_query(self):
+        """A 3-query same-shape session performs zero epilogue traces
+        after query 1 (the amortization acceptance hook)."""
+        session = serving.DatasetSession(make_columns(), n_chunks=2)
+        params = count_sum_params()
+        traces = []
+        for seed in range(3):
+            before = finalize.trace_count()
+            session.query(params, epsilon=1.0, delta=1e-6, seed=seed,
+                          secure_host_noise=False).to_columns()
+            traces.append(finalize.trace_count() - before)
+        assert traces[1] == 0 and traces[2] == 0, traces
+
+
+class TestQueryBuilderOnSession:
+    def _frame(self):
+        rng = np.random.default_rng(3)
+        n = 20_000
+        return {
+            "user": rng.integers(0, 1500, n),
+            "day": rng.integers(0, 25, n),
+            "spend": rng.integers(1, 6, n).astype(np.float32),
+        }
+
+    def test_session_query_matches_frame_query(self):
+        df = self._frame()
+        session = serving.DatasetSession.from_frame(
+            df, "user", "day", "spend", n_chunks=2,
+            secure_host_noise=False)
+        build = lambda b: (b.groupby(  # noqa: E731
+            "day", max_groups_contributed=3,
+            max_contributions_per_group=2).count().sum(
+                "spend", min_value=0, max_value=5).build_query())
+        on_session = build(pdp.QueryBuilder.on(session)).run_query(
+            pdp.dataframes.Budget(1.0, 1e-6), seed=4)
+        # The cold comparator: same frame through a session-free engine
+        # with the session's chunk count.
+        data = pdp.ColumnarData(pid=df["user"], pk=df["day"],
+                                value=df["spend"])
+        params = pdp.AggregateParams(metrics=[M.COUNT, M.SUM],
+                                     max_partitions_contributed=3,
+                                     max_contributions_per_partition=2,
+                                     min_value=0.0, max_value=5.0)
+        cold = run_cold(data, params, seed=4, n_chunks=2)
+        keep = cold["keep_mask"]
+        np.testing.assert_array_equal(
+            np.sort(on_session["day"]),
+            np.sort(cold["partition_id"][keep]))
+        out_by_day = dict(zip(on_session["day"].tolist(),
+                              on_session["count"].tolist()))
+        cold_by_day = dict(zip(cold["partition_id"][keep].tolist(),
+                               cold["count"][keep].tolist()))
+        assert out_by_day == cold_by_day
+
+    def test_wrong_groupby_column_refused(self):
+        session = serving.DatasetSession.from_frame(
+            self._frame(), "user", "day", "spend", n_chunks=2)
+        with pytest.raises(ValueError, match="grouped by"):
+            pdp.QueryBuilder.on(session).groupby(
+                "user", max_groups_contributed=3,
+                max_contributions_per_group=2)
+
+    def test_wrong_value_column_refused(self):
+        df = self._frame()
+        df["other"] = df["spend"]
+        session = serving.DatasetSession.from_frame(
+            df, "user", "day", "spend", n_chunks=2)
+        builder = pdp.QueryBuilder.on(session).groupby(
+            "day", max_groups_contributed=3, max_contributions_per_group=2)
+        with pytest.raises(ValueError, match="value column"):
+            builder.sum("other", min_value=0, max_value=5).build_query()
+
+    def test_plain_query_caches_conversion(self):
+        df = self._frame()
+        query = (pdp.QueryBuilder(df, "user").groupby(
+            "day", max_groups_contributed=3,
+            max_contributions_per_group=2).count().build_query())
+        query.run_query(pdp.dataframes.Budget(1.0, 1e-6), seed=1)
+        query.run_query(pdp.dataframes.Budget(1.0, 1e-6), seed=2)
+        query.run_query(pdp.dataframes.Budget(1.0, 1e-6), seed=3)
+        assert query.conversions == 1
