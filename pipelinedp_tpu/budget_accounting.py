@@ -76,8 +76,15 @@ class TenantBudgetLedger:
     # summation error; anything past it is a real overdraw.
     _REL_SLACK = 1e-9
 
+    # WAL record kinds (runtime.journal record ``kind``; tokens are
+    # ("ledger_charge", index, eps, delta, note) / ("ledger_refund",
+    # index) — index-unique, so the journal's duplicate-token refusal
+    # never fires on legitimate ledger traffic).
+    _KIND_CHARGE = "ledger_charge"
+    _KIND_REFUND = "ledger_refund"
+
     def __init__(self, tenant_id: str, total_epsilon: float,
-                 total_delta: float = 0.0):
+                 total_delta: float = 0.0, wal=None):
         input_validators.validate_epsilon_delta(total_epsilon, total_delta,
                                                 "TenantBudgetLedger")
         self._tenant_id = str(tenant_id)
@@ -85,6 +92,27 @@ class TenantBudgetLedger:
         self._total_delta = float(total_delta)
         self._lock = threading.Lock()
         self._charges: List[LedgerCharge] = []
+        self._refunded: set = set()
+        # Durability (serving fleet, SERVING.md "Fleet operation"): a
+        # runtime.ReleaseJournal-shaped WAL makes the ledger survive
+        # process death — each charge is fsync'd write-ahead (durable
+        # BEFORE the query it pays for runs, so a crash errs toward
+        # over-counting spend, never under), refunds append their own
+        # records, and construction replays the recovered records into
+        # the in-memory state.
+        self._wal = wal
+        if wal is not None:
+            self._restore_from_wal()
+
+    def _restore_from_wal(self) -> None:
+        for record in self._wal.records:
+            if record.kind == self._KIND_CHARGE:
+                _, index, eps, delta, note = record.token
+                self._charges.append(
+                    LedgerCharge(index=int(index), epsilon=float(eps),
+                                 delta=float(delta), note=str(note)))
+            elif record.kind == self._KIND_REFUND:
+                self._refunded.add(int(record.token[1]))
 
     @property
     def tenant_id(self) -> str:
@@ -106,15 +134,24 @@ class TenantBudgetLedger:
         with self._lock:
             return tuple(self._charges)
 
+    def _live_charges(self) -> List[LedgerCharge]:
+        """Committed, un-refunded charges (lock held by the caller)."""
+        return [c for c in self._charges if c.index not in self._refunded]
+
+    @property
+    def refunded_indices(self) -> frozenset:
+        with self._lock:
+            return frozenset(self._refunded)
+
     @property
     def spent_epsilon(self) -> float:
         with self._lock:
-            return math.fsum(c.epsilon for c in self._charges)
+            return math.fsum(c.epsilon for c in self._live_charges())
 
     @property
     def spent_delta(self) -> float:
         with self._lock:
-            return math.fsum(c.delta for c in self._charges)
+            return math.fsum(c.delta for c in self._live_charges())
 
     @property
     def remaining_epsilon(self) -> float:
@@ -130,10 +167,9 @@ class TenantBudgetLedger:
         input_validators.validate_epsilon_delta(
             epsilon, delta, "TenantBudgetLedger.charge")
         with self._lock:
-            eps_after = math.fsum(
-                [c.epsilon for c in self._charges] + [epsilon])
-            delta_after = math.fsum(
-                [c.delta for c in self._charges] + [delta])
+            live = self._live_charges()
+            eps_after = math.fsum([c.epsilon for c in live] + [epsilon])
+            delta_after = math.fsum([c.delta for c in live] + [delta])
             slack = 1.0 + self._REL_SLACK
             if (eps_after > self._total_epsilon * slack
                     or delta_after > self._total_delta * slack
@@ -148,8 +184,43 @@ class TenantBudgetLedger:
             record = LedgerCharge(index=len(self._charges),
                                   epsilon=float(epsilon),
                                   delta=float(delta), note=note)
+            if self._wal is not None:
+                # Write-ahead: the charge is durable before it is
+                # acknowledged in memory (and therefore before the query
+                # it pays for runs).
+                self._wal.commit(
+                    (self._KIND_CHARGE, record.index, record.epsilon,
+                     record.delta, record.note), kind=self._KIND_CHARGE)
             self._charges.append(record)
             return record
+
+    def refund(self, charge: LedgerCharge) -> None:
+        """Exactly reverses one committed charge.
+
+        The serving layer's failure-isolation contract (SERVING.md):
+        a query whose release token never committed drew no randomness
+        and published nothing, so its pre-paid slice goes back to the
+        tenant — ``spent_epsilon``/``spent_delta`` return exactly to
+        their pre-charge values (the refunded charge is excluded from
+        the fsum, not approximately subtracted). Refunding twice, or
+        refunding a charge this ledger never committed, raises
+        ``BudgetAccountantError``. Durable ledgers append the refund to
+        the WAL write-ahead, so the refund survives process death too.
+        """
+        with self._lock:
+            if (charge.index >= len(self._charges)
+                    or self._charges[charge.index] != charge):
+                raise BudgetAccountantError(
+                    f"tenant {self._tenant_id!r}: refund of a charge "
+                    f"this ledger never committed ({charge!r})")
+            if charge.index in self._refunded:
+                raise BudgetAccountantError(
+                    f"tenant {self._tenant_id!r}: charge #{charge.index} "
+                    f"was already refunded")
+            if self._wal is not None:
+                self._wal.commit((self._KIND_REFUND, charge.index),
+                                 kind=self._KIND_REFUND)
+            self._refunded.add(charge.index)
 
     def make_accountant(self, epsilon: float, delta: float = 0.0,
                         note: str = "",

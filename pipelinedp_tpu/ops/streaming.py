@@ -1132,15 +1132,44 @@ class ResidentWire:
 
     @property
     def host_nbytes(self) -> int:
-        return int(self.slab.nbytes)
+        return int(self.slab.nbytes) if self.slab is not None else 0
 
     @property
     def device_nbytes(self) -> int:
-        return int(self.slab.nbytes) if self._device_slab is not None else 0
+        if self._device_slab is None or self.slab is None:
+            return 0
+        return int(self.slab.nbytes)
 
     @property
     def device_resident(self) -> bool:
         return self._device_slab is not None
+
+    @property
+    def loaded(self) -> bool:
+        """Whether the slab bytes are in memory (False after ``unload``;
+        the serving SessionManager's disk-spill rung)."""
+        return self.slab is not None
+
+    def unload(self) -> None:
+        """Frees the slab bytes (host AND device) while keeping every
+        piece of metadata — counts, format, fingerprint — so a spilled
+        handle can be digest-validated back in with :meth:`reload`.
+        Replaying an unloaded handle is a caller bug (the serving layer
+        re-hydrates before it replays)."""
+        self._device_slab = None
+        self.slab = None
+
+    def reload(self, slab: np.ndarray) -> None:
+        """Restores the slab bytes of an unloaded handle. The caller
+        (serving/store.py) has already digest-validated the bytes
+        against the fingerprint; this only guards the geometry."""
+        slab = np.asarray(slab)
+        expected = (self.k, self.fmt.width)
+        if slab.shape != expected or slab.dtype != np.uint8:
+            raise ValueError(
+                f"reload geometry mismatch: got {slab.dtype}{slab.shape}, "
+                f"handle expects uint8{expected}")
+        self.slab = slab
 
     def ensure_device(self):
         """Device copy of the whole slab (single-device handles only);
@@ -1149,6 +1178,10 @@ class ResidentWire:
             raise ValueError(
                 "device residency applies to single-device handles; mesh "
                 "replays ship each chunk sharded per query")
+        if self.slab is None:
+            raise ValueError(
+                "handle is unloaded (spilled); reload it before asking "
+                "for device residency")
         if self._device_slab is None:
             self._device_slab = jax.device_put(self.slab)
         return self._device_slab

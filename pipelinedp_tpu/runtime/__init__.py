@@ -71,7 +71,8 @@ from pipelinedp_tpu.runtime.journal import (  # noqa: F401
     FileReleaseJournal, JournalCorruptError, ReleaseJournal, ReleaseRecord)
 from pipelinedp_tpu.runtime.retry import RetryPolicy, classify  # noqa: F401
 from pipelinedp_tpu.runtime.watchdog import (  # noqa: F401
-    EVENT_WATCHDOG_TIMEOUTS, DispatchHangError, DispatchWatchdog)
+    EVENT_WATCHDOG_TIMEOUTS, Deadline, DispatchHangError, DispatchWatchdog,
+    QueryDeadlineError)
 from pipelinedp_tpu.runtime.driver import (  # noqa: F401
     EVENT_CHECKPOINT_BYTES, EVENT_DEGRADATIONS, EVENT_HANGS, EVENT_RESUMES,
     EVENT_RETRIES, DevicePlacement, SlabDriver, SlabPlan)
@@ -98,6 +99,11 @@ class StreamResilience:
     None defers to ``PIPELINEDP_TPU_WATCHDOG_S`` (0 = disabled, the
     default — enabling it trades a little cross-window pipelining for
     bounded hang detection).
+
+    ``deadline`` is the serving layer's per-query time budget
+    (watchdog.Deadline): the driver checks it between windows and
+    before backoff sleeps and raises ``QueryDeadlineError`` — outside
+    the retry handler, so an expired query propagates immediately.
     """
     retry_policy: RetryPolicy = dataclasses.field(default_factory=RetryPolicy)
     fault_injector: Optional[FaultInjector] = None
@@ -105,6 +111,7 @@ class StreamResilience:
     resume_from: Optional[StreamCheckpoint] = None
     key_counter: int = -1
     watchdog_timeout_s: Optional[float] = None
+    deadline: Optional[Deadline] = None
 
 
 def resilience_counters() -> Dict[str, int]:

@@ -181,20 +181,28 @@ class InMemoryCheckpointStore(CheckpointStore):
         self._checkpoints.pop(run_id, None)
 
 
-def _payload_digest(accs, qhist, meta_core: str) -> str:
-    """Full-content digest of one snapshot (dtype/shape/bytes of every
-    array + the core metadata). Unlike ``array_digest`` this hashes every
-    byte: a checkpoint is small (the accumulators, not the input), and a
-    torn/bit-rotted snapshot must be *distinguishable* from a legitimate
-    fingerprint mismatch so recovery can fall back to an older snapshot
-    instead of refusing the resume outright."""
+def content_digest(meta_core: str, *arrays) -> str:
+    """Full-content digest of a durable payload: the caller's core
+    metadata string plus dtype/shape/every byte of each array. Unlike
+    ``array_digest`` nothing is sampled — this names payloads small
+    enough to hash whole (checkpoint snapshots, spilled serving-session
+    chunks and bound-cache entries), where a torn or bit-rotted file
+    must be *distinguishable* from a legitimate fingerprint mismatch so
+    recovery can fall back (or recompute) instead of refusing or —
+    worse — serving wrong bits."""
     digest = hashlib.sha256()
     digest.update(meta_core.encode())
-    for arr in accs + ((qhist,) if qhist is not None else ()):
+    for arr in arrays:
         arr = np.asarray(arr)
         digest.update(str((arr.dtype, arr.shape)).encode())
         digest.update(np.ascontiguousarray(arr).tobytes())
     return digest.hexdigest()[:32]
+
+
+def _payload_digest(accs, qhist, meta_core: str) -> str:
+    """One checkpoint snapshot's content digest (metadata + arrays)."""
+    return content_digest(meta_core,
+                          *(accs + ((qhist,) if qhist is not None else ())))
 
 
 class FileCheckpointStore(CheckpointStore):

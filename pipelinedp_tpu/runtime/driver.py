@@ -221,13 +221,14 @@ class SlabDriver:
         k = plan.n_chunks
         accs, qhist = placement.init_state()
 
-        policy = injector = cp_policy = None
+        policy = injector = cp_policy = deadline = None
         key_fp = wire_fp = None
         cursor = 0
         if resilience is not None:
             policy = resilience.retry_policy
             injector = resilience.fault_injector
             cp_policy = resilience.checkpoint_policy
+            deadline = getattr(resilience, "deadline", None)
             if cp_policy is not None or resilience.resume_from is not None:
                 key_fp = checkpoint_lib.key_fingerprint(self._key)
                 wire_fp = checkpoint_lib.wire_fingerprint(
@@ -295,6 +296,13 @@ class SlabDriver:
                     max_workers=depth,
                     thread_name_prefix=placement.prefetch_prefix)
             while cursor < k:
+                if deadline is not None:
+                    # Cooperative per-query deadline (serving): checked
+                    # OUTSIDE the retry handler so an expired query
+                    # propagates typed and immediately — it never burns
+                    # retries or backoff against an exhausted budget.
+                    deadline.check(f"slab window starting at chunk "
+                                   f"{cursor}")
                 s1 = min(cursor + window, k)
                 this_window = ordinal
                 ordinal += 1
@@ -404,6 +412,9 @@ class SlabDriver:
                     failures += 1
                     if failures > policy.max_retries:
                         raise
+                    if deadline is not None:
+                        # Never back off past the query's budget.
+                        deadline.check(f"retry of window [{cursor}, {s1})")
                     profiler.count_event(EVENT_RETRIES)
                     policy.sleep(policy.backoff_s(failures - 1))
                     continue

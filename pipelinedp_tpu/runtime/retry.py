@@ -15,7 +15,13 @@ Failure taxonomy (what the slab drivers do with a caught exception):
     (:class:`watchdog.DispatchHangError` — a hang is retried with
     backoff like any transient fault, and retry exhaustion surfaces the
     typed error instead of an indefinite hang). Re-issued after bounded
-    exponential backoff.
+    exponential backoff. :class:`watchdog.QueryDeadlineError` (a serving
+    query past its per-query deadline) is also transient — *retryable by
+    the caller* with a fresh deadline, since the expired attempt
+    released nothing — but the slab driver itself never retries it: the
+    deadline is checked before each window and before each backoff
+    sleep, outside the retry handler, so an expired query propagates
+    immediately instead of burning retries against an exhausted budget.
   * ``fatal`` — everything else (including :class:`faults.HostCrash` and
     privacy-relevant guards like the wirecodec corrupted-input
     RuntimeError). Propagates; recovery is restart + checkpoint resume.
@@ -53,6 +59,8 @@ def classify(exc: BaseException) -> str:
     if isinstance(exc, faults.InjectedFault):
         return TRANSIENT
     if isinstance(exc, watchdog_lib.DispatchHangError):
+        # Covers QueryDeadlineError too (a subclass): both mean "the
+        # time budget expired with nothing released".
         return TRANSIENT
     if isinstance(exc, RuntimeError) and any(code in message
                                              for code in _TRANSIENT_CODES):

@@ -28,9 +28,11 @@ bought with a little cross-window pipelining (RESILIENCE.md).
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import queue
 import threading
+import time
 from typing import Callable, Optional, TypeVar
 
 from pipelinedp_tpu import profiler
@@ -63,6 +65,65 @@ class DispatchHangError(RuntimeError):
             f"policy)")
         self.what = what
         self.timeout_s = timeout_s
+
+
+class QueryDeadlineError(DispatchHangError):
+    """A serving query exceeded its per-query deadline.
+
+    Raised by two cooperating mechanisms (serving/manager.py,
+    SERVING.md "Fleet operation"): the slab driver checks the
+    :class:`Deadline` between windows (a long-but-progressing replay
+    stops at the next window boundary), and the serving layer runs the
+    whole query under a :class:`DispatchWatchdog` whose budget is the
+    remaining deadline (a *wedged* replay — which never reaches a window
+    boundary — is abandoned and surfaced within the deadline).
+
+    Classified ``transient`` by runtime/retry.py (it subclasses
+    DispatchHangError): the caller may safely retry with a fresh
+    deadline — no randomness was released by the expired attempt on the
+    cooperative path, and on the watchdog path the at-most-once journal
+    refuses any replay the abandoned worker might still commit.
+    """
+
+    def __init__(self, what: str, deadline_s: float):
+        # Skip DispatchHangError.__init__'s message; a deadline is a
+        # budget the caller chose, not a wedged dispatch.
+        RuntimeError.__init__(
+            self, f"query deadline: {what} did not complete within the "
+            f"{deadline_s:g}s deadline (shed or retry with a fresh "
+            f"deadline; no noise was released by this attempt)")
+        self.what = what
+        self.timeout_s = deadline_s
+
+
+@dataclasses.dataclass
+class Deadline:
+    """A monotonic per-query time budget (serving/manager.py).
+
+    ``expires_at`` is a ``time.monotonic()`` timestamp so the budget is
+    immune to wall-clock jumps. The slab driver calls :meth:`check`
+    between windows and before retry backoff sleeps, so an expired
+    query surfaces promptly as :class:`QueryDeadlineError` instead of
+    finishing (or backing off) past its budget.
+    """
+    expires_at: float
+    total_s: float
+
+    @classmethod
+    def after(cls, seconds: float) -> "Deadline":
+        return cls(expires_at=time.monotonic() + float(seconds),
+                   total_s=float(seconds))
+
+    def remaining_s(self) -> float:
+        return self.expires_at - time.monotonic()
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining_s() <= 0
+
+    def check(self, what: str) -> None:
+        if self.expired:
+            raise QueryDeadlineError(what, self.total_s)
 
 
 def env_timeout_s() -> Optional[float]:

@@ -25,14 +25,39 @@ package is the long-lived serving layer over the columnar engine:
 L5 user code stays declarative: ``dataframes.QueryBuilder.on(session)``
 builds queries against a session exactly like against a frame.
 
+The durable fleet layer (SERVING.md "Fleet operation") sits on top:
+
+  * :class:`~pipelinedp_tpu.serving.store.SessionStore` spills sessions
+    to an atomic, per-chunk-digested on-disk layout;
+    ``session.save(store)`` + ``store.open(name)`` survive process
+    death with bit-identical warm queries, reattached per-tenant WAL
+    journals/ledgers, and cross-restart release replays refused.
+  * :class:`~pipelinedp_tpu.serving.manager.SessionManager` admits many
+    sessions under one residency budget with an LRU demotion ladder
+    (device → host slab → disk spill → on-demand re-hydration), a
+    bounded in-flight admission gate (typed
+    ``SessionOverloadedError`` shedding), and per-query deadlines
+    (``QueryDeadlineError`` riding the DispatchWatchdog).
+
 See SERVING.md for the session lifecycle, memory/eviction knobs, tenant
 budget semantics and the interaction with checkpoint/resume.
 """
 
 from pipelinedp_tpu.serving.session import (  # noqa: F401
     EVENT_BOUND_EVICTIONS, EVENT_BOUND_HITS, EVENT_BOUND_MISSES,
-    EVENT_QUERIES, BATCH_WIDTH_ENV, RESIDENT_BYTES_ENV, DatasetSession,
-    QueryConfig, SessionClosedError, StaleDatasetError, TenantState,
-    batch_width, resident_byte_budget, serving_counters)
+    EVENT_DEADLINE_HITS, EVENT_DEVICE_FALLBACKS, EVENT_QUERIES,
+    EVENT_REHYDRATIONS, BATCH_WIDTH_ENV, DEADLINE_ENV, RESIDENT_BYTES_ENV,
+    DatasetSession, QueryConfig, SessionClosedError, StaleDatasetError,
+    TenantState, batch_width, default_deadline_s, resident_byte_budget,
+    serving_counters)
+from pipelinedp_tpu.serving.store import (  # noqa: F401
+    EVENT_BOUND_DROPPED, EVENT_OPENS, EVENT_SAVES, SESSION_DIR_ENV,
+    SessionCorruptError, SessionNotFoundError, SessionStore,
+    SessionStoreError)
+from pipelinedp_tpu.serving.manager import (  # noqa: F401
+    EVENT_DEMOTIONS, EVENT_SHED, EVENT_SPILLS, INFLIGHT_ENV,
+    SessionManager, SessionOverloadedError, fleet_counters,
+    max_inflight_default)
 from pipelinedp_tpu.budget_accounting import (  # noqa: F401
     BudgetExhaustedError, TenantBudgetLedger)
+from pipelinedp_tpu.runtime.watchdog import QueryDeadlineError  # noqa: F401

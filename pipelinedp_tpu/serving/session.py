@@ -26,6 +26,7 @@ arrays, so the race costs work, never correctness.
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import threading
 from typing import Any, Dict, List, Optional, Sequence
@@ -43,6 +44,8 @@ from pipelinedp_tpu.ops import columnar, encoding, finalize as finalize_ops
 from pipelinedp_tpu.ops import streaming
 from pipelinedp_tpu.runtime import checkpoint as checkpoint_lib
 from pipelinedp_tpu.runtime import journal as journal_lib
+from pipelinedp_tpu.runtime import retry as retry_lib
+from pipelinedp_tpu.runtime import watchdog as watchdog_lib
 
 # Tuning knobs (validated via native.loader.env_int; README "Tuning
 # knobs" + SERVING.md):
@@ -51,15 +54,29 @@ from pipelinedp_tpu.runtime import journal as journal_lib
 #     cache LRU-evicts to stay under what remains (default 1 GiB).
 #   PIPELINEDP_TPU_SERVING_BATCH — max query configs packed into one
 #     vmapped launch by query_batch (default 32).
+#   PIPELINEDP_TPU_QUERY_DEADLINE_S — default per-query deadline in
+#     seconds (0 = none): an expired query surfaces as a typed,
+#     retryable QueryDeadlineError instead of running (or hanging)
+#     unboundedly.
 RESIDENT_BYTES_ENV = "PIPELINEDP_TPU_RESIDENT_BYTES"
 BATCH_WIDTH_ENV = "PIPELINEDP_TPU_SERVING_BATCH"
+DEADLINE_ENV = "PIPELINEDP_TPU_QUERY_DEADLINE_S"
 
 # Profiler event counters (profiler.count_event / event_count; the
-# replay-side counters live in ops/streaming.py):
+# replay-side counters live in ops/streaming.py, the fleet-level
+# admission/demotion counters in serving/manager.py):
 EVENT_QUERIES = "serving/queries"
 EVENT_BOUND_HITS = "serving/bound_cache_hits"
 EVENT_BOUND_MISSES = "serving/bound_cache_misses"
 EVENT_BOUND_EVICTIONS = "serving/bound_cache_evictions"
+# Graceful degradation: device-resident replays that hit
+# RESOURCE_EXHAUSTED and fell back to host-window shipping instead of
+# failing the query.
+EVENT_DEVICE_FALLBACKS = "serving/device_fallbacks"
+# Queries that tripped their per-query deadline (QueryDeadlineError).
+EVENT_DEADLINE_HITS = "serving/query_deadline_hits"
+# Spilled sessions re-hydrated from the store on demand.
+EVENT_REHYDRATIONS = "serving/sessions_rehydrations"
 
 
 def resident_byte_budget() -> int:
@@ -75,8 +92,17 @@ def batch_width() -> int:
     return loader.env_int(BATCH_WIDTH_ENV, 32, 1, 1024)
 
 
+def default_deadline_s() -> Optional[float]:
+    """Validated PIPELINEDP_TPU_QUERY_DEADLINE_S (None when 0/unset)."""
+    from pipelinedp_tpu.native import loader
+    seconds = loader.env_int(DEADLINE_ENV, 0, 0, 24 * 3600)
+    return float(seconds) if seconds > 0 else None
+
+
 def serving_counters() -> Dict[str, int]:
-    """Snapshot of the serving counters (bench.py surfaces this)."""
+    """Snapshot of the serving counters (bench.py surfaces this; the
+    fleet-level admission/demotion counters ride
+    serving.fleet_counters())."""
     return {
         "queries": profiler.event_count(EVENT_QUERIES),
         "bound_cache_hits": profiler.event_count(EVENT_BOUND_HITS),
@@ -87,6 +113,8 @@ def serving_counters() -> Dict[str, int]:
             streaming.EVENT_SERVING_REPLAYS),
         "kernel_dispatches": profiler.event_count(
             streaming.EVENT_SERVING_LAUNCHES),
+        "device_fallbacks": profiler.event_count(EVENT_DEVICE_FALLBACKS),
+        "query_deadline_hits": profiler.event_count(EVENT_DEADLINE_HITS),
     }
 
 
@@ -173,6 +201,11 @@ class _PreparedQuery:
     middle: float
     need_flags: tuple
     has_group_clip: bool
+    # Tenant bookkeeping for exact refunds on a failed batch: the
+    # pre-run ledger charge and the TenantState it was charged against
+    # (None for non-tenant configs).
+    state: Any = None
+    charge: Any = None
 
 
 class DatasetSession:
@@ -214,25 +247,13 @@ class DatasetSession:
                      finalize_ops.EpilogueCache] = None,
                  verify_source: bool = True,
                  name: str = "dataset"):
-        self._name = name
-        self._mesh = mesh
-        self._public = (list(public_partitions)
-                        if public_partitions is not None else None)
-        self._secure_host_noise = secure_host_noise
-        self._segment_sort = segment_sort
-        self._compact_merge = compact_merge
-        self._epilogue_cache = (epilogue_cache if epilogue_cache is not None
-                                else finalize_ops.default_cache())
-        self._byte_budget = (int(resident_bytes) if resident_bytes is not None
-                             else resident_byte_budget())
-        self._closed = False
-        self._lock = threading.Lock()
-        self._bound_cache: "collections.OrderedDict[tuple, _BoundCacheEntry]"
-        self._bound_cache = collections.OrderedDict()
-        self._cache_bytes = 0
-        self._tenants: Dict[str, TenantState] = {}
-        self._queries = 0
-        self._frame_meta = None  # set by from_frame
+        self._init_common(name=name, mesh=mesh,
+                          public_partitions=public_partitions,
+                          secure_host_noise=secure_host_noise,
+                          segment_sort=segment_sort,
+                          compact_merge=compact_merge,
+                          epilogue_cache=epilogue_cache,
+                          resident_bytes=resident_bytes)
 
         with profiler.stage("dp/ingest"):
             pid, pk, value, _, pk_vocab = encoding.encode_rows(
@@ -259,6 +280,78 @@ class DatasetSession:
         if (mesh is None and self._wire.n_rows > 0
                 and self._wire.host_nbytes <= self._byte_budget):
             self._wire.ensure_device()
+
+    def _init_common(self, *, name, mesh, public_partitions,
+                     secure_host_noise, segment_sort, compact_merge,
+                     epilogue_cache, resident_bytes) -> None:
+        """State shared by ingest (__init__) and store re-hydration
+        (:meth:`_restore`)."""
+        self._name = name
+        self._mesh = mesh
+        self._public = (list(public_partitions)
+                        if public_partitions is not None else None)
+        self._secure_host_noise = secure_host_noise
+        self._segment_sort = segment_sort
+        self._compact_merge = compact_merge
+        self._epilogue_cache = (epilogue_cache if epilogue_cache is not None
+                                else finalize_ops.default_cache())
+        self._byte_budget = (int(resident_bytes) if resident_bytes is not None
+                             else resident_byte_budget())
+        self._closed = False
+        self._lock = threading.Lock()
+        self._bound_cache: "collections.OrderedDict[tuple, _BoundCacheEntry]"
+        self._bound_cache = collections.OrderedDict()
+        self._cache_bytes = 0
+        self._tenants: Dict[str, TenantState] = {}
+        self._queries = 0
+        self._frame_meta = None  # set by from_frame
+        # Durable-fleet state (serving/store.py, serving/manager.py):
+        #   _store_binding — (SessionStore, name) after save()/open();
+        #   _manager — the SessionManager this session is admitted to;
+        #   _spilled — wire bytes live only in the store (rung 3 of the
+        #     demotion ladder); queries re-hydrate on demand;
+        #   _active — queries currently executing (spill never unloads a
+        #     handle a replay is reading);
+        #   _lifecycle_lock — serializes spill / re-hydrate / query
+        #     start+finish, so lifecycle transitions and replays never
+        #     interleave;
+        #   _deadline_tls — the running query's Deadline, read by
+        #     _accumulate on whatever thread executes the replay.
+        self._store_binding = None
+        self._manager = None
+        self._spilled = False
+        self._active = 0
+        self._lifecycle_lock = threading.Lock()
+        self._deadline_tls = threading.local()
+
+    @classmethod
+    def _restore(cls, wire: streaming.ResidentWire,
+                 pk_vocab: encoding.Vocabulary, *,
+                 public_partitions, mesh, name: str,
+                 secure_host_noise: bool, segment_sort, compact_merge,
+                 resident_bytes: Optional[int],
+                 epilogue_cache: Optional[finalize_ops.EpilogueCache],
+                 store_binding) -> "DatasetSession":
+        """A session over an already-validated wire handle — the store's
+        re-hydration path (serving/store.py). No ingest runs, no source
+        columns exist (``verify_source`` has nothing to verify: the wire
+        was digest-validated against its fingerprint on load)."""
+        self = cls.__new__(cls)
+        self._init_common(name=name, mesh=mesh,
+                          public_partitions=public_partitions,
+                          secure_host_noise=secure_host_noise,
+                          segment_sort=segment_sort,
+                          compact_merge=compact_merge,
+                          epilogue_cache=epilogue_cache,
+                          resident_bytes=resident_bytes)
+        self._pk_vocab = pk_vocab
+        self._wire = wire
+        self._source = self._source_digest = None
+        self._store_binding = store_binding
+        if (mesh is None and wire.n_rows > 0 and wire.loaded
+                and wire.host_nbytes <= self._byte_budget):
+            wire.ensure_device()
+        return self
 
     # -- construction from L5 frames ------------------------------------
 
@@ -349,6 +442,10 @@ class DatasetSession:
                 "byte_budget": self._byte_budget,
                 "queries": self._queries,
                 "n_chunks": self._wire.n_chunks,
+                "spilled": self._spilled,
+                "active_queries": self._active,
+                "store": (self._store_binding[0].path(self._store_binding[1])
+                          if self._store_binding is not None else None),
                 "tenants": {
                     tid: {
                         "spent_epsilon": st.ledger.spent_epsilon,
@@ -374,6 +471,110 @@ class DatasetSession:
 
     def __exit__(self, exc_type, exc_val, exc_tb) -> None:
         self.close()
+
+    # -- persistence & fleet lifecycle (serving/store.py, manager.py) ----
+
+    @property
+    def is_spilled(self) -> bool:
+        """True when the wire bytes live only in the session store (the
+        demotion ladder's disk rung); the next query re-hydrates."""
+        return self._spilled
+
+    @property
+    def store_binding(self):
+        """(SessionStore, stored name) after save()/open(), else None."""
+        return self._store_binding
+
+    def save(self, store=None) -> str:
+        """Spills the session durably: wire chunks (per-chunk digested),
+        bound-cache entries (content-digested), tenant registrations —
+        and migrates every tenant's release journal and budget ledger
+        onto fsync'd WALs under the store, so ``SessionStore.open``
+        after process death re-hydrates a session whose warm queries are
+        bit-identical and whose cross-restart release/spend replays are
+        still refused. Returns the on-disk session path. The session
+        stays fully usable (saving is not spilling)."""
+        if store is None:
+            if self._store_binding is None:
+                raise ValueError(
+                    "session has no bound store; pass save(store=)")
+            store = self._store_binding[0]
+        self._check_open()
+        return store.save(self)
+
+    def spill(self, store=None) -> bool:
+        """Demotes the session to the disk rung: saves (if needed) and
+        frees the wire bytes (host and device) and the in-memory bound
+        cache. Returns False — and keeps everything — when a query is
+        executing (a replay must never lose the slab under its feet).
+        The persisted bound entries re-hydrate with the wire."""
+        with self._lifecycle_lock:
+            if self._active > 0:
+                return False
+            if self._spilled:
+                return True
+            self.save(store)
+            with self._lock:
+                self._wire.unload()
+                self._bound_cache.clear()
+                self._cache_bytes = 0
+                self._spilled = True
+            return True
+
+    def rehydrate(self) -> None:
+        """Loads the wire bytes (and persisted bound entries) back from
+        the bound store; idempotent. Chunk digests are validated against
+        the handle's fingerprint — a corrupted spill refuses
+        (SessionCorruptError) rather than serving wrong bits; corrupted
+        bound entries are dropped and recompute via kernel replay."""
+        with self._lifecycle_lock:
+            self._rehydrate_locked()
+
+    def _rehydrate_locked(self) -> None:
+        if not self._spilled:
+            return
+        store, name = self._store_binding
+        slab, bound_entries = store.load_payload(name)
+        with self._lock:
+            self._check_open()
+            self._wire.reload(slab)
+            self._spilled = False
+        profiler.count_event(EVENT_REHYDRATIONS)
+        if (self._mesh is None and self._wire.n_rows > 0
+                and self._wire.host_nbytes <= self._byte_budget):
+            self._wire.ensure_device()
+        for key, result in bound_entries:
+            self._cache_insert(key, result)
+
+    def demote_device(self) -> bool:
+        """Demotion rung 1: frees the device copy of the wire (the host
+        slab stays authoritative; queries re-ship windows)."""
+        with self._lock:
+            if not self._wire.device_resident:
+                return False
+            self._wire.drop_device()
+            return True
+
+    @contextlib.contextmanager
+    def _pinned(self):
+        """Query-lifetime pin: re-hydrates a spilled session, then holds
+        ``_active`` > 0 so a concurrent spill can never unload the slab
+        a replay is reading. The manager is notified *after* the
+        lifecycle lock drops (its budget enforcement takes other
+        sessions' lifecycle locks — never while we hold ours)."""
+        with self._lifecycle_lock:
+            was_spilled = self._spilled
+            if was_spilled:
+                self._rehydrate_locked()
+            with self._lock:
+                self._active += 1
+        try:
+            if self._manager is not None:
+                self._manager.notify_used(self, rehydrated=was_spilled)
+            yield
+        finally:
+            with self._lock:
+                self._active -= 1
 
     # -- integrity -------------------------------------------------------
 
@@ -424,19 +625,37 @@ class DatasetSession:
                         ) -> TenantState:
         """Creates a tenant with its own cross-query budget ledger and
         at-most-once release journal (a FileReleaseJournal makes the
-        tenant's release history survive process death)."""
+        tenant's release history survive process death).
+
+        On a store-bound session (after save()/open()) both are durable
+        by default: the release journal and the ledger land on fsync'd
+        WALs under the store, and the registration is recorded in the
+        session manifest immediately — so a crash right after
+        registration still reattaches the tenant on reopen."""
         with self._lock:
             self._check_open()
             if tenant_id in self._tenants:
                 raise ValueError(f"tenant {tenant_id!r} already registered")
+            wal = None
+            if self._store_binding is not None:
+                store, name = self._store_binding
+                if release_journal is None:
+                    release_journal = journal_lib.FileReleaseJournal(
+                        store.tenant_release_path(name, tenant_id))
+                wal = journal_lib.FileReleaseJournal(
+                    store.tenant_ledger_path(name, tenant_id))
             state = TenantState(
                 ledger=budget_accounting.TenantBudgetLedger(
-                    tenant_id, total_epsilon, total_delta),
+                    tenant_id, total_epsilon, total_delta, wal=wal),
                 release_journal=(release_journal if release_journal
                                  is not None else
                                  journal_lib.ReleaseJournal()))
             self._tenants[tenant_id] = state
-            return state
+        if self._store_binding is not None:
+            store, name = self._store_binding
+            store.record_tenant(name, tenant_id, total_epsilon, total_delta,
+                                release_journal)
+        return state
 
     def tenant(self, tenant_id: str) -> TenantState:
         with self._lock:
@@ -478,31 +697,59 @@ class DatasetSession:
         this exact (kernel key, caps, clips, flags) was computed before
         (a hit is bitwise-exact by construction: the key includes the
         kernel-key fingerprint), replaying the retained wire otherwise.
-        Called by JaxDPEngine._execute on the resident path."""
+        Called by JaxDPEngine._execute on the resident path.
+
+        A running query's Deadline (thread-local, set by :meth:`query`)
+        is injected into the replay's resilience bundle so the slab
+        driver checks it cooperatively between windows. A
+        device-resident replay that hits RESOURCE_EXHAUSTED degrades
+        gracefully: the device copy is dropped and the replay re-issues
+        with host-window shipping — same chunk kernels, same keys, same
+        released bits, one fallback counter richer."""
         key_fp = checkpoint_lib.key_fingerprint(k_kernel)
         cache_key = self._cache_key(key_fp, kw)
-        with self._lock:
-            self._check_open()
-            entry = self._bound_cache.get(cache_key)
-            if entry is not None:
-                self._bound_cache.move_to_end(cache_key)
-                profiler.count_event(EVENT_BOUND_HITS)
-                return entry.result
-        profiler.count_event(EVENT_BOUND_MISSES)
+        with self._pinned():
+            with self._lock:
+                self._check_open()
+                entry = self._bound_cache.get(cache_key)
+                if entry is not None:
+                    self._bound_cache.move_to_end(cache_key)
+                    profiler.count_event(EVENT_BOUND_HITS)
+                    return entry.result
+            profiler.count_event(EVENT_BOUND_MISSES)
+            deadline = getattr(self._deadline_tls, "value", None)
+            if deadline is not None:
+                if resilience is None:
+                    from pipelinedp_tpu import runtime as runtime_lib
+                    resilience = runtime_lib.StreamResilience()
+                resilience.deadline = deadline
+            try:
+                result = self._replay(k_kernel, mesh, resilience, kw)
+            except Exception as exc:
+                if (retry_lib.classify(exc) != retry_lib.OOM
+                        or not self._wire.device_resident):
+                    raise
+                # Graceful degradation: a device-resident replay that
+                # exhausted device memory falls back to shipping host
+                # windows instead of failing the query.
+                self._wire.drop_device()
+                profiler.count_event(EVENT_DEVICE_FALLBACKS)
+                result = self._replay(k_kernel, mesh, resilience, kw)
+            self._cache_insert(cache_key, result)
+            return result
+
+    def _replay(self, k_kernel, mesh, resilience, kw):
         if mesh is not None:
             from pipelinedp_tpu.parallel import sharded
             mesh_kw = dict(kw)
             if mesh_kw.pop("quantile_spec", None) is not None:
                 raise NotImplementedError(
                     "quantile replay is single-device only")
-            result = sharded.replay_resident_wire(
+            return sharded.replay_resident_wire(
                 mesh, k_kernel, self._wire, resilience=resilience,
                 **mesh_kw)
-        else:
-            result = streaming.replay_resident_wire(
-                k_kernel, self._wire, resilience=resilience, **kw)
-        self._cache_insert(cache_key, result)
-        return result
+        return streaming.replay_resident_wire(
+            k_kernel, self._wire, resilience=resilience, **kw)
 
     def _cache_insert(self, cache_key: tuple, result) -> None:
         nbytes = self._result_nbytes(result)
@@ -534,6 +781,10 @@ class DatasetSession:
               secure_host_noise: Optional[bool] = None,
               release_journal: Optional[
                   journal_lib.ReleaseJournal] = None,
+              deadline_s: Optional[float] = None,
+              fault_injector=None,
+              watchdog_timeout_s: Optional[float] = None,
+              retry_policy=None,
               out_explain_computation_report=None
               ) -> jax_engine.LazyJaxResult:
         """Answers one DP query from the resident dataset.
@@ -541,11 +792,35 @@ class DatasetSession:
         Budget comes from ``tenant=`` (charged against the tenant's
         ledger; releases go through the tenant's at-most-once journal),
         an explicit ``accountant=``, or a fresh NaiveBudgetAccountant
-        over (epsilon, delta). The accountant's compute_budgets is called
-        here, so the returned LazyJaxResult is ready to consume.
+        over (epsilon, delta). The result is fully materialized before
+        returning: failures surface HERE, so a tenant charge whose
+        release token never committed is exactly refunded (the ledger,
+        bound cache and journal are left as if the query never ran).
+
+        ``deadline_s`` (default: the manager's deadline, else
+        PIPELINEDP_TPU_QUERY_DEADLINE_S) bounds the query end to end:
+        the slab driver checks the deadline between windows, and the
+        whole replay+finalize runs under a DispatchWatchdog with the
+        remaining budget — so even a *wedged* replay surfaces as a
+        typed, retryable ``QueryDeadlineError`` within the deadline. A
+        timed-out attempt is abandoned, not interrupted: its charge is
+        conservatively kept (the abandoned worker could still commit a
+        release), which is the same "err toward spent, never toward
+        double-release" stance the at-most-once journal takes.
+
+        ``fault_injector`` / ``watchdog_timeout_s`` / ``retry_policy``
+        thread straight into the replay's slab driver (the same
+        resilience surface a cold streamed run has — chaos and
+        kill-harness coverage extends to serving through them).
         """
         self._check_open()
+        if deadline_s is None:
+            deadline_s = (self._manager.default_deadline_s
+                          if self._manager is not None else None)
+            if deadline_s is None:
+                deadline_s = default_deadline_s()
         journal = release_journal
+        state = charge = None
         if tenant is not None:
             if accountant is not None:
                 raise ValueError(
@@ -554,8 +829,13 @@ class DatasetSession:
                 raise ValueError("tenant queries need epsilon= (the "
                                  "slice charged to the tenant's ledger)")
             state = self.tenant(tenant)
-            accountant = state.ledger.make_accountant(
-                epsilon, delta, note=f"query seed={seed}")
+            # Charge-before-run (the at-most-once stance): the slice is
+            # spent before any work happens — and exactly refunded below
+            # if the query dies before its release token commits.
+            charge = state.ledger.charge(epsilon, delta,
+                                         note=f"query seed={seed}")
+            accountant = budget_accounting.NaiveBudgetAccountant(
+                epsilon, delta)
             if journal is None:
                 journal = state.release_journal
         elif accountant is None:
@@ -576,15 +856,95 @@ class DatasetSession:
             segment_sort=self._segment_sort,
             compact_merge=self._compact_merge,
             epilogue_cache=self._epilogue_cache,
-            release_journal=journal)
-        result = engine.aggregate(
-            self, params, public_partitions=self._public,
-            out_explain_computation_report=out_explain_computation_report)
-        accountant.compute_budgets()
+            release_journal=journal,
+            fault_injector=fault_injector,
+            watchdog_timeout_s=watchdog_timeout_s,
+            retry_policy=retry_policy)
+
+        deadline = (watchdog_lib.Deadline.after(deadline_s)
+                    if deadline_s is not None else None)
+
+        def run_query():
+            # Runs on the watchdog worker when a deadline is set; the
+            # thread-local hands the Deadline to _accumulate on whatever
+            # thread executes the replay.
+            self._deadline_tls.value = deadline
+            try:
+                result = engine.aggregate(
+                    self, params, public_partitions=self._public,
+                    out_explain_computation_report=(
+                        out_explain_computation_report))
+                accountant.compute_budgets()
+                result.to_columns()  # materialize: replay + finalize
+                return result
+            finally:
+                self._deadline_tls.value = None
+
+        gate = (self._manager.admission()
+                if self._manager is not None else contextlib.nullcontext())
+        try:
+            with gate:
+                if deadline is None:
+                    result = run_query()
+                else:
+                    result = self._run_with_deadline(run_query, deadline,
+                                                     seed)
+        except BaseException as exc:
+            if isinstance(exc, watchdog_lib.QueryDeadlineError):
+                profiler.count_event(EVENT_DEADLINE_HITS)
+            self._maybe_refund(state, charge, journal, engine, exc)
+            raise
         with self._lock:
             self._queries += 1
         profiler.count_event(EVENT_QUERIES)
         return result
+
+    def _run_with_deadline(self, run_query, deadline, seed):
+        """The whole query under a DispatchWatchdog whose budget is the
+        remaining deadline: a wedged replay (which never reaches the
+        driver's cooperative between-window check) is abandoned and
+        surfaced as QueryDeadlineError within the deadline."""
+        wd = watchdog_lib.DispatchWatchdog(
+            max(deadline.remaining_s(), 1e-3))
+        parent_sinks = profiler.current_sinks()
+
+        def guarded():
+            with profiler.adopt_sinks(parent_sinks):
+                return run_query()
+
+        try:
+            return wd.call(f"query (session {self._name!r}, seed={seed})",
+                           guarded)
+        except watchdog_lib.QueryDeadlineError:
+            raise  # the driver's cooperative check, already typed
+        except watchdog_lib.DispatchHangError as exc:
+            raise watchdog_lib.QueryDeadlineError(
+                exc.what, deadline.total_s) from exc
+        finally:
+            wd.close()
+
+    def _maybe_refund(self, state, charge, journal, engine, exc) -> None:
+        """Exact refund of a charge whose query provably released
+        nothing (SERVING.md "Fleet operation" failure isolation):
+
+        * a refused replay (DoubleReleaseError) drew nothing in THIS
+          query — refund;
+        * a deadline abandonment might still commit+draw on the
+          abandoned worker — conservatively keep the charge;
+        * otherwise the release token is checked against the journal:
+          not committed means no noise was drawn — refund.
+        """
+        if state is None or charge is None:
+            return
+        if isinstance(exc, journal_lib.DoubleReleaseError):
+            state.ledger.refund(charge)
+            return
+        if isinstance(exc, watchdog_lib.QueryDeadlineError):
+            return
+        token = finalize_ops.release_token(engine._key_stream.fingerprint(),
+                                           engine._key_stream.counter)
+        if journal is None or not journal.has(token):
+            state.ledger.refund(charge)
 
     # -- batched queries -------------------------------------------------
 
@@ -603,11 +963,14 @@ class DatasetSession:
             raise NotImplementedError(
                 self._BATCH_UNSUPPORTED.format("VECTOR_SUM"))
         journal = None
+        state = charge = None
         if cfg.tenant is not None:
             state = self.tenant(cfg.tenant)
-            accountant = state.ledger.make_accountant(
+            charge = state.ledger.charge(
                 cfg.epsilon, cfg.delta,
                 note=f"batch query #{index} seed={cfg.seed}")
+            accountant = budget_accounting.NaiveBudgetAccountant(
+                cfg.epsilon, cfg.delta)
             journal = state.release_journal
         else:
             accountant = budget_accounting.NaiveBudgetAccountant(
@@ -646,7 +1009,8 @@ class DatasetSession:
             key_counter=key_counter, linf_cap=linf_cap, l0_cap=l0_cap,
             row_lo=row_lo, row_hi=row_hi, glo=glo, ghi=ghi, middle=middle,
             need_flags=jax_engine.derive_need_flags(compound),
-            has_group_clip=bool(params.bounds_per_partition_are_set))
+            has_group_clip=bool(params.bounds_per_partition_are_set),
+            state=state, charge=charge)
 
     def query_batch(self,
                     configs: Sequence[QueryConfig],
@@ -671,18 +1035,38 @@ class DatasetSession:
                 "through session.query")
         self.verify_source()
         width = max_width or batch_width()
-        prepared = [self._prepare_query(i, cfg, secure_host_noise)
-                    for i, cfg in enumerate(configs)]
-        results: List[Optional[dict]] = [None] * len(prepared)
-        # Launch groups: configs sharing the kernel statics
-        # (has_group_clip — the group-stage topology) batch together.
-        groups: Dict[bool, List[_PreparedQuery]] = {}
-        for p in prepared:
-            groups.setdefault(p.has_group_clip, []).append(p)
-        for has_group_clip, group in groups.items():
-            for s in range(0, len(group), width):
-                self._run_batch_group(group[s:s + width], has_group_clip,
-                                      results)
+        gate = (self._manager.admission()
+                if self._manager is not None else contextlib.nullcontext())
+        with gate, self._pinned():
+            prepared: List[_PreparedQuery] = []
+            results: List[Optional[dict]] = [None] * len(configs)
+            try:
+                for i, cfg in enumerate(configs):
+                    prepared.append(
+                        self._prepare_query(i, cfg, secure_host_noise))
+                # Launch groups: configs sharing the kernel statics
+                # (has_group_clip — the group-stage topology) batch
+                # together.
+                groups: Dict[bool, List[_PreparedQuery]] = {}
+                for p in prepared:
+                    groups.setdefault(p.has_group_clip, []).append(p)
+                for has_group_clip, group in groups.items():
+                    for s in range(0, len(group), width):
+                        self._run_batch_group(group[s:s + width],
+                                              has_group_clip, results)
+            except BaseException:
+                # Exact refunds for every tenant config whose release
+                # token never committed (the failed launch group and any
+                # group that never ran); finished configs keep their
+                # charge — their releases are out the door.
+                for p in prepared:
+                    if p.charge is None or p.state is None:
+                        continue
+                    token = finalize_ops.release_token(
+                        p.engine._key_stream.fingerprint(), p.key_counter)
+                    if not p.state.release_journal.has(token):
+                        p.state.ledger.refund(p.charge)
+                raise
         with self._lock:
             self._queries += len(prepared)
         profiler.count_event(EVENT_QUERIES, len(prepared))

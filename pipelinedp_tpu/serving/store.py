@@ -1,0 +1,632 @@
+"""SessionStore: the durable, crash-recoverable home of serving sessions.
+
+PR 9's ``DatasetSession`` made warm queries cheap but kept everything in
+process memory: a restart re-paid full ingest for every resident
+dataset, and tenant release/budget history died with the process. This
+module is the durability rung under the serving fleet (SERVING.md
+"Fleet operation"):
+
+  * ``DatasetSession.save(store)`` spills the session's ``ResidentWire``
+    — sorted chunk slab, per-bucket counts, base wire format,
+    ``resident_fingerprint`` — plus the bound-cache entries and the
+    tenant registrations to an on-disk session directory;
+  * ``SessionStore.open(name)`` re-hydrates a session after process
+    death whose warm queries are **bit-identical** to the original
+    session (and therefore to cold runs): the slab bytes are
+    digest-validated chunk by chunk against the save-time digests, and
+    the reconstructed format/counts are validated by recomputing the
+    wire fingerprint;
+  * tenant release journals and budget ledgers live on fsync'd WALs
+    (runtime/journal.py) under the session directory, so cross-restart
+    release replays are refused and ledger spend survives the crash.
+
+Torn-write discipline (the ``FileCheckpointStore`` rules): every payload
+file is written tmp + fsync + atomic rename, and the manifest — the
+only entry point — is renamed into place *last*, so a crash mid-save
+leaves either the previous complete session or no session, never a half
+one. Corruption detection is layered by blast radius: a corrupted wire
+payload refuses to open (``SessionCorruptError`` — the store must never
+serve wrong bits), while a corrupted bound-cache entry is merely
+dropped — the accumulators recompute exactly via kernel replay, so the
+failure costs a replay, not correctness.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import re
+import tempfile
+import zipfile
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pipelinedp_tpu import budget_accounting
+from pipelinedp_tpu import profiler
+from pipelinedp_tpu.ops import encoding, streaming, wirecodec
+from pipelinedp_tpu.runtime import checkpoint as checkpoint_lib
+from pipelinedp_tpu.runtime import journal as journal_lib
+
+# Default store root (README "Tuning knobs" + SERVING.md): sessions live
+# under ``$PIPELINEDP_TPU_SESSION_DIR/<name>/``.
+SESSION_DIR_ENV = "PIPELINEDP_TPU_SESSION_DIR"
+DEFAULT_ROOT = ".pdp-sessions"
+
+FORMAT_VERSION = 1
+MANIFEST_FILE = "manifest.json"
+WIRE_FILE = "wire.npz"
+BOUND_DIR = "bound"
+TENANT_DIR = "tenants"
+
+# Profiler event counters (profiler.count_event / event_count):
+EVENT_SAVES = "serving/store_saves"
+EVENT_OPENS = "serving/store_opens"
+# Spilled bound-cache entries dropped on load because their content
+# digest no longer matched (bit rot / torn write): the query that wants
+# them recomputes via kernel replay instead of crashing or serving
+# wrong bits.
+EVENT_BOUND_DROPPED = "serving/bound_cache_corrupt_dropped"
+
+
+class SessionStoreError(RuntimeError):
+    """Base of the session store's typed failures."""
+
+
+class SessionNotFoundError(SessionStoreError):
+    """No (complete) session of that name exists in the store."""
+
+
+class SessionCorruptError(SessionStoreError):
+    """A stored wire payload fails its digests: the store refuses to
+    re-hydrate rather than serve bits that differ from what was saved."""
+
+
+def default_root() -> str:
+    return os.environ.get(SESSION_DIR_ENV) or DEFAULT_ROOT
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """tmp + fsync + rename: the file is either the old version or the
+    complete new one, never a torn mix."""
+    parent = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=parent, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def _npz_bytes(arrays: Dict[str, np.ndarray]) -> bytes:
+    import io
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _chunk_digest(row: np.ndarray) -> str:
+    return hashlib.sha256(np.ascontiguousarray(row).tobytes()) \
+        .hexdigest()[:16]
+
+
+def _key_to_json(key: Tuple) -> Any:
+    """Bound-cache keys are canonical tuples of scalars (see
+    DatasetSession._canonical); JSON encodes tuples as lists."""
+    if isinstance(key, tuple):
+        return [_key_to_json(k) for k in key]
+    return key
+
+
+def _key_from_json(obj: Any) -> Any:
+    """Inverse of _key_to_json: every list becomes a tuple again, so the
+    loaded key compares equal to the live one that was saved."""
+    if isinstance(obj, list):
+        return tuple(_key_from_json(o) for o in obj)
+    return obj
+
+
+def _encode_vocab(vocab: encoding.Vocabulary
+                  ) -> Tuple[dict, Optional[np.ndarray]]:
+    """(manifest meta, optional array payload) for the pk vocabulary.
+
+    Scalar key sets round-trip as a numpy array inside wire.npz
+    (digested with the rest of the payload); tuple keys (multi-column
+    partition keys) and anything numpy would store as dtype=object go
+    through JSON in the manifest."""
+    keys = vocab.keys
+    arr = np.asarray(keys) if keys else np.zeros(0, dtype=np.int64)
+    if arr.dtype != object and arr.ndim == 1:
+        return {"kind": "array"}, arr
+    tuples = bool(keys) and isinstance(keys[0], tuple)
+    try:
+        payload = [list(k) if isinstance(k, tuple) else k for k in keys]
+        json.dumps(payload)
+    except TypeError as exc:
+        raise SessionStoreError(
+            f"partition-key vocabulary is not serializable (sample key "
+            f"{keys[0]!r}); a durable session needs JSON- or "
+            f"numpy-representable partition keys") from exc
+    return {"kind": "json", "keys": payload, "tuples": tuples}, None
+
+
+def _decode_vocab(meta: dict, arr: Optional[np.ndarray]
+                  ) -> encoding.Vocabulary:
+    if meta["kind"] == "array":
+        return encoding.Vocabulary.from_unique(arr)
+    keys = meta["keys"]
+    if meta["tuples"]:
+        keys = [tuple(k) for k in keys]
+    return encoding.Vocabulary(keys)
+
+
+def _result_arrays(result) -> Tuple[Tuple[np.ndarray, ...],
+                                    Optional[np.ndarray]]:
+    """(accs arrays, qhist) of one bound-cache result (accs alone, or
+    (accs, qhist) on the quantile path)."""
+    if isinstance(result, tuple) and not hasattr(result, "_fields"):
+        accs, qhist = result
+        return (tuple(np.asarray(a) for a in accs),
+                None if qhist is None else np.asarray(qhist))
+    return tuple(np.asarray(a) for a in result), None
+
+
+def _bound_entry_digest(key_json: str, accs, qhist) -> str:
+    return checkpoint_lib.content_digest(
+        key_json, *(accs + ((qhist,) if qhist is not None else ())))
+
+
+class SessionStore:
+    """A directory of durable serving sessions (module docstring).
+
+    One instance may back many sessions and many SessionManagers; all
+    methods take the session name. Paths under the store are stable, so
+    ``FileReleaseJournal``/ledger WALs handed out for a session keep
+    working across saves.
+    """
+
+    def __init__(self, root: Optional[str] = None):
+        self._root = root if root is not None else default_root()
+        os.makedirs(self._root, exist_ok=True)
+
+    @property
+    def root(self) -> str:
+        return self._root
+
+    @staticmethod
+    def _safe(name: str) -> str:
+        safe = re.sub(r"[^A-Za-z0-9._-]", "_", name)
+        if not safe or safe in (".", ".."):
+            raise SessionStoreError(f"unusable session name {name!r}")
+        return safe
+
+    def path(self, name: str) -> str:
+        return os.path.join(self._root, self._safe(name))
+
+    def exists(self, name: str) -> bool:
+        return os.path.exists(os.path.join(self.path(name), MANIFEST_FILE))
+
+    def names(self) -> List[str]:
+        """Names of complete (manifest-bearing) sessions in the store."""
+        out = []
+        for entry in sorted(os.listdir(self._root)):
+            if os.path.exists(os.path.join(self._root, entry,
+                                           MANIFEST_FILE)):
+                out.append(entry)
+        return out
+
+    def delete(self, name: str) -> None:
+        """Drops a stored session (manifest first, so a crash mid-delete
+        leaves an incomplete — and therefore invisible — directory)."""
+        import shutil
+        path = self.path(name)
+        manifest = os.path.join(path, MANIFEST_FILE)
+        if os.path.exists(manifest):
+            os.unlink(manifest)
+        if os.path.exists(path):
+            shutil.rmtree(path, ignore_errors=True)
+
+    # -- per-tenant durable state paths ----------------------------------
+
+    def tenant_release_path(self, name: str, tenant_id: str) -> str:
+        return os.path.join(self.path(name), TENANT_DIR,
+                            f"{self._safe(tenant_id)}.release.wal")
+
+    def tenant_ledger_path(self, name: str, tenant_id: str) -> str:
+        return os.path.join(self.path(name), TENANT_DIR,
+                            f"{self._safe(tenant_id)}.ledger.wal")
+
+    # -- save ------------------------------------------------------------
+
+    def save(self, session) -> str:
+        """Persists ``session`` (DatasetSession.save delegates here).
+
+        Layout under ``<root>/<name>/``::
+
+            wire.npz       slab + counts + n_uniq (+ vocab array)
+            bound/*.npz    spilled bound-cache entries, content-digested
+            tenants/*.wal  per-tenant release + ledger WALs (fsync'd)
+            manifest.json  digests + metadata — written LAST, atomically
+
+        Saving is idempotent and incremental: the wire payload is
+        written once (it is immutable), bound entries are content-
+        addressed, and only the manifest is rewritten.
+        """
+        name = session.name
+        path = self.path(name)
+        os.makedirs(path, exist_ok=True)
+        os.makedirs(os.path.join(path, BOUND_DIR), exist_ok=True)
+        os.makedirs(os.path.join(path, TENANT_DIR), exist_ok=True)
+
+        wire: streaming.ResidentWire = session._wire
+        if not wire.loaded:
+            raise SessionStoreError(
+                f"session {name!r} is spilled; re-hydrate before saving "
+                f"(the store already holds its latest saved state)")
+        vocab_meta, vocab_arr = _encode_vocab(session._pk_vocab)
+
+        wire_path = os.path.join(path, WIRE_FILE)
+        chunk_digests = [_chunk_digest(wire.slab[i])
+                         for i in range(wire.k)]
+        aux_arrays = [wire.counts, wire.n_uniq]
+        if vocab_arr is not None:
+            aux_arrays.append(vocab_arr)
+        aux_digest = checkpoint_lib.content_digest("aux", *aux_arrays)
+        # The wire payload is immutable per handle, so a re-save skips
+        # it — unless the name was previously used for a DIFFERENT
+        # handle (fingerprint mismatch, or no readable manifest to tell):
+        # then the stale payload must be replaced, not trusted.
+        write_wire = not os.path.exists(wire_path)
+        if not write_wire:
+            try:
+                write_wire = (self._read_manifest(name)["fingerprint"]
+                              != wire.fingerprint)
+            except SessionStoreError:
+                write_wire = True
+        if write_wire:
+            arrays = {"slab": wire.slab, "counts": wire.counts,
+                      "n_uniq": wire.n_uniq}
+            if vocab_arr is not None:
+                arrays["vocab_keys"] = vocab_arr
+            _atomic_write(wire_path, _npz_bytes(arrays))
+
+        # Bound-cache entries: content-addressed npz files, digested so
+        # re-hydration can tell bit rot from a valid accumulator and
+        # fall back to kernel replay.
+        bound_entries = []
+        with session._lock:
+            cache_snapshot = [(key, entry.result, entry.nbytes)
+                              for key, entry in session._bound_cache.items()]
+        for key, result, nbytes in cache_snapshot:
+            key_json = json.dumps(_key_to_json(key), sort_keys=False)
+            accs, qhist = _result_arrays(result)
+            digest = _bound_entry_digest(key_json, accs, qhist)
+            fname = hashlib.sha256(key_json.encode()).hexdigest()[:24] \
+                + ".npz"
+            fpath = os.path.join(path, BOUND_DIR, fname)
+            if not os.path.exists(fpath):
+                arrays = {f"accs_{i}": a for i, a in enumerate(accs)}
+                if qhist is not None:
+                    arrays["qhist"] = qhist
+                _atomic_write(fpath, _npz_bytes(arrays))
+            bound_entries.append({
+                "file": fname,
+                "key": _key_to_json(key),
+                "has_qhist": qhist is not None,
+                "digest": digest,
+                "nbytes": int(nbytes),
+            })
+
+        # Tenants: migrate in-memory journals/ledgers onto durable WALs
+        # under the store, then record the registrations.
+        tenants = []
+        with session._lock:
+            tenant_items = list(session._tenants.items())
+        for tenant_id, state in tenant_items:
+            state.release_journal = self._migrate_release_journal(
+                name, tenant_id, state.release_journal)
+            state.ledger = self._migrate_ledger(name, tenant_id,
+                                                state.ledger)
+            tenants.append(self._tenant_manifest_entry(
+                tenant_id, state.ledger, state.release_journal))
+
+        fmt = wire.fmt
+        manifest = {
+            "version": FORMAT_VERSION,
+            "name": name,
+            "fingerprint": wire.fingerprint,
+            "data_digest": wire.data_digest,
+            "n_rows": int(wire.n_rows),
+            "num_partitions": int(wire.num_partitions),
+            "n_dev": int(wire.n_dev),
+            "max_run": int(wire.max_run),
+            "fmt": {
+                "bytes_pid": fmt.bytes_pid,
+                "bits_pk": fmt.bits_pk,
+                "cap": fmt.cap,
+                "ucap": fmt.ucap,
+                "pid_mode": fmt.pid_mode,
+                "bits_pid": fmt.bits_pid,
+                "tile_rows": fmt.tile_rows,
+                "tile_slack": fmt.tile_slack,
+                "sort_value_narrow": fmt.sort_value_narrow,
+                "value": {
+                    "mode": fmt.value.mode,
+                    "bits": fmt.value.bits,
+                    "lo": fmt.value.lo,
+                    "scale": fmt.value.scale,
+                },
+            },
+            "chunk_digests": chunk_digests,
+            "aux_digest": aux_digest,
+            "vocab": vocab_meta,
+            "public_partitions": (
+                None if session._public is None else
+                [type(session)._canonical(p) for p in session._public]),
+            "knobs": {
+                "secure_host_noise": session._secure_host_noise,
+                "segment_sort": session._segment_sort,
+                "compact_merge": session._compact_merge,
+            },
+            "bound_entries": bound_entries,
+            "tenants": tenants,
+        }
+        _atomic_write(os.path.join(path, MANIFEST_FILE),
+                      json.dumps(manifest, indent=1).encode())
+        session._store_binding = (self, name)
+        profiler.count_event(EVENT_SAVES)
+        return path
+
+    @staticmethod
+    def _tenant_manifest_entry(tenant_id, ledger, release_journal) -> dict:
+        entry = {"id": tenant_id,
+                 "total_epsilon": ledger.total_epsilon,
+                 "total_delta": ledger.total_delta}
+        path = getattr(release_journal, "_path", None)
+        if path is not None:
+            entry["release_journal_path"] = os.path.abspath(path)
+        return entry
+
+    def _migrate_release_journal(self, name, tenant_id, journal):
+        """In-memory tenant journals become store-local FileReleaseJournals
+        with the committed records replayed in order; already-durable
+        journals are kept wherever the caller put them."""
+        if isinstance(journal, journal_lib.FileReleaseJournal):
+            return journal
+        durable = journal_lib.FileReleaseJournal(
+            self.tenant_release_path(name, tenant_id))
+        for record in journal.records:
+            if not durable.has(record.token):
+                durable.commit(record.token, kind=record.kind)
+        return durable
+
+    def _migrate_ledger(self, name, tenant_id,
+                        ledger: budget_accounting.TenantBudgetLedger):
+        """In-memory ledgers become WAL-backed ones with every committed
+        charge (and refund) replayed; WAL-backed ledgers pass through."""
+        if ledger._wal is not None:
+            return ledger
+        wal = journal_lib.FileReleaseJournal(
+            self.tenant_ledger_path(name, tenant_id))
+        durable = budget_accounting.TenantBudgetLedger(
+            ledger.tenant_id, ledger.total_epsilon, ledger.total_delta,
+            wal=wal)
+        refunded = ledger.refunded_indices
+        for charge in ledger.charges:
+            replayed = durable.charge(charge.epsilon, charge.delta,
+                                      note=charge.note)
+            # Refund immediately so a replayed prefix never holds MORE
+            # live budget than the original ledger ever did (refunding
+            # only at the end could spuriously overdraw when a later
+            # charge reused budget an earlier refund freed).
+            if charge.index in refunded:
+                durable.refund(replayed)
+        return durable
+
+    def record_tenant(self, name: str, tenant_id: str, total_epsilon: float,
+                      total_delta: float, release_journal) -> None:
+        """Appends one tenant registration to an existing manifest
+        atomically (so a crash between register_tenant and the next full
+        save still reattaches the tenant on reopen)."""
+        manifest = self._read_manifest(name)
+        ledger = budget_accounting.TenantBudgetLedger(
+            tenant_id, total_epsilon, total_delta)
+        entry = self._tenant_manifest_entry(tenant_id, ledger,
+                                            release_journal)
+        tenants = [t for t in manifest["tenants"] if t["id"] != tenant_id]
+        tenants.append(entry)
+        manifest["tenants"] = tenants
+        _atomic_write(os.path.join(self.path(name), MANIFEST_FILE),
+                      json.dumps(manifest, indent=1).encode())
+
+    # -- load ------------------------------------------------------------
+
+    def _read_manifest(self, name: str) -> dict:
+        path = os.path.join(self.path(name), MANIFEST_FILE)
+        if not os.path.exists(path):
+            raise SessionNotFoundError(
+                f"no session {name!r} in store {self._root!r}")
+        try:
+            with open(path, "rb") as f:
+                manifest = json.load(f)
+        except ValueError as exc:
+            raise SessionCorruptError(
+                f"session {name!r}: unreadable manifest ({exc})") from exc
+        if manifest.get("version") != FORMAT_VERSION:
+            raise SessionStoreError(
+                f"session {name!r}: manifest version "
+                f"{manifest.get('version')!r} (this build reads "
+                f"{FORMAT_VERSION})")
+        return manifest
+
+    def _load_wire_arrays(self, name: str, manifest: dict) -> dict:
+        path = os.path.join(self.path(name), WIRE_FILE)
+        try:
+            with np.load(path, allow_pickle=False) as data:
+                arrays = {k: np.array(data[k]) for k in data.files}
+        except (OSError, ValueError, KeyError,
+                zipfile.BadZipFile) as exc:
+            raise SessionCorruptError(
+                f"session {name!r}: unreadable wire payload ({exc})"
+            ) from exc
+        slab = arrays.get("slab")
+        if slab is None or len(slab) != len(manifest["chunk_digests"]):
+            raise SessionCorruptError(
+                f"session {name!r}: wire payload does not match the "
+                f"manifest chunk schedule")
+        for i, expected in enumerate(manifest["chunk_digests"]):
+            if _chunk_digest(slab[i]) != expected:
+                raise SessionCorruptError(
+                    f"session {name!r}: wire chunk {i} fails its content "
+                    f"digest — the spilled slab is corrupt; refusing to "
+                    f"serve bits that differ from what was saved")
+        aux = [arrays["counts"], arrays["n_uniq"]]
+        if "vocab_keys" in arrays:
+            aux.append(arrays["vocab_keys"])
+        if checkpoint_lib.content_digest("aux", *aux) \
+                != manifest["aux_digest"]:
+            raise SessionCorruptError(
+                f"session {name!r}: wire metadata (counts / vocabulary) "
+                f"fails its content digest")
+        return arrays
+
+    def _load_bound_entries(self, name: str, manifest: dict
+                            ) -> List[Tuple[Tuple, Any]]:
+        """Digest-validated bound-cache entries; corrupted ones are
+        dropped (and counted) — the query that wants them recomputes
+        via kernel replay, bit-identically."""
+        from pipelinedp_tpu.ops import columnar
+        out = []
+        for entry in manifest["bound_entries"]:
+            fpath = os.path.join(self.path(name), BOUND_DIR, entry["file"])
+            key = _key_from_json(entry["key"])
+            key_json = json.dumps(_key_to_json(key), sort_keys=False)
+            try:
+                with np.load(fpath, allow_pickle=False) as data:
+                    n_accs = sum(1 for f in data.files
+                                 if f.startswith("accs_"))
+                    accs = tuple(np.array(data[f"accs_{i}"])
+                                 for i in range(n_accs))
+                    qhist = (np.array(data["qhist"])
+                             if entry["has_qhist"] else None)
+            except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+                accs = None
+            if (accs is None or _bound_entry_digest(key_json, accs, qhist)
+                    != entry["digest"]):
+                logging.warning(
+                    "pipelinedp_tpu serving store: bound-cache entry %s "
+                    "of session %s is corrupt; dropping it (the query "
+                    "recomputes via kernel replay)", entry["file"], name)
+                profiler.count_event(EVENT_BOUND_DROPPED)
+                continue
+            result = columnar.PartitionAccumulators(*accs)
+            out.append((key, (result, qhist) if entry["has_qhist"]
+                        else result))
+        return out
+
+    def load_payload(self, name: str) -> Tuple[np.ndarray, list]:
+        """(validated slab, bound entries) — the re-hydration path for a
+        spilled session whose handle (metadata) is still in memory."""
+        manifest = self._read_manifest(name)
+        arrays = self._load_wire_arrays(name, manifest)
+        return arrays["slab"], self._load_bound_entries(name, manifest)
+
+    def _rebuild_wire(self, name: str, manifest: dict,
+                      arrays: dict) -> streaming.ResidentWire:
+        f = manifest["fmt"]
+        fmt = wirecodec.WireFormat(
+            bytes_pid=f["bytes_pid"], bits_pk=f["bits_pk"], cap=f["cap"],
+            ucap=f["ucap"],
+            value=wirecodec.ValuePlan(
+                mode=f["value"]["mode"], bits=f["value"]["bits"],
+                lo=f["value"]["lo"], scale=f["value"]["scale"]),
+            pid_mode=f["pid_mode"], bits_pid=f["bits_pid"],
+            tile_rows=f["tile_rows"], tile_slack=f["tile_slack"],
+            sort_value_narrow=f["sort_value_narrow"])
+        counts = np.asarray(arrays["counts"], dtype=np.int64)
+        n_uniq = np.asarray(arrays["n_uniq"], dtype=np.int64)
+        wire = streaming.ResidentWire(
+            slab=np.ascontiguousarray(arrays["slab"]),
+            counts=counts, n_uniq=n_uniq, fmt=fmt,
+            max_run=manifest["max_run"],
+            num_partitions=manifest["num_partitions"],
+            n_rows=manifest["n_rows"], n_dev=manifest["n_dev"],
+            data_digest=manifest["data_digest"],
+            fingerprint=manifest["fingerprint"])
+        # The chunk digests validated the slab bytes; recomputing the
+        # resident fingerprint validates everything else (format,
+        # counts, chunk count, source digest) against the save-time
+        # identity.
+        recomputed = wirecodec.resident_fingerprint(
+            wire.k, fmt, counts, n_uniq, manifest["data_digest"])
+        if recomputed != manifest["fingerprint"]:
+            raise SessionCorruptError(
+                f"session {name!r}: reconstructed wire fingerprint "
+                f"{recomputed} does not match the saved "
+                f"{manifest['fingerprint']} — manifest metadata is "
+                f"corrupt")
+        return wire
+
+    def open(self, name: str, *, mesh=None, resident_bytes=None,
+             epilogue_cache=None):
+        """Re-hydrates a stored session.
+
+        The returned DatasetSession serves warm queries bit-identical to
+        the session that was saved (tests/serving_fleet_test.py and the
+        serving kill harness pin this, single-device and mesh8), with
+        every saved tenant reattached to its durable release journal and
+        ledger WAL — a cross-restart release replay raises
+        DoubleReleaseError, and spent budget stays spent.
+
+        ``mesh`` must match the topology the wire was ingested for
+        (n_dev buckets per chunk).
+        """
+        from pipelinedp_tpu.serving.session import (DatasetSession,
+                                                    TenantState)
+
+        manifest = self._read_manifest(name)
+        n_dev = mesh.devices.size if mesh is not None else 1
+        if manifest["n_dev"] != n_dev:
+            raise ValueError(
+                f"session {name!r} was ingested for n_dev="
+                f"{manifest['n_dev']}; opening with n_dev={n_dev} cannot "
+                f"replay it (pass the matching mesh)")
+        arrays = self._load_wire_arrays(name, manifest)
+        wire = self._rebuild_wire(name, manifest, arrays)
+        vocab = _decode_vocab(manifest["vocab"],
+                              arrays.get("vocab_keys"))
+        knobs = manifest["knobs"]
+        session = DatasetSession._restore(
+            wire, vocab,
+            public_partitions=manifest["public_partitions"],
+            mesh=mesh, name=manifest["name"],
+            secure_host_noise=knobs["secure_host_noise"],
+            segment_sort=knobs["segment_sort"],
+            compact_merge=knobs["compact_merge"],
+            resident_bytes=resident_bytes,
+            epilogue_cache=epilogue_cache,
+            store_binding=(self, name))
+        for key, result in self._load_bound_entries(name, manifest):
+            session._cache_insert(key, result)
+        for entry in manifest["tenants"]:
+            release_path = entry.get(
+                "release_journal_path",
+                self.tenant_release_path(name, entry["id"]))
+            state = TenantState(
+                ledger=budget_accounting.TenantBudgetLedger(
+                    entry["id"], entry["total_epsilon"],
+                    entry["total_delta"],
+                    wal=journal_lib.FileReleaseJournal(
+                        self.tenant_ledger_path(name, entry["id"]))),
+                release_journal=journal_lib.FileReleaseJournal(
+                    release_path))
+            session._tenants[entry["id"]] = state
+        profiler.count_event(EVENT_OPENS)
+        return session

@@ -24,6 +24,28 @@ Modes (one per invocation: ``python kill_harness.py <mode> <workdir>``):
             spend journal; a second invocation must refuse the replay
             (BudgetAccountantError).
 
+Serving modes (ISSUE 10 — durable sessions; the SessionStore under
+``<workdir>/sessions``, a tenant with durable WAL journal + ledger):
+
+  serve_clean   — ingest, save, answer one tenant query from the saved
+                  session; prints the released columns (what the
+                  pre-kill session serves for this seed).
+  serve_prepare — ingest + save only (no query, no release).
+  serve_killed  — reopen the session from the store and run the same
+                  query with a scripted ``sigkill`` mid-replay: the
+                  process dies after the tenant charge was durably
+                  committed but before the release token was.
+  serve_resume  — reopen again, re-issue the query: released columns
+                  must be bit-identical to ``serve_clean``; also prints
+                  the tenant's durable ledger spend (the killed query's
+                  conservative charge survives).
+  serve_replay  — re-issue once more: the tenant's durable release
+                  journal must refuse the replayed token
+                  (DoubleReleaseError) — cross-restart at-most-once.
+
+Set ``PDP_KH_MESH=8`` to run the serving modes on an 8-device virtual
+mesh (the orchestrator also forces the XLA host-device-count flag).
+
 Marker lines on stdout (prefix ``HARNESS_``) carry the machine-readable
 outcome; everything else is free-form noise (JAX logs etc.).
 """
@@ -106,11 +128,82 @@ def _run_spend(workdir: str) -> None:
     print("HARNESS_SPEND_OK")
 
 
+def _serving_mesh():
+    if os.environ.get("PDP_KH_MESH") != "8":
+        return None
+    from pipelinedp_tpu.parallel import sharded
+    return sharded.make_mesh(8)
+
+
+def _serving_session(workdir: str, mode: str):
+    """The (store, session) pair of one serving-mode invocation:
+    ingest+save on the first touch of a workdir, reopen from the store
+    afterwards — so every post-prepare process exercises the real
+    re-hydration path."""
+    import numpy as np
+
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import serving
+
+    store = serving.SessionStore(os.path.join(workdir, "sessions"))
+    mesh = _serving_mesh()
+    if store.exists("kh-dataset"):
+        session = store.open("kh-dataset", mesh=mesh)
+    else:
+        pid, pk, value = _build_inputs()
+        session = serving.DatasetSession(
+            pdp.ColumnarData(pid=pid, pk=pk, value=value),
+            public_partitions=list(range(50)), mesh=mesh, n_chunks=8,
+            name="kh-dataset")
+        session.save(store)
+        # Durable-by-default on a store-bound session: WAL release
+        # journal + WAL ledger under <workdir>/sessions/kh-dataset/.
+        session.register_tenant("acme", total_epsilon=1e9,
+                                total_delta=1 - 1e-9)
+    return store, session
+
+
+def _run_serving(mode: str, workdir: str) -> None:
+    import numpy as np
+
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import runtime, serving
+
+    store, session = _serving_session(workdir, mode)
+    if mode == "serve_prepare":
+        print("HARNESS_SAVED " + session.fingerprint)
+        return
+    injector = None
+    if mode == "serve_killed":
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("sigkill", at_slab=0)])
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+        max_partitions_contributed=50,
+        max_contributions_per_partition=1_000,
+        min_value=0.0,
+        max_value=5.0)
+    try:
+        columns = session.query(params, epsilon=1.0, delta=1e-6, seed=3,
+                                tenant="acme", secure_host_noise=False,
+                                fault_injector=injector).to_columns()
+    except runtime.DoubleReleaseError:
+        print("HARNESS_DOUBLE_RELEASE")
+        return
+    ledger = session.tenant("acme").ledger
+    print(f"HARNESS_LEDGER {ledger.spent_epsilon:.6f}")
+    out = {name: np.asarray(col).tobytes().hex()
+           for name, col in sorted(columns.items())}
+    print("HARNESS_RESULT " + json.dumps({"mode": mode, "columns": out}))
+
+
 def main() -> None:
     mode, workdir = sys.argv[1], sys.argv[2]
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     if mode == "spend":
         _run_spend(workdir)
+    elif mode.startswith("serve_"):
+        _run_serving(mode, workdir)
     else:
         _run_engine(mode, workdir)
 

@@ -1,0 +1,581 @@
+"""Durable serving fleet tests (ISSUE 10; SERVING.md "Fleet operation").
+
+Contracts:
+  * Store round-trip — a session saved and reopened (same process or,
+    in tests/process_kill_test.py, after SIGKILL) serves warm queries
+    BIT-identical to the original session, single-device and mesh8;
+    corrupted wire payloads refuse to open, corrupted bound-cache
+    entries are dropped and recompute via kernel replay.
+  * Tenant durability — release journals and budget ledgers ride
+    fsync'd WALs under the store: cross-restart replays are refused and
+    spent budget stays spent.
+  * Exact refunds — a query that fails before its release token commits
+    refunds its tenant charge exactly (exhaust → refund → succeed), and
+    leaves the session, bound cache and journal unpoisoned.
+  * Fleet ladder — the SessionManager demotes LRU sessions
+    device → host → disk under one budget and re-hydrates on demand,
+    bit-identically.
+  * Overload — queries beyond the in-flight gate shed with a typed
+    SessionOverloadedError (never queue); a hung replay trips
+    QueryDeadlineError within its deadline; RESOURCE_EXHAUSTED on a
+    device-resident replay falls back to host shipping.
+  * Concurrency — a tenant hammer with shedding shows no cross-tenant
+    ledger or journal corruption.
+"""
+
+import glob
+import os
+import threading
+import time
+from unittest import mock
+
+import jax
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import profiler, runtime, serving
+from pipelinedp_tpu.ops import streaming
+from pipelinedp_tpu.parallel import sharded
+from pipelinedp_tpu.runtime import retry as retry_lib
+from pipelinedp_tpu.runtime import watchdog as watchdog_lib
+
+M = pdp.Metrics
+
+N_ROWS = 8_000
+N_USERS = 500
+N_PARTS = 32  # divides 8: the mesh pads nothing, mesh == single-device
+N_CHUNKS = 3
+
+
+@pytest.fixture(params=["single_device", "mesh8"], scope="module")
+def engine_mesh(request):
+    if request.param == "single_device":
+        return None
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return sharded.make_mesh(8)
+
+
+def make_columns(seed=0, n=N_ROWS, nparts=N_PARTS):
+    rng = np.random.default_rng(seed)
+    return pdp.ColumnarData(
+        pid=rng.integers(0, N_USERS, n).astype(np.int32),
+        pk=rng.integers(0, nparts, n).astype(np.int32),
+        value=rng.integers(1, 6, n).astype(np.float32))
+
+
+def count_sum_params(l0=8, linf=4):
+    return pdp.AggregateParams(metrics=[M.COUNT, M.SUM],
+                               max_partitions_contributed=l0,
+                               max_contributions_per_partition=linf,
+                               min_value=0.0,
+                               max_value=5.0)
+
+
+def assert_columns_identical(a: dict, b: dict):
+    assert list(a) == list(b)
+    for name in a:
+        np.testing.assert_array_equal(np.asarray(a[name]),
+                                      np.asarray(b[name]), err_msg=name)
+
+
+def q(session, seed, **kw):
+    kw.setdefault("epsilon", 1.0)
+    kw.setdefault("delta", 1e-6)
+    kw.setdefault("secure_host_noise", False)
+    return session.query(count_sum_params(), seed=seed, **kw).to_columns()
+
+
+class TestSessionStoreRoundTrip:
+    """save() / SessionStore.open() — reopened sessions are the same
+    session, bit for bit."""
+
+    def test_reopen_warm_parity(self, tmp_path, engine_mesh):
+        session = serving.DatasetSession(make_columns(), mesh=engine_mesh,
+                                         n_chunks=N_CHUNKS, name="rt")
+        want = q(session, seed=3)
+        store = serving.SessionStore(str(tmp_path))
+        session.save(store)
+        reopened = store.open("rt", mesh=engine_mesh)
+        got = q(reopened, seed=3)
+        assert_columns_identical(want, got)
+        # A seed the original session never ran matches too (full
+        # replay through the restored wire, not a cached result).
+        assert_columns_identical(q(session, seed=4), q(reopened, seed=4))
+
+    def test_reopen_preserves_identity_and_refuses_wrong_mesh(
+            self, tmp_path):
+        session = serving.DatasetSession(make_columns(), n_chunks=N_CHUNKS,
+                                         name="id")
+        store = serving.SessionStore(str(tmp_path))
+        session.save(store)
+        reopened = store.open("id")
+        assert reopened.fingerprint == session.fingerprint
+        assert reopened.n_chunks == session.n_chunks
+        assert reopened.num_partitions == session.num_partitions
+        if len(jax.devices()) >= 8:
+            with pytest.raises(ValueError, match="n_dev"):
+                store.open("id", mesh=sharded.make_mesh(8))
+
+    def test_string_partition_keys_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(1)
+        n = 2_000
+        data = pdp.ColumnarData(
+            pid=rng.integers(0, 200, n).astype(np.int32),
+            pk=np.array([f"key_{i % 10}" for i in range(n)]),
+            value=rng.integers(1, 6, n).astype(np.float32))
+        session = serving.DatasetSession(data, n_chunks=2, name="strkeys")
+        store = serving.SessionStore(str(tmp_path))
+        session.save(store)
+        reopened = store.open("strkeys")
+        assert reopened.pk_vocab.keys == session.pk_vocab.keys
+        a = session.query(count_sum_params(), epsilon=1.0, delta=1e-6,
+                          seed=2, secure_host_noise=False)
+        b = reopened.query(count_sum_params(), epsilon=1.0, delta=1e-6,
+                           seed=2, secure_host_noise=False)
+        assert a.partition_keys() == b.partition_keys()
+
+    def test_missing_session_and_store_listing(self, tmp_path):
+        store = serving.SessionStore(str(tmp_path))
+        with pytest.raises(serving.SessionNotFoundError):
+            store.open("nope")
+        session = serving.DatasetSession(make_columns(), n_chunks=N_CHUNKS,
+                                         name="listed")
+        session.save(store)
+        assert store.names() == ["listed"]
+        assert store.exists("listed")
+        store.delete("listed")
+        assert store.names() == []
+
+    def test_corrupted_wire_refuses_to_open(self, tmp_path):
+        session = serving.DatasetSession(make_columns(), n_chunks=N_CHUNKS,
+                                         name="corrupt")
+        store = serving.SessionStore(str(tmp_path))
+        session.save(store)
+        wire_path = os.path.join(store.path("corrupt"), "wire.npz")
+        blob = bytearray(open(wire_path, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(wire_path, "wb").write(bytes(blob))
+        with pytest.raises(serving.SessionCorruptError):
+            store.open("corrupt")
+
+    def test_corrupted_bound_entry_dropped_and_recomputed(self, tmp_path):
+        session = serving.DatasetSession(make_columns(), n_chunks=N_CHUNKS,
+                                         name="bc")
+        want = q(session, seed=3)  # populates the bound cache
+        store = serving.SessionStore(str(tmp_path))
+        session.save(store)
+        entries = glob.glob(os.path.join(store.path("bc"), "bound",
+                                         "*.npz"))
+        assert entries  # the cached accumulators were spilled
+        for path in entries:
+            with open(path, "r+b") as f:
+                f.seek(120)
+                f.write(b"\xff\xff\xff\xff")
+        before = profiler.event_count(serving.EVENT_BOUND_DROPPED)
+        reopened = store.open("bc")
+        assert (profiler.event_count(serving.EVENT_BOUND_DROPPED)
+                > before)
+        assert len(reopened._bound_cache) == 0
+        # The corrupted accumulators recompute via kernel replay —
+        # bit-identical, never wrong bits, never a crash.
+        assert_columns_identical(want, q(reopened, seed=3))
+
+    def test_save_requires_hydrated_session(self, tmp_path):
+        store = serving.SessionStore(str(tmp_path))
+        session = serving.DatasetSession(make_columns(), n_chunks=N_CHUNKS,
+                                         name="sp")
+        assert session.spill(store)
+        assert session.is_spilled
+        with pytest.raises(serving.SessionStoreError, match="spilled"):
+            store.save(session)
+        session.rehydrate()
+        assert not session.is_spilled
+
+
+class TestTenantDurability:
+    """Per-tenant WAL journals and ledgers reattach across restarts."""
+
+    def test_cross_restart_replay_refused_and_spend_survives(
+            self, tmp_path):
+        store = serving.SessionStore(str(tmp_path))
+        session = serving.DatasetSession(make_columns(), n_chunks=N_CHUNKS,
+                                         name="tenants")
+        session.register_tenant("acme", total_epsilon=3.0,
+                                total_delta=1e-5)
+        session.save(store)
+        want = q(session, seed=5, tenant="acme")
+        reopened = store.open("tenants")
+        state = reopened.tenant("acme")
+        assert state.ledger.spent_epsilon == pytest.approx(1.0)
+        assert len(state.release_journal) == 1
+        # Same tenant, same seed, across the "restart": refused before
+        # any noise is drawn — and the refused charge refunds exactly.
+        with pytest.raises(runtime.DoubleReleaseError):
+            q(reopened, seed=5, tenant="acme")
+        assert state.ledger.spent_epsilon == pytest.approx(1.0)
+        # A fresh seed is a fresh release, bit-identical across
+        # sessions of the same wire.
+        assert_columns_identical(q(session, seed=6, tenant="acme"),
+                                 q(reopened, seed=6, tenant="acme"))
+
+    def test_exhaustion_carries_across_reopen(self, tmp_path):
+        store = serving.SessionStore(str(tmp_path))
+        session = serving.DatasetSession(make_columns(), n_chunks=N_CHUNKS,
+                                         name="exh")
+        session.save(store)
+        session.register_tenant("acme", total_epsilon=1.0,
+                                total_delta=1e-6)
+        q(session, seed=1, tenant="acme")  # spends the whole budget
+        reopened = store.open("exh")
+        with pytest.raises(serving.BudgetExhaustedError):
+            q(reopened, seed=2, tenant="acme")
+
+    def test_migration_replays_refunds_in_place(self, tmp_path):
+        # A refunded charge freed budget a later charge reused; saving
+        # the session (which migrates the in-memory ledger onto a WAL)
+        # must replay that history without spuriously overdrawing.
+        session = serving.DatasetSession(make_columns(), n_chunks=N_CHUNKS,
+                                         name="mig")
+        session.register_tenant("acme", total_epsilon=1.0,
+                                total_delta=1e-6)
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("host_crash", at_slab=0)])
+        with pytest.raises(runtime.HostCrash):
+            q(session, seed=1, tenant="acme", fault_injector=injector)
+        q(session, seed=1, tenant="acme")  # reuses the refunded budget
+        store = serving.SessionStore(str(tmp_path))
+        session.save(store)  # must not raise BudgetExhaustedError
+        reopened = store.open("mig")
+        assert reopened.tenant("acme").ledger.spent_epsilon \
+            == pytest.approx(1.0)
+
+    def test_register_after_open_is_durable(self, tmp_path):
+        store = serving.SessionStore(str(tmp_path))
+        serving.DatasetSession(make_columns(), n_chunks=N_CHUNKS,
+                               name="late").save(store)
+        reopened = store.open("late")
+        reopened.register_tenant("newco", total_epsilon=2.0,
+                                 total_delta=1e-6)
+        # No save() in between: the registration was recorded in the
+        # manifest immediately, so a third process still sees it.
+        third = store.open("late")
+        assert third.tenant("newco").ledger.total_epsilon == 2.0
+
+
+class TestExactRefunds:
+    """Charge-before-run stays at-most-once; an uncommitted failure
+    refunds exactly."""
+
+    def test_exhaust_refund_succeed(self):
+        session = serving.DatasetSession(make_columns(), n_chunks=N_CHUNKS,
+                                         name="refund")
+        session.register_tenant("acme", total_epsilon=1.0,
+                                total_delta=1e-6)
+        state = session.tenant("acme")
+        cache_before = len(session._bound_cache)
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("host_crash", at_slab=0)])
+        # The failing charge takes the ENTIRE budget: only an exact
+        # refund lets the retry below fit.
+        with pytest.raises(runtime.HostCrash):
+            q(session, seed=5, tenant="acme", fault_injector=injector)
+        assert state.ledger.spent_epsilon == 0.0
+        assert state.ledger.spent_delta == 0.0
+        assert len(state.release_journal) == 0  # journal unpoisoned
+        assert len(session._bound_cache) == cache_before  # cache too
+        q(session, seed=5, tenant="acme")  # exhausts, exactly
+        assert state.ledger.spent_epsilon == pytest.approx(1.0)
+        with pytest.raises(serving.BudgetExhaustedError):
+            q(session, seed=6, tenant="acme")
+
+    def test_ledger_refund_invariants(self):
+        ledger = serving.TenantBudgetLedger("t", 2.0, 1e-6)
+        charge = ledger.charge(1.5, 0.0)
+        ledger.refund(charge)
+        assert ledger.spent_epsilon == 0.0
+        with pytest.raises(pdp.budget_accounting.BudgetAccountantError):
+            ledger.refund(charge)  # double refund
+        other = serving.TenantBudgetLedger("u", 2.0, 1e-6)
+        foreign = other.charge(1.0, 0.0)
+        with pytest.raises(pdp.budget_accounting.BudgetAccountantError):
+            ledger.refund(foreign)  # never committed here
+
+    def test_ledger_wal_roundtrip_with_refunds(self, tmp_path):
+        wal_path = str(tmp_path / "ledger.wal")
+        wal = runtime.FileReleaseJournal(wal_path)
+        ledger = serving.TenantBudgetLedger("t", 5.0, 0.0, wal=wal)
+        kept = ledger.charge(2.0, 0.0, note="kept")
+        refunded = ledger.charge(1.0, 0.0, note="refunded")
+        ledger.refund(refunded)
+        wal.close()
+        recovered = serving.TenantBudgetLedger(
+            "t", 5.0, 0.0, wal=runtime.FileReleaseJournal(wal_path))
+        assert recovered.spent_epsilon == pytest.approx(2.0)
+        assert recovered.charges[0].note == "kept"
+        assert recovered.refunded_indices == {refunded.index}
+
+    def test_batch_prepare_failure_refunds_earlier_configs(self):
+        session = serving.DatasetSession(make_columns(), n_chunks=N_CHUNKS,
+                                         name="batchref")
+        session.register_tenant("acme", total_epsilon=1.5,
+                                total_delta=1e-5)
+        state = session.tenant("acme")
+        cfg = dict(metrics=[M.COUNT], epsilon=1.0, delta=1e-6,
+                   max_partitions_contributed=8,
+                   max_contributions_per_partition=4, tenant="acme")
+        # Config 2's charge overdraws during preparation — config 1's
+        # already-committed charge must refund (its launch never ran).
+        with pytest.raises(serving.BudgetExhaustedError):
+            session.query_batch([serving.QueryConfig(seed=1, **cfg),
+                                 serving.QueryConfig(seed=2, **cfg)])
+        assert state.ledger.spent_epsilon == 0.0
+
+
+class TestManagerLadder:
+    """LRU demotion (device → host → disk) under one fleet budget."""
+
+    def test_demotion_spill_and_rehydration_parity(self, tmp_path):
+        store = serving.SessionStore(str(tmp_path))
+        # A 1-byte budget forces every admitted session down the full
+        # ladder as soon as another needs the space.
+        manager = serving.SessionManager(store, budget_bytes=1,
+                                         max_inflight=4)
+        manager.create("a", make_columns(1), n_chunks=N_CHUNKS)
+        manager.create("b", make_columns(2), n_chunks=N_CHUNKS)
+        counters = serving.fleet_counters(manager)
+        assert counters["demotions"] > 0
+        assert counters["sessions_spilled"] >= 1
+        # Querying the spilled LRU session re-hydrates it on demand —
+        # bit-identical to a never-spilled session over the same data.
+        want = q(serving.DatasetSession(make_columns(1), n_chunks=N_CHUNKS),
+                 seed=3)
+        before = profiler.event_count(serving.EVENT_REHYDRATIONS)
+        got = manager.query("a", count_sum_params(), epsilon=1.0,
+                            delta=1e-6, seed=3, secure_host_noise=False
+                            ).to_columns()
+        assert profiler.event_count(serving.EVENT_REHYDRATIONS) > before
+        assert_columns_identical(want, got)
+        manager.close()
+
+    def test_rich_budget_keeps_sessions_resident(self, tmp_path):
+        store = serving.SessionStore(str(tmp_path))
+        manager = serving.SessionManager(store, budget_bytes=1 << 30)
+        session = manager.create("only", make_columns(3),
+                                 n_chunks=N_CHUNKS)
+        assert not session.is_spilled
+        counters = serving.fleet_counters(manager)
+        assert counters["sessions_resident"] == 1
+        assert counters["sessions_spilled"] == 0
+        assert manager.get("only") is session
+        manager.remove("only")
+        with pytest.raises(KeyError):
+            manager.get("only")
+        session.close()
+
+    def test_attach_rejects_duplicate_names(self, tmp_path):
+        manager = serving.SessionManager(
+            serving.SessionStore(str(tmp_path)), budget_bytes=1 << 30)
+        manager.create("dup", make_columns(4), n_chunks=N_CHUNKS)
+        with pytest.raises(ValueError, match="already"):
+            manager.attach(serving.DatasetSession(
+                make_columns(5), n_chunks=N_CHUNKS, name="dup"))
+        manager.close()
+
+
+class TestAdmissionControl:
+    """The bounded in-flight gate sheds typed, never queues."""
+
+    def test_overload_sheds_typed_then_recovers(self, tmp_path):
+        manager = serving.SessionManager(
+            serving.SessionStore(str(tmp_path)), budget_bytes=1 << 30,
+            max_inflight=1)
+        session = manager.create("gate", make_columns(6),
+                                 n_chunks=N_CHUNKS)
+        q(session, seed=1)  # compile outside the timed window
+        release = threading.Event()
+        entered = threading.Event()
+        errors = []
+
+        orig = streaming._ResidentReplayPlacement.transfer
+
+        def blocking(placement, slab, s0, s1):
+            entered.set()
+            release.wait(timeout=30)
+            return orig(placement, slab, s0, s1)
+
+        def occupant():
+            try:
+                with mock.patch.object(streaming._ResidentReplayPlacement,
+                                       "transfer", blocking):
+                    q(session, seed=2)
+            except Exception as exc:  # surfaced to the main thread
+                errors.append(exc)
+
+        thread = threading.Thread(target=occupant)
+        thread.start()
+        assert entered.wait(timeout=30)
+        before = profiler.event_count(serving.EVENT_QUERIES)
+        shed_before = profiler.event_count(serving.EVENT_SHED)
+        with pytest.raises(serving.SessionOverloadedError):
+            q(session, seed=3)
+        assert profiler.event_count(serving.EVENT_SHED) == shed_before + 1
+        # Shed means shed: nothing ran, nothing queued.
+        assert profiler.event_count(serving.EVENT_QUERIES) == before
+        release.set()
+        thread.join(timeout=60)
+        assert not errors
+        # The gate freed: the same query now succeeds.
+        q(session, seed=3)
+        manager.close()
+
+    def test_shed_tenant_charge_refunds(self, tmp_path):
+        manager = serving.SessionManager(
+            serving.SessionStore(str(tmp_path)), budget_bytes=1 << 30,
+            max_inflight=1)
+        session = manager.create("gate2", make_columns(7),
+                                 n_chunks=N_CHUNKS)
+        session.register_tenant("acme", total_epsilon=10.0,
+                                total_delta=1e-5)
+        state = session.tenant("acme")
+        with manager.admission():  # fill the gate from this thread
+            with pytest.raises(serving.SessionOverloadedError):
+                q(session, seed=4, tenant="acme")
+        assert state.ledger.spent_epsilon == 0.0  # exact refund
+        manager.close()
+
+
+class TestQueryDeadlines:
+    """Per-query deadlines ride the DispatchWatchdog and the driver's
+    cooperative between-window check."""
+
+    def test_hung_replay_trips_deadline_within_budget(self):
+        session = serving.DatasetSession(make_columns(8),
+                                         n_chunks=N_CHUNKS, name="dl")
+        q(session, seed=1)  # compile first: the deadline times the hang
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("hang", at_slab=0, hang_s=30.0)])
+        before = profiler.event_count(serving.EVENT_DEADLINE_HITS)
+        t0 = time.monotonic()
+        with pytest.raises(serving.QueryDeadlineError):
+            q(session, seed=2, deadline_s=1.0, fault_injector=injector)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 10.0, f"deadline took {elapsed:.1f}s"
+        assert profiler.event_count(serving.EVENT_DEADLINE_HITS) \
+            == before + 1
+
+    def test_driver_cooperative_deadline_check(self):
+        # An already-expired Deadline in the resilience bundle trips at
+        # the first window boundary — no watchdog, no hang needed.
+        session = serving.DatasetSession(make_columns(9),
+                                         n_chunks=N_CHUNKS, name="coop")
+        resilience = runtime.StreamResilience(
+            deadline=runtime.Deadline.after(-1.0))
+        key = jax.random.PRNGKey(0)
+        with pytest.raises(serving.QueryDeadlineError):
+            streaming.replay_resident_wire(
+                key, session._wire, linf_cap=4, l0_cap=8,
+                row_clip_lo=0.0, row_clip_hi=5.0, middle=2.5,
+                group_clip_lo=0.0, group_clip_hi=20.0,
+                resilience=resilience)
+
+    def test_deadline_is_classified_retryable(self):
+        err = watchdog_lib.QueryDeadlineError("query", 1.0)
+        assert retry_lib.classify(err) == retry_lib.TRANSIENT
+
+    def test_deadline_keeps_tenant_charge_conservatively(self):
+        session = serving.DatasetSession(make_columns(10),
+                                         n_chunks=N_CHUNKS, name="dlt")
+        session.register_tenant("acme", total_epsilon=10.0,
+                                total_delta=1e-5)
+        state = session.tenant("acme")
+        injector = runtime.FaultInjector(
+            [runtime.FaultSpec("hang", at_slab=0, hang_s=15.0)])
+        with pytest.raises(serving.QueryDeadlineError):
+            q(session, seed=2, tenant="acme", deadline_s=0.5,
+              fault_injector=injector)
+        # The abandoned worker could still commit a release: the charge
+        # stays (err toward spent, never toward double-release).
+        assert state.ledger.spent_epsilon == pytest.approx(1.0)
+
+
+class TestDeviceOomFallback:
+    """RESOURCE_EXHAUSTED on a device-resident replay degrades to host
+    shipping instead of failing the query."""
+
+    def test_fallback_serves_bit_identical(self):
+        data = make_columns(11)
+        session = serving.DatasetSession(data, n_chunks=N_CHUNKS,
+                                         name="oom")
+        assert session._wire.device_resident
+        want = q(serving.DatasetSession(data, n_chunks=N_CHUNKS), seed=7)
+
+        orig = streaming._ResidentReplayPlacement.transfer
+
+        def oom_when_resident(placement, slab, s0, s1):
+            if placement._device_slab is not None:
+                raise RuntimeError(
+                    "RESOURCE_EXHAUSTED: out of device memory")
+            return orig(placement, slab, s0, s1)
+
+        before = profiler.event_count(serving.EVENT_DEVICE_FALLBACKS)
+        with mock.patch.object(streaming._ResidentReplayPlacement,
+                               "transfer", oom_when_resident):
+            got = q(session, seed=7)
+        assert profiler.event_count(serving.EVENT_DEVICE_FALLBACKS) \
+            == before + 1
+        assert not session._wire.device_resident
+        assert_columns_identical(want, got)
+
+
+class TestConcurrentTenantHammer:
+    """Shedding + concurrent tenants: every ledger and journal stays
+    exactly consistent with the set of successful queries."""
+
+    def test_no_cross_tenant_corruption_under_shedding(self, tmp_path):
+        manager = serving.SessionManager(
+            serving.SessionStore(str(tmp_path)), budget_bytes=1 << 30,
+            max_inflight=2)
+        session = manager.create("hammer", make_columns(12),
+                                 n_chunks=N_CHUNKS)
+        tenants = ["t0", "t1", "t2"]
+        for tid in tenants:
+            session.register_tenant(tid, total_epsilon=100.0,
+                                    total_delta=1e-3)
+        q(session, seed=999)  # compile up front
+        outcomes = {tid: {"ok": 0, "shed": 0} for tid in tenants}
+        outcome_lock = threading.Lock()
+        errors = []
+
+        def worker(tid, seed):
+            try:
+                q(session, seed=seed, tenant=tid)
+                with outcome_lock:
+                    outcomes[tid]["ok"] += 1
+            except serving.SessionOverloadedError:
+                with outcome_lock:
+                    outcomes[tid]["shed"] += 1
+            except Exception as exc:
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=worker, args=(tid, 100 * i + j))
+            for i, tid in enumerate(tenants) for j in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        total = sum(o["ok"] + o["shed"] for o in outcomes.values())
+        assert total == len(threads)
+        for tid in tenants:
+            state = session.tenant(tid)
+            # Ledger: exactly one epsilon per successful query (sheds
+            # refunded exactly); journal: exactly one release per
+            # successful query, none leaked across tenants.
+            assert state.ledger.spent_epsilon == pytest.approx(
+                float(outcomes[tid]["ok"]))
+            assert len(state.release_journal) == outcomes[tid]["ok"]
+        manager.close()
