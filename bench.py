@@ -42,6 +42,33 @@ CPU_ROWS = int(os.environ.get("BENCH_CPU_ROWS", 2_000_000))
 CPU_PARTITIONS = max(CPU_ROWS * N_PARTITIONS // N_ROWS, 1)
 
 
+def _trace_dir() -> str:
+    """Where per-row Chrome trace files land (BENCH_TRACE_DIR, default
+    a bench-traces dir under the system tmp)."""
+    import tempfile
+    path = os.environ.get("BENCH_TRACE_DIR")
+    if not path:
+        path = os.path.join(tempfile.gettempdir(), "pdp_bench_traces")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def _traced_run(label: str, fn):
+    """One EXTRA (untimed) execution of ``fn`` under a fresh tracer;
+    returns the written Chrome-trace path. Separate from the timed runs
+    so the published numbers stay tracing-free — the trace documents the
+    span structure of the row, not its timing."""
+    from pipelinedp_tpu.obs import trace as obs_trace
+
+    tracer = obs_trace.install(obs_trace.Tracer())
+    try:
+        fn()
+        return tracer.write_chrome(
+            os.path.join(_trace_dir(), f"{label}.json"))
+    finally:
+        obs_trace.shutdown()
+
+
 def _host_columns(seed=0):
     """Zipf-skewed partition popularity (movie-view-shaped): head partitions
     clear the private-selection threshold, the long tail is dropped.
@@ -113,7 +140,12 @@ def bench_e2e(pid, pk, value, n_runs=3):
     # the minimum is the honest sustained capability of the path.
     results = [run(i) for i in range(n_runs)]
     best_s, best_stages = min(results, key=lambda r: r[0])
-    return N_PARTITIONS / best_s, _coarse_phases(best_stages, best_s)
+    phases = _coarse_phases(best_stages, best_s)
+    try:
+        phases["trace_file"] = _traced_run("e2e", lambda: run(200))
+    except Exception as e:  # noqa: BLE001 — tracing never fails the row
+        phases["trace_error"] = f"{type(e).__name__}: {e}"[:120]
+    return N_PARTITIONS / best_s, phases
 
 
 def _coarse_phases(stages: dict, e2e_s: float) -> dict:
@@ -555,6 +587,26 @@ def bench_serving(pid, pk, value):
     out["warm_vs_cold"] = round(cold_s / min(warm_times), 2)
     out["per_query_epilogue_traces"] = traces
 
+    # Per-row trace (ISSUE 11): one extra (untimed) warm query exported
+    # through session.query(trace_path=) — the published Chrome trace
+    # shows the admission -> bound-cache/replay -> finalize span tree of
+    # the repeat-query serving shape.
+    try:
+        from pipelinedp_tpu.obs import trace as obs_trace
+        obs_trace.install(obs_trace.Tracer())
+        try:
+            trace_file = os.path.join(_trace_dir(), "serving_warm.json")
+            session.query(params, epsilon=EPS, delta=DELTA, seed=0,
+                          trace_path=trace_file).to_columns()
+            out["trace_file"] = trace_file
+        finally:
+            obs_trace.shutdown()
+    except Exception as e:  # noqa: BLE001 — tracing never fails the row
+        out["trace_error"] = f"{type(e).__name__}: {e}"[:120]
+    # This session's released-outcome audit slice (counts only — the
+    # row is trajectory data, not the trail itself).
+    out["audit_records"] = len(session.audit_trail)
+
     def batch_configs(width, base_seed):
         return [
             serving.QueryConfig(
@@ -682,6 +734,14 @@ def bench_cpu_baseline() -> float:
     return CPU_PARTITIONS / elapsed
 
 
+def _metrics_snapshot():
+    """The obs metrics-registry JSON snapshot (histograms arrive as
+    cumulative bucket counts + sum + count, the Prometheus shape)."""
+    from pipelinedp_tpu.obs import metrics as obs_metrics
+
+    return obs_metrics.default_registry().snapshot()
+
+
 def _resilience_counters():
     """Runtime resilience counters (retries, degradations, resumes,
     checkpoint_bytes, native_fallbacks, watchdog_timeouts,
@@ -796,6 +856,10 @@ def main():
         "host_cores": os.cpu_count(),
         "prefetch_depth": streaming_mod.prefetch_depth(),
         "resilience": _resilience_counters(),
+        # The full typed-metrics registry snapshot (ISSUE 11): every
+        # counter/gauge/histogram the run populated, plus the legacy
+        # event namespace — the same storage `to_prometheus()` scrapes.
+        "metrics": _metrics_snapshot(),
         **extra,
     }))
 

@@ -26,6 +26,8 @@ from typing import List, Optional
 
 from pipelinedp_tpu import input_validators
 from pipelinedp_tpu import pld as pld_lib
+from pipelinedp_tpu.obs import metrics as obs_metrics
+from pipelinedp_tpu.obs import trace as obs_trace
 from pipelinedp_tpu.aggregate_params import MechanismType
 
 Budget = collections.namedtuple("Budget", ["epsilon", "delta"])
@@ -192,7 +194,10 @@ class TenantBudgetLedger:
                     (self._KIND_CHARGE, record.index, record.epsilon,
                      record.delta, record.note), kind=self._KIND_CHARGE)
             self._charges.append(record)
-            return record
+        obs_metrics.default_registry().event_inc("serving/tenant_charges")
+        obs_trace.event("tenant_charge", epsilon=float(epsilon),
+                        delta=float(delta))
+        return record
 
     def refund(self, charge: LedgerCharge) -> None:
         """Exactly reverses one committed charge.
@@ -221,6 +226,8 @@ class TenantBudgetLedger:
                 self._wal.commit((self._KIND_REFUND, charge.index),
                                  kind=self._KIND_REFUND)
             self._refunded.add(charge.index)
+        obs_metrics.default_registry().event_inc("serving/tenant_refunds")
+        obs_trace.event("tenant_refund", epsilon=charge.epsilon)
 
     def make_accountant(self, epsilon: float, delta: float = 0.0,
                         note: str = "",

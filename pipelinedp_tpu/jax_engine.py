@@ -23,6 +23,7 @@ from __future__ import annotations
 import enum
 import functools
 import math
+import time
 from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
@@ -50,6 +51,8 @@ from pipelinedp_tpu import partition_selection as ps_lib
 from pipelinedp_tpu.report_generator import ExplainComputationReport
 from pipelinedp_tpu import noise_core
 from pipelinedp_tpu import profiler
+from pipelinedp_tpu.obs import metrics as obs_metrics
+from pipelinedp_tpu.obs import trace as obs_trace
 
 
 def _mechanism_noise_params(spec: budget_accounting.MechanismSpec,
@@ -1286,7 +1289,11 @@ class JaxDPEngine:
             is_public=is_public,
             num_partitions=num_partitions,
             max_rows_per_pid=max_rows_per_pid)
-        with profiler.stage("dp/finalize"):
+        t_fin0 = time.perf_counter()
+        with profiler.stage("dp/finalize"), \
+                obs_trace.span("engine/finalize",
+                               secure_host_noise=self._secure_host_noise,
+                               n_metrics=len(compound.combiners)):
             if self._secure_host_noise:
                 # One batched device→host transfer of every device-resident
                 # input; selection, noise and metric math then run in
@@ -1315,6 +1322,8 @@ class JaxDPEngine:
                 with profiler.stage("dp/finalize_transfer"):
                     metric_cols, keep = jax.device_get(
                         (device_cols, device_keep))
+        obs_metrics.finalize_seconds().observe(
+            time.perf_counter() - t_fin0)
         return finalize_ops.materialize(plan, scalars, metric_cols, keep,
                                         quantile_cols=quantile_cols)
 

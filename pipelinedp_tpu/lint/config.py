@@ -80,6 +80,15 @@ class LintConfig:
         "pipelinedp_tpu.lint.*",
     )
 
+    # DPL011 — telemetry-taint exemptions: the obs package itself (its
+    # job is building the records from already-validated scalars; the
+    # API-level check_safe_value gate plus its own tests are the
+    # control there) and the lint tree.
+    telemetry_taint_trusted: Tuple[str, ...] = (
+        "pipelinedp_tpu.obs.*",
+        "pipelinedp_tpu.lint.*",
+    )
+
     @staticmethod
     def _matches(module: str, patterns: Sequence[str]) -> bool:
         return any(fnmatch.fnmatch(module, p) for p in patterns)
@@ -95,6 +104,9 @@ class LintConfig:
 
     def is_release_taint_trusted(self, module: str) -> bool:
         return self._matches(module, self.release_taint_trusted)
+
+    def is_telemetry_taint_trusted(self, module: str) -> bool:
+        return self._matches(module, self.telemetry_taint_trusted)
 
 
 DEFAULT_CONFIG = LintConfig()

@@ -207,11 +207,14 @@ class ProjectFlow:
 
     # -- DPL007 exposure fixed point -----------------------------------------
 
-    def exposure(self, trusted: Callable[[str], bool]
+    def exposure(self, trusted: Callable[[str], bool],
+                 sink_kinds: FrozenSet[str] = frozenset({"sink"})
                  ) -> Dict[Tuple[str, str, FrozenSet[str]], bool]:
         """exposed[(func_qual, param, have_flags)] — can a value entering
-        ``param`` with ``have_flags`` already applied reach a host sink
-        without gaining the full {bound, noise} set?
+        ``param`` with ``have_flags`` already applied reach a sink of one
+        of ``sink_kinds`` ("sink" = host materialization for DPL007,
+        "obs" = telemetry record for DPL011) without gaining the full
+        {bound, noise} set?
 
         ``trusted(module)`` marks modules whose internals are exempt
         (the mechanism-primitive layer): their functions never expose.
@@ -230,8 +233,10 @@ class ProjectFlow:
             combined = have | frozenset(flow.gained)
             if combined == ALL_FLAGS:
                 return False
-            if flow.kind == "sink":
+            if flow.kind in sink_kinds:
                 return True
+            if flow.kind != "call":
+                return False
             callee = self.resolve(flow.detail, module)
             if callee is None or trusted(self.function_module[callee]):
                 return False
@@ -260,15 +265,17 @@ class ProjectFlow:
         self._flow_exposes = flow_exposes
         return exposed
 
-    def root_exposures(self, trusted: Callable[[str], bool]
+    def root_exposures(self, trusted: Callable[[str], bool],
+                       sink_kinds: FrozenSet[str] = frozenset({"sink"})
                        ) -> List[Tuple[str, TaintFlow]]:
         """(function qualname, flow) pairs where a private value that
-        *originates* in that function's parameters reaches a host sink
-        unsanitized — the DPL007 finding sites. A flow's ``gained``
-        already includes the origin parameter's base flags (e.g. ``accs``
-        parameters start contribution-bounded), so roots evaluate with no
-        extra incoming flags."""
-        self.exposure(trusted)
+        *originates* in that function's parameters reaches a sink of
+        ``sink_kinds`` unsanitized — the DPL007 ("sink") / DPL011
+        ("obs") finding sites. A flow's ``gained`` already includes the
+        origin parameter's base flags (e.g. ``accs`` parameters start
+        contribution-bounded), so roots evaluate with no extra incoming
+        flags."""
+        self.exposure(trusted, sink_kinds)
         out: List[Tuple[str, TaintFlow]] = []
         for qual, fsum in self.functions.items():
             module = self.function_module[qual]

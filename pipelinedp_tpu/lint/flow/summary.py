@@ -35,7 +35,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from pipelinedp_tpu.lint import astutils
 
-SUMMARY_VERSION = 1
+SUMMARY_VERSION = 2  # v2: DPL011 "obs" telemetry-sink flows
 
 # -- taint vocabulary (DPL007) ----------------------------------------------
 
@@ -64,6 +64,19 @@ NOISE_TARGET_RE = re.compile(
 # call on a tainted expression).
 SINK_TARGETS = frozenset({"jax.device_get"})
 SINK_METHOD = "tolist"
+
+# Telemetry sinks (DPL011): any obs.* record/span-attribute API.
+# Telemetry is operator-visible and outside the DP mechanism, so a
+# private value reaching one of these is a leak even when
+# contribution-bounded — only fully released (bounded AND noised)
+# aggregates may enter an obs record. Resolved ``pipelinedp_tpu.obs.*``
+# targets match by module; ``.set_attribute()`` / ``.add_event()`` /
+# ``.observe()`` / ``.record()`` match structurally (the obs objects —
+# spans, histograms, audit trails — are usually held in attributes the
+# resolver cannot type).
+OBS_TARGET_RE = re.compile(r"^pipelinedp_tpu\.obs\.")
+OBS_METHODS = frozenset({"set_attribute", "add_event", "observe",
+                         "record"})
 
 # Shape-preserving transforms: taint flows through unchanged.
 _PASSTHROUGH_RE = re.compile(r"^(?:numpy|jax\.numpy|jax\.lax)\.")
@@ -119,6 +132,8 @@ class TaintFlow:
 
     kind == "sink": a value originating in param ``origin`` reached the
     host sink ``detail`` at ``line`` having gained ``gained`` flags.
+    kind == "obs": same, but the sink is a telemetry record/attribute
+    API (DPL011) instead of a host materialization.
     kind == "call": the value was passed to project callee ``detail`` at
     positional ``arg_pos`` — exposure depends on the callee's summary.
     """
@@ -637,11 +652,28 @@ class _TaintWalker:
             if taint is not None and taint.gained != ALL_FLAGS:
                 self._sink(taint, node, ".tolist()")
             return None
+        # Telemetry record/attribute methods (DPL011): tainted arguments
+        # reaching a span/metric/audit API are an obs leak.
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in OBS_METHODS):
+            self._expr(node.func.value, state)
+            for taint in (self._expr(a, state) for a in arg_exprs):
+                if taint is not None and taint.gained != ALL_FLAGS:
+                    self._obs_sink(taint, node,
+                                   f".{node.func.attr}()")
+            return None
         arg_taints = [self._expr(a, state) for a in arg_exprs]
         if target in SINK_TARGETS:
             for taint in arg_taints:
                 if taint is not None and taint.gained != ALL_FLAGS:
                     self._sink(taint, node, target)
+            return None
+        if OBS_TARGET_RE.match(target):
+            # Resolved obs.* call (span attrs, event payloads, metric
+            # constructors): tainted args are an obs leak.
+            for taint in arg_taints:
+                if taint is not None and taint.gained != ALL_FLAGS:
+                    self._obs_sink(taint, node, target)
             return None
         merged = self._merge(arg_taints)
         if BOUND_TARGET_RE.search(target):
@@ -677,6 +709,11 @@ class _TaintWalker:
         self.flows.append(TaintFlow(
             origin=taint.origin, gained=tuple(sorted(taint.gained)),
             kind="sink", line=node.lineno, detail=sink))
+
+    def _obs_sink(self, taint: _Taint, node: ast.AST, sink: str) -> None:
+        self.flows.append(TaintFlow(
+            origin=taint.origin, gained=tuple(sorted(taint.gained)),
+            kind="obs", line=node.lineno, detail=sink))
 
 
 # ---------------------------------------------------------------------------

@@ -12,6 +12,7 @@ from pipelinedp_tpu.lint.rules.commit_before_draw import (
     CommitBeforeDrawRule,
 )
 from pipelinedp_tpu.lint.rules.donated_reuse import DonatedReuseRule
+from pipelinedp_tpu.lint.rules.telemetry_taint import TelemetryTaintRule
 
 ALL_RULES = (
     KeyReuseRule,
@@ -24,6 +25,7 @@ ALL_RULES = (
     ThreadEscapeRule,
     CommitBeforeDrawRule,
     DonatedReuseRule,
+    TelemetryTaintRule,
 )
 
 __all__ = [cls.__name__ for cls in ALL_RULES] + ["ALL_RULES"]

@@ -1,0 +1,70 @@
+"""DPL011 — telemetry-taint: private data reaching an obs record.
+
+Telemetry (pipelinedp_tpu/obs/: span attributes, metric observations
+and labels, audit-record fields) is operator-visible and sits OUTSIDE
+the DP mechanism — nothing written there is noise-protected or budget-
+accounted. The hard rule (OBSERVABILITY.md "DP-safety stance") is that
+raw privacy ids, partition keys, and unreleased (pre-noise) values
+never enter any obs record; only operational aggregates and fully
+released (bounded AND noised) statistics may.
+
+dpflow tracks values originating in private-column parameters (``pid``
+/ ``pk`` / ``value`` raw; ``accs`` / ``qhist`` accumulators, which are
+bounded but still pre-noise) through assignments, transforms and
+project call chains, and flags any path that reaches an ``obs.*`` API —
+a resolved ``pipelinedp_tpu.obs.*`` call, or a structural
+``.set_attribute()`` / ``.add_event()`` / ``.observe()`` / ``.record()``
+method — while missing either sanitization flag. Note the asymmetry
+with DPL007: contribution bounding alone is NOT enough here; a bounded
+but un-noised per-partition aggregate in a span attribute is exactly
+the leak this rule exists to catch.
+
+The runtime twin of this rule is ``obs.metrics.check_safe_value`` (the
+API refuses forbidden keys and non-scalar payloads at call time); the
+serving test matrix scans every emitted record dynamically. DPL011 is
+the shift-left layer: the flow never ships.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from pipelinedp_tpu.lint.engine import Finding, ProjectContext, ProjectRule
+from pipelinedp_tpu.lint.flow.summary import FLAG_NOISE
+
+
+class TelemetryTaintRule(ProjectRule):
+    rule_id = "DPL011"
+    name = "telemetry-taint"
+    description = ("A private input column (or pre-noise accumulator) "
+                   "flows into an obs.* span attribute, metric "
+                   "observation, or audit-record field.")
+    hint = ("Telemetry may carry operational aggregates and RELEASED "
+            "statistics only. Record a count/timing derived from the "
+            "DP output (post-noise, post-selection), or drop the field; "
+            "never attach pids, partition keys, or pre-noise "
+            "accumulator values to a span, metric, or audit record.")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        flow = project.flow
+        trusted = project.config.is_telemetry_taint_trusted
+        findings: List[Finding] = []
+        for qual, tf in flow.root_exposures(trusted,
+                                            sink_kinds=frozenset({"obs"})):
+            module = flow.function_module[qual]
+            func = qual[len(module) + 1:]
+            if tf.kind == "obs":
+                what = f"enters the obs record API `{tf.detail}`"
+            else:
+                callee = tf.detail.split(".")[-1]
+                what = (f"is handed to `{callee}` which records it into "
+                        f"telemetry")
+            note = ("" if FLAG_NOISE in tf.gained else
+                    " before any noise mechanism")
+            findings.append(Finding(
+                self.rule_id, project.relpath_of(module), tf.line, 1,
+                f"private value `{tf.origin}` in `{func}` {what}{note} — "
+                f"telemetry is outside the DP mechanism and must never "
+                f"carry unreleased data",
+                self.hint))
+        return findings

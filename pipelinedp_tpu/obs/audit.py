@@ -1,0 +1,219 @@
+"""DP-safe release audit trail: exactly which releases was tenant X
+charged for?
+
+Every query a :class:`~pipelinedp_tpu.serving.session.DatasetSession`
+finishes — successfully or not — appends one :class:`AuditRecord`: the
+release token it committed (or would have), the mechanism kinds and
+(ε, δ) charged, DP-released partition counts, timing, and a typed
+outcome. The trail is the operator's ground truth for budget disputes
+("show me every release acme paid for") and incident forensics ("what
+did the fleet do between 14:02 and 14:07") — per tenant, append-only,
+and durable when the session is store-bound.
+
+Durability rides the same fsync'd WAL machinery as the release journal
+(:class:`~pipelinedp_tpu.runtime.journal.JsonlWal`): write-ahead
+appends with per-record digests, torn-tail truncation on recovery,
+typed refusal on interior corruption, so the trail a SIGKILL'd process
+left behind replays exactly on reopen (tests/process_kill_test.py pins
+this through the kill harness). A query that died before its outcome
+was decided leaves NO record — the trail errs toward under-reporting
+in-flight work, never toward inventing outcomes.
+
+Outcomes (:data:`OUTCOMES`):
+
+  * ``released`` — the release token committed and the columns went out.
+  * ``refunded`` — the query failed before its token committed; any
+    tenant charge was exactly refunded.
+  * ``shed`` — admission control refused the query (typed overload).
+  * ``deadline-expired`` — the per-query deadline fired; the charge is
+    conservatively kept (the abandoned worker may still commit).
+  * ``double-release-refused`` — the at-most-once journal refused a
+    replayed token before any noise was drawn.
+
+DP-safety stance (the hard rule, OBSERVABILITY.md): an audit record
+carries *mechanism metadata and DP-released aggregates only*. Raw
+privacy ids, partition keys, and unreleased (pre-noise) values are
+refused at the API — the schema is FIXED (no free-form payloads), every
+field value is validated scalar, and ``partitions_kept`` /
+``partitions_dropped`` are counts of the *noised, selection-filtered*
+output, i.e. already-released information. dplint DPL011 flags private
+columns flowing into this module statically; the serving test matrix
+scans every emitted record dynamically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from pipelinedp_tpu.obs import metrics as metrics_lib
+
+# Profiler event counters (kept importable without the profiler: these
+# are credited through metrics_lib.default_registry() directly).
+EVENT_AUDIT_RECORDS = "obs/audit_records"
+EVENT_AUDIT_RECOVERIES = "obs/audit_recoveries"
+
+OUTCOMES = frozenset({
+    "released", "refunded", "shed", "deadline-expired",
+    "double-release-refused",
+})
+
+
+class AuditCorruptError(RuntimeError):
+    """The audit WAL holds a malformed interior record — the trail
+    cannot be trusted, so recovery refuses rather than silently
+    forgetting a committed outcome."""
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditRecord:
+    """One query outcome, in commit order. ``token`` is the canonical
+    release token string (root-key fingerprint + KeyStream counter) —
+    the same identity the at-most-once journal refuses replays by."""
+    seq: int
+    ts_unix: float
+    session: str
+    tenant: Optional[str]
+    token: str
+    outcome: str
+    mechanisms: Tuple[str, ...]
+    noise_kind: str
+    epsilon: float
+    delta: float
+    partitions_kept: int
+    partitions_dropped: int
+    duration_s: float
+    seed: int
+
+    def to_payload(self) -> dict:
+        out = dataclasses.asdict(self)
+        out["mechanisms"] = list(self.mechanisms)
+        return out
+
+    @staticmethod
+    def from_payload(payload: dict) -> "AuditRecord":
+        return AuditRecord(
+            seq=int(payload["seq"]),
+            ts_unix=float(payload["ts_unix"]),
+            session=payload["session"],
+            tenant=payload["tenant"],
+            token=payload["token"],
+            outcome=payload["outcome"],
+            mechanisms=tuple(payload["mechanisms"]),
+            noise_kind=payload["noise_kind"],
+            epsilon=float(payload["epsilon"]),
+            delta=float(payload["delta"]),
+            partitions_kept=int(payload["partitions_kept"]),
+            partitions_dropped=int(payload["partitions_dropped"]),
+            duration_s=float(payload["duration_s"]),
+            seed=int(payload["seed"]),
+        )
+
+
+class AuditTrail:
+    """Append-only per-session outcome log (module docstring).
+
+    ``path=None`` keeps the trail in memory (dies with the process —
+    fine for ad-hoc sessions); a path makes it a durable
+    :class:`~pipelinedp_tpu.runtime.journal.JsonlWal`.
+    :meth:`bind` upgrades an in-memory trail in place when a session
+    becomes store-bound, replaying the already-recorded outcomes onto
+    the WAL so nothing is lost at the save boundary.
+    """
+
+    def __init__(self, path: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._records: List[AuditRecord] = []
+        self._wal = None
+        if path is not None:
+            self._open_wal(path)
+
+    def _open_wal(self, path: str) -> None:
+        from pipelinedp_tpu.runtime import journal as journal_lib
+        self._wal = journal_lib.JsonlWal(
+            path, corrupt_error=AuditCorruptError)
+        recovered = [AuditRecord.from_payload(p)
+                     for p in self._wal.recovered]
+        self._records = recovered + self._records
+        if recovered:
+            metrics_lib.default_registry().event_inc(
+                EVENT_AUDIT_RECOVERIES)
+
+    @property
+    def path(self) -> Optional[str]:
+        return self._wal.path if self._wal is not None else None
+
+    @property
+    def durable(self) -> bool:
+        return self._wal is not None
+
+    def bind(self, path: str) -> None:
+        """Makes the trail durable at ``path``: recovers whatever a
+        previous process committed there, then appends this trail's
+        in-memory records after it (re-sequenced). Idempotent for an
+        already-durable trail."""
+        with self._lock:
+            if self._wal is not None:
+                return
+            pending = self._records
+            self._records = []
+            self._open_wal(path)
+            for record in pending:
+                self._append_locked(record)
+
+    def _append_locked(self, record: AuditRecord) -> AuditRecord:
+        record = dataclasses.replace(record, seq=len(self._records))
+        if self._wal is not None:
+            self._wal.append(record.to_payload())
+        self._records.append(record)
+        return record
+
+    def record(self, *, session: str, tenant: Optional[str], token: str,
+               outcome: str, mechanisms, noise_kind: str,
+               epsilon: float, delta: float, partitions_kept: int,
+               partitions_dropped: int, duration_s: float,
+               seed: int) -> AuditRecord:
+        """Appends one outcome. The schema is closed — there is no
+        free-form field, so nothing data-shaped can ride along — and
+        every value passes the shared obs payload gate."""
+        if outcome not in OUTCOMES:
+            raise ValueError(
+                f"unknown audit outcome {outcome!r}; expected one of "
+                f"{sorted(OUTCOMES)}")
+        mechanisms = tuple(str(m) for m in mechanisms)
+        fields = {
+            "session": session, "tenant": tenant, "token": str(token),
+            "noise_kind": str(noise_kind), "epsilon": float(epsilon),
+            "delta": float(delta),
+            "partitions_kept": int(partitions_kept),
+            "partitions_dropped": int(partitions_dropped),
+            "duration_s": float(duration_s), "seed": int(seed),
+        }
+        for key, value in fields.items():
+            metrics_lib.check_safe_value(key, value)
+        record = AuditRecord(
+            seq=-1, ts_unix=time.time(), outcome=outcome,
+            mechanisms=mechanisms, **fields)
+        with self._lock:
+            record = self._append_locked(record)
+        metrics_lib.default_registry().event_inc(EVENT_AUDIT_RECORDS)
+        return record
+
+    def records(self, tenant: Optional[str] = None
+                ) -> Tuple[AuditRecord, ...]:
+        """The trail in commit order (optionally one tenant's slice)."""
+        with self._lock:
+            if tenant is None:
+                return tuple(self._records)
+            return tuple(r for r in self._records if r.tenant == tenant)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._wal is not None:
+                self._wal.close()

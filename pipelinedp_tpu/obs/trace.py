@@ -1,0 +1,300 @@
+"""Span tracing: why was THIS query slow?
+
+A :class:`Tracer` records a tree of timed spans with explicit parent
+links — admission → replay → per-window encode/transfer/dispatch/sync →
+finalize — plus point events (retry, degrade, watchdog timeout, resume)
+attached to the span they happened under. The export is Chrome
+trace-event JSON (``ph: "X"`` complete events + ``ph: "i"`` instants),
+loadable directly in Perfetto / ``chrome://tracing``, per process
+(:meth:`Tracer.export_chrome`) or per query (filter by the root span's
+``trace_id``; ``DatasetSession.query(trace_path=...)`` does this).
+
+Zero cost when disabled — the design constraint that lets the
+instrumentation live permanently in the slab driver and the serving hot
+path: with no tracer installed, :func:`span` returns one shared
+null context and :func:`event` returns immediately; no dict, no clock
+read, no lock. Released values are bit-identical with tracing on or
+off (spans read clocks, never data or keys; pinned by
+tests/obs_serving_test.py).
+
+Enabling: install programmatically (``trace.install(trace.Tracer())``)
+or set ``PIPELINEDP_TPU_TRACE=<path>`` — a tracer is installed at
+import and the process trace is written to ``<path>`` at exit (a
+directory gets ``trace_<pid>.json`` inside it).
+
+Cross-thread spans: the current span is thread-local; worker threads
+(watchdog query runner, slab prefetch pool) join their parent's tree
+with ``with trace.attach(parent_span):`` — the same handoff shape as
+``profiler.adopt_sinks``.
+
+DP-safety: span names are static strings; attribute and event payloads
+go through :func:`~pipelinedp_tpu.obs.metrics.check_safe_value` — raw
+pids, partition keys, pre-noise values and any array are refused at the
+API (TelemetryLeakError), and dplint DPL011 flags offending call sites
+statically.
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import contextlib
+import dataclasses
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Deque, Dict, List, Optional, Tuple
+
+from pipelinedp_tpu.obs import metrics as metrics_lib
+
+TRACE_ENV = "PIPELINEDP_TPU_TRACE"
+
+# Bounded finished-span buffer: a long-lived serving process must not
+# grow its trace without bound; the newest spans win (the ones an
+# operator debugging "why was that query slow" wants).
+MAX_SPANS = 200_000
+
+
+@dataclasses.dataclass
+class Span:
+    """One finished (or in-flight) span. Times are perf_counter_ns."""
+    name: str
+    span_id: int
+    parent_id: Optional[int]
+    trace_id: int
+    thread_id: int
+    t0_ns: int
+    dur_ns: int = -1  # -1 while in flight
+    attrs: Dict[str, object] = dataclasses.field(default_factory=dict)
+    events: List[Tuple[str, int, Dict[str, object]]] = dataclasses.field(
+        default_factory=list)
+
+    def set_attribute(self, key: str, value) -> None:
+        metrics_lib.check_safe_value(key, value)
+        self.attrs[key] = value
+
+    def add_event(self, name: str, **attrs) -> None:
+        for k, v in attrs.items():
+            metrics_lib.check_safe_value(k, v)
+        self.events.append((name, time.perf_counter_ns(), dict(attrs)))
+
+
+class _SpanCtx:
+    """Context manager entering ``span`` as the thread's current span
+    and finishing it on exit."""
+
+    __slots__ = ("_tracer", "_span")
+
+    def __init__(self, tracer: "Tracer", span: Span):
+        self._tracer = tracer
+        self._span = span
+
+    def __enter__(self) -> Span:
+        self._tracer._push(self._span)
+        return self._span
+
+    def __exit__(self, exc_type, exc_val, exc_tb) -> None:
+        self._tracer._pop_finish(self._span, failed=exc_type is not None)
+
+
+class Tracer:
+    """Thread-safe span recorder (module docstring)."""
+
+    def __init__(self, max_spans: int = MAX_SPANS):
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._tls = threading.local()
+        self._finished: Deque[Span] = collections.deque(maxlen=max_spans)
+
+    # -- span lifecycle ---------------------------------------------------
+
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def current(self) -> Optional[Span]:
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1] if stack else None
+
+    def span(self, name: str, parent: Optional[Span] = None,
+             **attrs) -> _SpanCtx:
+        """A new span under ``parent`` (default: this thread's current
+        span; None makes a root). Use as a context manager."""
+        for k, v in attrs.items():
+            metrics_lib.check_safe_value(k, v)
+        if parent is None:
+            parent = self.current()
+        with self._lock:
+            span_id = next(self._ids)
+        sp = Span(
+            name=name, span_id=span_id,
+            parent_id=parent.span_id if parent is not None else None,
+            trace_id=parent.trace_id if parent is not None else span_id,
+            thread_id=threading.get_ident(),
+            t0_ns=time.perf_counter_ns(), attrs=dict(attrs))
+        return _SpanCtx(self, sp)
+
+    def _push(self, span: Span) -> None:
+        self._stack().append(span)
+
+    def _pop_finish(self, span: Span, failed: bool) -> None:
+        span.dur_ns = time.perf_counter_ns() - span.t0_ns
+        if failed:
+            span.attrs.setdefault("error", True)
+        stack = self._stack()
+        if stack and stack[-1] is span:
+            stack.pop()
+        else:  # defensive: unbalanced exit never corrupts other spans
+            try:
+                stack.remove(span)
+            except ValueError:
+                pass
+        with self._lock:
+            self._finished.append(span)
+
+    def event(self, name: str, **attrs) -> None:
+        """Attaches a point event to the current span (dropped when no
+        span is open — events without context have no tree to hang on)."""
+        cur = self.current()
+        if cur is not None:
+            cur.add_event(name, **attrs)
+
+    @contextlib.contextmanager
+    def attach(self, parent: Optional[Span]):
+        """Installs ``parent`` as this thread's current span so spans
+        opened here join the parent's tree (cross-thread handoff)."""
+        if parent is None:
+            yield
+            return
+        stack = self._stack()
+        stack.append(parent)
+        try:
+            yield
+        finally:
+            if stack and stack[-1] is parent:
+                stack.pop()
+
+    # -- export -----------------------------------------------------------
+
+    def spans(self, trace_id: Optional[int] = None) -> List[Span]:
+        with self._lock:
+            out = list(self._finished)
+        if trace_id is not None:
+            out = [s for s in out if s.trace_id == trace_id]
+        return out
+
+    def export_chrome(self, trace_id: Optional[int] = None) -> dict:
+        """Chrome trace-event JSON object (Perfetto-loadable):
+        ``{"traceEvents": [...], "displayTimeUnit": "ms"}``. Span
+        timestamps are microseconds from an arbitrary epoch; parent
+        links ride ``args.span_id`` / ``args.parent_id``."""
+        pid = os.getpid()
+        events = []
+        for s in self.spans(trace_id):
+            args = {"span_id": s.span_id, "trace_id": s.trace_id}
+            if s.parent_id is not None:
+                args["parent_id"] = s.parent_id
+            args.update(s.attrs)
+            events.append({
+                "name": s.name, "ph": "X", "pid": pid,
+                "tid": s.thread_id, "ts": s.t0_ns / 1000.0,
+                "dur": max(s.dur_ns, 0) / 1000.0, "args": args,
+            })
+            for ev_name, ts_ns, ev_attrs in s.events:
+                events.append({
+                    "name": ev_name, "ph": "i", "s": "t", "pid": pid,
+                    "tid": s.thread_id, "ts": ts_ns / 1000.0,
+                    "args": dict(ev_attrs, span_id=s.span_id),
+                })
+        events.sort(key=lambda e: e["ts"])
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def write_chrome(self, path: str,
+                     trace_id: Optional[int] = None) -> str:
+        """Writes the Chrome trace JSON to ``path`` (a directory gets
+        ``trace_<pid>.json`` inside it); returns the file path."""
+        if os.path.isdir(path):
+            path = os.path.join(path, f"trace_{os.getpid()}.json")
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.export_chrome(trace_id), f)
+        return path
+
+    def reset(self) -> None:
+        with self._lock:
+            self._finished.clear()
+
+
+# -- the process-global tracer ----------------------------------------------
+
+_active: Optional[Tracer] = None
+_NULL_CTX = contextlib.nullcontext(None)
+
+
+def install(tracer: Optional[Tracer] = None) -> Tracer:
+    """Installs (and returns) the process tracer; spans start recording
+    on every instrumented path."""
+    global _active
+    if tracer is None:
+        tracer = Tracer()
+    _active = tracer
+    return tracer
+
+
+def shutdown() -> None:
+    """Uninstalls the process tracer; span()/event() return to no-ops."""
+    global _active
+    _active = None
+
+
+def active() -> Optional[Tracer]:
+    return _active
+
+
+def enabled() -> bool:
+    return _active is not None
+
+
+def span(name: str, parent: Optional[Span] = None, **attrs):
+    """Module-level span entry: a real span ctx when a tracer is
+    installed, the shared null context (zero cost) otherwise."""
+    t = _active
+    if t is None:
+        return _NULL_CTX
+    return t.span(name, parent=parent, **attrs)
+
+
+def event(name: str, **attrs) -> None:
+    t = _active
+    if t is not None:
+        t.event(name, **attrs)
+
+
+def current() -> Optional[Span]:
+    t = _active
+    return t.current() if t is not None else None
+
+
+def attach(parent: Optional[Span]):
+    t = _active
+    if t is None or parent is None:
+        return _NULL_CTX
+    return t.attach(parent)
+
+
+def _init_from_env() -> None:
+    path = os.environ.get(TRACE_ENV, "")
+    if not path or path == "0":
+        return
+    tracer = install()
+    if path != "1":
+        atexit.register(lambda: tracer.write_chrome(path))
+
+
+_init_from_env()

@@ -57,6 +57,7 @@ from pipelinedp_tpu import dp_computations
 from pipelinedp_tpu import noise_core
 from pipelinedp_tpu import partition_selection as ps_lib
 from pipelinedp_tpu import profiler
+from pipelinedp_tpu.obs import trace as obs_trace
 from pipelinedp_tpu.aggregate_params import NoiseKind
 from pipelinedp_tpu.ops import noise as noise_ops
 from pipelinedp_tpu.ops import selection as selection_ops
@@ -740,6 +741,10 @@ class EpilogueCache:
                         self._max_entries * self._SIGS_PER_ENTRY):
                     self._seen_signatures.popitem(last=False)
                 profiler.count_event(_CACHE_MISS_EVENT)
+                # A miss on the serving path usually means a retrace is
+                # about to happen — exactly the "why was THIS query
+                # slow" evidence a span wants.
+                obs_trace.event("epilogue_cache_miss")
             fn = self._executables.get(key)
             if fn is None:
                 if builder is not None:

@@ -40,6 +40,7 @@ import numpy as np
 
 from pipelinedp_tpu.ops import columnar, wirecodec
 from pipelinedp_tpu import profiler
+from pipelinedp_tpu.obs import trace as obs_trace
 from pipelinedp_tpu.runtime import driver as driver_lib
 
 # Knuth multiplicative hash so that structured pid spaces (all-even ids,
@@ -1424,6 +1425,8 @@ def replay_resident_wire(key: jax.Array,
         accs, qhist = _zero_accs(num_partitions, quantile_spec)
         return (accs, qhist) if quantile_spec is not None else accs
     profiler.count_event(EVENT_SERVING_REPLAYS)
+    obs_trace.event("wire_replay", n_chunks=wire.n_chunks,
+                    device_resident=wire.device_resident)
     fmt, int_clip, sort_stats = finish_wire_plan(
         wire.fmt, segment_sort, wire.max_run,
         num_partitions=num_partitions, row_clip_lo=row_clip_lo,

@@ -934,6 +934,8 @@ def replay_resident_wire(mesh: Mesh,
             *(jax.device_put(np.zeros(padded_p, np.float32), part_sharding)
               for _ in range(5)))
     profiler.count_event(streaming.EVENT_SERVING_REPLAYS)
+    from pipelinedp_tpu.obs import trace as obs_trace
+    obs_trace.event("wire_replay", n_chunks=wire.n_chunks, n_dev=n_dev)
     fmt, int_clip, sort_stats = streaming.finish_wire_plan(
         wire.fmt, segment_sort, wire.max_run, num_partitions=padded_p,
         row_clip_lo=row_clip_lo, row_clip_hi=row_clip_hi,

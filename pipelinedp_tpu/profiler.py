@@ -24,7 +24,8 @@ into this module from pool threads, so entry points are classified:
   * **pool-safe**: ``stage()``, ``current_sinks()``, ``adopt_sinks()``,
     ``count_event()``, ``event_count()``, ``event_counts()`` — sink
     mutation funnels through ``_add_stage_time`` under ``_sink_lock``,
-    counters through ``_counter_lock``, and the sink *list* is
+    counters through the obs metrics registry's single lock, and the
+    sink *list* is
     thread-local (``adopt_sinks`` installs the parent's collectors into
     the worker's own ``_collect`` slot, never sharing the list object
     across threads).
@@ -48,6 +49,8 @@ import time
 from typing import Dict, Iterator, Optional
 
 import jax
+
+from pipelinedp_tpu.obs import metrics as obs_metrics
 
 # Active wall-clock stage collectors (see collect_stage_times). Thread-local
 # so concurrent engines don't interleave their phase budgets; worker pools
@@ -108,27 +111,30 @@ def adopt_sinks(sinks) -> "Iterator[None]":
 # them to count epilogue retraces and executable-cache hits). Unlike stage
 # times these are process-global — a retrace is a property of the jit
 # caches, which are shared across engines and threads.
-_counter_lock = threading.Lock()
-_counters: Dict[str, int] = {}
+#
+# Since PR 11 these are back-compat shims over the typed metrics
+# registry (pipelinedp_tpu/obs/metrics.py, the "events" namespace):
+# every historical caller keeps working, and the same storage feeds the
+# Prometheus exposition and JSON snapshot exporters. The registry runs
+# every event operation under ONE lock, so reset_events(prefix) racing
+# count_event from prefetch/watchdog threads can never lose an
+# increment to a detached counter (pinned by the obs hammer tests).
 
 
 def count_event(name: str, n: int = 1) -> None:
     """Increments a named global counter (e.g. one per jit trace).
-    Pool-safe: guarded by _counter_lock."""
-    with _counter_lock:
-        _counters[name] = _counters.get(name, 0) + n
+    Pool-safe: atomic under the obs metrics-registry lock."""
+    obs_metrics.default_registry().event_inc(name, n)
 
 
 def event_count(name: str) -> int:
     """Current value of a named counter (0 if never incremented)."""
-    with _counter_lock:
-        return _counters.get(name, 0)
+    return obs_metrics.default_registry().event_value(name)
 
 
 def event_counts() -> Dict[str, int]:
     """Snapshot of all named counters."""
-    with _counter_lock:
-        return dict(_counters)
+    return obs_metrics.default_registry().event_values()
 
 
 def reset_events(prefix: Optional[str] = None) -> None:
@@ -136,14 +142,10 @@ def reset_events(prefix: Optional[str] = None) -> None:
 
     Test/bench plumbing: counters are process-global, so suites that
     assert on deltas (e.g. the runtime/* resilience counters) reset their
-    slice first instead of bookkeeping baselines.
+    slice first instead of bookkeeping baselines. Atomic with respect to
+    concurrent count_event calls (same registry lock).
     """
-    with _counter_lock:
-        if prefix is None:
-            _counters.clear()
-        else:
-            for name in [n for n in _counters if n.startswith(prefix)]:
-                del _counters[name]
+    obs_metrics.default_registry().reset_events(prefix)
 
 
 @contextlib.contextmanager

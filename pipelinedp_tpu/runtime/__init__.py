@@ -68,7 +68,8 @@ from pipelinedp_tpu.runtime.faults import (  # noqa: F401
     InjectedOom, InjectedTransferError)
 from pipelinedp_tpu.runtime.journal import (  # noqa: F401
     EVENT_JOURNAL_BYTES, EVENT_JOURNAL_RECOVERIES, DoubleReleaseError,
-    FileReleaseJournal, JournalCorruptError, ReleaseJournal, ReleaseRecord)
+    FileReleaseJournal, JournalCorruptError, JsonlWal, ReleaseJournal,
+    ReleaseRecord)
 from pipelinedp_tpu.runtime.retry import RetryPolicy, classify  # noqa: F401
 from pipelinedp_tpu.runtime.watchdog import (  # noqa: F401
     EVENT_WATCHDOG_TIMEOUTS, Deadline, DispatchHangError, DispatchWatchdog,

@@ -54,6 +54,8 @@ import dataclasses
 from typing import Any, Callable, List, Optional, Tuple
 
 from pipelinedp_tpu import profiler
+from pipelinedp_tpu.obs import metrics as obs_metrics
+from pipelinedp_tpu.obs import trace as obs_trace
 from pipelinedp_tpu.runtime import checkpoint as checkpoint_lib
 from pipelinedp_tpu.runtime import retry as retry_lib
 from pipelinedp_tpu.runtime import watchdog as watchdog_lib
@@ -245,16 +247,23 @@ class SlabDriver:
                         cp, expects_qhist=plan.quantile)
                     cursor = int(cp.next_chunk)
                     profiler.count_event(EVENT_RESUMES)
+                    obs_trace.event("resume", next_chunk=cursor)
 
         def save_checkpoint(next_chunk, accs, qhist):
-            host_accs, host_q = placement.snapshot(accs, qhist)
-            cp = checkpoint_lib.StreamCheckpoint(
-                run_id=cp_policy.run_id, next_chunk=next_chunk, n_chunks=k,
-                accs=host_accs, qhist=host_q,
-                key_fingerprint=key_fp, wire_fingerprint=wire_fp,
-                key_counter=resilience.key_counter)
-            cp_policy.store.save(cp)
+            import time as time_lib
+            t0 = time_lib.perf_counter()
+            with obs_trace.span("driver/checkpoint",
+                                next_chunk=int(next_chunk)):
+                host_accs, host_q = placement.snapshot(accs, qhist)
+                cp = checkpoint_lib.StreamCheckpoint(
+                    run_id=cp_policy.run_id, next_chunk=next_chunk,
+                    n_chunks=k, accs=host_accs, qhist=host_q,
+                    key_fingerprint=key_fp, wire_fingerprint=wire_fp,
+                    key_counter=resilience.key_counter)
+                cp_policy.store.save(cp)
             profiler.count_event(EVENT_CHECKPOINT_BYTES, cp.nbytes())
+            obs_metrics.checkpoint_write_seconds().observe(
+                time_lib.perf_counter() - t0)
 
         compact = placement.compact
         donating = placement.donates and not compact
@@ -278,10 +287,14 @@ class SlabDriver:
         executor = None
         inflight = {}
         parent_sinks = profiler.current_sinks()
+        parent_span = obs_trace.current()
 
         def prefetch_call(a, b):
-            with profiler.adopt_sinks(parent_sinks):
-                with profiler.stage("dp/wire_sort_parallel"):
+            with profiler.adopt_sinks(parent_sinks), \
+                    obs_trace.attach(parent_span):
+                with profiler.stage("dp/wire_sort_parallel"), \
+                        obs_trace.span("driver/prefetch_encode",
+                                       chunk0=a, chunk1=b):
                     return self._prepare_slab(a, b)
 
         def discard_inflight():
@@ -309,10 +322,14 @@ class SlabDriver:
                 in_dispatch = False
                 try:
                     with profiler.stage(
-                            f"{placement.stage_prefix}{cursor}"):
+                            f"{placement.stage_prefix}{cursor}"), \
+                            obs_trace.span("driver/window", chunk0=cursor,
+                                           chunk1=s1, attempt=failures):
                         fut = inflight.pop((cursor, s1), None)
-                        slab = (fut.result() if fut is not None
-                                else self._prepare_slab(cursor, s1))
+                        with obs_trace.span("driver/encode",
+                                            prefetched=fut is not None):
+                            slab = (fut.result() if fut is not None
+                                    else self._prepare_slab(cursor, s1))
                         if plan.retain_sink is not None:
                             # Retain-wire mode: hand the validated host
                             # slab to the session before it is consumed
@@ -338,27 +355,32 @@ class SlabDriver:
                                 injector.check("transfer", this_window)
                             return placement.transfer(slab, s0, s1)
 
-                        payload = guarded(f"transfer of window "
-                                          f"[{s0}, {s1})", do_transfer)
+                        with obs_trace.span("driver/transfer"):
+                            payload = guarded(f"transfer of window "
+                                              f"[{s0}, {s1})", do_transfer)
                         if injector is not None:
                             injector.check("kernel", this_window)
                         for c in range(s0, s1):
-                            if compact:
-                                pending.append(guarded(
-                                    f"chunk {c} dispatch",
-                                    lambda c=c: placement.compact_step(
-                                        c, payload, c - s0)))
-                                profiler.count_event(EVENT_COMPACT_CHUNKS)
-                            else:
-                                in_dispatch = donating
-                                accs, qhist = guarded(
-                                    f"chunk {c} dispatch",
-                                    lambda c=c: placement.step(
-                                        c, payload, c - s0, accs, qhist))
-                                in_dispatch = False
-                                profiler.count_event(
-                                    EVENT_PARTITION_SCATTERS,
-                                    plan.scatter_passes)
+                            with obs_trace.span("driver/dispatch",
+                                                chunk=c):
+                                if compact:
+                                    pending.append(guarded(
+                                        f"chunk {c} dispatch",
+                                        lambda c=c: placement.compact_step(
+                                            c, payload, c - s0)))
+                                    profiler.count_event(
+                                        EVENT_COMPACT_CHUNKS)
+                                else:
+                                    in_dispatch = donating
+                                    accs, qhist = guarded(
+                                        f"chunk {c} dispatch",
+                                        lambda c=c: placement.step(
+                                            c, payload, c - s0, accs,
+                                            qhist))
+                                    in_dispatch = False
+                                    profiler.count_event(
+                                        EVENT_PARTITION_SCATTERS,
+                                        plan.scatter_passes)
                             if plan.on_chunk is not None:
                                 plan.on_chunk()
                             cursor = c + 1
@@ -367,14 +389,17 @@ class SlabDriver:
                             # here means dispatched-but-unmaterialized
                             # state: only a checkpoint is trustworthy.
                             in_dispatch = True
-                            wd.call("window sync",
-                                    lambda: placement.sync(accs, qhist,
-                                                           pending))
+                            with obs_trace.span("driver/sync"):
+                                wd.call("window sync",
+                                        lambda: placement.sync(accs, qhist,
+                                                               pending))
                             in_dispatch = False
                 except Exception as exc:
                     failure_kind = retry_lib.classify(exc)
                     if isinstance(exc, watchdog_lib.DispatchHangError):
                         profiler.count_event(EVENT_HANGS)
+                        obs_trace.event("watchdog_timeout",
+                                        error=type(exc).__name__)
                     if policy is None or failure_kind == retry_lib.FATAL:
                         raise
                     if in_dispatch:
@@ -395,6 +420,7 @@ class SlabDriver:
                         cursor = int(cp.next_chunk)
                         pending.clear()
                         profiler.count_event(EVENT_RESUMES)
+                        obs_trace.event("resume", next_chunk=cursor)
                     if (failure_kind == retry_lib.OOM
                             and placement.degradable):
                         smaller = policy.degrade_slab_buckets(window)
@@ -408,6 +434,8 @@ class SlabDriver:
                             window = smaller
                             discard_inflight()
                             profiler.count_event(EVENT_DEGRADATIONS)
+                            obs_trace.event("degrade",
+                                            window_chunks=smaller)
                             continue
                     failures += 1
                     if failures > policy.max_retries:
@@ -416,6 +444,8 @@ class SlabDriver:
                         # Never back off past the query's budget.
                         deadline.check(f"retry of window [{cursor}, {s1})")
                     profiler.count_event(EVENT_RETRIES)
+                    obs_trace.event("retry", error=type(exc).__name__,
+                                    attempt=failures)
                     policy.sleep(policy.backoff_s(failures - 1))
                     continue
                 failures = 0

@@ -45,7 +45,7 @@ import json
 import os
 import tempfile
 import threading
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 from pipelinedp_tpu import profiler
 
@@ -65,6 +65,133 @@ class JournalCorruptError(RuntimeError):
     """A durable journal holds a malformed interior record — committed
     release history cannot be trusted, so recovery refuses rather than
     silently forgetting a release."""
+
+
+class JsonlWal:
+    """The shared fsync'd JSON-lines WAL (one implementation, many
+    journals): FileReleaseJournal, the durable tenant ledgers, and the
+    obs release-audit trail (pipelinedp_tpu/obs/audit.py) all ride it.
+
+    Disk format: one JSON object per line, ``seq``-numbered from 0,
+    with a truncated-sha256 ``digest`` over the canonical (sorted-key)
+    payload appended as the last key. Appends are write-ahead durable:
+    the line is flushed and fsync'd before :meth:`append` returns.
+    Recovery truncates a torn tail (a partial last line was never
+    acknowledged) but raises ``corrupt_error`` on interior corruption —
+    committed history is never silently forgotten. :meth:`rewrite`
+    compacts atomically (tmp + fsync + rename).
+    """
+
+    def __init__(self, path: str,
+                 corrupt_error=None):
+        self._path = path
+        self._corrupt_error = (corrupt_error if corrupt_error is not None
+                               else JournalCorruptError)
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._fh = None
+        self.recovered: List[dict] = self._recover()
+        self._fh = open(self._path, "ab")
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    @staticmethod
+    def _canonical(payload: dict) -> str:
+        return json.dumps(payload, sort_keys=True, separators=(",", ":"))
+
+    @classmethod
+    def _line(cls, payload: dict) -> bytes:
+        canonical = cls._canonical(payload)
+        return (canonical[:-1]
+                + f',"digest":"{_record_digest(canonical)}"}}'
+                + "\n").encode()
+
+    def _parse_line(self, raw: bytes, expected_seq: int) -> Optional[dict]:
+        """Validated payload dict from one WAL line, or None."""
+        try:
+            obj = json.loads(raw.decode())
+            digest = obj.pop("digest")
+        except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+            return None
+        if not isinstance(obj, dict) or \
+                _record_digest(self._canonical(obj)) != digest:
+            return None
+        if obj.get("seq") != expected_seq:
+            return None
+        return obj
+
+    def _recover(self) -> List[dict]:
+        if not os.path.exists(self._path):
+            return []
+        with open(self._path, "rb") as f:
+            data = f.read()
+        payloads: List[dict] = []
+        good_end = 0
+        lines = data.split(b"\n")
+        # A trailing b"" element means the file ends with a complete
+        # newline-terminated record; anything else is a tail candidate.
+        for i, raw in enumerate(lines):
+            if raw == b"" and i == len(lines) - 1:
+                break
+            payload = self._parse_line(raw, expected_seq=len(payloads))
+            if payload is None:
+                if i == len(lines) - 1 or (i == len(lines) - 2
+                                           and lines[-1] == b""):
+                    # Torn tail: the crash happened mid-append, so this
+                    # record was never acknowledged — drop it.
+                    break
+                raise self._corrupt_error(
+                    f"{self._path}: record {len(payloads)} is malformed "
+                    f"but later records follow — the journal is "
+                    f"corrupted, not torn; refusing to guess at its "
+                    f"history")
+            payloads.append(payload)
+            good_end += len(raw) + 1
+        if good_end != len(data):
+            # Truncate the torn tail so the next append starts a clean
+            # line (a partial line would otherwise fuse with it).
+            with open(self._path, "r+b") as f:
+                f.truncate(good_end)
+        return payloads
+
+    def append(self, payload: dict) -> int:
+        """Durably appends one payload (must carry its ``seq``; must not
+        carry a ``digest`` key); returns the bytes written."""
+        if "digest" in payload:
+            raise ValueError("payload key 'digest' is reserved by the WAL")
+        line = self._line(payload)
+        self._fh.write(line)
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        return len(line)
+
+    def rewrite(self, payloads) -> None:
+        """Atomically replaces the file with ``payloads`` (compaction;
+        tmp + fsync + rename so a crash leaves the previous file)."""
+        parent = os.path.dirname(self._path) or "."
+        fd, tmp = tempfile.mkstemp(dir=parent, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                for payload in payloads:
+                    f.write(self._line(payload))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        if self._fh is not None:
+            self._fh.close()
+        self._fh = open(self._path, "ab")
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -139,127 +266,61 @@ def _canonical_token(token):
     return token
 
 
-def _record_payload(record: ReleaseRecord) -> str:
-    """The canonical serialized form of one record (digest input)."""
-    return json.dumps(
-        {"seq": record.seq, "kind": record.kind, "token": record.token},
-        sort_keys=True, separators=(",", ":"))
-
-
 def _record_digest(payload: str) -> str:
     return hashlib.sha256(payload.encode()).hexdigest()[:16]
 
 
 class FileReleaseJournal(ReleaseJournal):
-    """WAL-backed journal surviving process death (module docstring)."""
+    """WAL-backed journal surviving process death (module docstring).
+    The file discipline — fsync'd appends, per-record digests, torn-tail
+    truncation, interior-corruption refusal, atomic compaction — lives
+    in the shared :class:`JsonlWal`."""
 
     def __init__(self, path: str):
         super().__init__()
         self._path = path
-        parent = os.path.dirname(path)
-        if parent:
-            os.makedirs(parent, exist_ok=True)
-        self._fh = None
-        self.recovered_records = self._recover()
-        self._fh = open(self._path, "ab")
-
-    # -- recovery ---------------------------------------------------------
-
-    def _recover(self) -> int:
-        if not os.path.exists(self._path):
-            return 0
-        with open(self._path, "rb") as f:
-            data = f.read()
+        self._wal = JsonlWal(path)
         records: List[ReleaseRecord] = []
-        good_end = 0
-        lines = data.split(b"\n")
-        # A trailing b"" element means the file ends with a complete
-        # newline-terminated record; anything else is a tail candidate.
-        for i, raw in enumerate(lines):
-            if raw == b"" and i == len(lines) - 1:
-                break
-            record = self._parse_line(raw, expected_seq=len(records))
-            if record is None:
-                if i == len(lines) - 1 or (i == len(lines) - 2
-                                           and lines[-1] == b""):
-                    # Torn tail: the crash happened mid-append, so this
-                    # record was never acknowledged — drop it.
-                    break
-                raise JournalCorruptError(
-                    f"{self._path}: record {len(records)} is malformed "
-                    f"but later records follow — the journal is "
-                    f"corrupted, not torn; refusing to guess at release "
-                    f"history")
-            records.append(record)
-            good_end += len(raw) + 1
-        if good_end != len(data):
-            # Truncate the torn tail so the next append starts a clean
-            # line (a partial line would otherwise fuse with it).
-            with open(self._path, "r+b") as f:
-                f.truncate(good_end)
+        try:
+            for payload in self._wal.recovered:
+                records.append(ReleaseRecord(
+                    seq=int(payload["seq"]), kind=payload["kind"],
+                    token=_canonical_token(payload["token"])))
+        except (KeyError, TypeError, ValueError) as exc:
+            raise self._corrupt(
+                f"{path}: record {len(records)} is not a release record "
+                f"({exc})")
         for record in records:
             self._committed[record.token] = record
             self._records.append(record)
         if records:
             profiler.count_event(EVENT_JOURNAL_RECOVERIES)
-        return len(records)
+        self.recovered_records = len(records)
 
     @staticmethod
-    def _parse_line(raw: bytes, expected_seq: int):
-        """ReleaseRecord from one WAL line, or None when malformed."""
-        try:
-            obj = json.loads(raw.decode())
-            digest = obj.pop("digest")
-            record = ReleaseRecord(seq=int(obj["seq"]), kind=obj["kind"],
-                                   token=_canonical_token(obj["token"]))
-        except (ValueError, KeyError, TypeError, UnicodeDecodeError):
-            return None
-        if _record_digest(_record_payload(record)) != digest:
-            return None
-        if record.seq != expected_seq:
-            return None
-        return record
+    def _corrupt(msg: str) -> "JournalCorruptError":
+        return JournalCorruptError(msg)
 
     # -- durability -------------------------------------------------------
 
+    @staticmethod
+    def _payload(record: ReleaseRecord) -> dict:
+        return {"seq": record.seq, "kind": record.kind,
+                "token": record.token}
+
     def _persist(self, record: ReleaseRecord) -> None:
-        payload = _record_payload(record)
-        line = (payload[:-1] + f',"digest":"{_record_digest(payload)}"}}'
-                + "\n").encode()
-        self._fh.write(line)
-        self._fh.flush()
-        os.fsync(self._fh.fileno())
-        profiler.count_event(EVENT_JOURNAL_BYTES, len(line))
+        nbytes = self._wal.append(self._payload(record))
+        profiler.count_event(EVENT_JOURNAL_BYTES, nbytes)
 
     def compact(self) -> None:
         """Atomically rewrites the WAL from the in-memory records (drops
         any truncated torn-tail bytes for good; tmp + fsync + rename, so
         a crash mid-compaction leaves the previous file intact)."""
         with self._lock:
-            parent = os.path.dirname(self._path) or "."
-            fd, tmp = tempfile.mkstemp(dir=parent, suffix=".tmp")
-            try:
-                with os.fdopen(fd, "wb") as f:
-                    for record in self._records:
-                        payload = _record_payload(record)
-                        f.write((payload[:-1] +
-                                 f',"digest":"{_record_digest(payload)}"}}'
-                                 + "\n").encode())
-                    f.flush()
-                    os.fsync(f.fileno())
-                os.replace(tmp, self._path)
-            except BaseException:
-                if os.path.exists(tmp):
-                    os.unlink(tmp)
-                raise
-            if self._fh is not None:
-                self._fh.close()
-            self._fh = open(self._path, "ab")
+            self._wal.rewrite(self._payload(r) for r in self._records)
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        self._wal.close()
 
     def __enter__(self) -> "FileReleaseJournal":
         return self

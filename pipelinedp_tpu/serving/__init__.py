@@ -61,3 +61,5 @@ from pipelinedp_tpu.serving.manager import (  # noqa: F401
 from pipelinedp_tpu.budget_accounting import (  # noqa: F401
     BudgetExhaustedError, TenantBudgetLedger)
 from pipelinedp_tpu.runtime.watchdog import QueryDeadlineError  # noqa: F401
+from pipelinedp_tpu.obs.audit import (  # noqa: F401
+    AuditCorruptError, AuditRecord, AuditTrail)

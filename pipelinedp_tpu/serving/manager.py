@@ -32,9 +32,12 @@ from __future__ import annotations
 import collections
 import contextlib
 import threading
+import time
 from typing import Dict, List, Optional
 
 from pipelinedp_tpu import profiler
+from pipelinedp_tpu.obs import metrics as obs_metrics
+from pipelinedp_tpu.obs import trace as obs_trace
 from pipelinedp_tpu.serving import session as session_lib
 from pipelinedp_tpu.serving import store as store_lib
 
@@ -205,18 +208,27 @@ class SessionManager:
     @contextlib.contextmanager
     def admission(self):
         """The bounded in-flight gate: entered by every query of a
-        managed session. Full gate → typed shed, never a queue."""
+        managed session. Full gate → typed shed, never a queue.
+
+        The gate-acquisition wait (lock contention — sheds don't wait,
+        by design) feeds the ``pipelinedp_tpu_admission_wait_seconds``
+        histogram, and the in-flight count is exported as a gauge."""
+        t0 = time.perf_counter()
         with self._lock:
             if self._inflight >= self._max_inflight:
                 profiler.count_event(EVENT_SHED)
                 raise SessionOverloadedError(self._inflight,
                                              self._max_inflight)
             self._inflight += 1
+            obs_metrics.inflight_queries().set(self._inflight)
+        obs_metrics.admission_wait_seconds().observe(
+            time.perf_counter() - t0)
         try:
             yield
         finally:
             with self._lock:
                 self._inflight -= 1
+                obs_metrics.inflight_queries().set(self._inflight)
 
     def notify_used(self, session, rehydrated: bool) -> None:
         """Called by a session at query start (after its lifecycle lock
@@ -245,21 +257,30 @@ class SessionManager:
         sessions with queries in flight are skipped — at worst the
         fleet transiently overshoots by the active working set, it
         never thrashes the session being served."""
-        while self.resident_bytes() > self._budget:
+        while True:
+            resident = self.resident_bytes()
+            obs_metrics.fleet_resident_bytes().set(resident)
+            if resident <= self._budget:
+                return
             with self._lock:
                 candidates = [s for s in self._sessions.values()
                               if s is not protect and not s.is_spilled]
             demoted = False
             for candidate in candidates:  # LRU first
-                if candidate.demote_device():
-                    profiler.count_event(EVENT_DEMOTIONS)
-                    demoted = True
-                    break
-                if candidate.spill(self._store):
-                    profiler.count_event(EVENT_DEMOTIONS)
-                    profiler.count_event(EVENT_SPILLS)
-                    demoted = True
-                    break
+                with obs_trace.span("fleet/demote",
+                                    session=candidate.name):
+                    if candidate.demote_device():
+                        profiler.count_event(EVENT_DEMOTIONS)
+                        obs_trace.event("demote_device",
+                                        session=candidate.name)
+                        demoted = True
+                        break
+                    if candidate.spill(self._store):
+                        profiler.count_event(EVENT_DEMOTIONS)
+                        profiler.count_event(EVENT_SPILLS)
+                        obs_trace.event("spill", session=candidate.name)
+                        demoted = True
+                        break
             if not demoted:
                 return  # nothing left to demote; overshoot transiently
 

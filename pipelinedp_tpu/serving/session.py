@@ -29,6 +29,7 @@ import collections
 import contextlib
 import dataclasses
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -40,6 +41,9 @@ from pipelinedp_tpu import jax_engine
 from pipelinedp_tpu import profiler
 from pipelinedp_tpu.aggregate_params import (AggregateParams, MechanismType,
                                              Metric, Metrics, NoiseKind)
+from pipelinedp_tpu.obs import audit as audit_lib
+from pipelinedp_tpu.obs import metrics as obs_metrics
+from pipelinedp_tpu.obs import trace as obs_trace
 from pipelinedp_tpu.ops import columnar, encoding, finalize as finalize_ops
 from pipelinedp_tpu.ops import streaming
 from pipelinedp_tpu.runtime import checkpoint as checkpoint_lib
@@ -255,7 +259,8 @@ class DatasetSession:
                           epilogue_cache=epilogue_cache,
                           resident_bytes=resident_bytes)
 
-        with profiler.stage("dp/ingest"):
+        with profiler.stage("dp/ingest"), \
+                obs_trace.span("serving/ingest", session=name):
             pid, pk, value, _, pk_vocab = encoding.encode_rows(
                 data, True, None, None,
                 public_partitions=self._public, factorize_pid=False)
@@ -323,6 +328,10 @@ class DatasetSession:
         self._active = 0
         self._lifecycle_lock = threading.Lock()
         self._deadline_tls = threading.local()
+        # Release audit trail (obs/audit.py): in-memory until the
+        # session is store-bound, then durable under the store
+        # (_bind_audit) so outcomes survive process death.
+        self._audit = audit_lib.AuditTrail()
 
     @classmethod
     def _restore(cls, wire: streaming.ResidentWire,
@@ -348,6 +357,7 @@ class DatasetSession:
         self._wire = wire
         self._source = self._source_digest = None
         self._store_binding = store_binding
+        self._bind_audit()
         if (mesh is None and wire.n_rows > 0 and wire.loaded
                 and wire.host_nbytes <= self._byte_budget):
             wire.ensure_device()
@@ -465,6 +475,7 @@ class DatasetSession:
             self._bound_cache.clear()
             self._cache_bytes = 0
             self._source = None
+        self._audit.close()
 
     def __enter__(self) -> "DatasetSession":
         return self
@@ -485,6 +496,22 @@ class DatasetSession:
         """(SessionStore, stored name) after save()/open(), else None."""
         return self._store_binding
 
+    @property
+    def audit_trail(self) -> audit_lib.AuditTrail:
+        """The session's release audit trail (obs/audit.py): one record
+        per finished query with mechanism kinds, (ε, δ), kept/dropped
+        partition counts, timing and a typed outcome. Durable (WAL
+        under the store) once the session is store-bound."""
+        return self._audit
+
+    def _bind_audit(self) -> None:
+        """Moves the audit trail onto its durable WAL under the bound
+        store (idempotent; the in-memory prefix is replayed onto disk)."""
+        if self._store_binding is None or self._audit.durable:
+            return
+        store, name = self._store_binding
+        self._audit.bind(store.audit_path(name))
+
     def save(self, store=None) -> str:
         """Spills the session durably: wire chunks (per-chunk digested),
         bound-cache entries (content-digested), tenant registrations —
@@ -500,7 +527,10 @@ class DatasetSession:
                     "session has no bound store; pass save(store=)")
             store = self._store_binding[0]
         self._check_open()
-        return store.save(self)
+        with obs_trace.span("fleet/save", session=self._name):
+            path = store.save(self)
+        self._bind_audit()
+        return path
 
     def spill(self, store=None) -> bool:
         """Demotes the session to the disk rung: saves (if needed) and
@@ -533,18 +563,22 @@ class DatasetSession:
     def _rehydrate_locked(self) -> None:
         if not self._spilled:
             return
-        store, name = self._store_binding
-        slab, bound_entries = store.load_payload(name)
-        with self._lock:
-            self._check_open()
-            self._wire.reload(slab)
-            self._spilled = False
-        profiler.count_event(EVENT_REHYDRATIONS)
-        if (self._mesh is None and self._wire.n_rows > 0
-                and self._wire.host_nbytes <= self._byte_budget):
-            self._wire.ensure_device()
-        for key, result in bound_entries:
-            self._cache_insert(key, result)
+        t0 = time.perf_counter()
+        with obs_trace.span("fleet/rehydrate", session=self._name):
+            store, name = self._store_binding
+            slab, bound_entries = store.load_payload(name)
+            with self._lock:
+                self._check_open()
+                self._wire.reload(slab)
+                self._spilled = False
+            profiler.count_event(EVENT_REHYDRATIONS)
+            if (self._mesh is None and self._wire.n_rows > 0
+                    and self._wire.host_nbytes <= self._byte_budget):
+                self._wire.ensure_device()
+            for key, result in bound_entries:
+                self._cache_insert(key, result)
+        obs_metrics.rehydration_seconds().observe(
+            time.perf_counter() - t0)
 
     def demote_device(self) -> bool:
         """Demotion rung 1: frees the device copy of the wire (the host
@@ -715,6 +749,7 @@ class DatasetSession:
                 if entry is not None:
                     self._bound_cache.move_to_end(cache_key)
                     profiler.count_event(EVENT_BOUND_HITS)
+                    obs_trace.event("bound_cache_hit")
                     return entry.result
             profiler.count_event(EVENT_BOUND_MISSES)
             deadline = getattr(self._deadline_tls, "value", None)
@@ -723,18 +758,24 @@ class DatasetSession:
                     from pipelinedp_tpu import runtime as runtime_lib
                     resilience = runtime_lib.StreamResilience()
                 resilience.deadline = deadline
-            try:
-                result = self._replay(k_kernel, mesh, resilience, kw)
-            except Exception as exc:
-                if (retry_lib.classify(exc) != retry_lib.OOM
-                        or not self._wire.device_resident):
-                    raise
-                # Graceful degradation: a device-resident replay that
-                # exhausted device memory falls back to shipping host
-                # windows instead of failing the query.
-                self._wire.drop_device()
-                profiler.count_event(EVENT_DEVICE_FALLBACKS)
-                result = self._replay(k_kernel, mesh, resilience, kw)
+            t_replay0 = time.perf_counter()
+            with obs_trace.span("serving/replay", session=self._name,
+                                n_chunks=self._wire.n_chunks):
+                try:
+                    result = self._replay(k_kernel, mesh, resilience, kw)
+                except Exception as exc:
+                    if (retry_lib.classify(exc) != retry_lib.OOM
+                            or not self._wire.device_resident):
+                        raise
+                    # Graceful degradation: a device-resident replay that
+                    # exhausted device memory falls back to shipping host
+                    # windows instead of failing the query.
+                    self._wire.drop_device()
+                    profiler.count_event(EVENT_DEVICE_FALLBACKS)
+                    obs_trace.event("device_fallback")
+                    result = self._replay(k_kernel, mesh, resilience, kw)
+            obs_metrics.replay_seconds().observe(
+                time.perf_counter() - t_replay0)
             self._cache_insert(cache_key, result)
             return result
 
@@ -785,6 +826,7 @@ class DatasetSession:
               fault_injector=None,
               watchdog_timeout_s: Optional[float] = None,
               retry_policy=None,
+              trace_path: Optional[str] = None,
               out_explain_computation_report=None
               ) -> jax_engine.LazyJaxResult:
         """Answers one DP query from the resident dataset.
@@ -812,6 +854,17 @@ class DatasetSession:
         thread straight into the replay's slab driver (the same
         resilience surface a cold streamed run has — chaos and
         kill-harness coverage extends to serving through them).
+
+        Observability (OBSERVABILITY.md): the query runs under a
+        ``serving/query`` root span (admission → replay → finalize
+        children), lands one latency observation in the
+        ``pipelinedp_tpu_query_seconds`` histogram, and appends one
+        typed-outcome record to the session's audit trail — all
+        regardless of success. ``trace_path`` writes THIS query's span
+        tree as Chrome trace JSON when a tracer is installed
+        (``obs.trace.install()`` / ``PIPELINEDP_TPU_TRACE``); it is a
+        no-op otherwise. None of this can change released bits: spans
+        read clocks, never data or keys.
         """
         self._check_open()
         if deadline_s is None:
@@ -882,24 +935,50 @@ class DatasetSession:
 
         gate = (self._manager.admission()
                 if self._manager is not None else contextlib.nullcontext())
+        t_q0 = time.perf_counter()
+        root_span = None
         try:
-            with gate:
-                if deadline is None:
-                    result = run_query()
-                else:
-                    result = self._run_with_deadline(run_query, deadline,
-                                                     seed)
+            with obs_trace.span("serving/query", session=self._name,
+                                seed=seed, tenant=tenant or "",
+                                n_metrics=len(params.metrics)
+                                ) as root_span:
+                with contextlib.ExitStack() as stack:
+                    with obs_trace.span(
+                            "serving/admission",
+                            managed=self._manager is not None):
+                        stack.enter_context(gate)
+                    if deadline is None:
+                        result = run_query()
+                    else:
+                        result = self._run_with_deadline(
+                            run_query, deadline, seed, root_span)
         except BaseException as exc:
             if isinstance(exc, watchdog_lib.QueryDeadlineError):
                 profiler.count_event(EVENT_DEADLINE_HITS)
             self._maybe_refund(state, charge, journal, engine, exc)
+            self._finish_query_obs(
+                engine=engine, params=params, tenant=tenant,
+                accountant=accountant, seed=seed,
+                outcome=self._failure_outcome(exc),
+                duration_s=time.perf_counter() - t_q0)
             raise
+        self._finish_query_obs(
+            engine=engine, params=params, tenant=tenant,
+            accountant=accountant, seed=seed, outcome="released",
+            duration_s=time.perf_counter() - t_q0,
+            cols=result.to_columns())
+        if trace_path is not None and root_span is not None:
+            tracer = obs_trace.active()
+            if tracer is not None:
+                tracer.write_chrome(trace_path,
+                                    trace_id=root_span.trace_id)
         with self._lock:
             self._queries += 1
         profiler.count_event(EVENT_QUERIES)
         return result
 
-    def _run_with_deadline(self, run_query, deadline, seed):
+    def _run_with_deadline(self, run_query, deadline, seed,
+                           parent_span=None):
         """The whole query under a DispatchWatchdog whose budget is the
         remaining deadline: a wedged replay (which never reaches the
         driver's cooperative between-window check) is abandoned and
@@ -909,7 +988,10 @@ class DatasetSession:
         parent_sinks = profiler.current_sinks()
 
         def guarded():
-            with profiler.adopt_sinks(parent_sinks):
+            # The watchdog worker joins the query's stage-time sinks AND
+            # its span tree (cross-thread parent handoff).
+            with profiler.adopt_sinks(parent_sinks), \
+                    obs_trace.attach(parent_span):
                 return run_query()
 
         try:
@@ -945,6 +1027,46 @@ class DatasetSession:
                                            engine._key_stream.counter)
         if journal is None or not journal.has(token):
             state.ledger.refund(charge)
+
+    @staticmethod
+    def _failure_outcome(exc) -> str:
+        """The audit-trail outcome of a failed query (obs/audit.py
+        OUTCOMES): every failure that refunds reads ``refunded``; the
+        typed fleet failures keep their own names."""
+        if isinstance(exc, journal_lib.DoubleReleaseError):
+            return "double-release-refused"
+        if isinstance(exc, watchdog_lib.QueryDeadlineError):
+            return "deadline-expired"
+        from pipelinedp_tpu.serving import manager as manager_lib
+        if isinstance(exc, manager_lib.SessionOverloadedError):
+            return "shed"
+        return "refunded"
+
+    def _finish_query_obs(self, *, engine, params, tenant, accountant,
+                          seed, outcome, duration_s, cols=None) -> None:
+        """One query's telemetry epilogue: the e2e latency observation
+        and the audit record. ``cols`` (released columns) is only
+        present for the ``released`` outcome; kept/dropped counts are
+        read off the DP output (already-released information), never
+        off raw data. -1 marks "query produced no output"."""
+        obs_metrics.query_seconds().observe(duration_s, outcome=outcome)
+        kept = dropped = -1
+        if cols is not None:
+            keep = np.asarray(cols["keep_mask"])
+            kept = int(keep.sum())
+            dropped = int(keep.size) - kept
+        token = finalize_ops.release_token(
+            engine._key_stream.fingerprint(), engine._key_stream.counter)
+        self._audit.record(
+            session=self._name, tenant=tenant, token=str(token),
+            outcome=outcome,
+            mechanisms=[str(m) for m in params.metrics],
+            noise_kind=getattr(params.noise_kind, "value",
+                               str(params.noise_kind)),
+            epsilon=float(accountant.total_epsilon),
+            delta=float(accountant.total_delta),
+            partitions_kept=kept, partitions_dropped=dropped,
+            duration_s=duration_s, seed=seed)
 
     # -- batched queries -------------------------------------------------
 
@@ -1037,7 +1159,10 @@ class DatasetSession:
         width = max_width or batch_width()
         gate = (self._manager.admission()
                 if self._manager is not None else contextlib.nullcontext())
-        with gate, self._pinned():
+        t_b0 = time.perf_counter()
+        with obs_trace.span("serving/query_batch", session=self._name,
+                            n_configs=len(configs)), \
+                gate, self._pinned():
             prepared: List[_PreparedQuery] = []
             results: List[Optional[dict]] = [None] * len(configs)
             try:
@@ -1054,23 +1179,58 @@ class DatasetSession:
                     for s in range(0, len(group), width):
                         self._run_batch_group(group[s:s + width],
                                               has_group_clip, results)
-            except BaseException:
+            except BaseException as exc:
                 # Exact refunds for every tenant config whose release
                 # token never committed (the failed launch group and any
                 # group that never ran); finished configs keep their
                 # charge — their releases are out the door.
                 for p in prepared:
-                    if p.charge is None or p.state is None:
-                        continue
-                    token = finalize_ops.release_token(
-                        p.engine._key_stream.fingerprint(), p.key_counter)
-                    if not p.state.release_journal.has(token):
-                        p.state.ledger.refund(p.charge)
+                    if p.charge is not None and p.state is not None:
+                        token = finalize_ops.release_token(
+                            p.engine._key_stream.fingerprint(),
+                            p.key_counter)
+                        if not p.state.release_journal.has(token):
+                            p.state.ledger.refund(p.charge)
+                self._audit_batch(configs, prepared, results,
+                                  time.perf_counter() - t_b0, exc)
                 raise
+        self._audit_batch(configs, prepared, results,
+                          time.perf_counter() - t_b0, None)
         with self._lock:
             self._queries += len(prepared)
         profiler.count_event(EVENT_QUERIES, len(prepared))
         return results  # type: ignore[return-value]
+
+    def _audit_batch(self, configs, prepared, results, duration_s,
+                     exc) -> None:
+        """One audit record per prepared batch config. A config whose
+        released columns landed in ``results`` (or whose tenant journal
+        holds its token) reads ``released``; the rest take the batch
+        failure's outcome."""
+        outcome_on_failure = (self._failure_outcome(exc)
+                              if exc is not None else "refunded")
+        for p in prepared:
+            cfg = configs[p.index]
+            token = finalize_ops.release_token(
+                p.engine._key_stream.fingerprint(), p.key_counter)
+            cols = results[p.index]
+            released = cols is not None or (
+                p.state is not None
+                and p.state.release_journal.has(token))
+            kept = dropped = -1
+            if cols is not None:
+                keep = np.asarray(cols["keep_mask"])
+                kept = int(keep.sum())
+                dropped = int(keep.size) - kept
+            self._audit.record(
+                session=self._name, tenant=cfg.tenant, token=str(token),
+                outcome="released" if released else outcome_on_failure,
+                mechanisms=[str(m) for m in cfg.metrics],
+                noise_kind=getattr(cfg.noise_kind, "value",
+                                   str(cfg.noise_kind)),
+                epsilon=float(cfg.epsilon), delta=float(cfg.delta),
+                partitions_kept=kept, partitions_dropped=dropped,
+                duration_s=duration_s, seed=cfg.seed)
 
     def _run_batch_group(self, group: List[_PreparedQuery],
                          has_group_clip: bool,

@@ -243,6 +243,13 @@ class SessionStore:
         return os.path.join(self.path(name), TENANT_DIR,
                             f"{self._safe(tenant_id)}.ledger.wal")
 
+    def audit_path(self, name: str) -> str:
+        """The session's release-audit-trail WAL (obs/audit.py): rides
+        the same fsync'd JsonlWal discipline as the tenant journals, so
+        committed query outcomes survive SIGKILL and replay exactly on
+        reopen."""
+        return os.path.join(self.path(name), "audit.wal")
+
     # -- save ------------------------------------------------------------
 
     def save(self, session) -> str:
@@ -381,6 +388,7 @@ class SessionStore:
         _atomic_write(os.path.join(path, MANIFEST_FILE),
                       json.dumps(manifest, indent=1).encode())
         session._store_binding = (self, name)
+        session._bind_audit()
         profiler.count_event(EVENT_SAVES)
         return path
 

@@ -1,0 +1,33 @@
+"""dplint fixture — DPL011 violations: private data enters telemetry."""
+
+import numpy as np
+
+from pipelinedp_tpu.obs import trace as obs_trace
+from pipelinedp_tpu.ops import columnar
+
+
+def leak_span_attribute(pid):
+    # A raw privacy-id column attached to a span attribute.
+    with obs_trace.span("serving/query", first_pid=pid[0]):
+        return None
+
+
+def _record_metric(histogram, values):
+    histogram.observe(values)
+
+
+def leak_via_helper(histogram, value):
+    scaled = np.abs(value)
+    return _record_metric(histogram, scaled)
+
+
+def leak_bounded_only(key, pid, pk, value, n, span):
+    accs = columnar.bound_and_aggregate(key, pid, pk, value,
+                                        num_partitions=n)
+    # Bounded but PRE-NOISE: still unreleased — telemetry may only
+    # carry fully released statistics.
+    span.set_attribute("partition_total", accs)
+
+
+def leak_audit_field(audit, pk):
+    audit.record(partition_keys=pk)

@@ -170,6 +170,11 @@ def _run_serving(mode: str, workdir: str) -> None:
     from pipelinedp_tpu import runtime, serving
 
     store, session = _serving_session(workdir, mode)
+    # The audit trail recovered from the store's fsync'd WAL — what the
+    # previous (possibly SIGKILLed) process durably committed. Printed
+    # BEFORE the query so even the killed mode reports it.
+    print("HARNESS_AUDIT_RECOVERED " + json.dumps(
+        [r.to_payload() for r in session.audit_trail.records()]))
     if mode == "serve_prepare":
         print("HARNESS_SAVED " + session.fingerprint)
         return
@@ -188,10 +193,14 @@ def _run_serving(mode: str, workdir: str) -> None:
                                 tenant="acme", secure_host_noise=False,
                                 fault_injector=injector).to_columns()
     except runtime.DoubleReleaseError:
+        print("HARNESS_AUDIT " + json.dumps(
+            [r.to_payload() for r in session.audit_trail.records()]))
         print("HARNESS_DOUBLE_RELEASE")
         return
     ledger = session.tenant("acme").ledger
     print(f"HARNESS_LEDGER {ledger.spent_epsilon:.6f}")
+    print("HARNESS_AUDIT " + json.dumps(
+        [r.to_payload() for r in session.audit_trail.records()]))
     out = {name: np.asarray(col).tobytes().hex()
            for name, col in sorted(columns.items())}
     print("HARNESS_RESULT " + json.dumps({"mode": mode, "columns": out}))

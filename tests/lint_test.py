@@ -31,6 +31,7 @@ MIN_BAD_FINDINGS = {
     "DPL008": 3,  # element write, mutator call, attribute write
     "DPL009": 2,  # direct draw before commit, draw via helper
     "DPL010": 3,  # read after donate, loop carry, exception path
+    "DPL011": 4,  # span attr, metric observe (direct + via helper), audit
 }
 ALL_RULE_IDS = sorted(MIN_BAD_FINDINGS)
 

@@ -186,3 +186,35 @@ class TestServingKillRecovery:
 
     def test_cross_restart_release_replay_refused(self, serve_kill_run):
         _marker(serve_kill_run["replay"], "HARNESS_DOUBLE_RELEASE")
+
+    def test_audit_trail_replays_exactly_across_sigkill(
+            self, serve_kill_run):
+        """The release audit trail (obs/audit.py) survives SIGKILL
+        byte-for-byte: every process recovers exactly the records the
+        previous one durably committed — no invented outcomes for the
+        killed in-flight query, no lost outcomes for finished ones."""
+        def audit(proc, prefix):
+            return json.loads(_marker(proc, prefix)[len(prefix):])
+
+        # The killed query never decided an outcome — the trail it saw
+        # on open was empty, and it appended nothing before dying.
+        assert audit(serve_kill_run["killed"],
+                     "HARNESS_AUDIT_RECOVERED ") == []
+        # The resume recovered that same empty trail, then recorded its
+        # own released query.
+        assert audit(serve_kill_run["resumed"],
+                     "HARNESS_AUDIT_RECOVERED ") == []
+        resumed_post = audit(serve_kill_run["resumed"], "HARNESS_AUDIT ")
+        assert [r["outcome"] for r in resumed_post] == ["released"]
+        # The replay process recovers the resume's record EXACTLY
+        # (same payload bytes through the WAL), then appends the typed
+        # refusal.
+        replay_pre = audit(serve_kill_run["replay"],
+                           "HARNESS_AUDIT_RECOVERED ")
+        assert replay_pre == resumed_post
+        replay_post = audit(serve_kill_run["replay"], "HARNESS_AUDIT ")
+        assert [r["outcome"] for r in replay_post] == [
+            "released", "double-release-refused"]
+        # Same token both times: the refusal names the release it
+        # refused to replay.
+        assert replay_post[0]["token"] == replay_post[1]["token"]
