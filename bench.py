@@ -95,7 +95,7 @@ def _params():
         max_value=5.0)
 
 
-def bench_e2e(pid, pk, value, n_runs=3):
+def bench_e2e(pid, pk, value, n_runs=3, segment_sort="auto"):
     """Full public-API path on raw host columns.
 
     Returns (partitions_per_sec, phases) where phases is the per-stage
@@ -120,7 +120,8 @@ def bench_e2e(pid, pk, value, n_runs=3):
             t0 = time.perf_counter()
             data = pdp.ColumnarData(pid=pid, pk=pk, value=value)
             accountant = pdp.NaiveBudgetAccountant(EPS, DELTA)
-            engine = pdp.JaxDPEngine(accountant, seed=seed)
+            engine = pdp.JaxDPEngine(accountant, seed=seed,
+                                     segment_sort=segment_sort)
             result = engine.aggregate(data, _params())
             accountant.compute_budgets()
             cols = result.to_columns()
@@ -234,8 +235,8 @@ def bench_e2e_steady(pid, pk, value, n_calls=4, secure_host_noise=True):
 def bench_kernel(pid, pk, value) -> dict:
     """Fused device step on resident data (sustained throughput).
 
-    Three sort configurations of the same bounding kernel A/B the round-9
-    tentpole on resident columns:
+    Four group-stage configurations of the same bounding kernel A/B the
+    round-10 tentpole on resident columns:
       * general — unsorted rows, 4-key/7-operand sort (the historical
         kernel-resident row since round 1, kept for trajectory
         continuity: this is the ~305k/s floor the tentpole targets);
@@ -244,15 +245,23 @@ def bench_kernel(pid, pk, value) -> dict:
         sort with the float32 value payload (the wire-ingest kernel of
         rounds 6-8, segment_sort=False);
       * tiled — the same packed keys over bucketed segment-local tiles
-        with the narrow value payload and int32 group accumulation (this
-        round's default, segment_sort="auto"). Bit-identical sampling to
-        packed.
+        with the narrow value payload and int32 group accumulation
+        (rounds 9's default, segment_sort=True);
+      * hash — the SORTLESS hash-binned group stage (round 10,
+        segment_sort="hash"; the auto default for this COUNT+SUM shape
+        under the exactness gate): one scatter into per-segment bins,
+        keyed-priority selection, zero sort passes over the wire.
+        Bit-identical sampling (and, under the gate, bit-identical
+        releases) to packed/tiled.
 
-    Returns {partitions_per_sec (headline = tiled), *_partitions_per_sec
-    per config, sort: per-config columnar.sort_cost rows + reduction
-    ratios}; the modeled costs are also credited to the ops/sort_*
-    profiler counters exactly as the streaming drivers do per executed
-    chunk.
+    Returns {partitions_per_sec (headline = hash, the auto default at
+    this shape), *_partitions_per_sec per config, sort: per-config
+    columnar.sort_cost rows + reduction ratios + the hash grid's
+    occupancy, and modeled_vs_measured_sort_bytes — the statically
+    summed model vs the bytes actually credited to the ops/sort_*
+    counters during the timed runs (ratio 1.0 = the counter story is
+    honest)}; costs are credited to the profiler counters exactly as
+    the streaming drivers do per executed chunk.
     """
     import jax
     import jax.numpy as jnp
@@ -328,6 +337,11 @@ def bench_kernel(pid, pk, value) -> dict:
     max_segments = wirecodec.round_ucap(int((per_pid > 0).sum()))
     tile_slack = -(-max_run // 8) * 8
     tile_rows = 1 << max(10, (4 * max_run - 1).bit_length())
+    # Hash-bin grid (round 10): one bin per pid segment, width = the max
+    # single-pid run rounded up — the same prep-time stats the wire's
+    # plan_group_binning sizes from.
+    hash_bin_rows = max(8, (max_run + 7) & ~7)
+    hash_bins = max_segments
     # Narrow value payload: star ratings 1..5 are their own plane index
     # (lo=0, scale=1, 3 bits) — the same affine-grid contract the wire
     # codec's VALUE_PLANES mode ships.
@@ -343,6 +357,9 @@ def bench_kernel(pid, pk, value) -> dict:
         "tiled": columnar.sort_cost(N_ROWS, tile_rows=tile_rows,
                                     tile_slack=tile_slack, value_bytes=1,
                                     **sort_kw),
+        "hash": columnar.sort_cost(N_ROWS, hash_bins=hash_bins,
+                                   hash_bin_rows=hash_bin_rows,
+                                   value_bytes=1, **sort_kw),
     }
     out = {"sort": {name: dict(c) for name, c in costs.items()}}
     out["sort"]["tiled_vs_packed_operand_byte_reduction"] = round(
@@ -351,7 +368,12 @@ def bench_kernel(pid, pk, value) -> dict:
     out["sort"]["tiled_vs_general_operand_byte_reduction"] = round(
         1.0 - costs["tiled"]["operand_bytes"]
         / max(costs["general"]["operand_bytes"], 1), 3)
+    # The sortless group stage: zero sort operand bytes by construction.
+    out["sort"]["hash_sort_operand_bytes"] = costs["hash"]["operand_bytes"]
+    out["sort"]["hash_bin_occupancy_pct"] = round(
+        100.0 * N_ROWS / max(hash_bins * hash_bin_rows, 1), 1)
 
+    bytes_before = profiler.event_count(columnar.EVENT_SORT_BYTES)
     out["general_partitions_per_sec"] = round(
         measure(make_step(), [pid, pk, value], costs["general"]), 1)
     packed_kw = dict(pid_sorted=True, max_segments=max_segments)
@@ -364,9 +386,27 @@ def bench_kernel(pid, pk, value) -> dict:
     if int_clip is not None:
         tiled_kw.update(int_accumulate=True, int_clip_lo=int_clip[0],
                         int_clip_hi=int_clip[1])
-    out["partitions_per_sec"] = round(
+    out["tiled_partitions_per_sec"] = round(
         measure(make_step(**tiled_kw),
                 [spid, spk, svalue.astype(np.int32)], costs["tiled"]), 1)
+    hash_kw = dict(hash_bins=hash_bins, hash_bin_rows=hash_bin_rows,
+                   value_is_index=True, value_lo=0.0, value_scale=1.0,
+                   value_sort_bits=3, **packed_kw)
+    # Headline: the hash-binned sortless stage — what segment_sort="auto"
+    # compiles for this COUNT+SUM shape under the exactness gate.
+    out["hash_partitions_per_sec"] = out["partitions_per_sec"] = round(
+        measure(make_step(**hash_kw),
+                [spid, spk, svalue.astype(np.int32)], costs["hash"]), 1)
+    # Counter-vs-model honesty check: the bytes credited during the
+    # timed runs must equal the statically summed model (3 timed
+    # executions per config; the hash config contributes zero).
+    modeled = 3 * sum(costs[c]["operand_bytes"] for c in costs)
+    measured = profiler.event_count(columnar.EVENT_SORT_BYTES) \
+        - bytes_before
+    out["modeled_vs_measured_sort_bytes"] = {
+        "modeled": modeled, "measured_counter": measured,
+        "ratio": round(measured / max(modeled, 1), 4),
+    }
     return out
 
 
@@ -798,6 +838,36 @@ def main():
     except Exception as e:  # noqa: BLE001
         extra["percentile_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
+        # Round-10 e2e A/B: the same engine path with the group stage
+        # forced to the tiled sort vs the sortless hash bins — the e2e
+        # twin of the kernel A/B (ROADMAP item 3's measurement ask).
+        # The headline e2e row above rides "auto", which resolves to
+        # hash for this COUNT+SUM shape under the exactness gate.
+        from pipelinedp_tpu import profiler as _prof
+        from pipelinedp_tpu.ops import columnar as _columnar
+        before = {
+            k: _prof.event_count(k)
+            for k in (_columnar.EVENT_HASH_PASSES,
+                      _columnar.EVENT_HASH_OCCUPANCY,
+                      _columnar.EVENT_HASH_DEMOTIONS)
+        }
+        hash_pps, _ = bench_e2e(pid, pk, value, n_runs=2,
+                                segment_sort="hash")
+        counters = {
+            k.rsplit("/", 1)[1]: _prof.event_count(k) - before[k]
+            for k in before
+        }
+        tiled_pps, _ = bench_e2e(pid, pk, value, n_runs=2,
+                                 segment_sort=True)
+        extra["e2e_segment_sort_ab"] = {
+            "hash_partitions_per_sec": round(hash_pps, 1),
+            "tiled_partitions_per_sec": round(tiled_pps, 1),
+            "hash_vs_tiled": round(hash_pps / max(tiled_pps, 1e-9), 3),
+            "hash_counters": counters,
+        }
+    except Exception as e:  # noqa: BLE001
+        extra["e2e_segment_sort_ab_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
         # De-confounding row (round-5 advisor): the same shape with
         # uniform CONTINUOUS values, which defeat the affine-integer plane
         # encoding and ship raw float32 — so codec gains (compressible
@@ -843,9 +913,10 @@ def main():
         "vs_baseline": round(e2e_pps / cpu_pps, 2),
         "kernel_partitions_per_sec": round(kernel_pps, 1),
         "kernel_vs_baseline": round(kernel_pps / cpu_pps, 2),
-        # Round-9 tentpole A/B on the kernel-resident row: general (the
+        # Round-10 tentpole A/B on the kernel-resident row: general (the
         # historical ~305k floor), packed (rounds 6-8 wire kernel), tiled
-        # (segment-local sort + narrow payload, the new default) — with
+        # (round-9 segment-local sort), hash (round-10 sortless group
+        # stage, the new auto default under the exactness gate) — with
         # the modeled ops/sort_* counters per configuration.
         "kernel_sort": kernel,
         "cpu_baseline_partitions_per_sec": round(cpu_pps, 1),

@@ -389,17 +389,23 @@ class JaxDPEngine:
         # regime where those passes dominate); True forces it; False
         # restores the legacy per-chunk scatters (the parity oracle).
         self._compact_merge = compact_merge
-        # Bucketed segment-local sort inside the streamed chunk kernels
-        # (ops/columnar tiled sampler; wirecodec.plan_segment_tiling):
-        # the packed 3-key bounding sort runs over fixed-width bucket
-        # tiles (span = tile width, not chunk rows) instead of the whole
-        # chunk, with tile slack sized from the wire's prep-time max
-        # single-pid run — together with the narrow-dtype sort payload
-        # and int32 group accumulation that ride with it. Released values
-        # are BIT-identical in every mode — the knob is pure kernel
-        # geometry. "auto" engages when the tile heuristic wins; True
-        # forces tiling whenever geometry permits; False restores the
-        # full round-8 kernel (the parity oracle).
+        # Group-stage strategy of the streamed chunk kernels
+        # (ops/columnar samplers; wirecodec.plan_group_binning resolves
+        # the knob into a 4-way general/packed/tiled/hash dispatch):
+        #   "auto"  — hash-binned SORTLESS group stage when it is
+        #             provably bit-identical (columnar.hash_exact_gate +
+        #             no norm columns), else the bucketed segment-local
+        #             tiled sort when the tile heuristic wins, else the
+        #             packed global sort. Bit-identical released values
+        #             across all of these by construction.
+        #   "hash"  — force the sortless group stage whenever its grid
+        #             geometry is computable (chunks that overflow the
+        #             bins demote to tiled per chunk): zero sort passes;
+        #             exact counts always, sums ULP-close outside the
+        #             exactness gate (bit-identical inside it).
+        #   True    — force tiling whenever geometry permits.
+        #   False   — the full round-8 kernel (global packed sort, f32
+        #             payload, float accumulation — the parity oracle).
         self._segment_sort = segment_sort
         # Resilience knobs (pipelinedp_tpu/runtime/, RESILIENCE.md):
         #   checkpoint_policy: runtime.CheckpointPolicy — snapshot the

@@ -229,10 +229,22 @@ _KEY_BITS = 96  # three uint32 sort keys
 #                          network moves: rows * bytes_per_row * log2(span).
 #                          The tiled path shrinks it through the log factor
 #                          (span = tile width, not chunk rows) and the
-#                          narrowed value payload.
+#                          narrowed value payload; the hash-binned group
+#                          stage makes ZERO sort passes, so it credits 0.
 EVENT_SORT_ROWS = "ops/sort_rows"
 EVENT_SORT_TILES = "ops/sort_tiles"
 EVENT_SORT_BYTES = "ops/sort_operand_bytes"
+
+# Hash-binned (sortless) group-stage counters, per EXECUTED chunk:
+#   ops/hash_bin_passes              chunks that ran the hash-binned stage
+#   ops/hash_bin_occupancy_pct      cumulative per-chunk grid occupancy in
+#                                    percent (divide by passes for the mean)
+#   ops/hash_bin_overflow_demotions chunks whose RLE entry count exceeded
+#                                    the static bin count and were demoted
+#                                    to the tiled sort by the host driver
+EVENT_HASH_PASSES = "ops/hash_bin_passes"
+EVENT_HASH_OCCUPANCY = "ops/hash_bin_occupancy_pct"
+EVENT_HASH_DEMOTIONS = "ops/hash_bin_overflow_demotions"
 
 
 def packed_key_layout(n: int, num_partitions: int,
@@ -267,7 +279,8 @@ def sort_cost(n: int, *, num_partitions: int,
               max_segments: Optional[int] = None, pid_sorted: bool = False,
               tile_rows: int = 0, tile_slack: int = 0,
               has_value: bool = True, value_bytes: int = 4,
-              need_order: bool = False, l1_mode: bool = False) -> dict:
+              need_order: bool = False, l1_mode: bool = False,
+              hash_bins: int = 0, hash_bin_rows: int = 0) -> dict:
     """Static cost model of the sampler sort one kernel execution runs.
 
     Mirrors _dispatch_sampler's trace-time dispatch exactly, so host
@@ -278,6 +291,10 @@ def sort_cost(n: int, *, num_partitions: int,
     an O(N log N) sort network moves — credited to the profiler counters
     EVENT_SORT_ROWS / EVENT_SORT_TILES / EVENT_SORT_BYTES per executed
     chunk by the streaming drivers and bench.py.
+
+    kind "hash" (the sortless hash-binned group stage) reports its grid
+    geometry in rows/span/tiles but ZERO operand_bytes — the group stage
+    makes no sort pass over the wire at all.
     """
     if n <= 0:
         return {"kind": "empty", "rows": 0, "span": 1, "tiles": 0,
@@ -287,6 +304,10 @@ def sort_cost(n: int, *, num_partitions: int,
     if packed:
         bpr = 12 + (value_bytes if has_value else 0) + (4 if need_order
                                                         else 0)
+        if hash_bins and hash_bin_rows:
+            return {"kind": "hash", "rows": hash_bins * hash_bin_rows,
+                    "span": hash_bin_rows, "tiles": hash_bins,
+                    "bytes_per_row": 0, "operand_bytes": 0}
         if tile_rows and tile_rows + tile_slack < n:
             w = tile_rows + tile_slack
             tiles = -(-n // tile_rows)
@@ -379,6 +400,22 @@ def _prefix_changed(keys, prefix_bits: int) -> jnp.ndarray:
     return jnp.concatenate([jnp.ones((1,), dtype=bool), changed])
 
 
+def _sampler_randomness(key: jax.Array, n: int, randbits: int):
+    """(salt, rand): the PRNG draws of the presorted samplers.
+
+    Shared by the packed/tiled sort-key construction AND the hash-binned
+    stage — draw-for-draw the same derivation (salt from the second split,
+    per-row tiebreak bits from the first, truncated to the packed layout's
+    rand field), so every sampler keyed the same way makes identical
+    sampling decisions."""
+    k1, k2 = jax.random.split(key)
+    salt = jax.random.bits(k2, (), dtype=jnp.uint32)
+    rand = jax.random.bits(k1, (n,), dtype=jnp.uint32)
+    if randbits < 32:
+        rand = rand >> jnp.uint32(32 - randbits)
+    return salt, rand
+
+
 def _packed_sort_fields(key: jax.Array, pid: jnp.ndarray, pk: jnp.ndarray,
                         valid: jnp.ndarray, *, num_partitions: int,
                         max_segments: int):
@@ -392,20 +429,15 @@ def _packed_sort_fields(key: jax.Array, pid: jnp.ndarray, pk: jnp.ndarray,
     identical to the packed global sort's.
     """
     n = pid.shape[0]
-    k1, k2 = jax.random.split(key)
-    salt = jax.random.bits(k2, (), dtype=jnp.uint32)
-    ghash = _group_hash(pid, pk, salt)
-
     segbits, pkbits, randbits, padbits = packed_key_layout(
         n, num_partitions, max_segments)
+    salt, rand = _sampler_randomness(key, n, randbits)
+    ghash = _group_hash(pid, pk, salt)
 
     is_new_pid = valid & jnp.concatenate(
         [jnp.ones((1,), dtype=bool), pid[1:] != pid[:-1]])
     seg = jnp.maximum(jnp.cumsum(is_new_pid.astype(jnp.int32)) - 1,
                       0).astype(jnp.uint32)
-    rand = jax.random.bits(k1, (n,), dtype=jnp.uint32)
-    if randbits < 32:
-        rand = rand >> jnp.uint32(32 - randbits)
     fields = [(seg, segbits), (ghash, 32),
               (pk.astype(jnp.uint32), pkbits), (rand, randbits)]
     if padbits:
@@ -595,22 +627,192 @@ def _sample_rows_and_groups_tiled(key: jax.Array, pid: jnp.ndarray,
                                 linf_cap, l0_cap, sval, order)
 
 
+class BinnedRows(NamedTuple):
+    """The hash-binned (sortless) twin of SampledRows
+    (``segment_sort="hash"``).
+
+    Rows never ride a sort: each pid segment occupies one row of a
+    ``[hash_bins, hash_bin_rows]`` grid (cells in arrival order), and
+    the Linf/L0 sampling decisions come from keyed-priority selection
+    inside each bin — pairwise comparisons against the SAME salt /
+    truncated-rand draws the packed sort uses as its keys
+    (``_sampler_randomness``), so the sampled row multiset is identical
+    to the sort path's prefix-take for the same PRNG key.
+
+    Row-domain fields (original arrival order, [n]): keep_row /
+    keep_group_row are the Linf / L0 decisions; lead_row marks each
+    KEPT group's leader (its first row in arrival order) — the slot the
+    group's accumulator columns live at.
+
+    Grid-domain fields ([hash_bins, hash_bin_rows] or [.., .., W]):
+    trace-time context for the group reduce — ``same`` is the
+    group-membership pairwise mask, ``contrib`` additionally gates the
+    contributor by its Linf decision, ``cell`` maps each row to its
+    flat grid cell, ``sval`` is the value gathered into the grid.
+
+    ``ok`` is the contract backstop: False (a row failed to bin — the
+    per-segment width contract was violated by corrupt wire metadata)
+    empties every decision, so a violated contract yields empty
+    accumulators rather than a silently re-sampled release, exactly
+    like the tiled sampler's slack backstop.
+    """
+    keep_row: jnp.ndarray  # [n] Linf decision per row
+    keep_group_row: jnp.ndarray  # [n] L0 decision of the row's group
+    lead_row: jnp.ndarray  # [n] kept-group leader marker
+    cell: jnp.ndarray  # [n] flat grid cell of each row
+    same: jnp.ndarray  # [S, W, W] same-group pairwise mask
+    contrib: jnp.ndarray  # [S, W, W] same-group & contributor-kept
+    grid_valid: jnp.ndarray  # [S, W] occupied-cell mask
+    spk: jnp.ndarray  # [S, W] partition ids on the grid
+    sval: Optional[jnp.ndarray]  # [S, W] value on the grid
+    ok: jnp.ndarray  # scalar backstop
+
+
+def _bin_rows_and_groups_hash(key: jax.Array, pid: jnp.ndarray,
+                              pk: jnp.ndarray, valid: jnp.ndarray,
+                              linf_cap, l0_cap, *, num_partitions: int,
+                              max_segments: int, hash_bins: int,
+                              hash_bin_rows: int,
+                              value: Optional[jnp.ndarray] = None
+                              ) -> BinnedRows:
+    """The sortless group-stage sampler: one scatter into per-segment
+    bins, keyed-priority selection inside each bin, ZERO sort passes.
+
+    Same presorted-ingest contract as the packed/tiled samplers (valid
+    prefix, pid nondecreasing, distinct pids <= max_segments) plus the
+    host-sized grid geometry: hash_bins >= the chunk's pid segments
+    (the driver demotes chunks that do not fit — n_uniq > hash_bins —
+    to the tiled kernel) and hash_bin_rows >= the longest single-pid
+    run (row_packer prep stats, like tile_slack).
+
+    Sampling-parity argument (the load-bearing contract): the packed
+    sort orders rows by (segment, ghash, pk, rand, arrival) and takes
+    per-group / per-segment prefixes. Here every decision is the rank
+    form of the same order — a row's Linf rank is the count of
+    same-group rows with smaller (rand, arrival), a group's L0 rank is
+    the count of distinct same-segment groups with smaller (ghash, pk)
+    — over the identical salt/rand draws (_sampler_randomness). The
+    kept row multiset and kept group set are therefore IDENTICAL to the
+    sort path's for the same key; only the accumulation order differs
+    (which the int-exactness gate makes bit-invisible).
+    """
+    n = pid.shape[0]
+    s_bins = int(hash_bins)
+    w = int(hash_bin_rows)
+    _, _, randbits, _ = packed_key_layout(n, num_partitions, max_segments)
+    salt, rand = _sampler_randomness(key, n, randbits)
+    ghash = _group_hash(pid, pk, salt)
+
+    idx = jnp.arange(n, dtype=jnp.int32)
+    is_new_pid = valid & jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), pid[1:] != pid[:-1]])
+    seg = jnp.maximum(jnp.cumsum(is_new_pid.astype(jnp.int32)) - 1, 0)
+    seg_start = jax.lax.cummax(jnp.where(is_new_pid, idx, 0))
+
+    # Bin scatter: segment s's rows land in grid row s at their
+    # within-segment position (injective; segments are arrival-
+    # contiguous so the grid gather below is near-sequential). Segments
+    # beyond hash_bins drop and trip the ok backstop.
+    starts = jnp.zeros((s_bins,), jnp.int32).at[seg].max(seg_start,
+                                                         mode="drop")
+    src = starts[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+    srcc = jnp.minimum(src, n - 1)
+    grid_valid = ((src < n) & valid[srcc]
+                  & (seg[srcc]
+                     == jnp.arange(s_bins, dtype=jnp.int32)[:, None]))
+    n_valid = jnp.sum(valid.astype(jnp.int32))
+    ok = jnp.sum(grid_valid.astype(jnp.int32)) == n_valid
+
+    ones32 = jnp.uint32(0xFFFFFFFF)
+    bg = jnp.where(grid_valid, ghash[srcc], ones32)
+    bpk = jnp.where(grid_valid, pk[srcc], _INT32_MAX).astype(jnp.int32)
+    brand = jnp.where(grid_valid, rand[srcc], ones32)
+    sval = None
+    if value is not None:
+        sval = jnp.where(grid_valid, value[srcc],
+                         jnp.zeros((), dtype=value.dtype))
+
+    # Pairwise keyed-priority selection, j = target cell, k = the other
+    # cell of the same bin (XLA fuses each mask chain into its reduce —
+    # nothing [S, W, W]-sized is materialized).
+    cv_j = grid_valid[:, :, None]
+    cv_k = grid_valid[:, None, :]
+    tri = (jnp.arange(w, dtype=jnp.int32)[None, :, None]
+           > jnp.arange(w, dtype=jnp.int32)[None, None, :])  # k before j
+    same = (cv_j & cv_k
+            & (bg[:, :, None] == bg[:, None, :])
+            & (bpk[:, :, None] == bpk[:, None, :]))
+    # Linf: rank within the group by (rand, arrival) — the packed
+    # sort's tiebreak key and its stable tie order.
+    before_in_group = same & ((brand[:, None, :] < brand[:, :, None])
+                              | ((brand[:, None, :] == brand[:, :, None])
+                                 & tri))
+    keep_row_grid = grid_valid & (jnp.sum(before_in_group, axis=2)
+                                  < linf_cap)
+    is_leader = grid_valid & ~jnp.any(same & tri, axis=2)
+    # L0: rank of the row's group among the segment's distinct groups
+    # ordered by (ghash, pk) — count the leaders with a smaller key
+    # (evaluated at every member: its key equals its leader's).
+    gkey_lt = (is_leader[:, None, :] & cv_j
+               & ((bg[:, None, :] < bg[:, :, None])
+                  | ((bg[:, None, :] == bg[:, :, None])
+                     & (bpk[:, None, :] < bpk[:, :, None]))))
+    keep_group_grid = grid_valid & (jnp.sum(gkey_lt, axis=2) < l0_cap)
+    contrib = same & keep_row_grid[:, None, :]
+
+    # Gather the decisions back to the (smaller) row domain: each valid
+    # row's cell is (seg, idx - seg_start); the backstop masks
+    # everything when any row failed to bin.
+    cell = jnp.clip(seg * w + (idx - seg_start), 0, s_bins * w - 1)
+    rv = valid & ok
+    keep_row = rv & keep_row_grid.reshape(-1)[cell]
+    keep_group_row = rv & keep_group_grid.reshape(-1)[cell]
+    lead_row = (rv & is_leader.reshape(-1)[cell]
+                & keep_group_grid.reshape(-1)[cell])
+    return BinnedRows(keep_row, keep_group_row, lead_row, cell, same,
+                      contrib, grid_valid, bpk, sval, ok)
+
+
+def _hash_group_sum(b: BinnedRows, col: jnp.ndarray) -> jnp.ndarray:
+    """[n] per-group sum of a grid column over the group's KEPT rows,
+    gathered back to each row (meaningful at lead_row slots). One fused
+    mask-multiply-reduce over the bins — the sortless replacement for
+    the sorted path's group segment-sum."""
+    g = jnp.sum(jnp.where(b.contrib, col[:, None, :],
+                          jnp.zeros((), dtype=col.dtype)), axis=2)
+    return g.reshape(-1)[b.cell]
+
+
 def _dispatch_sampler(key, pid, pk, valid, linf_cap, l0_cap, l1_cap, *,
                       num_partitions, max_segments, pid_sorted, tile_rows,
-                      tile_slack, value, need_order=False) -> SampledRows:
+                      tile_slack, value, need_order=False,
+                      hash_bins=0, hash_bin_rows=0):
     """Trace-time sampler dispatch shared by every bounding kernel.
 
-    pid_sorted/max_segments/tile_* are static and `l1_cap is None` is a
-    pytree-structure (not value) test — the branch is deliberately
-    resolved at trace time, like the need_* flags. All three samplers
-    produce the same sampling distribution; the tiled and packed presorted
-    samplers are additionally BIT-identical to each other.
+    pid_sorted/max_segments/tile_*/hash_* are static and `l1_cap is
+    None` is a pytree-structure (not value) test — the branch is
+    deliberately resolved at trace time, like the need_* flags. All
+    samplers produce the same sampling distribution; the packed, tiled
+    and hash-binned presorted samplers additionally make BIT-identical
+    sampling decisions (the hash path returns them as a
+    :class:`BinnedRows` rank view instead of a sorted sequence —
+    callers branch on the type at trace time).
+
+    Dispatch order on presorted ingest: hash-binned (sortless group
+    stage) when the grid geometry is set, else tiled, else the packed
+    global sort; the general 4-key sort otherwise.
     """
     n = pid.shape[0]
     # dplint: disable=DPL003 — static/structural branch, resolved per compile
     if (pid_sorted and l1_cap is None
             and presorted_fits(n, num_partitions, max_segments)):
         max_seg = int(max_segments) if max_segments else n
+        if hash_bins and hash_bin_rows:
+            return _bin_rows_and_groups_hash(
+                key, pid, pk, valid, linf_cap, l0_cap,
+                num_partitions=num_partitions, max_segments=max_seg,
+                hash_bins=hash_bins, hash_bin_rows=hash_bin_rows,
+                value=value)
         if tile_rows and tile_rows + tile_slack < n:
             return _sample_rows_and_groups_tiled(
                 key, pid, pk, valid, linf_cap, l0_cap,
@@ -654,26 +856,12 @@ def _widen_sorted_value(sval, value_is_index: bool, value_lo, value_scale):
     return sval_f, sval_i
 
 
-def int_accumulation_plan(plan_lo, plan_scale, plan_bits: int, row_clip_lo,
-                          row_clip_hi, linf_cap
-                          ) -> Optional[Tuple[int, int]]:
-    """(int-domain row clip bounds) when the group-stage count and sum
-    columns may accumulate in int32 BIT-IDENTICALLY to the float32 path,
-    else None.
-
-    Exactness argument: when the value grid (lo + idx * scale) and any
-    finite row clip bound are integers, AND |lo| + max_idx * |scale| <
-    2^24 (so the float32 reconstruction's intermediate product and sum
-    are themselves exactly representable integers — without this a
-    product >= 2^24 can round, e.g. lo=-16777215, scale=3, idx=5592407
-    reconstructs 5.0 in float32 but 6 in int32), every per-row clipped
-    value is the same exact integer in float32 AND int32; with at most
-    linf_cap kept rows per group and linf_cap * max|value| < 2^24, every
-    float32 partial sum of the legacy group segment-sum is an exactly
-    representable integer — so the int32 sums widen to the same float32
-    bits at the partition fold. Requires a concrete (host) linf_cap; a
-    traced cap cannot be bounded statically.
-    """
+def _int_plan_bounds(plan_lo, plan_scale, plan_bits: int, row_clip_lo,
+                     row_clip_hi, linf_cap
+                     ) -> Optional[Tuple[int, int, float]]:
+    """(int clip lo, int clip hi, max |clipped row value|) under the
+    int-exactness gate, or None — the shared core of
+    int_accumulation_plan and hash_exact_gate."""
     try:
         linf = int(linf_cap)
     except (TypeError, ValueError):
@@ -700,7 +888,129 @@ def int_accumulation_plan(plan_lo, plan_scale, plan_bits: int, row_clip_lo,
             return None
     if linf * max(bounds) >= (1 << 24):
         return None
-    return iclo, ichi
+    return iclo, ichi, float(max(bounds))
+
+
+def int_accumulation_plan(plan_lo, plan_scale, plan_bits: int, row_clip_lo,
+                          row_clip_hi, linf_cap
+                          ) -> Optional[Tuple[int, int]]:
+    """(int-domain row clip bounds) when the group-stage count and sum
+    columns may accumulate in int32 BIT-IDENTICALLY to the float32 path,
+    else None.
+
+    Exactness argument: when the value grid (lo + idx * scale) and any
+    finite row clip bound are integers, AND |lo| + max_idx * |scale| <
+    2^24 (so the float32 reconstruction's intermediate product and sum
+    are themselves exactly representable integers — without this a
+    product >= 2^24 can round, e.g. lo=-16777215, scale=3, idx=5592407
+    reconstructs 5.0 in float32 but 6 in int32), every per-row clipped
+    value is the same exact integer in float32 AND int32; with at most
+    linf_cap kept rows per group and linf_cap * max|value| < 2^24, every
+    float32 partial sum of the legacy group segment-sum is an exactly
+    representable integer — so the int32 sums widen to the same float32
+    bits at the partition fold. Requires a concrete (host) linf_cap; a
+    traced cap cannot be bounded statically.
+    """
+    r = _int_plan_bounds(plan_lo, plan_scale, plan_bits, row_clip_lo,
+                         row_clip_hi, linf_cap)
+    return None if r is None else (r[0], r[1])
+
+
+def hash_exact_gate(plan_lo, plan_scale, plan_bits: int, row_clip_lo,
+                    row_clip_hi, linf_cap, group_clip_lo, group_clip_hi,
+                    cap_rows) -> bool:
+    """Whether the hash-binned group stage is BIT-identical to the
+    sorted paths at this configuration, regardless of reduction order.
+
+    Strengthens the int_accumulation_plan gate so that EVERY float32
+    partial sum anywhere in the kernel — group stage and partition fold,
+    in any association — is an exactly representable integer, making
+    the accumulation order (the only thing that differs between the
+    hash-binned and sorted group stages; the sampled multiset is
+    identical) bit-invisible:
+
+      * the int plan holds (integer grid, integer row clips,
+        linf_cap * max|v| < 2^24 — group partials exact);
+      * finite group-sum clip bounds are integers (clipped group sums
+        stay integers) — a clip can RAISE a magnitude (clip(5, 1000,
+        inf) = 1000), so its bounds enter the partition bound below;
+      * cap_rows < 2^24 (partition counts / pid-counts exact) and
+        cap_rows * max(|v|, |finite group clips|) < 2^24 (partition
+        sums exact: at most cap_rows groups, each bounded by the row
+        total or its clip).
+
+    The norm columns (mean/variance) are non-integer, so this gate
+    only certifies kernels that do not read them — the auto dispatch
+    additionally requires need_norm = need_norm_sq = False.
+    """
+    r = _int_plan_bounds(plan_lo, plan_scale, plan_bits, row_clip_lo,
+                         row_clip_hi, linf_cap)
+    if r is None:
+        return False
+    vmax = r[2]
+    bound = vmax
+    for b in (group_clip_lo, group_clip_hi):
+        fb = float(b)
+        if math.isnan(fb):
+            return False
+        if math.isfinite(fb):
+            if not fb.is_integer():
+                return False
+            bound = max(bound, abs(fb))
+    try:
+        cap = int(cap_rows)
+    except (TypeError, ValueError):
+        return False
+    return cap < (1 << 24) and cap * bound < (1 << 24)
+
+
+def _hash_partition_accumulators(s: BinnedRows, pk: jnp.ndarray, *,
+                                 num_partitions: int, row_clip_lo,
+                                 row_clip_hi, middle, group_clip_lo,
+                                 group_clip_hi, need_count, need_sum,
+                                 need_norm, need_norm_sq, has_group_clip,
+                                 value_is_index, value_lo, value_scale
+                                 ) -> PartitionAccumulators:
+    """Partition accumulators straight out of the hash bins: per-group
+    sums at leader rows, then ONE stacked scatter covering every
+    accumulator column ([num_partitions, n_cols] with a [n, n_cols]
+    update set — the "one scatter per accumulator" shape, fused).
+
+    The accumulation order differs from the sorted paths (row order vs
+    group-sorted order), which the hash_exact_gate makes bit-invisible;
+    outside the gate counts stay exact and sums are ULP-close.
+    """
+    sval, _ = _widen_sorted_value(s.sval, value_is_index, value_lo,
+                                  value_scale)
+    dtype = jnp.promote_types(sval.dtype, jnp.float32)
+    vclip = jnp.clip(sval, row_clip_lo, row_clip_hi).astype(dtype)
+    vnorm = vclip - middle
+    gw = s.lead_row.astype(dtype)
+    cols = [gw]  # pid_count: one per kept group
+    if need_count:
+        cols.append(_hash_group_sum(s, jnp.ones_like(vclip)) * gw)
+    if need_sum:
+        g_sum = _hash_group_sum(s, vclip)
+        if has_group_clip:
+            g_sum = jnp.clip(g_sum, group_clip_lo, group_clip_hi)
+        cols.append(g_sum * gw)
+    if need_norm:
+        cols.append(_hash_group_sum(s, vnorm) * gw)
+    if need_norm_sq:
+        cols.append(_hash_group_sum(s, vnorm * vnorm) * gw)
+
+    tgt = jnp.where(s.lead_row, pk, num_partitions).astype(jnp.int32)
+    out = jnp.zeros((num_partitions, len(cols)), dtype).at[tgt].add(
+        jnp.stack(cols, axis=-1), mode="drop")
+    zeros = jnp.zeros((num_partitions,), dtype=dtype)
+    slot = iter(range(1, len(cols)))
+    return PartitionAccumulators(
+        pid_count=out[:, 0],
+        count=out[:, next(slot)] if need_count else zeros,
+        sum=out[:, next(slot)] if need_sum else zeros,
+        norm_sum=out[:, next(slot)] if need_norm else zeros,
+        norm_sq_sum=out[:, next(slot)] if need_norm_sq else zeros,
+    )
 
 
 @functools.partial(jax.jit,
@@ -709,6 +1019,7 @@ def int_accumulation_plan(plan_lo, plan_scale, plan_bits: int, row_clip_lo,
                                     "need_norm_sq", "has_group_clip",
                                     "pid_sorted", "max_segments",
                                     "tile_rows", "tile_slack",
+                                    "hash_bins", "hash_bin_rows",
                                     "value_is_index", "value_sort_bits",
                                     "int_accumulate"))
 def bound_and_aggregate(key: jax.Array,
@@ -735,6 +1046,8 @@ def bound_and_aggregate(key: jax.Array,
                         max_segments: Optional[int] = None,
                         tile_rows: int = 0,
                         tile_slack: int = 0,
+                        hash_bins: int = 0,
+                        hash_bin_rows: int = 0,
                         value_is_index: bool = False,
                         value_lo=0.0,
                         value_scale=1.0,
@@ -773,6 +1086,17 @@ def bound_and_aggregate(key: jax.Array,
         global packed sort. Requires pid_sorted and tile_slack >= the
         longest single-pid run (the drivers derive it from the wire's
         prep-time per-pid counts). Bit-identical sampling either way.
+      hash_bins/hash_bin_rows: static grid geometry of the sortless
+        hash-binned group stage (_bin_rows_and_groups_hash;
+        segment_sort="hash") — takes precedence over tile geometry.
+        Requires pid_sorted, hash_bins >= the chunk's distinct pids and
+        hash_bin_rows >= the longest single-pid run (both host-derived
+        from the wire's prep stats; the drivers demote chunks that do
+        not fit back to the tiled kernel). Identical sampled multiset;
+        bit-identical released values under columnar.hash_exact_gate,
+        ULP-close sums (exact counts) otherwise. int_accumulate is
+        ignored on this path — under the gate its float32 sums are
+        already exact integers, which is the same bits.
       value_is_index: the value column arrives as the int32 affine plane
         index of the wire codec (VALUE_PLANES); it rides the sort narrow
         (value_sort_bits picks uint8/uint16 when the plane count fits)
@@ -796,7 +1120,19 @@ def bound_and_aggregate(key: jax.Array,
         key, pid, pk, valid, linf_cap, l0_cap, l1_cap,
         num_partitions=num_partitions, max_segments=max_segments,
         pid_sorted=pid_sorted, tile_rows=tile_rows, tile_slack=tile_slack,
+        hash_bins=hash_bins, hash_bin_rows=hash_bin_rows,
         value=_narrow_sort_value(value, value_is_index, value_sort_bits))
+    if isinstance(s, BinnedRows):
+        # Sortless group stage: per-group sums inside the bins, one
+        # stacked scatter straight to the partition accumulators.
+        return _hash_partition_accumulators(
+            s, pk, num_partitions=num_partitions, row_clip_lo=row_clip_lo,
+            row_clip_hi=row_clip_hi, middle=middle,
+            group_clip_lo=group_clip_lo, group_clip_hi=group_clip_hi,
+            need_count=need_count, need_sum=need_sum, need_norm=need_norm,
+            need_norm_sq=need_norm_sq, has_group_clip=has_group_clip,
+            value_is_index=value_is_index, value_lo=value_lo,
+            value_scale=value_scale)
     sval, sval_i = _widen_sorted_value(s.sval, value_is_index, value_lo,
                                        value_scale)
 
@@ -909,12 +1245,84 @@ class CompactGroups(NamedTuple):
     n_kept: jnp.ndarray
 
 
+def _compact_from_groups(kept, g_pk_safe, cols, *, max_groups: int,
+                         num_partitions: int, dtype) -> CompactGroups:
+    """Compacts per-group accumulator columns (any layout: the sorted
+    paths' [n] group slots or the hash path's [n] leader rows) into
+    CompactGroups: kept entries pack to a [max_groups] prefix, a stable
+    [max_groups] sort by pk groups equal partitions, and a run
+    reduction emits ONE subtotal per partition — kept-entry order is
+    preserved within a partition, so the sorted paths reproduce the
+    legacy scatter's fold order bitwise."""
+    g = max_groups
+    pos = (jnp.cumsum(kept.astype(jnp.int32)) - 1)
+    idx = jnp.where(kept, pos, g)
+    cpk = jnp.full((g,), num_partitions, dtype=jnp.int32)
+    cpk = cpk.at[idx].set(g_pk_safe, mode="drop")
+    ccols = [jnp.zeros((g,), dtype=dtype).at[idx].set(c, mode="drop")
+             for c in cols]
+
+    # Stable sort by pk: equal-pk groups stay in kept order, so the run
+    # reduction below adds them in exactly the legacy scatter's order.
+    sorted_ops = jax.lax.sort([cpk] + ccols, num_keys=1, is_stable=True)
+    spk_c = sorted_ops[0]
+    is_run_start = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), spk_c[1:] != spk_c[:-1]])
+    run_id = (jnp.cumsum(is_run_start) - 1).astype(jnp.int32)
+    rseg = functools.partial(jax.ops.segment_sum, segment_ids=run_id,
+                             num_segments=g, indices_are_sorted=True)
+    run_pk = jax.ops.segment_max(spk_c, run_id, num_segments=g,
+                                 indices_are_sorted=True)
+    subtot = [rseg(c) for c in sorted_ops[1:]]
+    n_kept = jnp.sum(kept.astype(jnp.int32))
+    return CompactGroups(run_pk, subtot[0], subtot[1], subtot[2],
+                         subtot[3], subtot[4], n_kept)
+
+
+def _hash_compact_groups(s: BinnedRows, pk: jnp.ndarray, *,
+                         num_partitions: int, max_groups: int,
+                         row_clip_lo, row_clip_hi, middle, group_clip_lo,
+                         group_clip_hi, need_count, need_sum, need_norm,
+                         need_norm_sq, has_group_clip, value_is_index,
+                         value_lo, value_scale) -> CompactGroups:
+    """Compact per-group columns straight out of the hash bins (the
+    compact-merge twin of _hash_partition_accumulators): group sums at
+    leader rows compact to the shared CompactGroups shape, so the
+    merge-side machinery (PR 5) is reused unchanged. Kept-group order
+    is row (arrival) order rather than the sorted paths' group order —
+    bit-invisible under hash_exact_gate, ULP-only otherwise."""
+    sval, _ = _widen_sorted_value(s.sval, value_is_index, value_lo,
+                                  value_scale)
+    dtype = jnp.promote_types(sval.dtype, jnp.float32)
+    vclip = jnp.clip(sval, row_clip_lo, row_clip_hi).astype(dtype)
+    vnorm = vclip - middle
+    gw = s.lead_row.astype(dtype)
+    zeros_n = jnp.zeros_like(gw)
+    g_sum = zeros_n
+    if need_sum:
+        g_sum = _hash_group_sum(s, vclip)
+        if has_group_clip:
+            g_sum = jnp.clip(g_sum, group_clip_lo, group_clip_hi)
+    cols = (gw,
+            _hash_group_sum(s, jnp.ones_like(vclip)) * gw
+            if need_count else zeros_n,
+            g_sum * gw if need_sum else zeros_n,
+            _hash_group_sum(s, vnorm) * gw if need_norm else zeros_n,
+            _hash_group_sum(s, vnorm * vnorm) * gw
+            if need_norm_sq else zeros_n)
+    g_pk_safe = jnp.where(s.lead_row, pk, 0).astype(jnp.int32)
+    return _compact_from_groups(s.lead_row, g_pk_safe, cols,
+                                max_groups=max_groups,
+                                num_partitions=num_partitions, dtype=dtype)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("num_partitions", "max_groups",
                                     "need_count", "need_sum", "need_norm",
                                     "need_norm_sq", "has_group_clip",
                                     "pid_sorted", "max_segments",
                                     "tile_rows", "tile_slack",
+                                    "hash_bins", "hash_bin_rows",
                                     "value_is_index", "value_sort_bits",
                                     "int_accumulate"))
 def bound_and_aggregate_compact(key: jax.Array,
@@ -942,6 +1350,8 @@ def bound_and_aggregate_compact(key: jax.Array,
                                 max_segments: Optional[int] = None,
                                 tile_rows: int = 0,
                                 tile_slack: int = 0,
+                                hash_bins: int = 0,
+                                hash_bin_rows: int = 0,
                                 value_is_index: bool = False,
                                 value_lo=0.0,
                                 value_scale=1.0,
@@ -973,7 +1383,18 @@ def bound_and_aggregate_compact(key: jax.Array,
         key, pid, pk, valid, linf_cap, l0_cap, l1_cap,
         num_partitions=num_partitions, max_segments=max_segments,
         pid_sorted=pid_sorted, tile_rows=tile_rows, tile_slack=tile_slack,
+        hash_bins=hash_bins, hash_bin_rows=hash_bin_rows,
         value=_narrow_sort_value(value, value_is_index, value_sort_bits))
+    if isinstance(s, BinnedRows):
+        return _hash_compact_groups(
+            s, pk, num_partitions=num_partitions, max_groups=max_groups,
+            row_clip_lo=row_clip_lo, row_clip_hi=row_clip_hi,
+            middle=middle, group_clip_lo=group_clip_lo,
+            group_clip_hi=group_clip_hi, need_count=need_count,
+            need_sum=need_sum, need_norm=need_norm,
+            need_norm_sq=need_norm_sq, has_group_clip=has_group_clip,
+            value_is_index=value_is_index, value_lo=value_lo,
+            value_scale=value_scale)
     sval, sval_i = _widen_sorted_value(s.sval, value_is_index, value_lo,
                                        value_scale)
 
@@ -1017,30 +1438,9 @@ def bound_and_aggregate_compact(key: jax.Array,
             g_norm * gw if need_norm else zeros_n,
             g_norm_sq * gw if need_norm_sq else zeros_n)
 
-    kept = g_keep > 0
-    g = max_groups
-    pos = (jnp.cumsum(kept.astype(jnp.int32)) - 1)
-    idx = jnp.where(kept, pos, g)
-    cpk = jnp.full((g,), num_partitions, dtype=jnp.int32)
-    cpk = cpk.at[idx].set(g_pk_safe, mode="drop")
-    ccols = [jnp.zeros((g,), dtype=dtype).at[idx].set(c, mode="drop")
-             for c in cols]
-
-    # Stable sort by pk: equal-pk groups stay in group order, so the run
-    # reduction below adds them in exactly the legacy scatter's order.
-    sorted_ops = jax.lax.sort([cpk] + ccols, num_keys=1, is_stable=True)
-    spk_c = sorted_ops[0]
-    is_run_start = jnp.concatenate(
-        [jnp.ones((1,), dtype=bool), spk_c[1:] != spk_c[:-1]])
-    run_id = (jnp.cumsum(is_run_start) - 1).astype(jnp.int32)
-    rseg = functools.partial(jax.ops.segment_sum, segment_ids=run_id,
-                             num_segments=g, indices_are_sorted=True)
-    run_pk = jax.ops.segment_max(spk_c, run_id, num_segments=g,
-                                 indices_are_sorted=True)
-    subtot = [rseg(c) for c in sorted_ops[1:]]
-    n_kept = jnp.sum(kept.astype(jnp.int32))
-    return CompactGroups(run_pk, subtot[0], subtot[1], subtot[2],
-                         subtot[3], subtot[4], n_kept)
+    return _compact_from_groups(g_keep > 0, g_pk_safe, cols,
+                                max_groups=max_groups,
+                                num_partitions=num_partitions, dtype=dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("num_partitions",
@@ -1199,14 +1599,17 @@ def bound_and_aggregate_vector(key: jax.Array,
 @functools.partial(jax.jit,
                    static_argnames=("pid_sorted", "max_segments",
                                     "num_partitions", "tile_rows",
-                                    "tile_slack"))
+                                    "tile_slack", "hash_bins",
+                                    "hash_bin_rows"))
 def bound_row_mask(key: jax.Array, pid: jnp.ndarray, pk: jnp.ndarray,
                    valid: jnp.ndarray, linf_cap, l0_cap,
                    l1_cap=None, *, pid_sorted: bool = False,
                    max_segments: Optional[int] = None,
                    num_partitions: Optional[int] = None,
                    tile_rows: int = 0,
-                   tile_slack: int = 0) -> jnp.ndarray:
+                   tile_slack: int = 0,
+                   hash_bins: int = 0,
+                   hash_bin_rows: int = 0) -> jnp.ndarray:
     """Per-row keep mask (original row order) after Linf + L0 bounding.
 
     Identical sampling decisions to bound_and_aggregate for the same key —
@@ -1229,8 +1632,12 @@ def bound_row_mask(key: jax.Array, pid: jnp.ndarray, pk: jnp.ndarray,
         num_partitions=num_partitions if num_partitions is not None else 0,
         max_segments=max_segments,
         pid_sorted=pid_sorted and num_partitions is not None,
-        tile_rows=tile_rows, tile_slack=tile_slack, value=None,
+        tile_rows=tile_rows, tile_slack=tile_slack,
+        hash_bins=hash_bins, hash_bin_rows=hash_bin_rows, value=None,
         need_order=True)
+    if isinstance(s, BinnedRows):
+        # The hash-binned decisions are already in original row order.
+        return s.keep_row & s.keep_group_row
     keep_sorted_rows = s.keep_row & s.keep_group_row
     return jnp.zeros((n,), dtype=bool).at[s.order].set(keep_sorted_rows)
 

@@ -105,20 +105,35 @@ COMPACT_MIN_PARTITIONS = 1 << 17
 
 def finish_wire_plan(fmt, segment_sort, max_run, *, num_partitions: int,
                      row_clip_lo, row_clip_hi, linf_cap, l1_mode: bool,
-                     with_quantile_mask: bool = False):
+                     with_quantile_mask: bool = False,
+                     group_clip_lo=-np.inf, group_clip_hi=np.inf,
+                     need_flags=(True, True, True, True)):
     """Finalizes a wire format for the chunk kernels -> (fmt, int_clip,
     sort_stats). Shared by the single-device slab loop and the mesh chunk
     loop (parallel/sharded.py) so both paths resolve the segment_sort
     knob, the int32-accumulation gate, and the per-chunk sort cost
     identically.
 
-    fmt gains tile geometry when the knob + prep-time max_run allow
-    (wirecodec.plan_segment_tiling); int_clip is the int32 row-clip pair
-    when VALUE_PLANES chunks may accumulate in int32 bit-identically
+    fmt gains tile geometry and (segment_sort "hash", or "auto" under
+    the order-exactness gate) the hash-bin grid of the sortless group
+    stage (wirecodec.plan_group_binning — the 4-way
+    general/packed/tiled/hash dispatch); int_clip is the int32 row-clip
+    pair when VALUE_PLANES chunks may accumulate in int32 bit-identically
     (columnar.int_accumulation_plan), else None; sort_stats is the
     columnar.sort_cost dict one executed chunk kernel credits to the
     ops/sort_* counters (plus the replayed row-mask sort when the chunk
-    also feeds quantile histograms).
+    also feeds quantile histograms), its resolved ``kind``, and — when
+    the hash grid is planned — the ``demoted`` stats of the per-chunk
+    tiled fallback plus the ``grid_cells`` occupancy denominator.
+
+    "auto" picks the hash-binned stage only when it is provably
+    bit-identical to the sorted paths: columnar.hash_exact_gate holds
+    (every float32 partial sum is an exact integer, so the different
+    accumulation order cannot change a bit), the kernel reads no norm
+    columns (mean/variance sums are non-integer), no L1 mode, and the
+    grid fits every chunk. segment_sort="hash" forces the stage whenever
+    its geometry is computable — exact counts, ULP-close sums outside
+    the gate, with the tiled path as the parity oracle.
 
     segment_sort=False is the full round-8 parity oracle: no tiling, the
     value widens to float32 at decode (f32 sort payload), and the group
@@ -126,15 +141,24 @@ def finish_wire_plan(fmt, segment_sort, max_run, *, num_partitions: int,
     kernel-side change, not just the tile geometry."""
     if segment_sort is False:
         fmt = dataclasses.replace(fmt, tile_rows=0, tile_slack=0,
+                                  hash_bins=0, hash_bin_rows=0,
                                   sort_value_narrow=False)
         clip = None
     else:
-        fmt = wirecodec.plan_segment_tiling(fmt, segment_sort, max_run)
         clip = None
+        exact = False
         if fmt.value.mode == wirecodec.VALUE_PLANES:
             clip = columnar.int_accumulation_plan(
                 fmt.value.lo, fmt.value.scale, fmt.value.bits,
                 row_clip_lo, row_clip_hi, linf_cap)
+            if (clip is not None and not l1_mode
+                    and not (need_flags[2] or need_flags[3])):
+                exact = columnar.hash_exact_gate(
+                    fmt.value.lo, fmt.value.scale, fmt.value.bits,
+                    row_clip_lo, row_clip_hi, linf_cap,
+                    group_clip_lo, group_clip_hi, fmt.cap)
+        fmt = wirecodec.plan_group_binning(fmt, segment_sort, max_run,
+                                           exact=exact)
         if clip is not None:
             clip = (np.int32(clip[0]), np.int32(clip[1]))
     vb = 4
@@ -142,20 +166,57 @@ def finish_wire_plan(fmt, segment_sort, max_run, *, num_partitions: int,
             and fmt.sort_value_narrow):
         vb = 1 if fmt.value.bits <= 8 else (
             2 if fmt.value.bits <= 16 else 4)
-    tiles = ((fmt.tile_rows, fmt.tile_slack) if fmt.pid_sorted
-             else (0, 0))
-    kw = dict(num_partitions=num_partitions,
-              max_segments=fmt.ucap if fmt.pid_sorted else None,
-              pid_sorted=fmt.pid_sorted, tile_rows=tiles[0],
-              tile_slack=tiles[1], l1_mode=l1_mode)
-    cost = columnar.sort_cost(fmt.cap, value_bytes=vb, **kw)
-    stats = {name: cost[name]
-             for name in ("rows", "tiles", "operand_bytes")}
-    if with_quantile_mask:
-        mask = columnar.sort_cost(fmt.cap, has_value=False,
-                                  need_order=True, **kw)
-        stats = {name: stats[name] + mask[name] for name in stats}
+
+    def cost_stats(hash_bins, hash_bin_rows):
+        tiles = ((fmt.tile_rows, fmt.tile_slack) if fmt.pid_sorted
+                 else (0, 0))
+        kw = dict(num_partitions=num_partitions,
+                  max_segments=fmt.ucap if fmt.pid_sorted else None,
+                  pid_sorted=fmt.pid_sorted, tile_rows=tiles[0],
+                  tile_slack=tiles[1], hash_bins=hash_bins,
+                  hash_bin_rows=hash_bin_rows, l1_mode=l1_mode)
+        cost = columnar.sort_cost(fmt.cap, value_bytes=vb, **kw)
+        out = {name: cost[name]
+               for name in ("rows", "tiles", "operand_bytes")}
+        if with_quantile_mask:
+            mask = columnar.sort_cost(fmt.cap, has_value=False,
+                                      need_order=True, **kw)
+            for name in ("rows", "tiles", "operand_bytes"):
+                out[name] += mask[name]
+        out["kind"] = cost["kind"]
+        return out
+
+    hb = (fmt.hash_bins, fmt.hash_bin_rows) if fmt.pid_sorted else (0, 0)
+    stats = cost_stats(*hb)
+    if hb[0]:
+        stats["demoted"] = cost_stats(0, 0)
+        stats["grid_cells"] = hb[0] * hb[1]
     return fmt, clip, stats
+
+
+def resolved_sampler_desc(fmt, segment_sort, max_run, *,
+                          num_partitions: int, row_clip_lo, row_clip_hi,
+                          linf_cap, l1_mode: bool, group_clip_lo,
+                          group_clip_hi, need_flags) -> str:
+    """Opaque identity of the RESOLVED sampler a query config runs —
+    the sampler kind plus the finished wire-format geometry (tile/hash
+    fields, narrow-payload flag) the chunk kernels compile against.
+
+    Two knob settings that resolve to the same kernel get the same
+    descriptor; the same knob string resolving differently (e.g. "auto"
+    picking hash under the exactness gate vs tiled outside it) gets a
+    different one. The serving bound cache keys on this instead of the
+    raw knob string, so flipping ``segment_sort`` between queries can
+    never alias a cached accumulator across samplers (the checkpoint
+    path gets the same guarantee from ``repr(fmt)`` riding the wire
+    fingerprint).
+    """
+    fmt2, int_clip, stats = finish_wire_plan(
+        fmt, segment_sort, max_run, num_partitions=num_partitions,
+        row_clip_lo=row_clip_lo, row_clip_hi=row_clip_hi,
+        linf_cap=linf_cap, l1_mode=l1_mode, group_clip_lo=group_clip_lo,
+        group_clip_hi=group_clip_hi, need_flags=tuple(need_flags))
+    return f"{stats['kind']}:{fmt2!r}"
 
 
 def _count_sort_stats(stats) -> None:
@@ -306,6 +367,8 @@ def _decode_for_kernel(row, n_valid, n_uniq, fmt):
     kwargs = dict(
         tile_rows=fmt.tile_rows if fmt.pid_sorted else 0,
         tile_slack=fmt.tile_slack if fmt.pid_sorted else 0,
+        hash_bins=fmt.hash_bins if fmt.pid_sorted else 0,
+        hash_bin_rows=fmt.hash_bin_rows if fmt.pid_sorted else 0,
         value_is_index=value_as_index,
         value_lo=np.float32(fmt.value.lo),
         value_scale=np.float32(fmt.value.scale),
@@ -430,10 +493,25 @@ def _merge_pending(accs, pending, num_partitions, need_flags):
         need_flags=tuple(need_flags))
 
 
+def _credit_chunk_stats(stats, n_valid) -> None:
+    """Per-executed-chunk counter crediting: the sort-cost model plus
+    the hash-bin pass/occupancy counters (the drivers' host-side twin
+    of the jitted kernels, which cannot count per execution)."""
+    if stats is None:
+        return
+    _count_sort_stats(stats)
+    if stats.get("kind") == "hash":
+        profiler.count_event(columnar.EVENT_HASH_PASSES)
+        cells = max(int(stats.get("grid_cells", 0)), 1)
+        profiler.count_event(columnar.EVENT_HASH_OCCUPANCY,
+                             min(100, (100 * int(n_valid)) // cells))
+
+
 def _build_chunk_steps(key, fmt, int_clip, *, num_partitions, linf_cap,
                        l0_cap, row_clip_lo, row_clip_hi, middle,
                        group_clip_lo, group_clip_hi, l1_cap, need_flags,
-                       has_group_clip, quantile_spec, compact_merge):
+                       has_group_clip, quantile_spec, compact_merge,
+                       sort_stats=None):
     """(step_chunk, compact_step, merge_fn) for one finished wire format.
 
     The single place the per-chunk kernel closures are built, shared by
@@ -442,19 +520,44 @@ def _build_chunk_steps(key, fmt, int_clip, *, num_partitions, linf_cap,
     identical kernels under the identical ``fold_in(key, c)`` schedule —
     the warm-path bit-parity contract of SERVING.md rests on this.
 
+    When fmt plans the hash-binned group stage, the per-chunk demotion
+    lives here: a chunk whose RLE entry count exceeds the static bin
+    count runs the tiled kernel instead (a second compile of the same
+    step with the hash fields zeroed) — decided on HOST data that is
+    part of the wire fingerprint, so cold runs, warm replays and
+    resumes demote identically and released bits never depend on it.
+
+    sort_stats (finish_wire_plan) makes the steps credit the executed
+    sort-cost model and hash-bin counters per chunk — per-chunk because
+    demoted chunks must credit the fallback cost, which the driver's
+    single on_chunk hook cannot distinguish.
+
     compact_step/merge_fn are None when the compact merge does not apply
     (knob off, too few partitions, PID_PLANES wire — no per-chunk pid
     bound — or quantile histograms, which stay on the legacy fold).
     """
+    hash_on = fmt.hash_bins > 0 and fmt.pid_sorted
+    fmt_demoted = (dataclasses.replace(fmt, hash_bins=0, hash_bin_rows=0)
+                   if hash_on else fmt)
+
+    def chunk_plan(n_uniq_c, n_valid):
+        if hash_on and n_uniq_c > fmt.hash_bins:
+            profiler.count_event(columnar.EVENT_HASH_DEMOTIONS)
+            demoted = (sort_stats or {}).get("demoted")
+            _credit_chunk_stats(demoted, n_valid)
+            return fmt_demoted
+        _credit_chunk_stats(sort_stats, n_valid)
+        return fmt
 
     def step_chunk(c, bucket_row, accs, qhist, n_valid, n_uniq_c):
+        use_fmt = chunk_plan(n_uniq_c, n_valid)
         if quantile_spec is not None:
             return _chunk_step_rle_quantile(
                 jax.random.fold_in(key, c), bucket_row, n_valid,
                 n_uniq_c, accs, qhist, linf_cap, l0_cap, row_clip_lo,
                 row_clip_hi, middle, group_clip_lo, group_clip_hi,
                 quantile_spec[1], quantile_spec[2], l1_cap,
-                num_partitions=num_partitions, fmt=fmt,
+                num_partitions=num_partitions, fmt=use_fmt,
                 num_leaves=quantile_spec[0],
                 need_flags=tuple(need_flags),
                 has_group_clip=has_group_clip)
@@ -462,7 +565,7 @@ def _build_chunk_steps(key, fmt, int_clip, *, num_partitions, linf_cap,
             jax.random.fold_in(key, c), bucket_row, n_valid, n_uniq_c,
             accs, linf_cap, l0_cap, row_clip_lo, row_clip_hi, middle,
             group_clip_lo, group_clip_hi, l1_cap, int_clip,
-            num_partitions=num_partitions, fmt=fmt,
+            num_partitions=num_partitions, fmt=use_fmt,
             need_flags=tuple(need_flags),
             has_group_clip=has_group_clip,
             int_accumulate=int_clip is not None), qhist
@@ -475,11 +578,12 @@ def _build_chunk_steps(key, fmt, int_clip, *, num_partitions, linf_cap,
         if max_groups is not None:
 
             def compact_step(c, bucket_row, n_valid, n_uniq_c):
+                use_fmt = chunk_plan(n_uniq_c, n_valid)
                 return _chunk_step_rle_compact(
                     jax.random.fold_in(key, c), bucket_row, n_valid,
                     n_uniq_c, linf_cap, l0_cap, row_clip_lo, row_clip_hi,
                     middle, group_clip_lo, group_clip_hi, l1_cap, int_clip,
-                    num_partitions=num_partitions, fmt=fmt,
+                    num_partitions=num_partitions, fmt=use_fmt,
                     max_groups=max_groups, need_flags=tuple(need_flags),
                     has_group_clip=has_group_clip,
                     int_accumulate=int_clip is not None)
@@ -534,15 +638,16 @@ def _chunk_step_rle_quantile(key, row, n_valid, n_uniq, accs, qhist,
         pid_sorted=fmt.pid_sorted,
         max_segments=fmt.ucap if fmt.pid_sorted else None,
         **vkw)
-    # Same pid_sorted/tile statics as the aggregation kernel, so the
-    # replayed sampling decisions stay identical (shared packed-key sort,
-    # tiled or global).
+    # Same pid_sorted/tile/hash statics as the aggregation kernel, so the
+    # replayed sampling decisions stay identical (shared packed-key sort
+    # or hash-binned selection).
     row_keep = columnar.bound_row_mask(
         key, pid, pk, valid, linf_cap, l0_cap, l1_cap=l1_cap,
         pid_sorted=fmt.pid_sorted,
         max_segments=fmt.ucap if fmt.pid_sorted else None,
         num_partitions=num_partitions,
-        tile_rows=vkw["tile_rows"], tile_slack=vkw["tile_slack"])
+        tile_rows=vkw["tile_rows"], tile_slack=vkw["tile_slack"],
+        hash_bins=vkw["hash_bins"], hash_bin_rows=vkw["hash_bin_rows"])
     if vkw["value_is_index"]:
         # The leaf histogram buckets float values; reconstruct with the
         # decode expression (bit-exact twin of the non-index decode).
@@ -672,9 +777,11 @@ def stream_bound_and_aggregate(
                 num_partitions=num_partitions, row_clip_lo=row_clip_lo,
                 row_clip_hi=row_clip_hi, linf_cap=linf_cap,
                 l1_mode=l1_cap is not None,
-                with_quantile_mask=quantile_spec is not None)
+                with_quantile_mask=quantile_spec is not None,
+                group_clip_lo=group_clip_lo, group_clip_hi=group_clip_hi,
+                need_flags=tuple(need_flags))
 
-        def build_steps(fmt, int_clip):
+        def build_steps(fmt, int_clip, sort_stats):
             return _build_chunk_steps(
                 key, fmt, int_clip, num_partitions=num_partitions,
                 linf_cap=linf_cap, l0_cap=l0_cap, row_clip_lo=row_clip_lo,
@@ -682,7 +789,7 @@ def stream_bound_and_aggregate(
                 group_clip_lo=group_clip_lo, group_clip_hi=group_clip_hi,
                 l1_cap=l1_cap, need_flags=need_flags,
                 has_group_clip=has_group_clip, quantile_spec=quantile_spec,
-                compact_merge=compact_merge)
+                compact_merge=compact_merge, sort_stats=sort_stats)
 
         scatter_passes = 1 + sum(bool(f) for f in need_flags)
 
@@ -749,14 +856,14 @@ def stream_bound_and_aggregate(
                                 "buckets")
                     return enc.emit_range(s0, s1, fmt)
 
-                step_chunk, compact_step, merge_fn = build_steps(fmt,
-                                                                 int_clip)
+                step_chunk, compact_step, merge_fn = build_steps(
+                    fmt, int_clip, sort_stats)
                 accs, qhist = _drive_slab_windows(
                     key, k, counts, n_uniq, fmt, prepare_slab, step_chunk,
                     n_t, num_partitions, quantile_spec, resilience,
                     lambda: _input_digest(pid, pk, value),
                     compact_step=compact_step, merge_fn=merge_fn,
-                    scatter_passes=scatter_passes, sort_stats=sort_stats)
+                    scatter_passes=scatter_passes)
         else:
             with profiler.stage("dp/wire_encode"):
                 slab, counts, n_uniq, fmt = wirecodec.encode_buckets_numpy(
@@ -766,14 +873,15 @@ def stream_bound_and_aggregate(
                     bits_pid=info.bits_pid)
             fmt, int_clip, sort_stats = _finish_wire_plan(fmt)
             n_t = n_transfers or _num_transfers(slab.nbytes, k)
-            step_chunk, compact_step, merge_fn = build_steps(fmt, int_clip)
+            step_chunk, compact_step, merge_fn = build_steps(
+                fmt, int_clip, sort_stats)
             accs, qhist = _drive_slab_windows(
                 key, k, counts, n_uniq, fmt,
                 lambda s0, s1: slab[s0:s1], step_chunk,
                 n_t, num_partitions, quantile_spec, resilience,
                 lambda: _input_digest(pid, pk, value),
                 compact_step=compact_step, merge_fn=merge_fn,
-                scatter_passes=scatter_passes, sort_stats=sort_stats)
+                scatter_passes=scatter_passes)
         if quantile_spec is not None:
             return accs, qhist
         return accs
@@ -1432,7 +1540,9 @@ def replay_resident_wire(key: jax.Array,
         num_partitions=num_partitions, row_clip_lo=row_clip_lo,
         row_clip_hi=row_clip_hi, linf_cap=linf_cap,
         l1_mode=l1_cap is not None,
-        with_quantile_mask=quantile_spec is not None)
+        with_quantile_mask=quantile_spec is not None,
+        group_clip_lo=group_clip_lo, group_clip_hi=group_clip_hi,
+        need_flags=tuple(need_flags))
     step_chunk, compact_step, merge_fn = _build_chunk_steps(
         key, fmt, int_clip, num_partitions=num_partitions,
         linf_cap=linf_cap, l0_cap=l0_cap, row_clip_lo=row_clip_lo,
@@ -1440,7 +1550,7 @@ def replay_resident_wire(key: jax.Array,
         group_clip_lo=group_clip_lo, group_clip_hi=group_clip_hi,
         l1_cap=l1_cap, need_flags=need_flags,
         has_group_clip=has_group_clip, quantile_spec=quantile_spec,
-        compact_merge=compact_merge)
+        compact_merge=compact_merge, sort_stats=sort_stats)
     k = wire.k
     placement = _ResidentReplayPlacement(
         device_slab=wire._device_slab,
@@ -1457,8 +1567,7 @@ def replay_resident_wire(key: jax.Array,
         counts=wire.counts,
         n_uniq=wire.n_uniq,
         scatter_passes=1 + sum(bool(f) for f in need_flags),
-        quantile=quantile_spec is not None,
-        on_chunk=lambda: _count_sort_stats(sort_stats))
+        quantile=quantile_spec is not None)
     accs, qhist = driver_lib.SlabDriver(
         placement, plan, lambda s0, s1: wire.slab[s0:s1], key,
         resilience).run()
@@ -1557,10 +1666,13 @@ def replay_resident_wire_batched(keys,
     if wire.n_rows == 0:
         return accs
     profiler.count_event(EVENT_SERVING_REPLAYS)
-    # Parity-oracle statics: tile-free packed sort, wide payload. PR 7's
-    # parity matrix pins every segment_sort mode bit-identical, so the
-    # batched lanes match sequential replays at any knob setting.
+    # Parity-oracle statics: tile-free packed sort, wide payload, no
+    # hash bins. PR 7's parity matrix pins the sorted segment_sort modes
+    # bit-identical (and the hash-binned stage matches them under its
+    # exactness gate — the only regime the auto dispatch picks it in),
+    # so the batched lanes match sequential replays at any knob setting.
     fmt = dataclasses.replace(wire.fmt, tile_rows=0, tile_slack=0,
+                              hash_bins=0, hash_bin_rows=0,
                               sort_value_narrow=False)
     linf = jnp.asarray(np.asarray(linf_caps, dtype=np.int32))
     l0 = jnp.asarray(np.asarray(l0_caps, dtype=np.int32))

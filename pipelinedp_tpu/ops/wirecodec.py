@@ -108,6 +108,13 @@ class WireFormat:
     bits_pid: int = 0  # pid bit-planes per row (PID_PLANES only)
     tile_rows: int = 0  # segment-local sort tile width (0 = untiled)
     tile_slack: int = 0  # per-tile slack >= max single-pid run
+    # Sortless hash-binned group stage (segment_sort="hash";
+    # plan_group_binning): per-segment bin count and bin width. Like the
+    # tile fields this is kernel geometry, not wire layout; 0 = off.
+    # Chunks whose RLE entry count exceeds hash_bins are demoted to the
+    # tiled kernel per chunk by the drivers (never wrong bits).
+    hash_bins: int = 0
+    hash_bin_rows: int = 0  # bin width >= max single-pid run
     # VALUE_PLANES chunks ride the kernel sort as the narrow plane index
     # (widened to float32 after it — bit-identical releases). False
     # restores the round-8 widen-at-decode kernel; like the tile fields
@@ -759,6 +766,62 @@ def plan_segment_tiling(fmt: WireFormat, segment_sort,
     if segment_sort == "auto" and tile > fmt.cap // 8:
         return fmt
     return dataclasses.replace(fmt, tile_rows=tile, tile_slack=slack)
+
+
+# Hash-binned group-stage geometry limits (plan_group_binning). The bin
+# width bounds the O(W^2) pairwise selection per segment — beyond
+# HASH_MAX_BIN_ROWS the quadratic term loses to the tiled sort, so auto
+# declines (forced "hash" tolerates up to HASH_FORCED_MAX_BIN_ROWS, the
+# compile-sanity ceiling). HASH_GRID_BLOWUP bounds the [bins, width]
+# grid relative to the chunk's rows: bins beyond the budget are not
+# allocated — chunks needing them demote to the tiled kernel per chunk.
+HASH_MAX_BIN_ROWS = 128
+HASH_FORCED_MAX_BIN_ROWS = 1024
+HASH_GRID_BLOWUP = 4
+
+
+def plan_group_binning(fmt: WireFormat, segment_sort, max_run: int, *,
+                       exact: bool = False) -> WireFormat:
+    """plan_segment_tiling extended to the 4-way sampler plan: resolves
+    the ``segment_sort`` knob into tile geometry AND, for the sortless
+    group stage, the ``[hash_bins, hash_bin_rows]`` bin grid.
+
+    segment_sort="hash" forces the hash-binned stage whenever its
+    geometry is computable (pid-sorted wire, known max_run, bin width
+    within the forced ceiling); "auto" additionally requires ``exact``
+    (the caller-evaluated columnar.hash_exact_gate — bit-identity to
+    the sorted paths), the auto bin-width ceiling, and bins for every
+    chunk within the grid budget (so auto never mixes kernels). The
+    tile geometry is always resolved too: it is the per-chunk demotion
+    target when a chunk's RLE entry count exceeds hash_bins.
+
+    Bin sizing from the row_packer prep stats: width = the max
+    single-pid run rounded up (a segment can never overflow its bin —
+    only corrupt wire metadata can, and the kernel backstop empties the
+    accumulators then), bins = the per-bucket RLE entry capacity
+    (every segment gets a bin) capped by the grid byte budget.
+    """
+    fmt = plan_segment_tiling(fmt, segment_sort, max_run)
+    if segment_sort is False or fmt.pid_mode != PID_RLE:
+        return fmt
+    if max_run is None or max_run <= 0:
+        return fmt
+    forced = segment_sort == "hash"
+    if not forced and not (segment_sort == "auto" and exact):
+        return fmt
+    w = _round8(max_run)
+    if w > (HASH_FORCED_MAX_BIN_ROWS if forced else HASH_MAX_BIN_ROWS):
+        return fmt
+    budget = max(8, (HASH_GRID_BLOWUP * fmt.cap) // w)
+    bins = min(_round8(fmt.ucap), _round8(budget))
+    if bins < 8:
+        return fmt
+    if not forced and bins < fmt.ucap:
+        # auto never plans a grid some chunks would overflow (mixed
+        # hash/tiled execution is the forced knob's explicit trade).
+        return fmt
+    return dataclasses.replace(fmt, hash_bins=int(bins),
+                               hash_bin_rows=int(w))
 
 
 def choose_pid_mode(n: int, pid_span: int, bytes_pid: int,

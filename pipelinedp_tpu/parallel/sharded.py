@@ -862,7 +862,9 @@ def stream_bound_and_aggregate(mesh: Mesh,
                 fmt, segment_sort, info.max_run,
                 num_partitions=padded_p, row_clip_lo=row_clip_lo,
                 row_clip_hi=row_clip_hi, linf_cap=linf_cap,
-                l1_mode=l1_cap is not None)
+                l1_mode=l1_cap is not None,
+                group_clip_lo=group_clip_lo, group_clip_hi=group_clip_hi,
+                need_flags=tuple(need_flags))
             return _drive_codec_chunks(mesh, key, emit, counts, n_uniq, fmt,
                                      n_c, n_dev, padded_p, linf_cap, l0_cap,
                                      row_clip_lo, row_clip_hi, middle,
@@ -882,7 +884,9 @@ def stream_bound_and_aggregate(mesh: Mesh,
         fmt, segment_sort, info.max_run,
         num_partitions=padded_p, row_clip_lo=row_clip_lo,
         row_clip_hi=row_clip_hi, linf_cap=linf_cap,
-        l1_mode=l1_cap is not None)
+        l1_mode=l1_cap is not None,
+        group_clip_lo=group_clip_lo, group_clip_hi=group_clip_hi,
+        need_flags=tuple(need_flags))
     return _drive_codec_chunks(mesh, key,
                              lambda c: slab[c * n_dev:(c + 1) * n_dev],
                              counts, n_uniq, fmt, n_c,
@@ -939,7 +943,9 @@ def replay_resident_wire(mesh: Mesh,
     fmt, int_clip, sort_stats = streaming.finish_wire_plan(
         wire.fmt, segment_sort, wire.max_run, num_partitions=padded_p,
         row_clip_lo=row_clip_lo, row_clip_hi=row_clip_hi,
-        linf_cap=linf_cap, l1_mode=l1_cap is not None)
+        linf_cap=linf_cap, l1_mode=l1_cap is not None,
+        group_clip_lo=group_clip_lo, group_clip_hi=group_clip_hi,
+        need_flags=tuple(need_flags))
     return _drive_codec_chunks(
         mesh, key, lambda c: wire.slab[c * n_dev:(c + 1) * n_dev],
         wire.counts, wire.n_uniq, fmt, wire.n_chunks, n_dev, padded_p,
@@ -1027,6 +1033,8 @@ def _drive_codec_chunks(mesh, key, emit, counts, n_uniq, fmt, n_c, n_dev,
     from pipelinedp_tpu import profiler
     from pipelinedp_tpu.ops import streaming
 
+    import dataclasses
+
     max_groups = None
     if (streaming._compact_enabled(compact_merge, padded_p)
             and fmt.pid_sorted):
@@ -1036,24 +1044,46 @@ def _drive_codec_chunks(mesh, key, emit, counts, n_uniq, fmt, n_c, n_dev,
     # Plain-int pair so the lru_cached kernel builders key on it.
     int_clip_key = (None if int_clip is None
                     else (int(int_clip[0]), int(int_clip[1])))
-    if compact:
-        kernel = _codec_compact_kernel(mesh, padded_p, fmt, max_groups,
-                                       l1_cap is not None, need_flags,
-                                       has_group_clip, int_clip_key)
-    else:
-        kernel = _codec_scalar_kernel(mesh, padded_p, fmt,
-                                      l1_cap is not None, need_flags,
-                                      has_group_clip, int_clip_key)
+
+    def build_kernel(f):
+        if compact:
+            return _codec_compact_kernel(mesh, padded_p, f, max_groups,
+                                         l1_cap is not None, need_flags,
+                                         has_group_clip, int_clip_key)
+        return _codec_scalar_kernel(mesh, padded_p, f,
+                                    l1_cap is not None, need_flags,
+                                    has_group_clip, int_clip_key)
+
+    kernel = build_kernel(fmt)
+    # Per-chunk demotion target of the hash-binned group stage: a chunk
+    # whose RLE entry count exceeds the static bin count runs the tiled
+    # kernel (built lazily on first demotion; decided on host counts
+    # that ride the wire fingerprint, so replays/resumes demote
+    # identically).
+    hash_on = fmt.hash_bins > 0 and fmt.pid_sorted
+    fmt_demoted = (dataclasses.replace(fmt, hash_bins=0, hash_bin_rows=0)
+                   if hash_on else fmt)
     scatter_passes = 1 + sum(bool(f) for f in need_flags)
-    # Every device sorts its own bucket, so one chunk executes n_dev
-    # bucket sorts (streaming._count_sort_stats credits the model per
-    # executed chunk, like the single-device slab loop).
-    if sort_stats is not None:
-        sort_stats = {name: v * n_dev for name, v in sort_stats.items()}
     sharding = NamedSharding(mesh, _spec(mesh))
     part_sharding = NamedSharding(mesh, _part_spec(mesh))
     counts = np.asarray(counts, dtype=np.int32)
     n_uniq = np.asarray(n_uniq, dtype=np.int32)
+
+    def credit(st, rows):
+        # Every device sorts (or hash-bins) its own bucket, so one chunk
+        # executes n_dev bucket stages; the hash pass/occupancy counters
+        # count per LAUNCH (one chunk = one kernel), like the demotion
+        # counter.
+        if st is None:
+            return
+        streaming._count_sort_stats(
+            {name: st[name] * n_dev
+             for name in ("rows", "tiles", "operand_bytes")})
+        if st.get("kind") == "hash":
+            profiler.count_event(columnar.EVENT_HASH_PASSES)
+            cells = max(int(st.get("grid_cells", 0)) * n_dev, 1)
+            profiler.count_event(columnar.EVENT_HASH_OCCUPANCY,
+                                 min(100, (100 * rows) // cells))
 
     def transfer_chunk(slab, c):
         dslab = jax.device_put(slab, sharding)
@@ -1065,12 +1095,19 @@ def _drive_codec_chunks(mesh, key, emit, counts, n_uniq, fmt, n_c, n_dev,
 
     def run_chunk(c, payload):
         dslab, dvalid, duniq = payload
+        use_kernel, st = kernel, sort_stats
+        if (hash_on and int(n_uniq[c * n_dev:(c + 1) * n_dev].max())
+                > fmt.hash_bins):
+            profiler.count_event(columnar.EVENT_HASH_DEMOTIONS)
+            use_kernel = build_kernel(fmt_demoted)
+            st = (sort_stats or {}).get("demoted")
+        credit(st, int(counts[c * n_dev:(c + 1) * n_dev].sum()))
         args = (jax.random.fold_in(key, c), dslab, dvalid, duniq,
                 linf_cap, l0_cap, float(row_clip_lo), float(row_clip_hi),
                 float(middle), float(group_clip_lo), float(group_clip_hi))
         if l1_cap is not None:
             args += (l1_cap,)
-        return kernel(*args)
+        return use_kernel(*args)
 
     def merge_pending(accs, pending):
         if accs is None:
@@ -1105,8 +1142,6 @@ def _drive_codec_chunks(mesh, key, emit, counts, n_uniq, fmt, n_c, n_dev,
         scatter_passes=scatter_passes,
         quantile=False,
         data_digest_fn=data_digest_fn,
-        on_chunk=((lambda: streaming._count_sort_stats(sort_stats))
-                  if sort_stats is not None else None),
         prefetch_depth=streaming.prefetch_depth())
     accs, _ = driver_lib.SlabDriver(
         placement, plan, lambda s0, s1: emit(s0), key, resilience).run()

@@ -713,6 +713,29 @@ class DatasetSession:
         return (key_fp,) + tuple(
             (k, self._canonical(kw[k])) for k in sorted(kw))
 
+    def _resolved_sampler(self, mesh, kw: dict) -> str:
+        """The RESOLVED sampler this query config compiles against
+        (streaming.resolved_sampler_desc), cached under the bound-cache
+        key so flipping ``segment_sort`` between queries — e.g. two
+        user-built engines over one session, or "auto" resolving
+        differently for different caps — can never alias a cached
+        accumulator produced by a different group stage."""
+        num_partitions = self._wire.num_partitions
+        if mesh is not None:
+            from pipelinedp_tpu.parallel import sharded
+            num_partitions = sharded.padded_num_partitions(
+                mesh, num_partitions)
+        return streaming.resolved_sampler_desc(
+            self._wire.fmt, kw.get("segment_sort", "auto"),
+            self._wire.max_run, num_partitions=num_partitions,
+            row_clip_lo=kw.get("row_clip_lo", -np.inf),
+            row_clip_hi=kw.get("row_clip_hi", np.inf),
+            linf_cap=kw.get("linf_cap", 1),
+            l1_mode=kw.get("l1_cap") is not None,
+            group_clip_lo=kw.get("group_clip_lo", -np.inf),
+            group_clip_hi=kw.get("group_clip_hi", np.inf),
+            need_flags=kw.get("need_flags", (True, True, True, True)))
+
     @staticmethod
     def _result_nbytes(result) -> int:
         arrays = []
@@ -741,7 +764,13 @@ class DatasetSession:
         with host-window shipping — same chunk kernels, same keys, same
         released bits, one fallback counter richer."""
         key_fp = checkpoint_lib.key_fingerprint(k_kernel)
-        cache_key = self._cache_key(key_fp, kw)
+        # The sampler enters the key as its RESOLVED identity, not the
+        # raw knob string: knobs that compile the same kernel share the
+        # entry ("auto" vs "hash" under the gate), knobs that compile
+        # different group stages can never alias.
+        kw_for_key = {k: v for k, v in kw.items() if k != "segment_sort"}
+        cache_key = self._cache_key(key_fp, kw_for_key) + (
+            ("resolved_sampler", self._resolved_sampler(mesh, kw)),)
         with self._pinned():
             with self._lock:
                 self._check_open()
