@@ -908,6 +908,22 @@ def main():
     print(json.dumps({
         "metric": "DP-aggregated partitions/sec (COUNT+SUM, 1M keys), "
                   "end-to-end through JaxDPEngine.aggregate",
+        # The workload-shape signature the bench regression gate
+        # (obs/regress.py) groups comparable rounds by — the resolved
+        # BENCH_* knobs, explicit so the gate no longer has to parse
+        # them out of the recorded command line.
+        "shape": {
+            "BENCH_ROWS": str(N_ROWS),
+            "BENCH_PARTITIONS": str(N_PARTITIONS),
+            "BENCH_CPU_ROWS": str(CPU_ROWS),
+            "BENCH_VECTOR_ROWS": str(VEC_ROWS),
+            "BENCH_PCT_ROWS": str(PCT_ROWS),
+            "BENCH_PCT_PARTITIONS": str(PCT_PARTITIONS),
+            "BENCH_SWEEP_GROUPS": str(
+                os.environ.get("BENCH_SWEEP_GROUPS", 2_000_000)),
+            "BENCH_SWEEP_PARTITIONS": str(
+                os.environ.get("BENCH_SWEEP_PARTITIONS", 100_000)),
+        },
         "value": round(e2e_pps, 1),
         "unit": "partitions/sec",
         "vs_baseline": round(e2e_pps / cpu_pps, 2),

@@ -35,7 +35,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from pipelinedp_tpu.lint import astutils
 
-SUMMARY_VERSION = 2  # v2: DPL011 "obs" telemetry-sink flows
+SUMMARY_VERSION = 3  # v3: PR-13 obs sinks (flight recorder, captures)
 
 # -- taint vocabulary (DPL007) ----------------------------------------------
 
@@ -76,7 +76,7 @@ SINK_METHOD = "tolist"
 # resolver cannot type).
 OBS_TARGET_RE = re.compile(r"^pipelinedp_tpu\.obs\.")
 OBS_METHODS = frozenset({"set_attribute", "add_event", "observe",
-                         "record"})
+                         "record", "write_capture"})
 
 # Shape-preserving transforms: taint flows through unchanged.
 _PASSTHROUGH_RE = re.compile(r"^(?:numpy|jax\.numpy|jax\.lax)\.")

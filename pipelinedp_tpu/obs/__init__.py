@@ -23,6 +23,19 @@ charged for" (OBSERVABILITY.md):
     mechanism kinds, (ε, δ) charged, kept/dropped partition counts,
     timings, typed outcomes; survives SIGKILL on store-bound sessions.
 
+The operational plane (PR 13) serves and persists all of it:
+
+  * :mod:`~pipelinedp_tpu.obs.flight` — the always-on bounded
+    flight recorder (post-mortem ring buffer + spool + slow-query
+    captures). Knobs: ``PIPELINEDP_TPU_FLIGHT_DIR``,
+    ``PIPELINEDP_TPU_SLOW_QUERY_S``, ``PIPELINEDP_TPU_CAPTURE_DIR``.
+  * :mod:`~pipelinedp_tpu.obs.ops_plane` — stdlib HTTP endpoints over
+    a live fleet: ``/metrics``, ``/healthz``, ``/statusz``,
+    ``/debug/flightz``. Knob: ``PIPELINEDP_TPU_OPS_PORT``.
+  * :mod:`~pipelinedp_tpu.obs.regress` — the bench-trajectory perf
+    regression gate (``python -m pipelinedp_tpu.obs.regress
+    BENCH_*.json``), wired into CI.
+
 DP-safety is a hard API rule, not a convention: raw pids, partition
 keys, and unreleased (pre-noise) values never enter any obs record —
 span attributes, metric labels and audit fields are validated scalars
@@ -34,10 +47,15 @@ reads clocks and counters, never data or keys, and results are pinned
 bit-identical with tracing on or off (tests/obs_serving_test.py).
 """
 
-from pipelinedp_tpu.obs import metrics, trace  # noqa: F401
+from pipelinedp_tpu.obs import flight, metrics, ops_plane, trace  # noqa: F401
+from pipelinedp_tpu.obs.flight import (  # noqa: F401
+    CAPTURE_DIR_ENV, FLIGHT_DIR_ENV, SLOW_QUERY_ENV, FlightEvent,
+    FlightRecorder)
 from pipelinedp_tpu.obs.metrics import (  # noqa: F401
     METRICS_ENV, Counter, Gauge, Histogram, MetricsRegistry,
     TelemetryLeakError, check_safe_value, default_registry)
+from pipelinedp_tpu.obs.ops_plane import (  # noqa: F401
+    OPS_PORT_ENV, OpsServer, serve_ops)
 from pipelinedp_tpu.obs.trace import TRACE_ENV, Span, Tracer  # noqa: F401
 
 # obs.audit imports runtime.journal (which imports the profiler); load

@@ -86,6 +86,12 @@ class AuditRecord:
     partitions_dropped: int
     duration_s: float
     seed: int
+    # Correlation key across the observability plane (PR 13): the same
+    # id appears on the query's root span, its flight-recorder events,
+    # and any slow-query capture file — so "show me why audit record N
+    # was slow" is one grep. Defaults to "" so PR-11 WAL records
+    # (which predate the field) keep parsing (pinned by tests).
+    trace_id: str = ""
 
     def to_payload(self) -> dict:
         out = dataclasses.asdict(self)
@@ -109,6 +115,7 @@ class AuditRecord:
             partitions_dropped=int(payload["partitions_dropped"]),
             duration_s=float(payload["duration_s"]),
             seed=int(payload["seed"]),
+            trace_id=str(payload.get("trace_id", "")),
         )
 
 
@@ -174,7 +181,7 @@ class AuditTrail:
                outcome: str, mechanisms, noise_kind: str,
                epsilon: float, delta: float, partitions_kept: int,
                partitions_dropped: int, duration_s: float,
-               seed: int) -> AuditRecord:
+               seed: int, trace_id: str = "") -> AuditRecord:
         """Appends one outcome. The schema is closed — there is no
         free-form field, so nothing data-shaped can ride along — and
         every value passes the shared obs payload gate."""
@@ -190,6 +197,7 @@ class AuditTrail:
             "partitions_kept": int(partitions_kept),
             "partitions_dropped": int(partitions_dropped),
             "duration_s": float(duration_s), "seed": int(seed),
+            "trace_id": str(trace_id),
         }
         for key, value in fields.items():
             metrics_lib.check_safe_value(key, value)

@@ -1,0 +1,460 @@
+"""Flight recorder: the post-mortem the process leaves when it dies.
+
+A serving process that wedges, crashes, or is SIGKILL'd takes its spans
+and metrics with it — the operator is left with WALs (what *committed*)
+and nothing about what the process was *doing*. The flight recorder
+closes that gap: an always-on, bounded ring buffer of operational
+events (window timings, retries, degradations, evictions, admission
+decisions, query lifecycle), fed from the existing span-event hooks in
+``runtime.SlabDriver``, ``DatasetSession.query`` and the dispatch
+watchdog, with three exit doors:
+
+  * **dump** — an atomic JSON snapshot of the ring (tmp + rename),
+    written on watchdog timeout, deadline expiry, unhandled engine
+    error, and at process exit. Never torn: readers see the previous
+    dump or the new one.
+  * **spool** — an append-per-event JSON-lines file next to the
+    session WALs (bound automatically for store-bound sessions, or via
+    ``PIPELINEDP_TPU_FLIGHT_DIR``). Each line hits the OS page cache at
+    record time, so even a SIGKILL'd process — which runs no atexit
+    handler — leaves a parseable event trail (a torn final line is
+    tolerated on read, like the WALs' torn tail).
+  * **slow-query capture** — queries exceeding
+    ``PIPELINEDP_TPU_SLOW_QUERY_S`` (or landing within 20% of their
+    deadline) write a full per-query bundle — Chrome trace, metrics
+    delta, flight-recorder slice — into a bounded capture directory,
+    correlated to the audit record by ``trace_id``
+    (:func:`write_capture`; the session drives it).
+
+DP-safety: every event attribute passes the shared obs payload gate
+(:func:`~pipelinedp_tpu.obs.metrics.check_safe_value`) — forbidden keys
+and non-scalar payloads are refused at the API, so a dump can never
+carry raw pids, partition keys, or pre-noise values; the serving leak
+scan covers dumps, spools and captures dynamically, and dplint DPL011
+counts this module's APIs among its telemetry sinks.
+
+Recording can never change released bits (it reads clocks and scalars,
+never data or keys) and never raises on I/O: a full disk degrades the
+post-mortem, not the query.
+
+This module is stdlib-only (plus obs.metrics, itself stdlib-only) so
+the runtime and watchdog can import it without cycles.
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import dataclasses
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Deque, Dict, List, Optional
+
+from pipelinedp_tpu.obs import metrics as metrics_lib
+
+# Tuning knobs (README "Tuning knobs" + OBSERVABILITY.md):
+#   PIPELINEDP_TPU_FLIGHT_DIR — binds the process spool + dump dir
+#     (store-bound sessions bind it automatically next to their WALs).
+#   PIPELINEDP_TPU_FLIGHT_EVENTS — ring capacity (default 2048).
+#   PIPELINEDP_TPU_SLOW_QUERY_S — slow-query capture threshold in
+#     seconds (0/unset = deadline-proximity captures only).
+#   PIPELINEDP_TPU_CAPTURE_DIR — where slow-query captures land
+#     (unset = captures disabled).
+#   PIPELINEDP_TPU_CAPTURES — max capture files kept (oldest pruned).
+FLIGHT_DIR_ENV = "PIPELINEDP_TPU_FLIGHT_DIR"
+FLIGHT_EVENTS_ENV = "PIPELINEDP_TPU_FLIGHT_EVENTS"
+SLOW_QUERY_ENV = "PIPELINEDP_TPU_SLOW_QUERY_S"
+CAPTURE_DIR_ENV = "PIPELINEDP_TPU_CAPTURE_DIR"
+CAPTURE_LIMIT_ENV = "PIPELINEDP_TPU_CAPTURES"
+
+DUMP_VERSION = 1
+
+# How many trailing event kinds a hang/deadline error message carries
+# (the "self-diagnosing hang report" satellite).
+POSTMORTEM_EVENTS = 8
+
+
+def ring_capacity() -> int:
+    """Validated PIPELINEDP_TPU_FLIGHT_EVENTS (default 2048)."""
+    from pipelinedp_tpu.native import loader
+    return loader.env_int(FLIGHT_EVENTS_ENV, 2048, 64, 1_000_000)
+
+
+def _env_float_s(name: str, lo: float, hi: float) -> Optional[float]:
+    """Validated float-seconds env knob: unset/empty/0 -> None; junk or
+    out-of-range raises (the env_int stance, for fractional seconds)."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return None
+    try:
+        parsed = float(raw.strip())
+    except ValueError:
+        raise ValueError(f"{name} must be a number of seconds, "
+                         f"got {raw!r}") from None
+    if parsed == 0:
+        return None
+    if not lo <= parsed <= hi:
+        raise ValueError(f"{name} must be in [{lo}, {hi}] (or 0 to "
+                         f"disable), got {parsed}")
+    return parsed
+
+
+def slow_query_threshold_s() -> Optional[float]:
+    """Validated PIPELINEDP_TPU_SLOW_QUERY_S (None when 0/unset)."""
+    return _env_float_s(SLOW_QUERY_ENV, 1e-6, 24 * 3600.0)
+
+
+def capture_dir() -> Optional[str]:
+    """The slow-query capture directory (None = captures disabled)."""
+    raw = os.environ.get(CAPTURE_DIR_ENV, "")
+    return raw if raw else None
+
+
+def capture_limit() -> int:
+    """Validated PIPELINEDP_TPU_CAPTURES (default 32 files kept)."""
+    from pipelinedp_tpu.native import loader
+    return loader.env_int(CAPTURE_LIMIT_ENV, 32, 1, 10_000)
+
+
+@dataclasses.dataclass(frozen=True)
+class FlightEvent:
+    """One recorded operational event. ``t_ns`` is perf_counter_ns (the
+    span clock — flight slices align with trace timestamps);
+    ``ts_unix`` anchors it to wall clock for cross-process correlation."""
+    seq: int
+    kind: str
+    ts_unix: float
+    t_ns: int
+    thread_id: int
+    attrs: Dict[str, object]
+
+    def to_payload(self) -> dict:
+        return {"seq": self.seq, "kind": self.kind,
+                "ts_unix": self.ts_unix, "t_ns": self.t_ns,
+                "thread_id": self.thread_id, "attrs": dict(self.attrs)}
+
+
+class FlightRecorder:
+    """Bounded ring of :class:`FlightEvent` (module docstring). Always
+    on; recording is one lock + one deque append (plus one buffered
+    line write when a spool is bound). Newest events win — the ones an
+    operator reconstructing a hang wants."""
+
+    def __init__(self, max_events: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._events: Deque[FlightEvent] = collections.deque(
+            maxlen=max_events if max_events is not None else ring_capacity())
+        self._seq = 0
+        self._spool_fh = None
+        self._spool_path: Optional[str] = None
+        self._dump_dir: Optional[str] = None
+
+    # -- recording --------------------------------------------------------
+
+    def record(self, kind: str, **attrs) -> FlightEvent:
+        """Appends one event; every attribute passes the shared obs
+        payload gate (TelemetryLeakError on private-data-shaped input)."""
+        for k, v in attrs.items():
+            metrics_lib.check_safe_value(k, v)
+        with self._lock:
+            event = FlightEvent(
+                seq=self._seq, kind=str(kind), ts_unix=time.time(),
+                t_ns=time.perf_counter_ns(),
+                thread_id=threading.get_ident(), attrs=dict(attrs))
+            self._seq += 1
+            self._events.append(event)
+            if self._spool_fh is not None:
+                try:
+                    self._spool_fh.write(
+                        json.dumps(event.to_payload(),
+                                   separators=(",", ":")) + "\n")
+                    # flush() lands the line in the OS page cache: it
+                    # survives SIGKILL (only an OS/power crash loses it;
+                    # the dump path is for that — and fsync per event
+                    # would put a disk sync on the serving hot path).
+                    self._spool_fh.flush()
+                except (OSError, ValueError):
+                    pass  # a dead spool degrades the post-mortem only
+        return event
+
+    # -- reads ------------------------------------------------------------
+
+    def events(self, last: Optional[int] = None,
+               since_seq: Optional[int] = None) -> List[FlightEvent]:
+        with self._lock:
+            out = list(self._events)
+        if since_seq is not None:
+            out = [e for e in out if e.seq >= since_seq]
+        if last is not None:
+            out = out[-last:]
+        return out
+
+    def watermark(self) -> int:
+        """The next event's seq — slice with events(since_seq=mark)."""
+        with self._lock:
+            return self._seq
+
+    # -- spool + dump destinations ---------------------------------------
+
+    @property
+    def spool_path(self) -> Optional[str]:
+        return self._spool_path
+
+    @property
+    def dump_dir(self) -> Optional[str]:
+        return self._dump_dir
+
+    def bind_spool(self, path: str) -> str:
+        """Opens (append) the JSON-lines spool at ``path``; subsequent
+        events stream there as they are recorded. Idempotent for the
+        same path; rebinding moves the stream."""
+        with self._lock:
+            if self._spool_path == path and self._spool_fh is not None:
+                return path
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            if self._spool_fh is not None:
+                try:
+                    self._spool_fh.close()
+                except OSError:
+                    pass
+            self._spool_fh = open(path, "a")
+            self._spool_path = path
+        return path
+
+    def set_dump_dir(self, path: str) -> None:
+        self._dump_dir = path
+
+    def dump(self, path: Optional[str] = None,
+             reason: str = "manual") -> Optional[str]:
+        """Atomically writes the ring as one JSON document (tmp + fsync
+        + rename; a reader never sees a torn dump). ``path`` defaults to
+        ``<dump_dir>/flight_<pid>.json``; returns the file path, or
+        None when no destination is configured or the write failed
+        (dumping is best-effort by design — it runs on error paths)."""
+        if path is None:
+            if self._dump_dir is None:
+                return None
+            path = os.path.join(self._dump_dir,
+                                f"flight_{os.getpid()}.json")
+        doc = {
+            "version": DUMP_VERSION,
+            "process_id": os.getpid(),
+            "ts_unix": time.time(),
+            "reason": reason,
+            "events": [e.to_payload() for e in self.events()],
+        }
+        try:
+            parent = os.path.dirname(path) or "."
+            os.makedirs(parent, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=parent, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(doc, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
+        except OSError:
+            return None
+        return path
+
+    def postmortem(self, dump_path: Optional[str] = None,
+                   last: int = POSTMORTEM_EVENTS) -> str:
+        """The one-line hang summary DispatchHangError/QueryDeadlineError
+        messages carry: the last recorded event kinds plus the dump
+        location, so a hang report is self-diagnosing."""
+        kinds = [e.kind for e in self.events(last=last)]
+        where = dump_path or self._spool_path
+        return (f"flight recorder: last events "
+                f"[{', '.join(kinds) if kinds else 'none'}]"
+                + (f"; dump: {where}" if where else ""))
+
+    def reset(self) -> None:
+        """Tests only: clears the ring (spool/dump bindings stay)."""
+        with self._lock:
+            self._events.clear()
+
+    def close_spool(self) -> None:
+        with self._lock:
+            if self._spool_fh is not None:
+                try:
+                    self._spool_fh.close()
+                except OSError:
+                    pass
+                self._spool_fh = None
+                self._spool_path = None
+
+
+# -- the process-global recorder (always on) ---------------------------------
+
+_recorder = FlightRecorder()
+
+
+def recorder() -> FlightRecorder:
+    return _recorder
+
+
+def record(kind: str, **attrs) -> FlightEvent:
+    """Module-level entry: records into the process flight recorder.
+    Also fed automatically by every ``obs.trace.event`` call site, so
+    the span-event vocabulary (retry / degrade / resume /
+    watchdog_timeout / device_fallback / bound_cache_hit / demote /
+    spill / shed) lands in the ring with no tracer installed."""
+    return _recorder.record(kind, **attrs)
+
+
+def events(last: Optional[int] = None) -> List[FlightEvent]:
+    return _recorder.events(last=last)
+
+
+def dump_now(reason: str) -> Optional[str]:
+    return _recorder.dump(reason=reason)
+
+
+def postmortem(dump_path: Optional[str] = None) -> str:
+    return _recorder.postmortem(dump_path)
+
+
+def ensure_process_spool(directory: str) -> str:
+    """Binds the process recorder's spool (and dump dir) under
+    ``directory`` — ``<directory>/flight_<pid>.jsonl`` — unless a spool
+    is already bound (first binding wins: the post-mortem lives next to
+    the first store's WALs). Store-bound sessions call this."""
+    if _recorder.spool_path is not None:
+        return _recorder.spool_path
+    path = os.path.join(directory, f"flight_{os.getpid()}.jsonl")
+    _recorder.bind_spool(path)
+    if _recorder.dump_dir is None:
+        _recorder.set_dump_dir(directory)
+    return path
+
+
+# -- reading dumps and spools back -------------------------------------------
+
+
+class FlightDumpError(ValueError):
+    """The artifact is corrupted beyond the tolerated torn tail."""
+
+
+def read_dump(path: str) -> dict:
+    """Parses either artifact shape into ``{..., "events": [...]}``:
+
+    * an atomic ``.json`` dump — parsed verbatim (it cannot be torn);
+    * a ``.jsonl`` spool — line-per-event with the WALs' torn-tail
+      stance: a malformed FINAL line was mid-write at death and is
+      dropped; a malformed interior line is real corruption and raises
+      :class:`FlightDumpError`.
+    """
+    with open(path, "r") as f:
+        raw = f.read()
+    # An atomic dump is one JSON document with an "events" key; anything
+    # else (including a one-event spool, which also parses as a single
+    # dict) reads as a line-per-event spool.
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "events" in doc:
+        return doc
+    events_out: List[dict] = []
+    lines = raw.split("\n")
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            obj = json.loads(line)
+            if not isinstance(obj, dict) or "kind" not in obj:
+                raise ValueError("not an event record")
+        except ValueError as exc:
+            tail = all(not later.strip() for later in lines[i + 1:])
+            if tail:
+                break  # torn tail: the write died mid-line
+            raise FlightDumpError(
+                f"{path}: spool line {i} is malformed but later events "
+                f"follow — corrupted, not torn ({exc})")
+        events_out.append(obj)
+    return {"version": DUMP_VERSION, "reason": "spool",
+            "source": "spool", "events": events_out}
+
+
+# -- slow-query captures -----------------------------------------------------
+
+
+def _capture_name(trace_id: str) -> str:
+    safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                   for c in str(trace_id))
+    return f"slowquery_{safe}.json"
+
+
+def write_capture(trace_id: str, document: dict,
+                  directory: Optional[str] = None) -> Optional[str]:
+    """Atomically writes one slow-query capture bundle, named by the
+    query's ``trace_id`` (the audit-record correlation key), and prunes
+    the directory to the newest ``capture_limit()`` files so a slow
+    fleet can never fill the disk with post-mortems. Best-effort:
+    returns None instead of raising on I/O failure."""
+    directory = directory if directory is not None else capture_dir()
+    if directory is None:
+        return None
+    path = os.path.join(directory, _capture_name(trace_id))
+    try:
+        os.makedirs(directory, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(document, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        _prune_captures(directory, capture_limit())
+    except OSError:
+        return None
+    return path
+
+
+def _prune_captures(directory: str, keep: int) -> None:
+    entries = []
+    for name in os.listdir(directory):
+        if name.startswith("slowquery_") and name.endswith(".json"):
+            full = os.path.join(directory, name)
+            try:
+                entries.append((os.path.getmtime(full), full))
+            except OSError:
+                continue
+    entries.sort()
+    for _, full in entries[:max(0, len(entries) - keep)]:
+        try:
+            os.unlink(full)
+        except OSError:
+            pass
+
+
+# -- env wiring --------------------------------------------------------------
+
+
+def _atexit_dump() -> None:
+    _recorder.dump(reason="atexit")
+
+
+def _init_from_env() -> None:
+    directory = os.environ.get(FLIGHT_DIR_ENV, "")
+    if directory:
+        ensure_process_spool(directory)
+
+
+_init_from_env()
+# Registered unconditionally: with no dump dir bound it is a no-op, and
+# a dir bound later (store binding) still gets the exit dump.
+atexit.register(_atexit_dump)

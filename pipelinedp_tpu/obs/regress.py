@@ -1,0 +1,214 @@
+"""Bench-trajectory regression gate: a perf regression fails the build.
+
+The repo tracks its performance as a trajectory of ``BENCH_r*.json``
+rows (bench.py output + metadata). Until this module, the trajectory
+was inspected by hand; now ``python -m pipelinedp_tpu.obs.regress
+BENCH_*.json`` loads it, compares the newest round's headline metrics
+against the **best comparable prior round**, and exits nonzero when
+any headline regressed beyond its noise-aware threshold — wired into
+CI so a perf regression fails the build the way a test failure does.
+
+Comparability: rounds are only compared when they ran the same
+workload shape — the ``BENCH_*`` env assignments parsed from the
+recorded ``cmd`` (or an explicit ``"shape"`` key, which newer bench.py
+rows embed). A round with no comparable prior reports ``NEW`` and
+cannot fail the gate.
+
+Noise awareness: every metric carries a base relative tolerance (CPU
+smoke numbers jitter; ratio metrics like ``warm_vs_cold`` jitter more
+because both numerator and denominator move), and when three or more
+comparable priors exist the tolerance widens to twice the trajectory's
+own coefficient of variation (capped). The gate compares against the
+best prior — a slow round never lowers the bar for the next one.
+
+Output is a markdown report (stdout, and ``--out`` for a file /
+``$GITHUB_STEP_SUMMARY``); exit status 0 = no regressions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import re
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# Headline metrics: (label, dotted path under the row's "parsed" dict,
+# base relative tolerance). All are higher-is-better.
+HEADLINE_METRICS: Tuple[Tuple[str, str, float], ...] = (
+    ("e2e_partitions_per_sec", "value", 0.15),
+    ("kernel_partitions_per_sec", "kernel_partitions_per_sec", 0.15),
+    ("kernel_general_pps", "kernel_sort.general_partitions_per_sec", 0.20),
+    ("kernel_packed_pps", "kernel_sort.packed_partitions_per_sec", 0.20),
+    ("kernel_tiled_pps", "kernel_sort.tiled_partitions_per_sec", 0.20),
+    ("kernel_hash_pps", "kernel_sort.hash_partitions_per_sec", 0.20),
+    ("e2e_steady_pps", "e2e_steady.steady_state_partitions_per_sec", 0.20),
+    ("serving_warm_vs_cold", "serving.warm_vs_cold", 0.35),
+    ("serving_warm_query_pps",
+     "serving.warm_query_partitions_per_sec", 0.25),
+    ("serving_cold_pps", "serving.cold_partitions_per_sec", 0.20),
+    ("serving_batched_qps_w32",
+     "serving.batched.width_32_queries_per_sec", 0.40),
+    ("utility_sweep_vs_host", "utility_sweep_vs_host", 0.35),
+)
+
+MAX_TOLERANCE = 0.50
+_SHAPE_RE = re.compile(r"\b(BENCH_[A-Z_]+)=(\S+)")
+
+
+def _get_path(d: dict, dotted: str) -> Optional[float]:
+    cur: object = d
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+        return None
+    return float(cur)
+
+
+def shape_signature(row: dict) -> Tuple[Tuple[str, str], ...]:
+    """The workload-shape identity two rounds must share to compare:
+    the explicit ``"shape"`` dict when bench.py embedded one (at the
+    row top level or inside ``parsed``), else the BENCH_* env
+    assignments parsed out of the recorded command line."""
+    shape = row.get("shape")
+    if not (isinstance(shape, dict) and shape):
+        shape = (row.get("parsed") or {}).get("shape")
+    if isinstance(shape, dict) and shape:
+        return tuple(sorted((str(k), str(v)) for k, v in shape.items()))
+    return tuple(sorted(_SHAPE_RE.findall(row.get("cmd", ""))))
+
+
+def load_rows(paths: Sequence[str]) -> List[dict]:
+    rows = []
+    for path in paths:
+        with open(path) as f:
+            row = json.load(f)
+        row["_path"] = path
+        rows.append(row)
+    rows.sort(key=lambda r: (r.get("n", 0), r["_path"]))
+    return rows
+
+
+def _tolerance(base: float, priors: Sequence[float]) -> float:
+    tol = base
+    if len(priors) >= 3:
+        mean = sum(priors) / len(priors)
+        if mean > 0:
+            var = sum((p - mean) ** 2 for p in priors) / (len(priors) - 1)
+            cv = math.sqrt(var) / mean
+            tol = max(base, 2.0 * cv)
+    return min(tol, MAX_TOLERANCE)
+
+
+def compare(rows: Sequence[dict],
+            tol_scale: float = 1.0) -> Tuple[List[dict], dict]:
+    """Compares the newest round against the best comparable prior per
+    headline metric. Returns (findings, summary); a finding with
+    ``status == "REGRESSION"`` fails the gate."""
+    if not rows:
+        raise ValueError("no bench rows given")
+    latest = rows[-1]
+    latest_sig = shape_signature(latest)
+    priors = [r for r in rows[:-1]
+              if shape_signature(r) == latest_sig]
+    findings: List[dict] = []
+    for label, path, base_tol in HEADLINE_METRICS:
+        current = _get_path(latest.get("parsed") or {}, path)
+        history = [v for v in
+                   (_get_path(r.get("parsed") or {}, path) for r in priors)
+                   if v is not None]
+        if current is None:
+            if history:
+                findings.append({
+                    "metric": label, "status": "GONE", "current": None,
+                    "best_prior": max(history), "ratio": None,
+                    "tolerance": None})
+            continue
+        if not history:
+            findings.append({
+                "metric": label, "status": "NEW", "current": current,
+                "best_prior": None, "ratio": None, "tolerance": None})
+            continue
+        best = max(history)
+        tol = _tolerance(base_tol, history) * tol_scale
+        ratio = current / best if best > 0 else math.inf
+        status = "REGRESSION" if ratio < 1.0 - tol else "OK"
+        findings.append({
+            "metric": label, "status": status, "current": current,
+            "best_prior": best, "ratio": round(ratio, 4),
+            "tolerance": round(tol, 4)})
+    summary = {
+        "latest_round": latest.get("n"),
+        "latest_path": latest["_path"],
+        "comparable_priors": [r.get("n") for r in priors],
+        "regressions": sum(1 for f in findings
+                           if f["status"] == "REGRESSION"),
+        "checked": sum(1 for f in findings if f["status"] in
+                       ("OK", "REGRESSION")),
+    }
+    return findings, summary
+
+
+def _fmt(v: Optional[float]) -> str:
+    if v is None:
+        return "—"
+    return f"{v:,.2f}" if abs(v) < 1000 else f"{v:,.0f}"
+
+
+def markdown_report(findings: Sequence[dict], summary: dict) -> str:
+    lines = [
+        "# Bench regression gate",
+        "",
+        f"Latest round: **r{summary['latest_round']}** "
+        f"(`{summary['latest_path']}`); comparable priors: "
+        f"{summary['comparable_priors'] or 'none'}.",
+        "",
+        "| metric | status | latest | best prior | ratio | tolerance |",
+        "|---|---|---|---|---|---|",
+    ]
+    for f in findings:
+        mark = {"REGRESSION": "❌ REGRESSION", "OK": "✅ OK",
+                "NEW": "🆕 NEW", "GONE": "⚠️ GONE"}[f["status"]]
+        lines.append(
+            f"| {f['metric']} | {mark} | {_fmt(f['current'])} | "
+            f"{_fmt(f['best_prior'])} | "
+            f"{f['ratio'] if f['ratio'] is not None else '—'} | "
+            f"{f['tolerance'] if f['tolerance'] is not None else '—'} |")
+    lines.append("")
+    if summary["regressions"]:
+        lines.append(f"**{summary['regressions']} regression(s)** out of "
+                     f"{summary['checked']} checked headline metrics — "
+                     f"the gate FAILS.")
+    else:
+        lines.append(f"No regressions across {summary['checked']} checked "
+                     f"headline metrics.")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m pipelinedp_tpu.obs.regress",
+        description="Bench-trajectory perf regression gate.")
+    parser.add_argument("files", nargs="+",
+                        help="BENCH_r*.json trajectory files")
+    parser.add_argument("--out", default=None,
+                        help="also write the markdown report here")
+    parser.add_argument("--tol-scale", type=float, default=1.0,
+                        help="scale every tolerance (tests use <1 to "
+                             "tighten, emergencies >1 to loosen)")
+    args = parser.parse_args(argv)
+    rows = load_rows(args.files)
+    findings, summary = compare(rows, tol_scale=args.tol_scale)
+    report = markdown_report(findings, summary)
+    sys.stdout.write(report)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(report)
+    return 1 if summary["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,6 +47,7 @@ import threading
 import time
 from typing import Deque, Dict, List, Optional, Tuple
 
+from pipelinedp_tpu.obs import flight as flight_lib
 from pipelinedp_tpu.obs import metrics as metrics_lib
 
 TRACE_ENV = "PIPELINEDP_TPU_TRACE"
@@ -271,6 +272,11 @@ def span(name: str, parent: Optional[Span] = None, **attrs):
 
 
 def event(name: str, **attrs) -> None:
+    # Every span event also lands in the always-on flight recorder
+    # (obs/flight.py): the retry/degrade/evict/hit vocabulary is exactly
+    # the post-mortem an operator wants from a dead process, and it must
+    # exist with no tracer installed.
+    flight_lib.record(name, **attrs)
     t = _active
     if t is not None:
         t.event(name, **attrs)

@@ -51,9 +51,11 @@ from __future__ import annotations
 
 import abc
 import dataclasses
+import time
 from typing import Any, Callable, List, Optional, Tuple
 
 from pipelinedp_tpu import profiler
+from pipelinedp_tpu.obs import flight as obs_flight
 from pipelinedp_tpu.obs import metrics as obs_metrics
 from pipelinedp_tpu.obs import trace as obs_trace
 from pipelinedp_tpu.runtime import checkpoint as checkpoint_lib
@@ -320,6 +322,7 @@ class SlabDriver:
                 this_window = ordinal
                 ordinal += 1
                 in_dispatch = False
+                w0, t_w0 = cursor, time.perf_counter()
                 try:
                     with profiler.stage(
                             f"{placement.stage_prefix}{cursor}"), \
@@ -448,6 +451,13 @@ class SlabDriver:
                                     attempt=failures)
                     policy.sleep(policy.backoff_s(failures - 1))
                     continue
+                # Window timing into the always-on flight recorder: the
+                # post-mortem of a later hang shows how far the stream
+                # got and how fast it was moving.
+                obs_flight.record(
+                    "window", chunk0=w0, chunk1=cursor,
+                    ms=round((time.perf_counter() - t_w0) * 1000.0, 3),
+                    attempt=failures)
                 failures = 0
                 since_checkpoint += 1
                 if (cp_policy is not None and cursor < k

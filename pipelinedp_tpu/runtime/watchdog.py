@@ -36,6 +36,7 @@ import time
 from typing import Callable, Optional, TypeVar
 
 from pipelinedp_tpu import profiler
+from pipelinedp_tpu.obs import flight as flight_lib
 
 # Profiler event counter: one per timed-out guarded operation (the
 # runtime/hangs_detected counter — one per hang the driver acted on —
@@ -57,14 +58,17 @@ class DispatchHangError(RuntimeError):
     error — either way the slab loop never hangs indefinitely.
     """
 
-    def __init__(self, what: str, timeout_s: float):
+    def __init__(self, what: str, timeout_s: float,
+                 postmortem: str = ""):
         super().__init__(
             f"dispatch watchdog: {what} made no progress within "
             f"{timeout_s:g}s (wedged transfer/dispatch abandoned; the "
             f"operation will be re-issued or surfaced by the retry "
-            f"policy)")
+            f"policy)"
+            + (f" [{postmortem}]" if postmortem else ""))
         self.what = what
         self.timeout_s = timeout_s
+        self.postmortem = postmortem
 
 
 class QueryDeadlineError(DispatchHangError):
@@ -85,15 +89,18 @@ class QueryDeadlineError(DispatchHangError):
     refuses any replay the abandoned worker might still commit.
     """
 
-    def __init__(self, what: str, deadline_s: float):
+    def __init__(self, what: str, deadline_s: float,
+                 postmortem: str = ""):
         # Skip DispatchHangError.__init__'s message; a deadline is a
         # budget the caller chose, not a wedged dispatch.
         RuntimeError.__init__(
             self, f"query deadline: {what} did not complete within the "
             f"{deadline_s:g}s deadline (shed or retry with a fresh "
-            f"deadline; no noise was released by this attempt)")
+            f"deadline; no noise was released by this attempt)"
+            + (f" [{postmortem}]" if postmortem else ""))
         self.what = what
         self.timeout_s = deadline_s
+        self.postmortem = postmortem
 
 
 @dataclasses.dataclass
@@ -123,7 +130,14 @@ class Deadline:
 
     def check(self, what: str) -> None:
         if self.expired:
-            raise QueryDeadlineError(what, self.total_s)
+            # A deadline expiry is a hang report: leave the flight dump
+            # and make the error message self-diagnosing (the dump path
+            # plus the last recorded events).
+            flight_lib.record("deadline_expired", what=what[:200],
+                              deadline_s=self.total_s)
+            dump = flight_lib.dump_now("deadline_expired")
+            raise QueryDeadlineError(what, self.total_s,
+                                     postmortem=flight_lib.postmortem(dump))
 
 
 def env_timeout_s() -> Optional[float]:
@@ -215,7 +229,14 @@ class DispatchWatchdog:
             self._worker.stop()
             self._worker = None
             profiler.count_event(EVENT_WATCHDOG_TIMEOUTS)
-            raise DispatchHangError(what, self.timeout_s)
+            # The post-mortem, while the evidence is fresh: one flight
+            # event, one atomic dump (when a dump dir is bound), and a
+            # self-diagnosing error message carrying both.
+            flight_lib.record("watchdog_timeout", what=what[:200],
+                              timeout_s=self.timeout_s)
+            dump = flight_lib.dump_now("watchdog_timeout")
+            raise DispatchHangError(what, self.timeout_s,
+                                    postmortem=flight_lib.postmortem(dump))
         if box.error is not None:
             raise box.error
         return box.result

@@ -63,3 +63,5 @@ from pipelinedp_tpu.budget_accounting import (  # noqa: F401
 from pipelinedp_tpu.runtime.watchdog import QueryDeadlineError  # noqa: F401
 from pipelinedp_tpu.obs.audit import (  # noqa: F401
     AuditCorruptError, AuditRecord, AuditTrail)
+from pipelinedp_tpu.obs.ops_plane import (  # noqa: F401
+    OPS_PORT_ENV, OpsServer, serve_ops)

@@ -37,6 +37,7 @@ from typing import Dict, List, Optional
 
 from pipelinedp_tpu import profiler
 from pipelinedp_tpu.obs import metrics as obs_metrics
+from pipelinedp_tpu.obs import ops_plane as ops_plane_lib
 from pipelinedp_tpu.obs import trace as obs_trace
 from pipelinedp_tpu.serving import session as session_lib
 from pipelinedp_tpu.serving import store as store_lib
@@ -114,12 +115,18 @@ class SessionManager:
       (PIPELINEDP_TPU_SERVING_INFLIGHT).
     default_deadline_s: per-query deadline for managed sessions; None
       defers to PIPELINEDP_TPU_QUERY_DEADLINE_S (0 = none).
+    ops_port: starts the observability endpoint (obs/ops_plane.py:
+      /metrics, /healthz, /statusz, /debug/flightz) over this manager —
+      0 binds an ephemeral port; None defers to
+      PIPELINEDP_TPU_OPS_PORT (unset/0 = no endpoint). ``close()``
+      stops it.
     """
 
     def __init__(self, store: Optional[store_lib.SessionStore] = None, *,
                  budget_bytes: Optional[int] = None,
                  max_inflight: Optional[int] = None,
-                 default_deadline_s: Optional[float] = None):
+                 default_deadline_s: Optional[float] = None,
+                 ops_port: Optional[int] = None):
         self._store = store if store is not None else store_lib.SessionStore()
         self._budget = (int(budget_bytes) if budget_bytes is not None
                         else session_lib.resident_byte_budget())
@@ -131,6 +138,10 @@ class SessionManager:
         # LRU order: least-recently-queried first.
         self._sessions: "collections.OrderedDict[str, session_lib.DatasetSession]"
         self._sessions = collections.OrderedDict()
+        if ops_port is None:
+            ops_port = ops_plane_lib.env_ops_port()
+        self._ops_server = (ops_plane_lib.serve_ops(self, port=ops_port)
+                            if ops_port is not None else None)
 
     @property
     def store(self) -> store_lib.SessionStore:
@@ -143,6 +154,11 @@ class SessionManager:
     @property
     def max_inflight(self) -> int:
         return self._max_inflight
+
+    @property
+    def ops_server(self):
+        """The running obs endpoint (ops_plane.OpsServer), or None."""
+        return self._ops_server
 
     # -- membership ------------------------------------------------------
 
@@ -190,7 +206,11 @@ class SessionManager:
         return session
 
     def close(self) -> None:
-        """Closes every admitted session and empties the fleet."""
+        """Closes every admitted session and empties the fleet (and
+        stops the obs endpoint when one is running)."""
+        if self._ops_server is not None:
+            self._ops_server.close()
+            self._ops_server = None
         with self._lock:
             sessions = list(self._sessions.values())
             self._sessions.clear()
@@ -217,6 +237,11 @@ class SessionManager:
         with self._lock:
             if self._inflight >= self._max_inflight:
                 profiler.count_event(EVENT_SHED)
+                # Admission decisions feed the flight recorder (via the
+                # span-event hook, tracer or not): a post-mortem shows
+                # the overload the process was shedding against.
+                obs_trace.event("shed", inflight=self._inflight,
+                                max_inflight=self._max_inflight)
                 raise SessionOverloadedError(self._inflight,
                                              self._max_inflight)
             self._inflight += 1
@@ -302,5 +327,7 @@ class SessionManager:
             "max_inflight": self._max_inflight,
             "inflight": inflight,
             "default_deadline_s": self.default_deadline_s,
+            "ops_url": (self._ops_server.url
+                        if self._ops_server is not None else None),
             "sessions": per_session,
         }

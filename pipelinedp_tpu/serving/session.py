@@ -28,6 +28,8 @@ from __future__ import annotations
 import collections
 import contextlib
 import dataclasses
+import itertools
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
@@ -42,6 +44,7 @@ from pipelinedp_tpu import profiler
 from pipelinedp_tpu.aggregate_params import (AggregateParams, MechanismType,
                                              Metric, Metrics, NoiseKind)
 from pipelinedp_tpu.obs import audit as audit_lib
+from pipelinedp_tpu.obs import flight as obs_flight
 from pipelinedp_tpu.obs import metrics as obs_metrics
 from pipelinedp_tpu.obs import trace as obs_trace
 from pipelinedp_tpu.ops import columnar, encoding, finalize as finalize_ops
@@ -81,6 +84,18 @@ EVENT_DEVICE_FALLBACKS = "serving/device_fallbacks"
 EVENT_DEADLINE_HITS = "serving/query_deadline_hits"
 # Spilled sessions re-hydrated from the store on demand.
 EVENT_REHYDRATIONS = "serving/sessions_rehydrations"
+# Slow-query capture bundles written (obs/flight.py; PR 13).
+EVENT_SLOW_CAPTURES = "serving/slow_query_captures"
+
+# Per-process query trace ids: "q<pid>-<n>". The same id lands on the
+# query's root span (attr "qid"), its flight-recorder events, its audit
+# record (trace_id) and any slow-query capture file — the correlation
+# key of the operational plane. Never derived from data.
+_QUERY_IDS = itertools.count()
+
+
+def _next_query_id() -> str:
+    return f"q{os.getpid()}-{next(_QUERY_IDS)}"
 
 
 def resident_byte_budget() -> int:
@@ -119,6 +134,7 @@ def serving_counters() -> Dict[str, int]:
             streaming.EVENT_SERVING_LAUNCHES),
         "device_fallbacks": profiler.event_count(EVENT_DEVICE_FALLBACKS),
         "query_deadline_hits": profiler.event_count(EVENT_DEADLINE_HITS),
+        "slow_query_captures": profiler.event_count(EVENT_SLOW_CAPTURES),
     }
 
 
@@ -458,8 +474,11 @@ class DatasetSession:
                           if self._store_binding is not None else None),
                 "tenants": {
                     tid: {
+                        "total_epsilon": st.ledger.total_epsilon,
                         "spent_epsilon": st.ledger.spent_epsilon,
                         "remaining_epsilon": st.ledger.remaining_epsilon,
+                        "total_delta": st.ledger.total_delta,
+                        "spent_delta": st.ledger.spent_delta,
                         "releases": len(st.release_journal),
                     }
                     for tid, st in self._tenants.items()
@@ -506,10 +525,16 @@ class DatasetSession:
 
     def _bind_audit(self) -> None:
         """Moves the audit trail onto its durable WAL under the bound
-        store (idempotent; the in-memory prefix is replayed onto disk)."""
-        if self._store_binding is None or self._audit.durable:
+        store (idempotent; the in-memory prefix is replayed onto disk),
+        and binds the process flight recorder's spool/dump next to the
+        WALs — so a SIGKILL'd or wedged process leaves its post-mortem
+        where its durable state lives (obs/flight.py)."""
+        if self._store_binding is None:
             return
         store, name = self._store_binding
+        obs_flight.ensure_process_spool(store.flight_dir())
+        if self._audit.durable:
+            return
         self._audit.bind(store.audit_path(name))
 
     def save(self, store=None) -> str:
@@ -884,6 +909,15 @@ class DatasetSession:
         resilience surface a cold streamed run has — chaos and
         kill-harness coverage extends to serving through them).
 
+        Operational plane (PR 13): every query gets a process-unique
+        trace id ("q<pid>-<n>") that lands on its root span, its
+        flight-recorder events, and its audit record — and when the
+        query exceeds ``PIPELINEDP_TPU_SLOW_QUERY_S`` or lands within
+        20% of its deadline (either outcome), a capture bundle (Chrome
+        trace when tracing is on, metrics delta, flight-recorder
+        slice) is written into the bounded
+        ``PIPELINEDP_TPU_CAPTURE_DIR``, named by that trace id.
+
         Observability (OBSERVABILITY.md): the query runs under a
         ``serving/query`` root span (admission → replay → finalize
         children), lands one latency observation in the
@@ -964,13 +998,26 @@ class DatasetSession:
 
         gate = (self._manager.admission()
                 if self._manager is not None else contextlib.nullcontext())
+        qid = _next_query_id()
+        # Slow-query capture bookkeeping (only when a capture dir is
+        # configured — the disabled path pays two None checks): the
+        # flight watermark and event-counter snapshot scope the capture
+        # to THIS query (taken before query_start so the slice holds
+        # the full lifecycle).
+        cap_dir = obs_flight.capture_dir()
+        cap_mark = obs_flight.recorder().watermark() if cap_dir else 0
+        cap_events0 = (obs_metrics.default_registry().event_values()
+                       if cap_dir else None)
+        obs_flight.record("query_start", qid=qid, session=self._name,
+                          seed=seed, tenant=tenant or "",
+                          deadline_s=deadline_s)
         t_q0 = time.perf_counter()
         root_span = None
         try:
             with obs_trace.span("serving/query", session=self._name,
                                 seed=seed, tenant=tenant or "",
-                                n_metrics=len(params.metrics)
-                                ) as root_span:
+                                n_metrics=len(params.metrics),
+                                qid=qid) as root_span:
                 with contextlib.ExitStack() as stack:
                     with obs_trace.span(
                             "serving/admission",
@@ -985,17 +1032,30 @@ class DatasetSession:
             if isinstance(exc, watchdog_lib.QueryDeadlineError):
                 profiler.count_event(EVENT_DEADLINE_HITS)
             self._maybe_refund(state, charge, journal, engine, exc)
+            outcome = self._failure_outcome(exc)
+            duration_s = time.perf_counter() - t_q0
+            if outcome == "refunded":
+                # An unhandled engine error (not a typed fleet outcome):
+                # leave the flight-recorder post-mortem while the ring
+                # still holds the failing query's events.
+                obs_flight.dump_now("engine_error")
             self._finish_query_obs(
                 engine=engine, params=params, tenant=tenant,
-                accountant=accountant, seed=seed,
-                outcome=self._failure_outcome(exc),
-                duration_s=time.perf_counter() - t_q0)
+                accountant=accountant, seed=seed, outcome=outcome,
+                duration_s=duration_s, qid=qid)
+            self._maybe_capture(qid, root_span, outcome, duration_s,
+                                deadline_s, cap_dir, cap_mark,
+                                cap_events0, seed=seed, tenant=tenant)
             raise
+        duration_s = time.perf_counter() - t_q0
         self._finish_query_obs(
             engine=engine, params=params, tenant=tenant,
             accountant=accountant, seed=seed, outcome="released",
-            duration_s=time.perf_counter() - t_q0,
+            duration_s=duration_s, qid=qid,
             cols=result.to_columns())
+        self._maybe_capture(qid, root_span, "released", duration_s,
+                            deadline_s, cap_dir, cap_mark, cap_events0,
+                            seed=seed, tenant=tenant)
         if trace_path is not None and root_span is not None:
             tracer = obs_trace.active()
             if tracer is not None:
@@ -1030,7 +1090,8 @@ class DatasetSession:
             raise  # the driver's cooperative check, already typed
         except watchdog_lib.DispatchHangError as exc:
             raise watchdog_lib.QueryDeadlineError(
-                exc.what, deadline.total_s) from exc
+                exc.what, deadline.total_s,
+                postmortem=exc.postmortem) from exc
         finally:
             wd.close()
 
@@ -1072,13 +1133,19 @@ class DatasetSession:
         return "refunded"
 
     def _finish_query_obs(self, *, engine, params, tenant, accountant,
-                          seed, outcome, duration_s, cols=None) -> None:
-        """One query's telemetry epilogue: the e2e latency observation
-        and the audit record. ``cols`` (released columns) is only
-        present for the ``released`` outcome; kept/dropped counts are
-        read off the DP output (already-released information), never
-        off raw data. -1 marks "query produced no output"."""
+                          seed, outcome, duration_s, qid="",
+                          cols=None) -> None:
+        """One query's telemetry epilogue: the e2e latency observation,
+        the flight-recorder outcome event, and the audit record (which
+        carries ``qid`` as its ``trace_id`` correlation key). ``cols``
+        (released columns) is only present for the ``released``
+        outcome; kept/dropped counts are read off the DP output
+        (already-released information), never off raw data. -1 marks
+        "query produced no output"."""
         obs_metrics.query_seconds().observe(duration_s, outcome=outcome)
+        obs_flight.record("query_finish", qid=qid, session=self._name,
+                          outcome=outcome,
+                          duration_ms=round(duration_s * 1000.0, 3))
         kept = dropped = -1
         if cols is not None:
             keep = np.asarray(cols["keep_mask"])
@@ -1095,7 +1162,58 @@ class DatasetSession:
             epsilon=float(accountant.total_epsilon),
             delta=float(accountant.total_delta),
             partitions_kept=kept, partitions_dropped=dropped,
-            duration_s=duration_s, seed=seed)
+            duration_s=duration_s, seed=seed, trace_id=qid)
+
+    def _maybe_capture(self, qid, root_span, outcome, duration_s,
+                       deadline_s, cap_dir, cap_mark, cap_events0, *,
+                       seed, tenant) -> None:
+        """Slow-query capture (OBSERVABILITY.md "Operational plane"): a
+        query that exceeded PIPELINEDP_TPU_SLOW_QUERY_S, or landed
+        within 20% of its deadline (expired ones included), writes a
+        full post-hoc bundle — Chrome trace (when tracing is on),
+        metrics delta, flight-recorder slice — into the bounded capture
+        directory, named by the query's trace id. Purely a read of
+        already-recorded telemetry: it cannot change released bits, and
+        write failures are swallowed (a capture is never worth a
+        query)."""
+        if cap_dir is None:
+            return
+        slow_s = obs_flight.slow_query_threshold_s()
+        near_deadline = (deadline_s is not None
+                         and duration_s >= 0.8 * float(deadline_s))
+        if not ((slow_s is not None and duration_s >= slow_s)
+                or near_deadline):
+            return
+        events_after = obs_metrics.default_registry().event_values()
+        before = cap_events0 or {}
+        metrics_delta = {k: v - before.get(k, 0)
+                         for k, v in events_after.items()
+                         if v != before.get(k, 0)}
+        chrome = None
+        tracer = obs_trace.active()
+        if tracer is not None and root_span is not None:
+            chrome = tracer.export_chrome(trace_id=root_span.trace_id)
+        document = {
+            "version": 1,
+            "trace_id": qid,
+            "session": self._name,
+            "seed": seed,
+            "tenant": tenant,
+            "outcome": outcome,
+            "duration_s": duration_s,
+            "deadline_s": deadline_s,
+            "slow_query_s": slow_s,
+            "near_deadline": near_deadline,
+            "metrics_delta": metrics_delta,
+            "flight_events": [e.to_payload() for e in
+                              obs_flight.recorder().events(
+                                  since_seq=cap_mark)],
+            "chrome_trace": chrome,
+        }
+        path = obs_flight.write_capture(qid, document, cap_dir)
+        if path is not None:
+            profiler.count_event(EVENT_SLOW_CAPTURES)
+            obs_flight.record("slow_query_capture", qid=qid, path=path)
 
     # -- batched queries -------------------------------------------------
 
@@ -1188,9 +1306,15 @@ class DatasetSession:
         width = max_width or batch_width()
         gate = (self._manager.admission()
                 if self._manager is not None else contextlib.nullcontext())
+        # One trace id for the whole batched launch: every config's
+        # audit record correlates to the same batch (they share the
+        # wire, the launch groups, and the failure domain).
+        qid = _next_query_id()
+        obs_flight.record("query_batch_start", qid=qid,
+                          session=self._name, n_configs=len(configs))
         t_b0 = time.perf_counter()
         with obs_trace.span("serving/query_batch", session=self._name,
-                            n_configs=len(configs)), \
+                            n_configs=len(configs), qid=qid), \
                 gate, self._pinned():
             prepared: List[_PreparedQuery] = []
             results: List[Optional[dict]] = [None] * len(configs)
@@ -1221,17 +1345,17 @@ class DatasetSession:
                         if not p.state.release_journal.has(token):
                             p.state.ledger.refund(p.charge)
                 self._audit_batch(configs, prepared, results,
-                                  time.perf_counter() - t_b0, exc)
+                                  time.perf_counter() - t_b0, exc, qid)
                 raise
         self._audit_batch(configs, prepared, results,
-                          time.perf_counter() - t_b0, None)
+                          time.perf_counter() - t_b0, None, qid)
         with self._lock:
             self._queries += len(prepared)
         profiler.count_event(EVENT_QUERIES, len(prepared))
         return results  # type: ignore[return-value]
 
     def _audit_batch(self, configs, prepared, results, duration_s,
-                     exc) -> None:
+                     exc, qid="") -> None:
         """One audit record per prepared batch config. A config whose
         released columns landed in ``results`` (or whose tenant journal
         holds its token) reads ``released``; the rest take the batch
@@ -1259,7 +1383,7 @@ class DatasetSession:
                                    str(cfg.noise_kind)),
                 epsilon=float(cfg.epsilon), delta=float(cfg.delta),
                 partitions_kept=kept, partitions_dropped=dropped,
-                duration_s=duration_s, seed=cfg.seed)
+                duration_s=duration_s, seed=cfg.seed, trace_id=qid)
 
     def _run_batch_group(self, group: List[_PreparedQuery],
                          has_group_clip: bool,

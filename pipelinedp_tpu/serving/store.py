@@ -250,6 +250,14 @@ class SessionStore:
         reopen."""
         return os.path.join(self.path(name), "audit.wal")
 
+    def flight_dir(self) -> str:
+        """Where the process flight recorder (obs/flight.py) spools and
+        dumps for store-bound sessions — next to the WALs, so a
+        SIGKILL'd or wedged serving process leaves its post-mortem in
+        the same place its durable state lives. Store-scoped (not
+        per-session): the recorder is process-global."""
+        return os.path.join(self._root, "flight")
+
     # -- save ------------------------------------------------------------
 
     def save(self, session) -> str:

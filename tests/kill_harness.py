@@ -42,6 +42,17 @@ Serving modes (ISSUE 10 — durable sessions; the SessionStore under
   serve_replay  — re-issue once more: the tenant's durable release
                   journal must refuse the replayed token
                   (DoubleReleaseError) — cross-restart at-most-once.
+  serve_ops     — reopen the fleet through a SessionManager with the
+                  observability endpoint up (obs/ops_plane.py) and
+                  print the live /statusz and /healthz payloads — the
+                  PR-13 acceptance that a reopened fleet reports its
+                  recovered session over HTTP.
+
+Every serving-mode process prints ``HARNESS_FLIGHT <spool>`` after the
+session is store-bound: the flight recorder (obs/flight.py) spools its
+events next to the store's WALs, so even the SIGKILL'd process leaves a
+parseable post-mortem with the query's trace id (correlating to the
+audit WAL's ``trace_id`` field).
 
 Set ``PDP_KH_MESH=8`` to run the serving modes on an 8-device virtual
 mesh (the orchestrator also forces the XLA host-device-count flag).
@@ -175,6 +186,12 @@ def _run_serving(mode: str, workdir: str) -> None:
     # BEFORE the query so even the killed mode reports it.
     print("HARNESS_AUDIT_RECOVERED " + json.dumps(
         [r.to_payload() for r in session.audit_trail.records()]))
+    # The flight-recorder spool this process writes (bound next to the
+    # store's WALs by the store binding) — printed BEFORE the query so
+    # the killed mode reports where its post-mortem will be.
+    from pipelinedp_tpu.obs import flight
+    print(f"HARNESS_FLIGHT {flight.recorder().spool_path}")
+    sys.stdout.flush()
     if mode == "serve_prepare":
         print("HARNESS_SAVED " + session.fingerprint)
         return
@@ -206,11 +223,35 @@ def _run_serving(mode: str, workdir: str) -> None:
     print("HARNESS_RESULT " + json.dumps({"mode": mode, "columns": out}))
 
 
+def _run_serve_ops(workdir: str) -> None:
+    """Reopens the stored fleet under a SessionManager with the obs
+    endpoint live and prints what /statusz and /healthz serve."""
+    import urllib.request
+
+    from pipelinedp_tpu import serving
+
+    store = serving.SessionStore(os.path.join(workdir, "sessions"))
+    manager = serving.SessionManager(store, ops_port=0)
+    manager.open("kh-dataset", mesh=_serving_mesh())
+    url = manager.ops_server.url
+    for marker, endpoint in (("HARNESS_STATUSZ", "/statusz"),
+                             ("HARNESS_HEALTHZ", "/healthz")):
+        body = urllib.request.urlopen(url + endpoint, timeout=30).read()
+        print(f"{marker} {body.decode()}".replace("\n", " "))
+    metrics_text = urllib.request.urlopen(
+        url + "/metrics", timeout=30).read().decode()
+    print("HARNESS_METRICS_LINES "
+          f"{sum(1 for li in metrics_text.splitlines() if li)}")
+    manager.close()
+
+
 def main() -> None:
     mode, workdir = sys.argv[1], sys.argv[2]
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     if mode == "spend":
         _run_spend(workdir)
+    elif mode == "serve_ops":
+        _run_serve_ops(workdir)
     elif mode.startswith("serve_"):
         _run_serving(mode, workdir)
     else:

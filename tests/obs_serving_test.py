@@ -17,13 +17,16 @@ The PR-11 acceptance surface that needs a real engine:
 """
 
 import json
+import os
 import threading
+import urllib.request
 
 import numpy as np
 import pytest
 
 import pipelinedp_tpu as pdp
 from pipelinedp_tpu import runtime, serving
+from pipelinedp_tpu.obs import flight as flight_lib
 from pipelinedp_tpu.obs import metrics as metrics_lib
 from pipelinedp_tpu.obs import trace as trace_lib
 from pipelinedp_tpu.parallel import sharded
@@ -73,6 +76,61 @@ def _assert_same_columns(a, b):
     for k in a:
         np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]),
                                       err_msg=k)
+
+
+class TestOpsPlaneBitIdentity:
+    """The PR-13 acceptance: released DP values are BIT-IDENTICAL with
+    the full operational plane enabled (tracer + live ops endpoint +
+    always-on flight recording + forced slow-query captures) vs
+    everything disabled — warm and cold, single-device and mesh8."""
+
+    @pytest.mark.parametrize("topology", ["single_device", "mesh8"])
+    def test_warm_and_cold_bit_identical_with_plane_on(
+            self, topology, tmp_path, monkeypatch):
+        mesh = sharded.make_mesh(8) if topology == "mesh8" else None
+        data = _data()
+
+        def cold():
+            accountant = pdp.NaiveBudgetAccountant(1.0, 1e-6)
+            engine = pdp.JaxDPEngine(accountant, seed=5, mesh=mesh,
+                                     stream_chunks=4,
+                                     secure_host_noise=False)
+            result = engine.aggregate(data, _params())
+            accountant.compute_budgets()
+            return result.to_columns()
+
+        # Plane fully off.
+        trace_lib.shutdown()
+        monkeypatch.delenv(flight_lib.CAPTURE_DIR_ENV, raising=False)
+        monkeypatch.delenv(flight_lib.SLOW_QUERY_ENV, raising=False)
+        with serving.DatasetSession(data, n_chunks=4, mesh=mesh,
+                                    name=f"plane-off-{topology}") as s:
+            warm_off = _query_cols(s)
+        cold_off = cold()
+
+        # Plane fully on: tracer installed, ops endpoint live over a
+        # manager, flight spool bound, every query captured.
+        monkeypatch.setenv(flight_lib.CAPTURE_DIR_ENV,
+                           str(tmp_path / "cap"))
+        monkeypatch.setenv(flight_lib.SLOW_QUERY_ENV, "0.000001")
+        trace_lib.install(trace_lib.Tracer())
+        manager = serving.SessionManager(
+            serving.SessionStore(str(tmp_path / "store")), ops_port=0)
+        try:
+            session = manager.create(f"plane-on-{topology}", data,
+                                     n_chunks=4, mesh=mesh)
+            warm_on = _query_cols(session)
+            cold_on = cold()
+            # The endpoint really is live while the bits are compared.
+            status = urllib.request.urlopen(
+                manager.ops_server.url + "/statusz", timeout=10).status
+            assert status == 200
+        finally:
+            manager.close()
+            trace_lib.shutdown()
+        assert os.listdir(tmp_path / "cap"), "capture never triggered"
+        _assert_same_columns(warm_off, warm_on)
+        _assert_same_columns(cold_off, cold_on)
 
 
 class TestBitIdentityOnOff:
@@ -266,6 +324,40 @@ class TestAuditOutcomes:
             assert sorted(r.seed for r in recs) == [100, 101, 102]
             assert all(r.partitions_kept >= 0 for r in recs)
 
+    def test_trace_id_correlates_audit_span_and_capture(
+            self, tracer, tmp_path, monkeypatch):
+        """The PR-13 correlation satellite: one query's audit record,
+        root span, flight events and slow-query capture all carry the
+        same trace id."""
+        cap_dir = str(tmp_path / "cap")
+        monkeypatch.setenv(flight_lib.CAPTURE_DIR_ENV, cap_dir)
+        monkeypatch.setenv(flight_lib.SLOW_QUERY_ENV, "0.000001")
+        with self._session(name="aud-corr") as session:
+            mark = flight_lib.recorder().watermark()
+            _query_cols(session, seed=11)
+            (rec,) = session.audit_trail.records()
+        qid = rec.trace_id
+        assert qid.startswith("q")
+        root = next(s for s in tracer.spans()
+                    if s.name == "serving/query")
+        assert root.attrs["qid"] == qid
+        kinds = {e.kind: e for e in
+                 flight_lib.recorder().events(since_seq=mark)}
+        assert kinds["query_start"].attrs["qid"] == qid
+        assert kinds["query_finish"].attrs["qid"] == qid
+        capture_path = os.path.join(cap_dir, f"slowquery_{qid}.json")
+        assert os.path.exists(capture_path)
+        capture = json.load(open(capture_path))
+        assert capture["trace_id"] == qid
+        assert capture["outcome"] == "released"
+        assert capture["metrics_delta"].get(
+            "serving/bound_cache_misses") == 1
+        assert "query_start" in [e["kind"]
+                                 for e in capture["flight_events"]]
+        # Tracing was on: the capture embeds this query's Chrome trace.
+        names = {e["name"] for e in capture["chrome_trace"]["traceEvents"]}
+        assert "serving/query" in names
+
     def test_audit_durable_on_saved_session(self, tmp_path):
         store = serving.SessionStore(str(tmp_path))
         with self._session(name="aud-store") as session:
@@ -283,11 +375,60 @@ class TestAuditOutcomes:
             reopened.close()
 
 
+class TestOpsEndpointsLive:
+    """The CI endpoint smoke (ISSUE 13): /metrics + /healthz + /statusz
+    against a LIVE SessionManager serving real queries."""
+
+    def test_endpoints_against_live_manager(self, tmp_path):
+        data = _data()
+        manager = serving.SessionManager(
+            serving.SessionStore(str(tmp_path / "store")), ops_port=0)
+        try:
+            session = manager.create("live", data, n_chunks=4)
+            session.register_tenant("acme", total_epsilon=10.0,
+                                    total_delta=1e-3)
+            _query_cols(session, seed=0, tenant="acme")
+            _query_cols(session, seed=1)
+            url = manager.ops_server.url
+
+            prom = urllib.request.urlopen(url + "/metrics",
+                                          timeout=10).read().decode()
+            assert "pipelinedp_tpu_query_seconds_bucket" in prom
+            assert "pipelinedp_tpu_events_total" in prom
+
+            health = json.loads(urllib.request.urlopen(
+                url + "/healthz", timeout=10).read())
+            assert health["status"] == "ok"
+            assert health["checks"]["sessions_resident"] == 1
+            assert health["checks"]["wal_writable"] is True
+
+            status = json.loads(urllib.request.urlopen(
+                url + "/statusz", timeout=10).read())
+            assert status["kind"] == "manager"
+            live = status["sessions"]["live"]
+            assert live["residency"] in ("device", "host")
+            assert live["queries"] == 2
+            acme = live["tenants"]["acme"]
+            assert acme["spent_epsilon"] == pytest.approx(1.0)
+            assert acme["epsilon_burn_pct"] == pytest.approx(10.0)
+            assert status["counters"]["queries"] >= 2
+
+            flightz = json.loads(urllib.request.urlopen(
+                url + "/debug/flightz", timeout=10).read())
+            assert "query_finish" in [e["kind"]
+                                      for e in flightz["events"]]
+        finally:
+            manager.close()
+
+
 class TestNoPrivateLeakScan:
     """Runs the serving matrix (success, batch, shed, deadline, refusal)
     with tracing on, then scans EVERY emitted obs record: span attrs,
-    span events, metric label values, audit fields. Nothing may be
-    array-shaped, carry a forbidden key, or contain a pid/pk sentinel."""
+    span events, metric label values, audit fields — and (PR 13) every
+    operational-plane surface: the /statusz, /healthz and
+    /debug/flightz payloads, the flight-recorder dump, and the
+    slow-query capture bundles. Nothing may be array-shaped, carry a
+    forbidden key, or contain a pid/pk sentinel."""
 
     def _scan_value(self, key, value, where):
         assert key not in metrics_lib.FORBIDDEN_KEYS, \
@@ -303,7 +444,33 @@ class TestNoPrivateLeakScan:
                 assert sentinel not in value, \
                     f"pid sentinel inside string {key!r} in {where}"
 
-    def test_full_matrix_emits_no_private_data(self, tracer):
+    def _scan_json(self, node, where, key="root"):
+        """Recursive scan of an operational-plane JSON payload: every
+        dict key is checked against the forbidden set, every leaf
+        against the sentinel window. One carve-out: the Chrome
+        trace-event schema requires a literal ``pid`` key — it must
+        hold the OS process id, never anything else."""
+        if isinstance(node, dict):
+            for k, v in node.items():
+                if k == "pid":
+                    assert v == os.getpid(), \
+                        f"chrome 'pid' is not the process id in {where}"
+                    continue
+                assert k not in metrics_lib.FORBIDDEN_KEYS, \
+                    f"forbidden key {k!r} in {where}"
+                self._scan_json(v, where, key=k)
+        elif isinstance(node, (list, tuple)):
+            for item in node:
+                self._scan_json(item, where, key=key)
+        else:
+            self._scan_value(key if key not in ("root",) else "leaf",
+                             node, where)
+
+    def test_full_matrix_emits_no_private_data(self, tracer, tmp_path,
+                                               monkeypatch):
+        cap_dir = str(tmp_path / "cap")
+        monkeypatch.setenv(flight_lib.CAPTURE_DIR_ENV, cap_dir)
+        monkeypatch.setenv(flight_lib.SLOW_QUERY_ENV, "0.000001")
         registry = metrics_lib.default_registry()
         data = _data()
         with serving.DatasetSession(data, n_chunks=4,
@@ -351,3 +518,23 @@ class TestNoPrivateLeakScan:
                         assert all(isinstance(m, str) for m in v)
                         continue
                     self._scan_value(k, v, f"audit record {rec.seq}")
+
+            # -- scan the operational plane (PR 13 satellite): the live
+            # endpoints, a flight-recorder dump, and every slow-query
+            # capture the matrix produced -------------------------------
+            with serving.serve_ops(session, port=0) as srv:
+                for endpoint in ("/statusz", "/healthz",
+                                 "/debug/flightz"):
+                    body = urllib.request.urlopen(
+                        srv.url + endpoint, timeout=10).read()
+                    self._scan_json(json.loads(body),
+                                    f"endpoint {endpoint}")
+            dump_path = flight_lib.recorder().dump(
+                str(tmp_path / "flight.json"), reason="leak-scan")
+            self._scan_json(flight_lib.read_dump(dump_path),
+                            "flight dump")
+            captures = os.listdir(cap_dir)
+            assert captures, "the matrix produced no slow-query capture"
+            for name in captures:
+                with open(os.path.join(cap_dir, name)) as f:
+                    self._scan_json(json.load(f), f"capture {name}")

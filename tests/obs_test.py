@@ -356,13 +356,14 @@ class TestProfilerShims:
 # ---------------------------------------------------------------------------
 
 
-def _record(trail, seed=0, outcome="released", tenant="acme"):
+def _record(trail, seed=0, outcome="released", tenant="acme",
+            trace_id=""):
     return trail.record(
         session="s", tenant=tenant, token=f"('fp', {seed})",
         outcome=outcome, mechanisms=["COUNT", "SUM"],
         noise_kind="laplace", epsilon=1.0, delta=1e-6,
         partitions_kept=10, partitions_dropped=5, duration_s=0.25,
-        seed=seed)
+        seed=seed, trace_id=trace_id)
 
 
 class TestAuditTrail:
@@ -460,3 +461,36 @@ class TestAuditTrail:
         r = _record(trail)
         assert audit_lib.AuditRecord.from_payload(
             json.loads(json.dumps(r.to_payload()))) == r
+
+    def test_trace_id_recorded_and_persisted(self, tmp_path):
+        path = str(tmp_path / "audit.wal")
+        trail = audit_lib.AuditTrail(path)
+        _record(trail, trace_id="q123-7")
+        trail.close()
+        reopened = audit_lib.AuditTrail(path)
+        assert reopened.records()[0].trace_id == "q123-7"
+
+    def test_pr11_records_without_trace_id_still_read(self, tmp_path):
+        """Back-compat pin (ISSUE 13): a WAL written before the
+        trace_id field existed must recover cleanly, reading the
+        missing field as the empty string — and appends after recovery
+        (which do carry trace_id) coexist in one file."""
+        from pipelinedp_tpu.runtime import journal as journal_lib
+
+        path = str(tmp_path / "audit.wal")
+        trail = audit_lib.AuditTrail(path)
+        pr11_payload = _record(trail, seed=0).to_payload()
+        trail.close()
+        # Rewrite the WAL with the PR-11 schema (no trace_id key).
+        del pr11_payload["trace_id"]
+        wal = journal_lib.JsonlWal(path)
+        wal.rewrite([pr11_payload])
+        wal.close()
+        reopened = audit_lib.AuditTrail(path)
+        assert len(reopened) == 1
+        assert reopened.records()[0].trace_id == ""
+        assert reopened.records()[0].seed == 0
+        _record(reopened, seed=1, trace_id="q9-1")
+        reopened.close()
+        final = audit_lib.AuditTrail(path)
+        assert [r.trace_id for r in final.records()] == ["", "q9-1"]

@@ -1,0 +1,277 @@
+"""Unit tests for the PR-13 operational plane: the flight recorder
+(ring/spool/dump/captures), the ops HTTP endpoints, and the
+self-diagnosing hang errors — everything that doesn't need an engine
+(the serving integration matrix lives in tests/obs_serving_test.py,
+the SIGKILL leg in tests/process_kill_test.py)."""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pipelinedp_tpu.obs import flight as flight_lib
+from pipelinedp_tpu.obs import metrics as metrics_lib
+from pipelinedp_tpu.obs import ops_plane
+from pipelinedp_tpu.runtime import watchdog as watchdog_lib
+
+
+# ---------------------------------------------------------------------------
+# FlightRecorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+
+    def test_ring_is_bounded_newest_win(self):
+        rec = flight_lib.FlightRecorder(max_events=4)
+        for i in range(10):
+            rec.record("e", i=i)
+        events = rec.events()
+        assert len(events) == 4
+        assert [e.attrs["i"] for e in events] == [6, 7, 8, 9]
+        # seq keeps counting past evictions (watermark semantics).
+        assert rec.watermark() == 10
+
+    def test_payload_gate_refuses_private_shapes(self):
+        rec = flight_lib.FlightRecorder(max_events=8)
+        with pytest.raises(metrics_lib.TelemetryLeakError):
+            rec.record("bad", pid=123)
+        with pytest.raises(metrics_lib.TelemetryLeakError):
+            rec.record("bad", rows=[1, 2, 3])
+        assert rec.events() == []
+
+    def test_since_seq_slicing(self):
+        rec = flight_lib.FlightRecorder(max_events=16)
+        rec.record("a")
+        mark = rec.watermark()
+        rec.record("b")
+        rec.record("c")
+        assert [e.kind for e in rec.events(since_seq=mark)] == ["b", "c"]
+
+    def test_dump_roundtrip_and_atomicity(self, tmp_path):
+        rec = flight_lib.FlightRecorder(max_events=8)
+        rec.record("x", n=1)
+        path = rec.dump(str(tmp_path / "f.json"), reason="test")
+        doc = flight_lib.read_dump(path)
+        assert doc["reason"] == "test"
+        assert doc["process_id"] == os.getpid()
+        assert [e["kind"] for e in doc["events"]] == ["x"]
+        # No stray tmp files: the write is tmp+rename.
+        assert [p.name for p in tmp_path.iterdir()] == ["f.json"]
+
+    def test_dump_without_destination_is_none(self):
+        rec = flight_lib.FlightRecorder(max_events=8)
+        assert rec.dump(reason="nowhere") is None
+
+    def test_spool_survives_torn_tail(self, tmp_path):
+        rec = flight_lib.FlightRecorder(max_events=8)
+        spool = rec.bind_spool(str(tmp_path / "s.jsonl"))
+        rec.record("one", n=1)
+        rec.record("two", n=2)
+        with open(spool, "a") as f:
+            f.write('{"kind":"torn-mid-wri')  # the kill point
+        doc = flight_lib.read_dump(spool)
+        assert [e["kind"] for e in doc["events"]] == ["one", "two"]
+
+    def test_spool_interior_corruption_refused(self, tmp_path):
+        path = tmp_path / "bad.jsonl"
+        path.write_text('garbage\n{"kind":"late","seq":1}\n')
+        with pytest.raises(flight_lib.FlightDumpError):
+            flight_lib.read_dump(str(path))
+
+    def test_concurrent_records_all_land(self):
+        rec = flight_lib.FlightRecorder(max_events=10_000)
+        def worker(t):
+            for i in range(200):
+                rec.record("hammer", t=t, i=i)
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        events = rec.events()
+        assert len(events) == 1600
+        assert len({e.seq for e in events}) == 1600
+
+    def test_postmortem_names_recent_events(self, tmp_path):
+        rec = flight_lib.FlightRecorder(max_events=8)
+        rec.record("retry")
+        rec.record("watchdog_timeout")
+        text = rec.postmortem("/some/dump.json")
+        assert "retry" in text and "watchdog_timeout" in text
+        assert "/some/dump.json" in text
+
+
+class TestSlowQueryCaptures:
+
+    def test_capture_written_and_pruned(self, tmp_path, monkeypatch):
+        d = str(tmp_path / "cap")
+        monkeypatch.setenv(flight_lib.CAPTURE_DIR_ENV, d)
+        monkeypatch.setenv(flight_lib.CAPTURE_LIMIT_ENV, "3")
+        paths = []
+        for i in range(6):
+            p = flight_lib.write_capture(f"q-{i}", {"trace_id": f"q-{i}"})
+            paths.append(p)
+            os.utime(p, (i, i))  # deterministic mtime order
+        kept = sorted(os.listdir(d))
+        assert len(kept) == 3
+        assert kept == ["slowquery_q-3.json", "slowquery_q-4.json",
+                        "slowquery_q-5.json"]
+        assert json.load(open(paths[-1]))["trace_id"] == "q-5"
+
+    def test_capture_disabled_without_dir(self, monkeypatch):
+        monkeypatch.delenv(flight_lib.CAPTURE_DIR_ENV, raising=False)
+        assert flight_lib.write_capture("q", {"a": 1}) is None
+
+    def test_slow_query_env_validation(self, monkeypatch):
+        monkeypatch.delenv(flight_lib.SLOW_QUERY_ENV, raising=False)
+        assert flight_lib.slow_query_threshold_s() is None
+        monkeypatch.setenv(flight_lib.SLOW_QUERY_ENV, "0")
+        assert flight_lib.slow_query_threshold_s() is None
+        monkeypatch.setenv(flight_lib.SLOW_QUERY_ENV, "1.5")
+        assert flight_lib.slow_query_threshold_s() == 1.5
+        monkeypatch.setenv(flight_lib.SLOW_QUERY_ENV, "junk")
+        with pytest.raises(ValueError):
+            flight_lib.slow_query_threshold_s()
+
+
+# ---------------------------------------------------------------------------
+# Self-diagnosing hang errors (satellite: dump path + last events in
+# the message)
+# ---------------------------------------------------------------------------
+
+
+class TestSelfDiagnosingHangErrors:
+
+    def test_watchdog_timeout_message_carries_postmortem(self, tmp_path):
+        flight_lib.recorder().set_dump_dir(str(tmp_path))
+        flight_lib.record("pre_hang_marker_event")
+        wd = watchdog_lib.DispatchWatchdog(0.05)
+        hang = threading.Event()
+        try:
+            with pytest.raises(watchdog_lib.DispatchHangError) as exc_info:
+                wd.call("test op", lambda: hang.wait(5))
+        finally:
+            hang.set()
+            wd.close()
+        msg = str(exc_info.value)
+        assert "flight recorder" in msg
+        assert "pre_hang_marker_event" in exc_info.value.postmortem
+        # The dump landed and parses.
+        dump_path = os.path.join(str(tmp_path),
+                                 f"flight_{os.getpid()}.json")
+        assert os.path.exists(dump_path)
+        doc = flight_lib.read_dump(dump_path)
+        assert doc["reason"] == "watchdog_timeout"
+        assert "watchdog_timeout" in [e["kind"] for e in doc["events"]]
+
+    def test_deadline_error_message_carries_postmortem(self):
+        deadline = watchdog_lib.Deadline.after(-1.0)  # already expired
+        with pytest.raises(watchdog_lib.QueryDeadlineError) as exc_info:
+            deadline.check("slab window at chunk 3")
+        assert "flight recorder" in str(exc_info.value)
+        assert exc_info.value.postmortem
+
+
+# ---------------------------------------------------------------------------
+# Ops endpoints
+# ---------------------------------------------------------------------------
+
+
+class _FakeSession:
+    """A stats()-shaped stand-in so endpoint tests need no engine."""
+
+    name = "fake"
+    store_binding = None
+
+    def stats(self):
+        return {
+            "wire_host_bytes": 1000, "wire_device_bytes": 0,
+            "bound_cache_bytes": 10, "bound_cache_entries": 1,
+            "resident_bytes": 1010, "byte_budget": 1 << 20,
+            "queries": 3, "n_chunks": 2, "spilled": False,
+            "active_queries": 0, "store": None,
+            "tenants": {"acme": {"total_epsilon": 4.0,
+                                 "spent_epsilon": 1.0,
+                                 "remaining_epsilon": 3.0,
+                                 "total_delta": 1e-3,
+                                 "spent_delta": 1e-6,
+                                 "releases": 1}},
+        }
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode(), r.headers.get("Content-Type")
+
+
+class TestOpsEndpoints:
+
+    @pytest.fixture
+    def server(self):
+        with ops_plane.serve_ops(_FakeSession(), port=0) as srv:
+            yield srv
+
+    def test_metrics_is_prometheus_text(self, server):
+        metrics_lib.default_registry().event_inc("ops_test/ping")
+        status, body, ctype = _get(server.url + "/metrics")
+        assert status == 200
+        assert ctype.startswith("text/plain")
+        assert "pipelinedp_tpu_events_total" in body
+
+    def test_statusz_shape(self, server):
+        status, body, _ = _get(server.url + "/statusz")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["kind"] == "session"
+        sess = doc["sessions"]["fake"]
+        assert sess["residency"] == "host"
+        acme = sess["tenants"]["acme"]
+        assert acme["epsilon_burn_pct"] == 25.0
+        assert "counters" in doc
+        assert "bound_cache_hit_rate" in doc["counters"]
+
+    def test_healthz_ok(self, server):
+        status, body, _ = _get(server.url + "/healthz")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["status"] == "ok"
+        assert doc["checks"]["sessions_resident"] == 1
+        assert doc["checks"]["sessions_spilled"] == 0
+        assert "watchdog" in doc["checks"]
+
+    def test_flightz_serves_recent_events(self, server):
+        flight_lib.record("flightz_probe_event")
+        status, body, _ = _get(server.url + "/debug/flightz")
+        assert status == 200
+        doc = json.loads(body)
+        assert "flightz_probe_event" in [e["kind"] for e in doc["events"]]
+
+    def test_unknown_path_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            _get(server.url + "/nope")
+        assert exc_info.value.code == 404
+
+    def test_ephemeral_port_and_close(self):
+        srv = ops_plane.serve_ops(_FakeSession(), port=0)
+        port = srv.port
+        assert port > 0
+        srv.close()
+        with pytest.raises(urllib.error.URLError):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz",
+                                   timeout=2)
+
+    def test_env_port_validation(self, monkeypatch):
+        monkeypatch.delenv(ops_plane.OPS_PORT_ENV, raising=False)
+        assert ops_plane.env_ops_port() is None
+        monkeypatch.setenv(ops_plane.OPS_PORT_ENV, "0")
+        assert ops_plane.env_ops_port() is None
+        monkeypatch.setenv(ops_plane.OPS_PORT_ENV, "8123")
+        assert ops_plane.env_ops_port() == 8123
+        monkeypatch.setenv(ops_plane.OPS_PORT_ENV, "junk")
+        with pytest.raises(ValueError):
+            ops_plane.env_ops_port()

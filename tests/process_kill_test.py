@@ -22,6 +22,7 @@ import sys
 import pytest
 
 from pipelinedp_tpu import runtime
+from pipelinedp_tpu.obs import flight as flight_lib
 
 _HARNESS = os.path.join(os.path.dirname(__file__), "kill_harness.py")
 
@@ -157,7 +158,7 @@ def serve_kill_run(tmp_path_factory, request):
     replay = _run_harness("serve_replay", kill_dir, mesh=mesh)
     assert replay.returncode == 0, replay.stderr
     return {"clean": clean, "killed": killed, "resumed": resumed,
-            "replay": replay, "kill_dir": kill_dir}
+            "replay": replay, "kill_dir": kill_dir, "mesh": mesh}
 
 
 class TestServingKillRecovery:
@@ -218,3 +219,70 @@ class TestServingKillRecovery:
         # Same token both times: the refusal names the release it
         # refused to replay.
         assert replay_post[0]["token"] == replay_post[1]["token"]
+
+
+class TestFlightRecorderKillLeg:
+    """The PR-13 operational-plane acceptance on the kill harness: a
+    SIGKILL'd process leaves a parseable flight-recorder post-mortem
+    next to its WALs, the post-mortem correlates to the recovered audit
+    trail by trace_id, and /statusz on the reopened fleet reports the
+    recovered session."""
+
+    @staticmethod
+    def _spool(proc):
+        path = _marker(proc, "HARNESS_FLIGHT ").split(" ", 1)[1]
+        assert path != "None", "flight spool was never bound"
+        return path
+
+    def test_killed_process_spool_parses(self, serve_kill_run):
+        # The killed process ran no atexit handler and flushed nothing
+        # on exit — the spool must still parse (torn tail tolerated)
+        # and hold the dead query's lifecycle up to the kill point.
+        spool = self._spool(serve_kill_run["killed"])
+        assert os.path.exists(spool)
+        doc = flight_lib.read_dump(spool)
+        kinds = [e["kind"] for e in doc["events"]]
+        assert "query_start" in kinds
+        starts = [e for e in doc["events"] if e["kind"] == "query_start"]
+        assert all(e["attrs"]["qid"] for e in starts)
+        # The kill hit mid-query: no query_finish was ever recorded.
+        assert "query_finish" not in kinds
+
+    def test_post_mortem_correlates_to_audit_wal(self, serve_kill_run):
+        # The resumed process's released query: its flight-recorder
+        # query events and its audit-WAL record share one trace_id.
+        spool = self._spool(serve_kill_run["resumed"])
+        doc = flight_lib.read_dump(spool)
+        start_qids = {e["attrs"]["qid"] for e in doc["events"]
+                      if e["kind"] == "query_start"}
+        finish = [e for e in doc["events"] if e["kind"] == "query_finish"]
+        assert len(finish) == 1
+        qid = finish[0]["attrs"]["qid"]
+        assert qid in start_qids
+        # The replay process recovered the resume's audit record from
+        # the WAL — trace_id intact across process death.
+        prefix = "HARNESS_AUDIT_RECOVERED "
+        recovered = json.loads(
+            _marker(serve_kill_run["replay"], prefix)[len(prefix):])
+        assert [r["trace_id"] for r in recovered] == [qid]
+        assert recovered[0]["outcome"] == "released"
+
+    def test_statusz_reports_recovered_session(self, serve_kill_run):
+        proc = _run_harness("serve_ops", serve_kill_run["kill_dir"],
+                            mesh=serve_kill_run["mesh"])
+        assert proc.returncode == 0, proc.stderr
+        statusz = json.loads(
+            _marker(proc, "HARNESS_STATUSZ ")[len("HARNESS_STATUSZ "):])
+        assert "kh-dataset" in statusz["sessions"]
+        sess = statusz["sessions"]["kh-dataset"]
+        assert sess["residency"] in ("device", "host")
+        assert "acme" in sess["tenants"]
+        # The killed charge + the resumed release: 2.0 epsilon burned
+        # against the durable ledger, visible over HTTP.
+        assert sess["tenants"]["acme"]["spent_epsilon"] == \
+            pytest.approx(2.0)
+        healthz = json.loads(
+            _marker(proc, "HARNESS_HEALTHZ ")[len("HARNESS_HEALTHZ "):])
+        assert healthz["status"] == "ok"
+        assert healthz["checks"]["wal_writable"] is True
+        assert int(_marker(proc, "HARNESS_METRICS_LINES ").split()[1]) > 0

@@ -1,0 +1,132 @@
+"""The bench-trajectory regression gate (obs/regress.py): the
+checked-in trajectory passes, a synthetically degraded copy fails, and
+rounds with different workload shapes never compare."""
+
+import copy
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from pipelinedp_tpu.obs import regress
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAJECTORY = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+
+
+def _row(n, cmd="BENCH_ROWS=1000 python bench.py", **parsed):
+    return {"n": n, "cmd": cmd, "parsed": parsed, "_path": f"r{n}"}
+
+
+class TestCompare:
+
+    def test_regression_flagged_beyond_tolerance(self):
+        rows = [_row(1, value=10_000.0), _row(2, value=7_000.0)]
+        findings, summary = regress.compare(rows)
+        (f,) = [x for x in findings if x["metric"] ==
+                "e2e_partitions_per_sec"]
+        assert f["status"] == "REGRESSION"
+        assert summary["regressions"] == 1
+
+    def test_within_tolerance_is_ok(self):
+        rows = [_row(1, value=10_000.0), _row(2, value=9_200.0)]
+        findings, _ = regress.compare(rows)
+        (f,) = [x for x in findings if x["metric"] ==
+                "e2e_partitions_per_sec"]
+        assert f["status"] == "OK"
+
+    def test_best_prior_not_latest_prior_is_the_bar(self):
+        # A slow middle round must not lower the bar.
+        rows = [_row(1, value=10_000.0), _row(2, value=6_000.0),
+                _row(3, value=7_000.0)]
+        findings, summary = regress.compare(rows)
+        (f,) = [x for x in findings if x["metric"] ==
+                "e2e_partitions_per_sec"]
+        assert f["best_prior"] == 10_000.0
+        assert f["status"] == "REGRESSION"
+
+    def test_different_shapes_never_compare(self):
+        rows = [_row(1, cmd="BENCH_ROWS=9 python bench.py",
+                     value=99_000.0),
+                _row(2, cmd="BENCH_ROWS=1000 python bench.py",
+                     value=10.0)]
+        findings, summary = regress.compare(rows)
+        (f,) = [x for x in findings if x["metric"] ==
+                "e2e_partitions_per_sec"]
+        assert f["status"] == "NEW"
+        assert summary["regressions"] == 0
+
+    def test_explicit_shape_key_wins_over_cmd(self):
+        a = _row(1, value=10_000.0)
+        b = _row(2, value=10_000.0)
+        a["shape"] = {"BENCH_ROWS": "1"}
+        b["shape"] = {"BENCH_ROWS": "2"}
+        findings, _ = regress.compare([a, b])
+        (f,) = [x for x in findings if x["metric"] ==
+                "e2e_partitions_per_sec"]
+        assert f["status"] == "NEW"
+
+    def test_noise_aware_tolerance_widens_with_cv(self):
+        # Three jittery priors -> tolerance grows to 2*cv and a drop
+        # inside that band passes.
+        rows = [_row(1, value=8_000.0), _row(2, value=12_000.0),
+                _row(3, value=10_000.0), _row(4, value=8_200.0)]
+        findings, _ = regress.compare(rows)
+        (f,) = [x for x in findings if x["metric"] ==
+                "e2e_partitions_per_sec"]
+        assert f["tolerance"] > 0.15
+        assert f["status"] == "OK"
+
+    def test_gone_metric_reported_not_failed(self):
+        rows = [_row(1, value=10.0, kernel_partitions_per_sec=5.0),
+                _row(2, value=10.0)]
+        findings, summary = regress.compare(rows)
+        (f,) = [x for x in findings if x["metric"] ==
+                "kernel_partitions_per_sec"]
+        assert f["status"] == "GONE"
+        assert summary["regressions"] == 0
+
+
+@pytest.mark.skipif(not TRAJECTORY, reason="no checked-in trajectory")
+class TestCheckedInTrajectory:
+    """The acceptance pins: exit 0 on the real trajectory, nonzero on a
+    degraded copy — through the same `python -m` entry point CI runs."""
+
+    def _run(self, files):
+        return subprocess.run(
+            [sys.executable, "-m", "pipelinedp_tpu.obs.regress"] + files,
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+
+    def test_current_trajectory_passes(self):
+        proc = self._run(TRAJECTORY)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "Bench regression gate" in proc.stdout
+        assert "REGRESSION" not in proc.stdout
+
+    def test_degraded_copy_fails(self, tmp_path):
+        files = []
+        for path in TRAJECTORY:
+            row = json.load(open(path))
+            out = tmp_path / os.path.basename(path)
+            files.append(str(out))
+            out.write_text(json.dumps(row))
+        # Halve the latest round's e2e headline: an unambiguous
+        # regression at any sane tolerance.
+        latest = json.load(open(files[-1]))
+        latest["parsed"]["value"] *= 0.5
+        with open(files[-1], "w") as f:
+            json.dump(latest, f)
+        proc = self._run(files)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "REGRESSION" in proc.stdout
+
+    def test_markdown_report_written(self, tmp_path):
+        out = tmp_path / "report.md"
+        rc = regress.main(TRAJECTORY + ["--out", str(out)])
+        assert rc == 0
+        text = out.read_text()
+        assert text.startswith("# Bench regression gate")
+        assert "| metric |" in text
