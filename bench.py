@@ -747,6 +747,81 @@ def _bench_serving_fleet(session, params, cold_s):
     return out
 
 
+# Live-session row (ISSUE 15): streaming-append + continual-release
+# shape. Epoch batches are sized so the row finishes in seconds while
+# every append still pays the full commit path (micro-encode gate,
+# fsync'd WAL record, union re-fold through the pinned chunk schedule).
+LIVE_EPOCHS = int(os.environ.get("BENCH_LIVE_EPOCHS", 6))
+LIVE_EPOCH_ROWS = int(os.environ.get("BENCH_LIVE_ROWS", 200_000))
+LIVE_PARTITIONS = 10_000
+
+
+def bench_live():
+    """Live-session row (ISSUE 15): append rows/sec through the fsync'd
+    WAL commit path, scheduled release windows/sec through the tenant
+    at-most-once journal, the warm full-union query, and the
+    live_counters() delta — so streaming ingest is tracked in the
+    trajectory the way batch serving is. Deterministic host noise
+    (secure_host_noise=False) keeps the row reproducible."""
+    import tempfile
+
+    from pipelinedp_tpu import serving
+    from pipelinedp_tpu.serving import live as live_mod
+
+    out = {}
+    rng = np.random.default_rng(9)
+    epochs = [
+        (rng.integers(0, max(LIVE_EPOCH_ROWS // 10, 1), LIVE_EPOCH_ROWS,
+                      dtype=np.int32),
+         rng.integers(0, LIVE_PARTITIONS, LIVE_EPOCH_ROWS,
+                      dtype=np.int32),
+         rng.integers(1, 6, LIVE_EPOCH_ROWS).astype(np.float32))
+        for _ in range(LIVE_EPOCHS)
+    ]
+    counters_before = live_mod.live_counters()
+    with tempfile.TemporaryDirectory() as td:
+        store = serving.SessionStore(td)
+        session = serving.LiveDatasetSession.create(
+            store=store, name="bench-live",
+            public_partitions=list(range(LIVE_PARTITIONS)),
+            n_chunks=4, window=serving.WindowSpec(size=2),
+            secure_host_noise=False)
+        session.register_tenant("bench", 1e6, 1 - 1e-9)
+        t0 = time.perf_counter()
+        for pid, pk, value in epochs:
+            session.append(pid, pk, value)
+        append_s = time.perf_counter() - t0
+        out["append_rows_per_sec"] = round(
+            LIVE_EPOCHS * LIVE_EPOCH_ROWS / append_s, 1)
+        out["append_epochs_per_sec"] = round(LIVE_EPOCHS / append_s, 2)
+        sched = session.release_schedule(
+            "bench-sched", _params(), epsilon=EPS, delta=DELTA,
+            tenant="bench", base_seed=17, secure_host_noise=False)
+        due = len(sched.due_windows())
+        t0 = time.perf_counter()
+        records = sched.tick()
+        tick_s = time.perf_counter() - t0
+        assert len(records) == due and due > 0
+        assert all(r["outcome"] == "released" for r in records)
+        out["windows_released"] = due
+        out["release_windows_per_sec"] = round(due / tick_s, 2)
+        # The warm full-union query a live session serves between
+        # scheduled releases (the folded union wire is resident).
+        t0 = time.perf_counter()
+        cols = session.query(_params(), epsilon=EPS, delta=DELTA,
+                             seed=5, secure_host_noise=False).to_columns()
+        union_s = time.perf_counter() - t0
+        assert int(np.asarray(cols["keep_mask"]).sum()) > 0
+        out["union_query_partitions_per_sec"] = round(
+            LIVE_PARTITIONS / union_s, 1)
+        out["status"] = session.live_status()
+        sched.close()
+        session.close()
+    after = live_mod.live_counters()
+    out["counters"] = {k: after[k] - counters_before[k] for k in after}
+    return out
+
+
 def bench_cpu_baseline() -> float:
     import pipelinedp_tpu as pdp
 
@@ -892,6 +967,12 @@ def main():
     except Exception as e:  # noqa: BLE001
         extra["serving_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
+        # Live-session row (ISSUE 15): streaming append throughput and
+        # scheduled windowed releases, tracked like batch serving.
+        extra["live"] = bench_live()
+    except Exception as e:  # noqa: BLE001
+        extra["live_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
         sweep_dev_sec, sweep_host_sec = bench_utility_sweep()
         extra.update({
             # BASELINE.md #5: 64-config multi-parameter sweep, 2M groups.
@@ -919,6 +1000,8 @@ def main():
             "BENCH_VECTOR_ROWS": str(VEC_ROWS),
             "BENCH_PCT_ROWS": str(PCT_ROWS),
             "BENCH_PCT_PARTITIONS": str(PCT_PARTITIONS),
+            "BENCH_LIVE_EPOCHS": str(LIVE_EPOCHS),
+            "BENCH_LIVE_ROWS": str(LIVE_EPOCH_ROWS),
             "BENCH_SWEEP_GROUPS": str(
                 os.environ.get("BENCH_SWEEP_GROUPS", 2_000_000)),
             "BENCH_SWEEP_PARTITIONS": str(

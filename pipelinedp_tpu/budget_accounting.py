@@ -48,11 +48,16 @@ class BudgetExhaustedError(BudgetAccountantError):
 
 @dataclasses.dataclass(frozen=True)
 class LedgerCharge:
-    """One committed cross-query budget charge of a TenantBudgetLedger."""
+    """One committed cross-query budget charge of a TenantBudgetLedger.
+
+    ``window`` tags charges made on behalf of one continual-release
+    window of a live session (serving/live.py); None for ordinary
+    (un-windowed) queries."""
     index: int
     epsilon: float
     delta: float
     note: str
+    window: Optional[str] = None
 
 
 class TenantBudgetLedger:
@@ -79,19 +84,36 @@ class TenantBudgetLedger:
     _REL_SLACK = 1e-9
 
     # WAL record kinds (runtime.journal record ``kind``; tokens are
-    # ("ledger_charge", index, eps, delta, note) / ("ledger_refund",
-    # index) — index-unique, so the journal's duplicate-token refusal
-    # never fires on legitimate ledger traffic).
+    # ("ledger_charge", index, eps, delta, note) — with a sixth
+    # ``window`` element when the charge is window-tagged — /
+    # ("ledger_refund", index) — index-unique, so the journal's
+    # duplicate-token refusal never fires on legitimate ledger traffic).
     _KIND_CHARGE = "ledger_charge"
     _KIND_REFUND = "ledger_refund"
 
     def __init__(self, tenant_id: str, total_epsilon: float,
-                 total_delta: float = 0.0, wal=None):
+                 total_delta: float = 0.0, wal=None,
+                 window_epsilon: Optional[float] = None,
+                 window_delta: Optional[float] = None):
         input_validators.validate_epsilon_delta(total_epsilon, total_delta,
                                                 "TenantBudgetLedger")
         self._tenant_id = str(tenant_id)
         self._total_epsilon = float(total_epsilon)
         self._total_delta = float(total_delta)
+        # Budget-over-time caps (serving/live.py, SERVING.md "Live
+        # sessions"): a window-tagged charge must also fit under the
+        # per-window (epsilon, delta) cap summed over every charge that
+        # ever carried the same window tag — so a tenant's exposure per
+        # release window stays bounded no matter how many scheduled
+        # releases (catch-ups, retries with fresh seeds) touch it.
+        if window_epsilon is not None or window_delta is not None:
+            input_validators.validate_epsilon_delta(
+                window_epsilon if window_epsilon is not None else 1.0,
+                window_delta or 0.0, "TenantBudgetLedger window cap")
+        self._window_epsilon = (None if window_epsilon is None
+                                else float(window_epsilon))
+        self._window_delta = (None if window_delta is None
+                              else float(window_delta))
         self._lock = threading.Lock()
         self._charges: List[LedgerCharge] = []
         self._refunded: set = set()
@@ -109,10 +131,15 @@ class TenantBudgetLedger:
     def _restore_from_wal(self) -> None:
         for record in self._wal.records:
             if record.kind == self._KIND_CHARGE:
-                _, index, eps, delta, note = record.token
+                # Pre-window records carry 5 token elements; windowed
+                # ones append the tag — both generations replay.
+                _, index, eps, delta, note = record.token[:5]
+                window = (str(record.token[5])
+                          if len(record.token) > 5 else None)
                 self._charges.append(
                     LedgerCharge(index=int(index), epsilon=float(eps),
-                                 delta=float(delta), note=str(note)))
+                                 delta=float(delta), note=str(note),
+                                 window=window))
             elif record.kind == self._KIND_REFUND:
                 self._refunded.add(int(record.token[1]))
 
@@ -163,11 +190,29 @@ class TenantBudgetLedger:
     def remaining_delta(self) -> float:
         return max(0.0, self._total_delta - self.spent_delta)
 
+    @property
+    def window_epsilon(self) -> Optional[float]:
+        return self._window_epsilon
+
+    @property
+    def window_delta(self) -> Optional[float]:
+        return self._window_delta
+
+    def window_spent(self, window: str) -> Budget:
+        """Live (un-refunded) spend charged against one window tag."""
+        with self._lock:
+            live = [c for c in self._live_charges()
+                    if c.window == str(window)]
+            return Budget(math.fsum(c.epsilon for c in live),
+                          math.fsum(c.delta for c in live))
+
     def charge(self, epsilon: float, delta: float = 0.0,
-               note: str = "") -> LedgerCharge:
+               note: str = "",
+               window: Optional[str] = None) -> LedgerCharge:
         """Commits a charge, or raises BudgetExhaustedError untouched."""
         input_validators.validate_epsilon_delta(
             epsilon, delta, "TenantBudgetLedger.charge")
+        window = None if window is None else str(window)
         with self._lock:
             live = self._live_charges()
             eps_after = math.fsum([c.epsilon for c in live] + [epsilon])
@@ -183,16 +228,42 @@ class TenantBudgetLedger:
                     f"{self._total_epsilon:.6g}, "
                     f"delta={delta_after - delta:.6g} of "
                     f"{self._total_delta:.6g})")
+            if window is not None and (self._window_epsilon is not None
+                                       or self._window_delta is not None):
+                win = [c for c in live if c.window == window]
+                win_eps = math.fsum([c.epsilon for c in win] + [epsilon])
+                win_delta = math.fsum([c.delta for c in win] + [delta])
+                cap_eps = (self._window_epsilon
+                           if self._window_epsilon is not None
+                           else self._total_epsilon)
+                cap_delta = (self._window_delta
+                             if self._window_delta is not None
+                             else self._total_delta)
+                if (win_eps > cap_eps * slack
+                        or win_delta > cap_delta * slack
+                        or (win_delta > 0 and cap_delta == 0)):
+                    raise BudgetExhaustedError(
+                        f"tenant {self._tenant_id!r}: charge (eps="
+                        f"{epsilon}, delta={delta}) would overdraw the "
+                        f"per-window cap of window {window!r} (window "
+                        f"spent eps={win_eps - epsilon:.6g} of "
+                        f"{cap_eps:.6g}, delta={win_delta - delta:.6g} "
+                        f"of {cap_delta:.6g})")
             record = LedgerCharge(index=len(self._charges),
                                   epsilon=float(epsilon),
-                                  delta=float(delta), note=note)
+                                  delta=float(delta), note=note,
+                                  window=window)
             if self._wal is not None:
                 # Write-ahead: the charge is durable before it is
                 # acknowledged in memory (and therefore before the query
-                # it pays for runs).
-                self._wal.commit(
-                    (self._KIND_CHARGE, record.index, record.epsilon,
-                     record.delta, record.note), kind=self._KIND_CHARGE)
+                # it pays for runs). Window-tagged charges append the
+                # tag as a sixth token element (older records stay
+                # readable — _restore_from_wal handles both shapes).
+                token = (self._KIND_CHARGE, record.index, record.epsilon,
+                         record.delta, record.note)
+                if window is not None:
+                    token = token + (window,)
+                self._wal.commit(token, kind=self._KIND_CHARGE)
             self._charges.append(record)
         obs_metrics.default_registry().event_inc("serving/tenant_charges")
         obs_trace.event("tenant_charge", epsilon=float(epsilon),

@@ -64,8 +64,16 @@ from pipelinedp_tpu.obs import metrics as metrics_lib
 #   PIPELINEDP_TPU_CAPTURE_DIR — where slow-query captures land
 #     (unset = captures disabled).
 #   PIPELINEDP_TPU_CAPTURES — max capture files kept (oldest pruned).
+#   PIPELINEDP_TPU_FLIGHT_SPOOL_BYTES — total byte budget across all
+#     spool segments (default 64 MiB); the active spool rotates at
+#     budget/segments bytes.
+#   PIPELINEDP_TPU_FLIGHT_SPOOL_SEGMENTS — how many spool files the
+#     budget is split over (active + rotated ``.1``..``.K-1``;
+#     default 4). Oldest segment is dropped on rotation.
 FLIGHT_DIR_ENV = "PIPELINEDP_TPU_FLIGHT_DIR"
 FLIGHT_EVENTS_ENV = "PIPELINEDP_TPU_FLIGHT_EVENTS"
+SPOOL_BYTES_ENV = "PIPELINEDP_TPU_FLIGHT_SPOOL_BYTES"
+SPOOL_SEGMENTS_ENV = "PIPELINEDP_TPU_FLIGHT_SPOOL_SEGMENTS"
 SLOW_QUERY_ENV = "PIPELINEDP_TPU_SLOW_QUERY_S"
 CAPTURE_DIR_ENV = "PIPELINEDP_TPU_CAPTURE_DIR"
 CAPTURE_LIMIT_ENV = "PIPELINEDP_TPU_CAPTURES"
@@ -81,6 +89,21 @@ def ring_capacity() -> int:
     """Validated PIPELINEDP_TPU_FLIGHT_EVENTS (default 2048)."""
     from pipelinedp_tpu.native import loader
     return loader.env_int(FLIGHT_EVENTS_ENV, 2048, 64, 1_000_000)
+
+
+def spool_byte_budget() -> int:
+    """Validated PIPELINEDP_TPU_FLIGHT_SPOOL_BYTES (default 64 MiB):
+    the total on-disk budget across the active spool and its rotated
+    segments. A long-lived serving process records events forever; the
+    budget is what keeps the post-mortem from eating the WAL volume."""
+    from pipelinedp_tpu.native import loader
+    return loader.env_int(SPOOL_BYTES_ENV, 64 << 20, 4096, 1 << 40)
+
+
+def spool_segment_count() -> int:
+    """Validated PIPELINEDP_TPU_FLIGHT_SPOOL_SEGMENTS (default 4)."""
+    from pipelinedp_tpu.native import loader
+    return loader.env_int(SPOOL_SEGMENTS_ENV, 4, 1, 64)
 
 
 def _env_float_s(name: str, lo: float, hi: float) -> Optional[float]:
@@ -150,6 +173,9 @@ class FlightRecorder:
         self._seq = 0
         self._spool_fh = None
         self._spool_path: Optional[str] = None
+        self._spool_bytes = 0
+        self._spool_segment_bytes = 0  # rotate threshold; 0 = unbound
+        self._spool_segments = 1
         self._dump_dir: Optional[str] = None
 
     # -- recording --------------------------------------------------------
@@ -168,14 +194,19 @@ class FlightRecorder:
             self._events.append(event)
             if self._spool_fh is not None:
                 try:
-                    self._spool_fh.write(
-                        json.dumps(event.to_payload(),
-                                   separators=(",", ":")) + "\n")
+                    line = (json.dumps(event.to_payload(),
+                                       separators=(",", ":")) + "\n")
+                    self._spool_fh.write(line)
                     # flush() lands the line in the OS page cache: it
                     # survives SIGKILL (only an OS/power crash loses it;
                     # the dump path is for that — and fsync per event
                     # would put a disk sync on the serving hot path).
                     self._spool_fh.flush()
+                    self._spool_bytes += len(line)
+                    if (self._spool_segment_bytes
+                            and self._spool_bytes
+                            >= self._spool_segment_bytes):
+                        self._rotate_spool_locked()
                 except (OSError, ValueError):
                     pass  # a dead spool degrades the post-mortem only
         return event
@@ -210,7 +241,11 @@ class FlightRecorder:
     def bind_spool(self, path: str) -> str:
         """Opens (append) the JSON-lines spool at ``path``; subsequent
         events stream there as they are recorded. Idempotent for the
-        same path; rebinding moves the stream."""
+        same path; rebinding moves the stream. The spool is size-capped:
+        it rotates at ``spool_byte_budget() / spool_segment_count()``
+        bytes into ``path.1`` .. ``path.K-1`` (oldest dropped), so an
+        always-on recorder holds a bounded slice of recent history
+        instead of growing without bound next to the WALs."""
         with self._lock:
             if self._spool_path == path and self._spool_fh is not None:
                 return path
@@ -224,7 +259,50 @@ class FlightRecorder:
                     pass
             self._spool_fh = open(path, "a")
             self._spool_path = path
+            self._spool_segments = spool_segment_count()
+            self._spool_segment_bytes = max(
+                4096, spool_byte_budget() // self._spool_segments)
+            try:
+                # Re-binding after a restart resumes an existing spool
+                # mid-segment: the counter starts at its current size so
+                # the rotation point is where it would have been.
+                self._spool_bytes = os.path.getsize(path)
+            except OSError:
+                self._spool_bytes = 0
         return path
+
+    def _rotate_spool_locked(self) -> None:
+        """Shifts the segment chain (``.K-1`` dropped, ``.i`` ->
+        ``.i+1``, active -> ``.1``) and reopens a fresh active spool.
+        Caller holds ``_lock``. A torn final line in a rotated segment
+        stays torn — :func:`read_dump` tolerates it per segment. With
+        one segment configured the active file is simply truncated.
+        Best-effort like all spool I/O: on failure the old handle keeps
+        streaming and the next threshold crossing retries."""
+        path = self._spool_path
+        if path is None or self._spool_fh is None:
+            return
+        try:
+            self._spool_fh.close()
+        except OSError:
+            pass
+        try:
+            if self._spool_segments > 1:
+                oldest = f"{path}.{self._spool_segments - 1}"
+                if os.path.exists(oldest):
+                    os.unlink(oldest)
+                for i in range(self._spool_segments - 2, 0, -1):
+                    src = f"{path}.{i}"
+                    if os.path.exists(src):
+                        os.replace(src, f"{path}.{i + 1}")
+                os.replace(path, f"{path}.1")
+            self._spool_fh = open(path, "w")
+            self._spool_bytes = 0
+        except OSError:
+            try:
+                self._spool_fh = open(path, "a")
+            except OSError:
+                self._spool_fh = None
 
     def set_dump_dir(self, path: str) -> None:
         self._dump_dir = path
@@ -381,6 +459,31 @@ def read_dump(path: str) -> dict:
                 f"{path}: spool line {i} is malformed but later events "
                 f"follow — corrupted, not torn ({exc})")
         events_out.append(obj)
+    return {"version": DUMP_VERSION, "reason": "spool",
+            "source": "spool", "events": events_out}
+
+
+def spool_segment_paths(path: str) -> List[str]:
+    """All on-disk segments of a rotated spool, oldest first
+    (``path.K-1`` .. ``path.1``, then the active ``path``)."""
+    out: List[str] = []
+    for i in range(spool_segment_count() - 1, 0, -1):
+        seg = f"{path}.{i}"
+        if os.path.exists(seg):
+            out.append(seg)
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
+def read_spool(path: str) -> dict:
+    """Reads a rotated spool chain back as one event stream, oldest
+    segment first. Torn-tail tolerance applies per segment — a segment
+    rotated away mid-write keeps its torn final line, and each file is
+    parsed with :func:`read_dump`'s stance independently."""
+    events_out: List[dict] = []
+    for seg in spool_segment_paths(path):
+        events_out.extend(read_dump(seg)["events"])
     return {"version": DUMP_VERSION, "reason": "spool",
             "source": "spool", "events": events_out}
 

@@ -441,6 +441,23 @@ def rehydration_seconds() -> Histogram:
         "Spilled-session re-hydration latency.")
 
 
+def append_seconds() -> Histogram:
+    """One live-session append end to end (digest + micro-encode + WAL
+    commit + epoch fold), labeled by outcome (committed / duplicate /
+    shed / late-rejected / dead-lettered / failed)."""
+    return default_registry().histogram(
+        "pipelinedp_tpu_append_seconds",
+        "LiveDatasetSession.append latency by outcome.")
+
+
+def release_tick_seconds() -> Histogram:
+    """One scheduled continual-release window (ReleaseSchedule), labeled
+    by outcome (released / recovered / suppressed)."""
+    return default_registry().histogram(
+        "pipelinedp_tpu_release_tick_seconds",
+        "Scheduled continual-release window latency by outcome.")
+
+
 def fleet_resident_bytes() -> Gauge:
     """Fleet-wide resident bytes across admitted sessions."""
     return default_registry().gauge(

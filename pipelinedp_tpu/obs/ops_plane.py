@@ -83,7 +83,7 @@ def _session_statusz(session_stats: dict) -> dict:
             total_epsilon=total,
             epsilon_burn_pct=(round(100.0 * spent / total, 2)
                               if total > 0 else 0.0))
-    return {
+    out = {
         "residency": _residency_tier(session_stats),
         "resident_bytes": session_stats.get("resident_bytes", 0),
         "wire_host_bytes": session_stats.get("wire_host_bytes", 0),
@@ -96,6 +96,9 @@ def _session_statusz(session_stats: dict) -> dict:
         "store": session_stats.get("store"),
         "tenants": tenants,
     }
+    if "live" in session_stats:
+        out["live"] = session_stats["live"]
+    return out
 
 
 def _fleet_counters() -> dict:

@@ -51,6 +51,9 @@ HEADLINE_METRICS: Tuple[Tuple[str, str, float], ...] = (
     ("serving_batched_qps_w32",
      "serving.batched.width_32_queries_per_sec", 0.40),
     ("utility_sweep_vs_host", "utility_sweep_vs_host", 0.35),
+    ("live_append_rows_per_sec", "live.append_rows_per_sec", 0.30),
+    ("live_release_windows_per_sec",
+     "live.release_windows_per_sec", 0.40),
 )
 
 MAX_TOLERANCE = 0.50

@@ -941,10 +941,17 @@ def stream_bound_and_aggregate(
     return accs
 
 
-def _input_digest(pid, pk, value) -> str:
+def input_digest(pid, pk, value) -> str:
+    """Content digest of one (pid, pk, value) column triple — the same
+    identity ``ResidentWire.data_digest`` carries, exposed for callers
+    that digest batches before ingesting them (the serving append WAL
+    keys its idempotency on this)."""
     from pipelinedp_tpu.runtime import checkpoint as checkpoint_lib
 
     return checkpoint_lib.array_digest(pid, pk, value)
+
+
+_input_digest = input_digest
 
 
 def _snapshot_host(accs, qhist):

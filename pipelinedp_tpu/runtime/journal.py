@@ -93,10 +93,19 @@ class JsonlWal:
         self._fh = None
         self.recovered: List[dict] = self._recover()
         self._fh = open(self._path, "ab")
+        self._next_seq = len(self.recovered)
 
     @property
     def path(self) -> str:
         return self._path
+
+    @property
+    def next_seq(self) -> int:
+        """The dense ``seq`` the next appended payload must carry:
+        recovered records plus appends since open. Callers that number
+        their own records (the serving append WAL, release schedules)
+        read it instead of re-deriving the count."""
+        return self._next_seq
 
     @staticmethod
     def _canonical(payload: dict) -> str:
@@ -166,11 +175,15 @@ class JsonlWal:
         self._fh.write(line)
         self._fh.flush()
         os.fsync(self._fh.fileno())
+        seq = payload.get("seq")
+        if isinstance(seq, int):
+            self._next_seq = max(self._next_seq, seq + 1)
         return len(line)
 
     def rewrite(self, payloads) -> None:
         """Atomically replaces the file with ``payloads`` (compaction;
         tmp + fsync + rename so a crash leaves the previous file)."""
+        payloads = list(payloads)
         parent = os.path.dirname(self._path) or "."
         fd, tmp = tempfile.mkstemp(dir=parent, suffix=".tmp")
         try:
@@ -187,6 +200,7 @@ class JsonlWal:
         if self._fh is not None:
             self._fh.close()
         self._fh = open(self._path, "ab")
+        self._next_seq = len(payloads)
 
     def close(self) -> None:
         if self._fh is not None:

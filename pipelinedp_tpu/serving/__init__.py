@@ -25,6 +25,17 @@ package is the long-lived serving layer over the columnar engine:
 L5 user code stays declarative: ``dataframes.QueryBuilder.on(session)``
 builds queries against a session exactly like against a frame.
 
+Live (streaming-append) sessions extend the session with crash-
+exactly-once ingest and continual releases (SERVING.md "Live
+sessions"): :class:`~pipelinedp_tpu.serving.live.LiveDatasetSession`
+accepts micro-batch appends committed through a fsync'd append WAL
+(SIGKILL lands the reopened session at exactly epoch N or N+1;
+duplicate batches are digest-idempotent), windows the epoch axis
+(:class:`~pipelinedp_tpu.serving.live.WindowSpec` — tumbling/sliding,
+watermark + late-arrival policy), and releases each sealed window
+exactly once across restarts through a
+:class:`~pipelinedp_tpu.serving.live.ReleaseSchedule`.
+
 The durable fleet layer (SERVING.md "Fleet operation") sits on top:
 
   * :class:`~pipelinedp_tpu.serving.store.SessionStore` spills sessions
@@ -58,9 +69,18 @@ from pipelinedp_tpu.serving.manager import (  # noqa: F401
     EVENT_DEMOTIONS, EVENT_SHED, EVENT_SPILLS, INFLIGHT_ENV,
     SessionManager, SessionOverloadedError, fleet_counters,
     max_inflight_default)
+from pipelinedp_tpu.serving.live import (  # noqa: F401
+    EVENT_APPENDS, EVENT_APPEND_DUPLICATES, EVENT_APPENDS_SHED,
+    EVENT_EPOCH_FOLDS, EVENT_LATE_DEADLETTERED, EVENT_LATE_REJECTED,
+    EVENT_RELEASES_RECOVERED, EVENT_RELEASES_SUPPRESSED,
+    EVENT_SCHEDULED_RELEASES, MAX_PENDING_ENV, AppendResult,
+    IngestOverloadedError, LateArrivalError, LiveDatasetSession,
+    ReleaseSchedule, WindowSpec, live_counters,
+    max_pending_appends_default, window_seed)
 from pipelinedp_tpu.budget_accounting import (  # noqa: F401
     BudgetExhaustedError, TenantBudgetLedger)
 from pipelinedp_tpu.runtime.watchdog import QueryDeadlineError  # noqa: F401
+from pipelinedp_tpu.runtime.journal import DoubleReleaseError  # noqa: F401
 from pipelinedp_tpu.obs.audit import (  # noqa: F401
     AuditCorruptError, AuditRecord, AuditTrail)
 from pipelinedp_tpu.obs.ops_plane import (  # noqa: F401

@@ -176,6 +176,15 @@ class SessionManager:
         session = self._store.open(name, **open_kwargs)
         return self.attach(session)
 
+    def open_live(self, name: str, **open_kwargs
+                  ) -> session_lib.DatasetSession:
+        """Reopens a stored LIVE session (append-WAL replay + union
+        fold; serving/live.py) from the manager's store and admits it —
+        its appends and scheduled releases then run under the fleet's
+        admission gate and deadlines like any query."""
+        session = self._store.open_live(name, **open_kwargs)
+        return self.attach(session)
+
     def attach(self, session: session_lib.DatasetSession
                ) -> session_lib.DatasetSession:
         """Admits an existing session: it joins the LRU set, its queries

@@ -680,7 +680,9 @@ class DatasetSession:
     def register_tenant(self, tenant_id: str, total_epsilon: float,
                         total_delta: float = 0.0,
                         release_journal: Optional[
-                            journal_lib.ReleaseJournal] = None
+                            journal_lib.ReleaseJournal] = None,
+                        window_epsilon: Optional[float] = None,
+                        window_delta: Optional[float] = None
                         ) -> TenantState:
         """Creates a tenant with its own cross-query budget ledger and
         at-most-once release journal (a FileReleaseJournal makes the
@@ -690,7 +692,12 @@ class DatasetSession:
         by default: the release journal and the ledger land on fsync'd
         WALs under the store, and the registration is recorded in the
         session manifest immediately — so a crash right after
-        registration still reattaches the tenant on reopen."""
+        registration still reattaches the tenant on reopen.
+
+        ``window_epsilon``/``window_delta`` cap the spend attributable to
+        any single release window on a live session (charges tagged with
+        a window label by the continual-release scheduler); untagged
+        queries see only the total caps."""
         with self._lock:
             self._check_open()
             if tenant_id in self._tenants:
@@ -705,7 +712,9 @@ class DatasetSession:
                     store.tenant_ledger_path(name, tenant_id))
             state = TenantState(
                 ledger=budget_accounting.TenantBudgetLedger(
-                    tenant_id, total_epsilon, total_delta, wal=wal),
+                    tenant_id, total_epsilon, total_delta, wal=wal,
+                    window_epsilon=window_epsilon,
+                    window_delta=window_delta),
                 release_journal=(release_journal if release_journal
                                  is not None else
                                  journal_lib.ReleaseJournal()))
@@ -713,7 +722,9 @@ class DatasetSession:
         if self._store_binding is not None:
             store, name = self._store_binding
             store.record_tenant(name, tenant_id, total_epsilon, total_delta,
-                                release_journal)
+                                release_journal,
+                                window_epsilon=window_epsilon,
+                                window_delta=window_delta)
         return state
 
     def tenant(self, tenant_id: str) -> TenantState:
@@ -738,21 +749,22 @@ class DatasetSession:
         return (key_fp,) + tuple(
             (k, self._canonical(kw[k])) for k in sorted(kw))
 
-    def _resolved_sampler(self, mesh, kw: dict) -> str:
+    def _resolved_sampler(self, mesh, kw: dict, wire=None) -> str:
         """The RESOLVED sampler this query config compiles against
         (streaming.resolved_sampler_desc), cached under the bound-cache
         key so flipping ``segment_sort`` between queries — e.g. two
         user-built engines over one session, or "auto" resolving
         differently for different caps — can never alias a cached
         accumulator produced by a different group stage."""
-        num_partitions = self._wire.num_partitions
+        wire = self._wire if wire is None else wire
+        num_partitions = wire.num_partitions
         if mesh is not None:
             from pipelinedp_tpu.parallel import sharded
             num_partitions = sharded.padded_num_partitions(
                 mesh, num_partitions)
         return streaming.resolved_sampler_desc(
-            self._wire.fmt, kw.get("segment_sort", "auto"),
-            self._wire.max_run, num_partitions=num_partitions,
+            wire.fmt, kw.get("segment_sort", "auto"),
+            wire.max_run, num_partitions=num_partitions,
             row_clip_lo=kw.get("row_clip_lo", -np.inf),
             row_clip_hi=kw.get("row_clip_hi", np.inf),
             linf_cap=kw.get("linf_cap", 1),
@@ -779,7 +791,19 @@ class DatasetSession:
         this exact (kernel key, caps, clips, flags) was computed before
         (a hit is bitwise-exact by construction: the key includes the
         kernel-key fingerprint), replaying the retained wire otherwise.
-        Called by JaxDPEngine._execute on the resident path.
+        Called by JaxDPEngine._execute on the resident path."""
+        return self._accumulate_wire(self._wire, None, k_kernel,
+                                     mesh=mesh, resilience=resilience,
+                                     **kw)
+
+    def _accumulate_wire(self, wire, key_prefix, k_kernel, *, mesh,
+                         resilience=None, **kw):
+        """The replay-or-cache body of :meth:`_accumulate`, parameterized
+        by the wire so live sessions can route window views through the
+        same machinery. ``key_prefix`` (a tuple or None) is prepended to
+        the bound-cache key — live sessions tag entries with the wire
+        fingerprint so an epoch bump invalidates only the entries the
+        fold actually changed.
 
         A running query's Deadline (thread-local, set by :meth:`query`)
         is injected into the replay's resilience bundle so the slab
@@ -795,7 +819,9 @@ class DatasetSession:
         # different group stages can never alias.
         kw_for_key = {k: v for k, v in kw.items() if k != "segment_sort"}
         cache_key = self._cache_key(key_fp, kw_for_key) + (
-            ("resolved_sampler", self._resolved_sampler(mesh, kw)),)
+            ("resolved_sampler", self._resolved_sampler(mesh, kw, wire)),)
+        if key_prefix is not None:
+            cache_key = (key_prefix,) + cache_key
         with self._pinned():
             with self._lock:
                 self._check_open()
@@ -814,26 +840,29 @@ class DatasetSession:
                 resilience.deadline = deadline
             t_replay0 = time.perf_counter()
             with obs_trace.span("serving/replay", session=self._name,
-                                n_chunks=self._wire.n_chunks):
+                                n_chunks=wire.n_chunks):
                 try:
-                    result = self._replay(k_kernel, mesh, resilience, kw)
+                    result = self._replay(k_kernel, mesh, resilience, kw,
+                                          wire)
                 except Exception as exc:
                     if (retry_lib.classify(exc) != retry_lib.OOM
-                            or not self._wire.device_resident):
+                            or not wire.device_resident):
                         raise
                     # Graceful degradation: a device-resident replay that
                     # exhausted device memory falls back to shipping host
                     # windows instead of failing the query.
-                    self._wire.drop_device()
+                    wire.drop_device()
                     profiler.count_event(EVENT_DEVICE_FALLBACKS)
                     obs_trace.event("device_fallback")
-                    result = self._replay(k_kernel, mesh, resilience, kw)
+                    result = self._replay(k_kernel, mesh, resilience, kw,
+                                          wire)
             obs_metrics.replay_seconds().observe(
                 time.perf_counter() - t_replay0)
             self._cache_insert(cache_key, result)
             return result
 
-    def _replay(self, k_kernel, mesh, resilience, kw):
+    def _replay(self, k_kernel, mesh, resilience, kw, wire=None):
+        wire = self._wire if wire is None else wire
         if mesh is not None:
             from pipelinedp_tpu.parallel import sharded
             mesh_kw = dict(kw)
@@ -841,10 +870,10 @@ class DatasetSession:
                 raise NotImplementedError(
                     "quantile replay is single-device only")
             return sharded.replay_resident_wire(
-                mesh, k_kernel, self._wire, resilience=resilience,
+                mesh, k_kernel, wire, resilience=resilience,
                 **mesh_kw)
         return streaming.replay_resident_wire(
-            k_kernel, self._wire, resilience=resilience, **kw)
+            k_kernel, wire, resilience=resilience, **kw)
 
     def _cache_insert(self, cache_key: tuple, result) -> None:
         nbytes = self._result_nbytes(result)
@@ -881,7 +910,8 @@ class DatasetSession:
               watchdog_timeout_s: Optional[float] = None,
               retry_policy=None,
               trace_path: Optional[str] = None,
-              out_explain_computation_report=None
+              out_explain_computation_report=None,
+              _live=None
               ) -> jax_engine.LazyJaxResult:
         """Answers one DP query from the resident dataset.
 
@@ -948,8 +978,9 @@ class DatasetSession:
             # Charge-before-run (the at-most-once stance): the slice is
             # spent before any work happens — and exactly refunded below
             # if the query dies before its release token commits.
-            charge = state.ledger.charge(epsilon, delta,
-                                         note=f"query seed={seed}")
+            charge = state.ledger.charge(
+                epsilon, delta, note=f"query seed={seed}",
+                window=(_live.window_tag if _live is not None else None))
             accountant = budget_accounting.NaiveBudgetAccountant(
                 epsilon, delta)
             if journal is None:
@@ -968,7 +999,8 @@ class DatasetSession:
             seed=seed,
             secure_host_noise=shn,
             mesh=self._mesh,
-            stream_chunks=self._wire.n_chunks,
+            stream_chunks=(_live.view.n_chunks if _live is not None
+                           else self._wire.n_chunks),
             segment_sort=self._segment_sort,
             compact_merge=self._compact_merge,
             epilogue_cache=self._epilogue_cache,
@@ -986,8 +1018,9 @@ class DatasetSession:
             # thread executes the replay.
             self._deadline_tls.value = deadline
             try:
+                target = self if _live is None else _live.view
                 result = engine.aggregate(
-                    self, params, public_partitions=self._public,
+                    target, params, public_partitions=self._public,
                     out_explain_computation_report=(
                         out_explain_computation_report))
                 accountant.compute_budgets()

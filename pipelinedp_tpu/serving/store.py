@@ -60,6 +60,11 @@ MANIFEST_FILE = "manifest.json"
 WIRE_FILE = "wire.npz"
 BOUND_DIR = "bound"
 TENANT_DIR = "tenants"
+# Live-session layout (serving/live.py; see the live section below).
+APPEND_WAL_FILE = "append.wal"
+EPOCH_DIR = "epochs"
+DEADLETTER_DIR = "deadletter"
+SCHEDULE_DIR = "schedule"
 
 # Profiler event counters (profiler.count_event / event_count):
 EVENT_SAVES = "serving/store_saves"
@@ -405,6 +410,10 @@ class SessionStore:
         entry = {"id": tenant_id,
                  "total_epsilon": ledger.total_epsilon,
                  "total_delta": ledger.total_delta}
+        if ledger.window_epsilon is not None \
+                or ledger.window_delta is not None:
+            entry["window_epsilon"] = ledger.window_epsilon
+            entry["window_delta"] = ledger.window_delta
         path = getattr(release_journal, "_path", None)
         if path is not None:
             entry["release_journal_path"] = os.path.abspath(path)
@@ -433,11 +442,13 @@ class SessionStore:
             self.tenant_ledger_path(name, tenant_id))
         durable = budget_accounting.TenantBudgetLedger(
             ledger.tenant_id, ledger.total_epsilon, ledger.total_delta,
-            wal=wal)
+            wal=wal, window_epsilon=ledger.window_epsilon,
+            window_delta=ledger.window_delta)
         refunded = ledger.refunded_indices
         for charge in ledger.charges:
             replayed = durable.charge(charge.epsilon, charge.delta,
-                                      note=charge.note)
+                                      note=charge.note,
+                                      window=charge.window)
             # Refund immediately so a replayed prefix never holds MORE
             # live budget than the original ledger ever did (refunding
             # only at the end could spuriously overdraw when a later
@@ -447,13 +458,16 @@ class SessionStore:
         return durable
 
     def record_tenant(self, name: str, tenant_id: str, total_epsilon: float,
-                      total_delta: float, release_journal) -> None:
+                      total_delta: float, release_journal, *,
+                      window_epsilon: Optional[float] = None,
+                      window_delta: Optional[float] = None) -> None:
         """Appends one tenant registration to an existing manifest
         atomically (so a crash between register_tenant and the next full
         save still reattaches the tenant on reopen)."""
         manifest = self._read_manifest(name)
         ledger = budget_accounting.TenantBudgetLedger(
-            tenant_id, total_epsilon, total_delta)
+            tenant_id, total_epsilon, total_delta,
+            window_epsilon=window_epsilon, window_delta=window_delta)
         entry = self._tenant_manifest_entry(tenant_id, ledger,
                                             release_journal)
         tenants = [t for t in manifest["tenants"] if t["id"] != tenant_id]
@@ -604,10 +618,15 @@ class SessionStore:
         ``mesh`` must match the topology the wire was ingested for
         (n_dev buckets per chunk).
         """
-        from pipelinedp_tpu.serving.session import (DatasetSession,
-                                                    TenantState)
+        from pipelinedp_tpu.serving.session import DatasetSession
 
         manifest = self._read_manifest(name)
+        if manifest.get("live"):
+            raise SessionStoreError(
+                f"session {name!r} is a live (streaming) session; its "
+                f"stored wire is a point-in-time spill, not the epoch "
+                f"log — reopen it with SessionStore.open_live so the "
+                f"append WAL replays")
         n_dev = mesh.devices.size if mesh is not None else 1
         if manifest["n_dev"] != n_dev:
             raise ValueError(
@@ -631,6 +650,14 @@ class SessionStore:
             store_binding=(self, name))
         for key, result in self._load_bound_entries(name, manifest):
             session._cache_insert(key, result)
+        self._reattach_tenants(session, name, manifest)
+        profiler.count_event(EVENT_OPENS)
+        return session
+
+    def _reattach_tenants(self, session, name: str, manifest: dict) -> None:
+        """Rebinds every manifest tenant to its durable ledger and
+        release-journal WALs (shared by open and open_live)."""
+        from pipelinedp_tpu.serving.session import TenantState
         for entry in manifest["tenants"]:
             release_path = entry.get(
                 "release_journal_path",
@@ -640,9 +667,134 @@ class SessionStore:
                     entry["id"], entry["total_epsilon"],
                     entry["total_delta"],
                     wal=journal_lib.FileReleaseJournal(
-                        self.tenant_ledger_path(name, entry["id"]))),
+                        self.tenant_ledger_path(name, entry["id"])),
+                    window_epsilon=entry.get("window_epsilon"),
+                    window_delta=entry.get("window_delta")),
                 release_journal=journal_lib.FileReleaseJournal(
                     release_path))
             session._tenants[entry["id"]] = state
+
+    # -- live (streaming append) sessions --------------------------------
+    #
+    # A live session keeps, next to the ordinary spill layout, the data
+    # that makes append crash-exactly-once (serving/live.py):
+    #
+    #     append.wal          fsync'd WAL: one "append" record per
+    #                         committed epoch (content digest + row
+    #                         count + event time) and one "advance"
+    #                         record per watermark advancement — the
+    #                         record count IS the epoch counter, and
+    #                         appending the record IS the commit point
+    #     epochs/e<N>.npz     the raw micro-batch of epoch N, written
+    #                         (tmp+fsync+rename) BEFORE its WAL record
+    #     deadletter/*.npz    late batches under the "dead_letter"
+    #                         policy, keyed by content digest
+    #     schedule/<id>.wal   per-ReleaseSchedule outcome WALs
+    #
+    # manifest["live"] marks the session as live (record_live) so the
+    # batch open() refuses it and open_live knows the window/watermark
+    # configuration to rebuild.
+
+    def append_wal_path(self, name: str) -> str:
+        return os.path.join(self.path(name), APPEND_WAL_FILE)
+
+    def epoch_path(self, name: str, epoch: int) -> str:
+        return os.path.join(self.path(name), EPOCH_DIR, f"e{epoch}.npz")
+
+    def save_epoch(self, name: str, epoch: int, pid, pk, value) -> str:
+        """Durably writes one epoch's raw micro-batch (atomic; the WAL
+        record that commits the epoch is appended only after this
+        returns, so a crash in between leaves an orphan payload the
+        next append simply overwrites)."""
+        path = self.epoch_path(name, epoch)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        arrays = {"pid": np.asarray(pid), "pk": np.asarray(pk)}
+        if value is not None:
+            arrays["value"] = np.asarray(value)
+        _atomic_write(path, _npz_bytes(arrays))
+        return path
+
+    def load_epoch(self, name: str, epoch: int, digest: str):
+        """(pid, pk, value) of one committed epoch, digest-validated
+        against the append-WAL record that committed it — a payload
+        that fails its digest refuses (the live session must never
+        fold rows that differ from what was committed)."""
+        path = self.epoch_path(name, epoch)
+        try:
+            with np.load(path, allow_pickle=False) as data:
+                pid = np.array(data["pid"])
+                pk = np.array(data["pk"])
+                value = (np.array(data["value"])
+                         if "value" in data.files else None)
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile) as exc:
+            raise SessionCorruptError(
+                f"session {name!r}: epoch {epoch} payload is unreadable "
+                f"({exc}); the append WAL committed it — refusing to "
+                f"reopen without its rows") from exc
+        if streaming.input_digest(pid, pk, value) != digest:
+            raise SessionCorruptError(
+                f"session {name!r}: epoch {epoch} payload fails the "
+                f"content digest its append-WAL record committed; "
+                f"refusing to fold rows that differ from what was "
+                f"acknowledged")
+        return pid, pk, value
+
+    def deadletter_path(self, name: str, digest: str) -> str:
+        return os.path.join(self.path(name), DEADLETTER_DIR,
+                            f"{digest}.npz")
+
+    def save_deadletter(self, name: str, digest: str, pid, pk,
+                        value) -> str:
+        """Persists one late batch under the dead-letter policy, keyed
+        by content digest (idempotent: a re-submitted late batch
+        overwrites its identical self)."""
+        path = self.deadletter_path(name, digest)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        arrays = {"pid": np.asarray(pid), "pk": np.asarray(pk)}
+        if value is not None:
+            arrays["value"] = np.asarray(value)
+        _atomic_write(path, _npz_bytes(arrays))
+        return path
+
+    def deadletter_digests(self, name: str) -> List[str]:
+        """Content digests of the dead-lettered batches, sorted."""
+        path = os.path.join(self.path(name), DEADLETTER_DIR)
+        if not os.path.isdir(path):
+            return []
+        return sorted(f[:-len(".npz")] for f in os.listdir(path)
+                      if f.endswith(".npz"))
+
+    def schedule_path(self, name: str, schedule_id: str) -> str:
+        return os.path.join(self.path(name), SCHEDULE_DIR,
+                            f"{self._safe(schedule_id)}.wal")
+
+    def record_live(self, name: str, meta: dict) -> None:
+        """Atomically records (or updates) the manifest's live-session
+        section — window/watermark configuration plus everything
+        open_live needs that the append WAL does not carry."""
+        manifest = self._read_manifest(name)
+        manifest["live"] = meta
+        _atomic_write(os.path.join(self.path(name), MANIFEST_FILE),
+                      json.dumps(manifest, indent=1).encode())
+
+    def open_live(self, name: str, *, mesh=None, resident_bytes=None,
+                  epilogue_cache=None):
+        """Reopens a live session after process death: replays the
+        append WAL, loads and digest-validates every committed epoch
+        payload, and rebuilds the union wire — landing at exactly the
+        epoch the WAL committed (N, or N+1 when the crash fell after
+        the WAL append), bit-identical to a session that never died.
+        See serving/live.py for the append/commit discipline."""
+        from pipelinedp_tpu.serving import live as live_lib
+
+        manifest = self._read_manifest(name)
+        if not manifest.get("live"):
+            raise SessionStoreError(
+                f"session {name!r} is not a live session; use "
+                f"SessionStore.open")
+        session = live_lib.LiveDatasetSession._reopen(
+            self, name, manifest, mesh=mesh,
+            resident_bytes=resident_bytes, epilogue_cache=epilogue_cache)
+        self._reattach_tenants(session, name, manifest)
         profiler.count_event(EVENT_OPENS)
         return session

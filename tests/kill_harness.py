@@ -54,6 +54,49 @@ events next to the store's WALs, so even the SIGKILL'd process leaves a
 parseable post-mortem with the query's trace id (correlating to the
 audit WAL's ``trace_id`` field).
 
+Live-session modes (ISSUE 15 — crash-exactly-once streaming append +
+windowed continual releases; ``LiveDatasetSession`` under
+``<workdir>/sessions``, tumbling size-1 windows, four 3000-row epochs):
+
+  live_clean        — fresh dir: create, append epochs 0..3, tick a
+                      ReleaseSchedule (3 sealed windows) and run one
+                      full-union query; prints per-window and full
+                      released columns.
+  live_cold         — fresh dir: the SAME windows and union answered by
+                      cold batch ``DatasetSession``s over the same rows
+                      with the same pinned n_chunks and per-window
+                      seeds — the bit-identity reference.
+  live_prepare      — create + append epochs 0 and 1 only.
+  live_kill_append  — reopen and append epoch 2 with the crash seam at
+                      the ``encode`` stage (after the epoch payload is
+                      staged, BEFORE the WAL commit): SIGKILL — reopen
+                      must land at exactly epoch 2 (N).
+  live_kill_fold    — append epoch 2 again with the seam at ``fold``
+                      (AFTER the WAL commit, before the in-memory
+                      fold): SIGKILL — reopen must land at epoch 3
+                      (N+1) with the fold rebuilt from the WAL.
+  live_epoch        — reopen only; prints epoch / fingerprint / sealed
+                      windows (the inspection step between kills).
+  live_dup          — reopen and re-submit the epoch-2 batch: must be
+                      a digest-idempotent no-op (duplicate=True, epoch
+                      unchanged).
+  live_resume       — reopen, append epoch 3, tick the schedule: all
+                      three sealed windows release; prints per-window
+                      and full columns (must be bit-identical to
+                      ``live_clean`` — the union crossed two SIGKILLs).
+  live_replay       — reopen, reattach the schedule (nothing due), and
+                      deliberately replay window [0,1): the tenant's
+                      durable release journal must refuse it
+                      (DoubleReleaseError) across restarts.
+  live_kill_release — a second schedule with the seam at the
+                      ``release`` stage: window [0,1) records, [1,2)
+                      releases its token then SIGKILL before the
+                      outcome record.
+  live_recover      — reattach the second schedule: [1,2) is due
+                      again, its catch-up re-run is refused by the
+                      release journal and recorded as ``recovered``
+                      (charge exactly refunded); [2,3) releases.
+
 Set ``PDP_KH_MESH=8`` to run the serving modes on an 8-device virtual
 mesh (the orchestrator also forces the XLA host-device-count flag).
 
@@ -64,6 +107,12 @@ outcome; everything else is free-form noise (JAX logs etc.).
 import json
 import os
 import sys
+
+# Script-mode execution puts tests/ (not the repo root) on sys.path;
+# the harness must import the package no matter how it was launched.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 
 def _build_inputs():
@@ -245,6 +294,200 @@ def _run_serve_ops(workdir: str) -> None:
     manager.close()
 
 
+# -- live-session modes (ISSUE 15) -------------------------------------------
+
+_LIVE_NAME = "kh-live"
+_LIVE_EPOCH_ROWS = 3_000
+_LIVE_BASE_SEED = 11
+_LIVE_EPS = 0.5
+_LIVE_DELTA = 1e-7
+
+
+def _build_live_epoch(e: int):
+    """Epoch ``e``'s micro-batch — deterministic per epoch so every
+    process (and the cold reference) regenerates identical rows."""
+    import numpy as np
+
+    rng = np.random.default_rng(100 + e)
+    n = _LIVE_EPOCH_ROWS
+    pid = rng.integers(1_000, 3_000, n).astype(np.int64)
+    pk = rng.integers(0, 50, n).astype(np.int32)
+    value = rng.uniform(0, 5, n).astype(np.float32)
+    return pid, pk, value
+
+
+def _live_params():
+    import pipelinedp_tpu as pdp
+
+    return pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+        max_partitions_contributed=50,
+        max_contributions_per_partition=1_000,
+        min_value=0.0,
+        max_value=5.0)
+
+
+def _hex_columns(columns) -> dict:
+    import numpy as np
+
+    return {name: np.asarray(col).tobytes().hex()
+            for name, col in sorted(columns.items())}
+
+
+def _live_session(workdir: str):
+    from pipelinedp_tpu import serving
+
+    store = serving.SessionStore(os.path.join(workdir, "sessions"))
+    mesh = _serving_mesh()
+    if store.exists(_LIVE_NAME):
+        session = store.open_live(_LIVE_NAME, mesh=mesh)
+    else:
+        # secure_host_noise=False on BOTH the live session and the cold
+        # reference: the secure path draws OS entropy by design, so
+        # bit-identity legs must pin the deterministic generator.
+        session = serving.LiveDatasetSession.create(
+            store=store, name=_LIVE_NAME,
+            public_partitions=list(range(50)), n_chunks=8,
+            window=serving.WindowSpec(size=1), mesh=mesh,
+            secure_host_noise=False)
+        session.register_tenant("acme", total_epsilon=1e9,
+                                total_delta=1 - 1e-9)
+    return store, session
+
+
+def _live_schedule(session, schedule_id: str, base_seed: int):
+    return session.release_schedule(
+        schedule_id, _live_params(), epsilon=_LIVE_EPS,
+        delta=_LIVE_DELTA, tenant="acme", base_seed=base_seed,
+        secure_host_noise=False)
+
+
+def _print_live_release(records, session) -> None:
+    out = {}
+    for r in records:
+        a, b = r["window"]
+        out[f"{a},{b}"] = _hex_columns(r["result"])
+    print("HARNESS_LIVE_WINDOWS " + json.dumps(out))
+    columns = session.query(
+        _live_params(), epsilon=1.0, delta=1e-6, seed=3, tenant="acme",
+        secure_host_noise=False).to_columns()
+    print("HARNESS_RESULT " + json.dumps(
+        {"mode": "live", "columns": _hex_columns(columns)}))
+    ledger = session.tenant("acme").ledger
+    print(f"HARNESS_LEDGER {ledger.spent_epsilon:.6f}")
+
+
+def _run_live_cold(workdir: str) -> None:
+    """The bit-identity reference: each window (and the full union)
+    answered by a cold batch session over the same rows with the same
+    pinned chunk count and the same per-window seed."""
+    import numpy as np
+
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import serving
+
+    mesh = _serving_mesh()
+    params = _live_params()
+    epochs = [_build_live_epoch(e) for e in range(4)]
+    windows = {}
+    for a in range(3):
+        pid, pk, value = epochs[a]
+        cold = serving.DatasetSession(
+            pdp.ColumnarData(pid=pid, pk=pk, value=value),
+            public_partitions=list(range(50)), mesh=mesh, n_chunks=8,
+            name=f"kh-cold-w{a}")
+        cols = cold.query(
+            params, epsilon=_LIVE_EPS, delta=_LIVE_DELTA,
+            seed=serving.window_seed(_LIVE_BASE_SEED, a, a + 1),
+            secure_host_noise=False).to_columns()
+        windows[f"{a},{a + 1}"] = _hex_columns(cols)
+    print("HARNESS_LIVE_WINDOWS " + json.dumps(windows))
+    union = serving.DatasetSession(
+        pdp.ColumnarData(pid=np.concatenate([e[0] for e in epochs]),
+                         pk=np.concatenate([e[1] for e in epochs]),
+                         value=np.concatenate([e[2] for e in epochs])),
+        public_partitions=list(range(50)), mesh=mesh, n_chunks=8,
+        name="kh-cold-union")
+    columns = union.query(params, epsilon=1.0, delta=1e-6, seed=3,
+                          secure_host_noise=False).to_columns()
+    print("HARNESS_RESULT " + json.dumps(
+        {"mode": "live_cold", "columns": _hex_columns(columns)}))
+
+
+def _run_live(mode: str, workdir: str) -> None:
+    from pipelinedp_tpu import serving
+    from pipelinedp_tpu.serving import live as live_lib
+
+    if mode == "live_cold":
+        _run_live_cold(workdir)
+        return
+    store, session = _live_session(workdir)
+    from pipelinedp_tpu.obs import flight
+    print(f"HARNESS_FLIGHT {flight.recorder().spool_path}")
+    sys.stdout.flush()
+
+    if mode == "live_clean":
+        for e in range(4):
+            session.append(*_build_live_epoch(e))
+        sched = _live_schedule(session, "sched", _LIVE_BASE_SEED)
+        _print_live_release(sched.tick(), session)
+    elif mode == "live_prepare":
+        for e in range(2):
+            session.append(*_build_live_epoch(e))
+        print("HARNESS_SAVED " + session.fingerprint)
+        print(f"HARNESS_LIVE_EPOCH {session.epoch}")
+    elif mode in ("live_kill_append", "live_kill_fold"):
+        stage = "encode" if mode == "live_kill_append" else "fold"
+        os.environ[live_lib.LIVE_CRASH_ENV] = f"{stage}@2"
+        session.append(*_build_live_epoch(2))
+        print("HARNESS_NOT_KILLED")  # must never print
+    elif mode == "live_epoch":
+        print("HARNESS_LIVE_STATE " + json.dumps({
+            "epoch": session.epoch,
+            "fingerprint": session.fingerprint,
+            "sealed": [list(w) for w in session.sealed_windows()]}))
+    elif mode == "live_dup":
+        before = session.epoch
+        res = session.append(*_build_live_epoch(2))
+        print("HARNESS_LIVE_DUP " + json.dumps({
+            "duplicate": res.duplicate, "epoch_before": before,
+            "epoch_after": session.epoch}))
+    elif mode == "live_resume":
+        session.append(*_build_live_epoch(3))
+        sched = _live_schedule(session, "sched", _LIVE_BASE_SEED)
+        _print_live_release(sched.tick(), session)
+    elif mode == "live_replay":
+        sched = _live_schedule(session, "sched", _LIVE_BASE_SEED)
+        print("HARNESS_LIVE_DUE " + json.dumps(
+            [list(w) for w in sched.due_windows()]))
+        try:
+            sched.replay(0, 1)
+        except serving.DoubleReleaseError:
+            ledger = session.tenant("acme").ledger
+            print(f"HARNESS_LEDGER {ledger.spent_epsilon:.6f}")
+            print("HARNESS_DOUBLE_RELEASE")
+            return
+        print("HARNESS_REPLAY_ALLOWED")  # must never print
+    elif mode == "live_kill_release":
+        os.environ[live_lib.LIVE_CRASH_ENV] = "release@1"
+        # A distinct base seed gives this schedule its own release
+        # tokens — "sched" already released these windows once.
+        sched = _live_schedule(session, "sched2", _LIVE_BASE_SEED + 1000)
+        sched.tick()
+        print("HARNESS_NOT_KILLED")  # must never print
+    elif mode == "live_recover":
+        sched = _live_schedule(session, "sched2", _LIVE_BASE_SEED + 1000)
+        print("HARNESS_LIVE_DUE " + json.dumps(
+            [list(w) for w in sched.due_windows()]))
+        records = sched.tick()
+        print("HARNESS_LIVE_OUTCOMES " + json.dumps(
+            [[list(r["window"]), r["outcome"]] for r in records]))
+        ledger = session.tenant("acme").ledger
+        print(f"HARNESS_LEDGER {ledger.spent_epsilon:.6f}")
+    else:
+        raise SystemExit(f"unknown live mode {mode!r}")
+
+
 def main() -> None:
     mode, workdir = sys.argv[1], sys.argv[2]
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -254,6 +497,8 @@ def main() -> None:
         _run_serve_ops(workdir)
     elif mode.startswith("serve_"):
         _run_serving(mode, workdir)
+    elif mode.startswith("live_"):
+        _run_live(mode, workdir)
     else:
         _run_engine(mode, workdir)
 

@@ -1,0 +1,537 @@
+"""Live-session tests (pipelinedp_tpu/serving/live.py, SERVING.md
+"Live sessions").
+
+Contracts:
+  * Window algebra — tumbling and sliding window edges are exact
+    (half-open ``[a, b)``, sealed iff ``b <= watermark - lateness``),
+    and late arrivals follow the configured policy: typed
+    ``LateArrivalError`` or dead-letter persistence, each with its
+    counter — never a silent fold into a sealed window.
+  * Bit-identity — a sealed window's query (and the full-union query)
+    is BIT-identical to the same query over the same rows ingested
+    cold with the session's pinned chunk count, including after
+    save/open_live. All parity legs pin ``secure_host_noise=False``:
+    the secure path draws OS entropy by design.
+  * Exactly-once releases — a ReleaseSchedule re-created with its
+    schedule_id after reopen owes exactly the unrecorded sealed
+    windows; a deliberate replay is refused (``DoubleReleaseError``);
+    empty windows release (noise-only) or suppress per policy,
+    deterministically.
+  * Backpressure — appends beyond the pending gate shed with a typed
+    ``IngestOverloadedError`` before any durable or budget effect.
+  * Per-window budget — ``register_tenant(window_epsilon=...)`` caps
+    each window tag independently of the total ledger.
+
+The true-SIGKILL legs (crash at either side of the WAL commit point,
+mid-schedule kills) live in tests/process_kill_test.py — they need
+real process death.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import profiler, runtime, serving
+from pipelinedp_tpu.budget_accounting import BudgetExhaustedError
+from pipelinedp_tpu.runtime.journal import DoubleReleaseError
+
+M = pdp.Metrics
+
+N_PARTS = 20
+N_CHUNKS = 4
+EPOCH_ROWS = 600
+
+
+def epoch_batch(e, n=EPOCH_ROWS, with_value=True):
+    rng = np.random.default_rng(200 + e)
+    pid = rng.integers(0, 300, n).astype(np.int64)
+    pk = rng.integers(0, N_PARTS, n).astype(np.int32)
+    value = rng.uniform(0, 5, n).astype(np.float32) if with_value else None
+    return pid, pk, value
+
+
+def count_sum_params():
+    return pdp.AggregateParams(
+        metrics=[M.COUNT, M.SUM],
+        max_partitions_contributed=N_PARTS,
+        max_contributions_per_partition=100,
+        min_value=0.0,
+        max_value=5.0)
+
+
+def make_live(tmp_path, sub="live", window=None, name="live-ds",
+              tenant=True, **kwargs):
+    store = serving.SessionStore(str(tmp_path / sub))
+    session = serving.LiveDatasetSession.create(
+        store=store, name=name,
+        public_partitions=list(range(N_PARTS)), n_chunks=N_CHUNKS,
+        window=window or serving.WindowSpec(size=1),
+        secure_host_noise=False, **kwargs)
+    if tenant:
+        session.register_tenant("acme", total_epsilon=1e6,
+                                total_delta=1 - 1e-9)
+    return store, session
+
+
+def cold_columns(pid, pk, value, *, epsilon, delta, seed):
+    cold = serving.DatasetSession(
+        pdp.ColumnarData(pid=pid, pk=pk, value=value),
+        public_partitions=list(range(N_PARTS)), n_chunks=N_CHUNKS,
+        name="cold-ref")
+    return cold.query(count_sum_params(), epsilon=epsilon, delta=delta,
+                      seed=seed, secure_host_noise=False).to_columns()
+
+
+def assert_identical(a: dict, b: dict):
+    assert list(a) == list(b)
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))
+
+
+class TestWindowSpec:
+
+    def test_tumbling_edges(self):
+        spec = serving.WindowSpec(size=2)
+        assert spec.stride == 2
+        assert spec.windows_sealed_by(0) == []
+        assert spec.windows_sealed_by(1) == []
+        assert spec.windows_sealed_by(2) == [(0, 2)]
+        assert spec.windows_sealed_by(3) == [(0, 2)]
+        assert spec.windows_sealed_by(6) == [(0, 2), (2, 4), (4, 6)]
+
+    def test_sliding_edges_overlap(self):
+        spec = serving.WindowSpec(size=3, slide=1)
+        assert spec.windows_sealed_by(3) == [(0, 3)]
+        assert spec.windows_sealed_by(5) == [(0, 3), (1, 4), (2, 5)]
+
+    def test_sliding_with_gaps(self):
+        # slide > size: disjoint windows with unwindowed gaps between.
+        spec = serving.WindowSpec(size=1, slide=3)
+        assert spec.windows_sealed_by(7) == [(0, 1), (3, 4), (6, 7)]
+
+    @pytest.mark.parametrize("kwargs", [
+        dict(size=0), dict(size=-1), dict(size=2, slide=0),
+        dict(size=1, allowed_lateness=-1),
+        dict(size=1, late_policy="drop")])
+    def test_invalid_specs_refused(self, kwargs):
+        with pytest.raises(ValueError):
+            serving.WindowSpec(**kwargs)
+
+    def test_meta_roundtrip(self):
+        spec = serving.WindowSpec(size=3, slide=2, allowed_lateness=1,
+                                  late_policy="dead_letter")
+        assert serving.WindowSpec.from_meta(spec.to_meta()) == spec
+
+
+class TestAppendBasics:
+
+    def test_epoch_watermark_progression(self, tmp_path):
+        _, s = make_live(tmp_path)
+        assert (s.epoch, s.watermark, s.sealed_windows()) == (0, 0, [])
+        s.append(*epoch_batch(0))
+        assert (s.epoch, s.watermark) == (1, 1)
+        s.append(*epoch_batch(1))
+        assert s.sealed_windows() == [(0, 1)]
+        assert s.is_sealed(0, 1) and not s.is_sealed(1, 2)
+
+    def test_empty_append_refused(self, tmp_path):
+        _, s = make_live(tmp_path)
+        with pytest.raises(ValueError, match="empty append"):
+            s.append(np.zeros(0, np.int64), np.zeros(0, np.int32),
+                     np.zeros(0, np.float32))
+
+    def test_duplicate_is_idempotent_noop(self, tmp_path):
+        _, s = make_live(tmp_path)
+        first = s.append(*epoch_batch(0))
+        assert first.committed and not first.duplicate
+        before = profiler.event_count(serving.EVENT_APPEND_DUPLICATES)
+        dup = s.append(*epoch_batch(0))
+        assert dup.duplicate and not dup.committed
+        assert dup.epoch == first.epoch
+        assert s.epoch == 1
+        assert profiler.event_count(
+            serving.EVENT_APPEND_DUPLICATES) == before + 1
+
+    def test_non_numeric_columns_refused(self, tmp_path):
+        _, s = make_live(tmp_path)
+        pid, pk, _ = epoch_batch(0)
+        with pytest.raises(ValueError, match="numeric columns only"):
+            s.append(pid, pk, np.array(["a"] * len(pid), dtype=object))
+
+    def test_value_presence_must_stay_consistent(self, tmp_path):
+        _, s = make_live(tmp_path)
+        s.append(*epoch_batch(0))
+        pid, pk, _ = epoch_batch(1)
+        with pytest.raises(ValueError, match="consistent"):
+            s.append(pid, pk, None)
+
+    def test_mismatched_column_lengths_refused(self, tmp_path):
+        _, s = make_live(tmp_path)
+        pid, pk, value = epoch_batch(0)
+        with pytest.raises(ValueError, match="lengths disagree"):
+            s.append(pid, pk[:-1], value)
+
+    def test_stats_and_status_report_live_state(self, tmp_path):
+        _, s = make_live(tmp_path)
+        s.append(*epoch_batch(0))
+        live = s.stats()["live"]
+        assert live == s.live_status()
+        assert live["epoch"] == 1
+        assert live["watermark"] == 1
+
+    def test_batch_open_refuses_live_session(self, tmp_path):
+        store, s = make_live(tmp_path)
+        s.append(*epoch_batch(0))
+        s.save()
+        with pytest.raises(serving.SessionStoreError, match="open_live"):
+            store.open("live-ds")
+
+    def test_advance_watermark_is_monotone_and_durable(self, tmp_path):
+        store, s = make_live(tmp_path)
+        s.append(*epoch_batch(0))
+        s.advance_watermark(3)
+        assert s.watermark == 4
+        s.advance_watermark(1)  # backwards: no-op
+        assert s.watermark == 4
+        assert s.sealed_windows() == [(0, 1), (1, 2), (2, 3)]
+        reopened = store.open_live("live-ds")
+        assert reopened.watermark == 4
+        assert reopened.sealed_windows() == s.sealed_windows()
+
+
+class TestLateArrivals:
+
+    def test_reject_policy_raises_typed_error(self, tmp_path):
+        _, s = make_live(tmp_path)
+        for e in range(3):
+            s.append(*epoch_batch(e))
+        before = profiler.event_count(serving.EVENT_LATE_REJECTED)
+        with pytest.raises(serving.LateArrivalError) as exc:
+            s.append(*epoch_batch(9), event_epoch=0)
+        assert exc.value.event_epoch == 0
+        assert exc.value.horizon == 2
+        assert s.epoch == 3  # nothing folded
+        assert profiler.event_count(
+            serving.EVENT_LATE_REJECTED) == before + 1
+
+    def test_allowed_lateness_admits_stragglers(self, tmp_path):
+        _, s = make_live(
+            tmp_path, window=serving.WindowSpec(size=1,
+                                                allowed_lateness=2))
+        for e in range(3):
+            s.append(*epoch_batch(e))
+        # horizon = max_event - lateness = 0: event 0 is still open.
+        res = s.append(*epoch_batch(9), event_epoch=0)
+        assert res.committed
+        # Lateness delays sealing by the same margin.
+        assert s.sealed_windows() == []
+
+    def test_dead_letter_policy_persists_and_counts(self, tmp_path):
+        store, s = make_live(
+            tmp_path, window=serving.WindowSpec(
+                size=1, late_policy="dead_letter"))
+        for e in range(3):
+            s.append(*epoch_batch(e))
+        before = profiler.event_count(serving.EVENT_LATE_DEADLETTERED)
+        res = s.append(*epoch_batch(9), event_epoch=0)
+        assert res.dead_lettered and not res.committed
+        assert s.epoch == 3
+        assert profiler.event_count(
+            serving.EVENT_LATE_DEADLETTERED) == before + 1
+        assert list(store.deadletter_digests("live-ds")) == [res.digest]
+        # Re-submitting the dead-lettered batch is an idempotent no-op.
+        again = s.append(*epoch_batch(9), event_epoch=0)
+        assert again.duplicate and again.dead_lettered
+        # The dead letter survives reopen — still refused, not folded.
+        reopened = store.open_live("live-ds")
+        again2 = reopened.append(*epoch_batch(9), event_epoch=0)
+        assert again2.duplicate and again2.dead_lettered
+        assert reopened.epoch == 3
+
+
+class TestBitIdentity:
+
+    def test_window_and_union_match_cold_batch(self, tmp_path):
+        _, s = make_live(tmp_path)
+        batches = [epoch_batch(e) for e in range(3)]
+        for b in batches:
+            s.append(*b)
+        for a in range(2):
+            live = s.window_query(
+                a, a + 1, count_sum_params(), epsilon=0.5, delta=1e-7,
+                seed=serving.window_seed(5, a, a + 1),
+                tenant="acme").to_columns()
+            cold = cold_columns(
+                *batches[a], epsilon=0.5, delta=1e-7,
+                seed=serving.window_seed(5, a, a + 1))
+            assert_identical(live, cold)
+        live_full = s.query(count_sum_params(), epsilon=1.0, delta=1e-6,
+                            seed=3, tenant="acme").to_columns()
+        cold_full = cold_columns(
+            np.concatenate([b[0] for b in batches]),
+            np.concatenate([b[1] for b in batches]),
+            np.concatenate([b[2] for b in batches]),
+            epsilon=1.0, delta=1e-6, seed=3)
+        assert_identical(live_full, cold_full)
+
+    def test_reopen_is_bit_deterministic(self, tmp_path):
+        store, s = make_live(tmp_path)
+        for e in range(3):
+            s.append(*epoch_batch(e))
+        fp = s.fingerprint
+        # Tenantless queries: the SAME (seed, window) query re-issued
+        # through a tenant would be refused by the at-most-once release
+        # journal — which is its own contract, tested elsewhere.
+        live = s.window_query(0, 1, count_sum_params(), epsilon=0.5,
+                              delta=1e-7, seed=17).to_columns()
+        reopened = store.open_live("live-ds")
+        assert reopened.epoch == 3
+        assert reopened.fingerprint == fp
+        again = reopened.window_query(
+            0, 1, count_sum_params(), epsilon=0.5, delta=1e-7,
+            seed=17).to_columns()
+        assert_identical(live, again)
+
+    def test_unsealed_window_query_refused(self, tmp_path):
+        _, s = make_live(tmp_path)
+        s.append(*epoch_batch(0))
+        with pytest.raises(ValueError, match="sealed"):
+            s.window_query(0, 1, count_sum_params(), epsilon=0.5,
+                           delta=1e-7, seed=1, tenant="acme")
+
+
+class TestBackpressure:
+
+    def test_zero_gate_sheds_before_any_effect(self, tmp_path):
+        _, s = make_live(tmp_path, max_pending_appends=0)
+        before = profiler.event_count(serving.EVENT_APPENDS_SHED)
+        with pytest.raises(serving.IngestOverloadedError) as exc:
+            s.append(*epoch_batch(0))
+        assert exc.value.max_pending == 0
+        assert profiler.event_count(
+            serving.EVENT_APPENDS_SHED) == before + 1
+        # Shed strictly before any durable or budget effect.
+        assert s.epoch == 0
+        assert s.tenant("acme").ledger.spent_epsilon == 0.0
+        assert s.stats()["live"]["pending_appends"] == 0
+
+    def test_env_default_gate(self, monkeypatch):
+        monkeypatch.delenv(serving.MAX_PENDING_ENV, raising=False)
+        assert serving.max_pending_appends_default() == 64
+        monkeypatch.setenv(serving.MAX_PENDING_ENV, "3")
+        assert serving.max_pending_appends_default() == 3
+
+
+class TestReleaseSchedule:
+
+    def _schedule(self, session, sid="sched", base_seed=5, **kwargs):
+        return session.release_schedule(
+            sid, count_sum_params(), epsilon=0.5, delta=1e-7,
+            tenant="acme", base_seed=base_seed, **kwargs)
+
+    def test_tick_releases_each_sealed_window_once(self, tmp_path):
+        _, s = make_live(tmp_path)
+        for e in range(3):
+            s.append(*epoch_batch(e))
+        sched = self._schedule(s)
+        records = sched.tick()
+        assert [r["window"] for r in records] == [(0, 1), (1, 2)]
+        assert all(r["outcome"] == "released" for r in records)
+        assert sched.tick() == []  # nothing due twice
+
+    def test_catchup_owes_exactly_the_unrecorded_windows(self, tmp_path):
+        store, s = make_live(tmp_path)
+        for e in range(3):
+            s.append(*epoch_batch(e))
+        sched = self._schedule(s)
+        sched.tick()
+        sched.close()
+        reopened = store.open_live("live-ds")
+        reopened.append(*epoch_batch(3))
+        again = self._schedule(reopened)
+        # Recorded windows stay recorded across the reopen; only the
+        # newly sealed window is due.
+        assert again.due_windows() == [(2, 3)]
+        records = again.tick()
+        assert [r["window"] for r in records] == [(2, 3)]
+        assert records[0]["outcome"] == "released"
+
+    def test_deliberate_replay_refused_and_refunded(self, tmp_path):
+        _, s = make_live(tmp_path)
+        for e in range(2):
+            s.append(*epoch_batch(e))
+        sched = self._schedule(s)
+        sched.tick()
+        spent = s.tenant("acme").ledger.spent_epsilon
+        with pytest.raises(DoubleReleaseError):
+            sched.replay(0, 1)
+        # The refused replay's charge was exactly refunded.
+        assert s.tenant("acme").ledger.spent_epsilon == spent
+
+    def test_replay_of_unrecorded_window_is_an_error(self, tmp_path):
+        _, s = make_live(tmp_path)
+        s.append(*epoch_batch(0))
+        sched = self._schedule(s)
+        with pytest.raises(ValueError, match="no recorded outcome"):
+            sched.replay(0, 1)
+
+    def test_schedule_requires_tenant(self, tmp_path):
+        _, s = make_live(tmp_path)
+        with pytest.raises(ValueError, match="tenant"):
+            s.release_schedule("sched", count_sum_params(),
+                               epsilon=0.5, tenant=None)
+
+    def test_empty_window_releases_noise_only_by_default(self, tmp_path):
+        _, s = make_live(tmp_path)
+        s.append(*epoch_batch(0), event_epoch=0)
+        s.advance_watermark(2)  # event 1 never arrives; [1,2) is empty
+        sched = self._schedule(s)
+        records = sched.tick()
+        by_window = {r["window"]: r for r in records}
+        assert by_window[(1, 2)]["outcome"] == "released"
+        # Noise-only, but a real release: every public partition kept.
+        cols = by_window[(1, 2)]["result"]
+        assert len(np.asarray(cols["count"])) == N_PARTS
+
+    def test_empty_window_release_is_deterministic(self, tmp_path):
+        results = []
+        for sub in ("a", "b"):
+            _, s = make_live(tmp_path, sub=sub)
+            s.append(*epoch_batch(0), event_epoch=0)
+            s.advance_watermark(2)
+            records = self._schedule(s).tick()
+            results.append({r["window"]: r["result"] for r in records})
+        for w in results[0]:
+            assert_identical(results[0][w], results[1][w])
+
+    def test_empty_window_suppress_policy(self, tmp_path):
+        _, s = make_live(tmp_path)
+        s.append(*epoch_batch(0), event_epoch=0)
+        s.advance_watermark(2)
+        before = profiler.event_count(serving.EVENT_RELEASES_SUPPRESSED)
+        records = self._schedule(s, empty_policy="suppress").tick()
+        by_window = {r["window"]: r for r in records}
+        assert by_window[(1, 2)]["outcome"] == "suppressed"
+        assert by_window[(1, 2)]["result"] is None
+        assert by_window[(0, 1)]["outcome"] == "released"
+        assert profiler.event_count(
+            serving.EVENT_RELEASES_SUPPRESSED) == before + 1
+
+    def test_invalid_empty_policy_refused(self, tmp_path):
+        _, s = make_live(tmp_path)
+        with pytest.raises(ValueError, match="empty_policy"):
+            self._schedule(s, empty_policy="drop")
+
+
+class TestWindowBudgetCaps:
+
+    def test_per_window_cap_independent_of_total(self, tmp_path):
+        _, s = make_live(tmp_path, tenant=False)
+        s.register_tenant("acme", total_epsilon=1e6,
+                          total_delta=1 - 1e-9, window_epsilon=1.0)
+        for e in range(2):
+            s.append(*epoch_batch(e))
+        params = count_sum_params()
+        s.window_query(0, 1, params, epsilon=0.6, delta=1e-7, seed=1,
+                       tenant="acme")
+        ledger = s.tenant("acme").ledger
+        assert ledger.window_spent("w[0,1)").epsilon == \
+            pytest.approx(0.6)
+        # Second query on the SAME window busts its cap ...
+        with pytest.raises(BudgetExhaustedError):
+            s.window_query(0, 1, params, epsilon=0.6, delta=1e-7,
+                           seed=2, tenant="acme")
+        # ... while the total ledger is nowhere near exhausted and a
+        # different window still has full headroom.
+        s.append(*epoch_batch(2))
+        s.window_query(1, 2, params, epsilon=0.6, delta=1e-7, seed=3,
+                       tenant="acme")
+
+    def test_window_caps_survive_reopen(self, tmp_path):
+        store, s = make_live(tmp_path, tenant=False)
+        s.register_tenant("acme", total_epsilon=1e6,
+                          total_delta=1 - 1e-9, window_epsilon=1.0)
+        for e in range(2):
+            s.append(*epoch_batch(e))
+        s.window_query(0, 1, count_sum_params(), epsilon=0.6,
+                       delta=1e-7, seed=1, tenant="acme")
+        reopened = store.open_live("live-ds")
+        ledger = reopened.tenant("acme").ledger
+        assert ledger.window_spent("w[0,1)").epsilon == \
+            pytest.approx(0.6)
+        with pytest.raises(BudgetExhaustedError):
+            reopened.window_query(0, 1, count_sum_params(), epsilon=0.6,
+                                  delta=1e-7, seed=2, tenant="acme")
+
+
+class TestLiveStatusz:
+
+    def test_statusz_surfaces_live_plane(self, tmp_path):
+        from pipelinedp_tpu.obs import ops_plane
+        _, s = make_live(tmp_path)
+        s.append(*epoch_batch(0))
+        s.append(*epoch_batch(1))
+        payload = ops_plane.statusz_payload(s)
+        live = payload["sessions"]["live-ds"]["live"]
+        assert live["epoch"] == 2
+        assert live["watermark"] == 2
+        assert live["sealed_windows"] == 1
+        assert live["window"] == serving.WindowSpec(size=1).to_meta()
+        # Batch sessions keep their statusz shape: no live key.
+        cold = serving.DatasetSession(
+            pdp.ColumnarData(*epoch_batch(0)),
+            public_partitions=list(range(N_PARTS)), n_chunks=N_CHUNKS,
+            name="cold-ref")
+        assert "live" not in ops_plane.statusz_payload(
+            cold)["sessions"]["cold-ref"]
+
+
+class TestLiveChaos:
+    """CI's live-chaos job sweeps PIPELINEDP_TPU_CHAOS_SEED: scripted
+    oom/transfer/kernel/host-crash faults (and hangs) injected into
+    every scheduled window release must be absorbed by retries with a
+    release stream bit-identical to the fault-free schedule. (The
+    SIGKILL-during-append legs live in process_kill_test.py — those
+    need real process death.)"""
+
+    def _seeds(self):
+        env = os.environ.get("PIPELINEDP_TPU_CHAOS_SEED")
+        return [int(env)] if env is not None else [0, 1, 2]
+
+    def _released(self, tmp_path, sub, **query_kwargs):
+        _, s = make_live(tmp_path, sub=sub)
+        for e in range(3):
+            s.append(*epoch_batch(e))
+        records = s.release_schedule(
+            "sched", count_sum_params(), epsilon=0.5, delta=1e-7,
+            tenant="acme", base_seed=5, **query_kwargs).tick()
+        assert [r["outcome"] for r in records] == ["released"] * 2
+        return {r["window"]: r["result"] for r in records}
+
+    def test_chaotic_release_stream_matches_clean(self, tmp_path):
+        clean = self._released(tmp_path, "clean")
+        for seed in self._seeds():
+            chaotic = self._released(
+                tmp_path, f"chaos{seed}",
+                fault_injector=runtime.FaultInjector.chaos(
+                    seed=seed, n_slabs=N_CHUNKS, fire_percent=50),
+                retry_policy=runtime.RetryPolicy(
+                    max_retries=20, sleep=lambda s: None))
+            for w in clean:
+                assert_identical(clean[w], chaotic[w])
+
+    def test_chaotic_releases_with_hangs_under_watchdog(self, tmp_path):
+        clean = self._released(tmp_path, "clean_h")
+        for seed in self._seeds():
+            chaotic = self._released(
+                tmp_path, f"chaos_h{seed}",
+                fault_injector=runtime.FaultInjector.chaos(
+                    seed=seed, n_slabs=N_CHUNKS, fire_percent=50,
+                    include_hang=True, hang_s=2.0),
+                watchdog_timeout_s=0.5,
+                retry_policy=runtime.RetryPolicy(
+                    max_retries=20, sleep=lambda s: None))
+            for w in clean:
+                assert_identical(clean[w], chaotic[w])

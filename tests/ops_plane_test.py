@@ -106,6 +106,98 @@ class TestFlightRecorder:
         assert "/some/dump.json" in text
 
 
+class TestSpoolRotation:
+    """The size-capped spool (ISSUE 15 satellite): an always-on
+    recorder must hold a bounded recent-history window on disk, not
+    grow without bound next to the WALs."""
+
+    def _recorder(self, tmp_path, monkeypatch, budget, segments):
+        monkeypatch.setenv(flight_lib.SPOOL_BYTES_ENV, str(budget))
+        monkeypatch.setenv(flight_lib.SPOOL_SEGMENTS_ENV, str(segments))
+        rec = flight_lib.FlightRecorder(max_events=64)
+        path = rec.bind_spool(str(tmp_path / "s.jsonl"))
+        return rec, path
+
+    def test_rotation_keeps_last_k_segments(self, tmp_path, monkeypatch):
+        rec, path = self._recorder(tmp_path, monkeypatch,
+                                   budget=8192, segments=3)
+        for i in range(400):
+            rec.record("tick", i=i, pad="x" * 64)
+        segs = flight_lib.spool_segment_paths(path)
+        # Exactly the configured chain: .2 (oldest), .1, active.
+        assert [os.path.basename(p) for p in segs] == \
+            ["s.jsonl.2", "s.jsonl.1", "s.jsonl"]
+        assert not os.path.exists(path + ".3")
+        # Total disk stays in the cap's neighborhood: each segment
+        # rotates at max(4096, budget/K) bytes (the floor keeps a
+        # pathological budget from thrashing), overshooting by at most
+        # one event line.
+        per_segment = max(4096, 8192 // 3)
+        total = sum(os.path.getsize(p) for p in segs)
+        assert total <= 3 * (per_segment + 256)
+
+    def test_read_spool_is_one_ordered_stream(self, tmp_path,
+                                              monkeypatch):
+        rec, path = self._recorder(tmp_path, monkeypatch,
+                                   budget=8192, segments=3)
+        for i in range(400):
+            rec.record("tick", i=i, pad="x" * 64)
+        doc = flight_lib.read_spool(path)
+        seqs = [e["seq"] for e in doc["events"]]
+        # Oldest-first across segments, contiguous, newest retained.
+        assert seqs == list(range(seqs[0], 400))
+
+    def test_torn_tail_tolerated_per_segment(self, tmp_path,
+                                             monkeypatch):
+        rec, path = self._recorder(tmp_path, monkeypatch,
+                                   budget=8192, segments=3)
+        for i in range(400):
+            rec.record("tick", i=i, pad="x" * 64)
+        # A line torn by a kill just before rotation stays torn in the
+        # rotated segment; reading tolerates it in EVERY segment.
+        with open(path + ".1", "a") as f:
+            f.write('{"kind":"torn-mid')
+        n = len(flight_lib.read_spool(path)["events"])
+        assert n > 0
+        # Interior corruption is still refused, per segment.
+        with open(path + ".2", "r+") as f:
+            f.write("garbage")
+        with pytest.raises(flight_lib.FlightDumpError):
+            flight_lib.read_spool(path)
+
+    def test_rebind_resumes_byte_counter(self, tmp_path, monkeypatch):
+        rec, path = self._recorder(tmp_path, monkeypatch,
+                                   budget=8192, segments=2)
+        rec.record("tick", pad="x" * 64)
+        rec.close_spool()
+        rec2 = flight_lib.FlightRecorder(max_events=64)
+        rec2.bind_spool(path)
+        # The restarted process picks up mid-segment, not at zero: the
+        # rotation point lands where it would have without the restart.
+        assert rec2._spool_bytes == os.path.getsize(path)
+
+    def test_single_segment_truncates_in_place(self, tmp_path,
+                                               monkeypatch):
+        rec, path = self._recorder(tmp_path, monkeypatch,
+                                   budget=4096, segments=1)
+        for i in range(200):
+            rec.record("tick", i=i, pad="x" * 64)
+        assert flight_lib.spool_segment_paths(path) == [path]
+        assert os.path.getsize(path) <= 4096 + 1024
+
+    def test_env_knob_validation(self, monkeypatch):
+        monkeypatch.delenv(flight_lib.SPOOL_BYTES_ENV, raising=False)
+        monkeypatch.delenv(flight_lib.SPOOL_SEGMENTS_ENV, raising=False)
+        assert flight_lib.spool_byte_budget() == 64 << 20
+        assert flight_lib.spool_segment_count() == 4
+        monkeypatch.setenv(flight_lib.SPOOL_BYTES_ENV, "12")
+        with pytest.raises(ValueError):
+            flight_lib.spool_byte_budget()
+        monkeypatch.setenv(flight_lib.SPOOL_SEGMENTS_ENV, "0")
+        with pytest.raises(ValueError):
+            flight_lib.spool_segment_count()
+
+
 class TestSlowQueryCaptures:
 
     def test_capture_written_and_pruned(self, tmp_path, monkeypatch):

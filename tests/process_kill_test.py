@@ -221,6 +221,141 @@ class TestServingKillRecovery:
         assert replay_post[0]["token"] == replay_post[1]["token"]
 
 
+def _json_marker(proc: subprocess.CompletedProcess, prefix: str):
+    return json.loads(_marker(proc, prefix)[len(prefix):])
+
+
+@pytest.fixture(scope="module",
+                params=["single_device",
+                        pytest.param("mesh8", marks=pytest.mark.slow)])
+def live_kill_run(tmp_path_factory, request):
+    """The live-session kill scenario (ISSUE 15): a streaming session
+    SIGKILLed mid-append at both sides of the WAL commit point, then
+    mid-release-schedule, reopened each time. One run per topology;
+    the tests below assert its facets (see the harness docstring for
+    the mode-by-mode script)."""
+    mesh = request.param == "mesh8"
+    clean_dir = str(tmp_path_factory.mktemp("live_clean"))
+    cold_dir = str(tmp_path_factory.mktemp("live_cold"))
+    kill_dir = str(tmp_path_factory.mktemp("live_kill"))
+    out = {"kill_dir": kill_dir, "mesh": mesh}
+    for step, mode, workdir in (
+            ("clean", "live_clean", clean_dir),
+            ("cold", "live_cold", cold_dir),
+            ("prepared", "live_prepare", kill_dir),
+            ("killed_append", "live_kill_append", kill_dir),
+            ("after_append_kill", "live_epoch", kill_dir),
+            ("killed_fold", "live_kill_fold", kill_dir),
+            ("after_fold_kill", "live_epoch", kill_dir),
+            ("dup", "live_dup", kill_dir),
+            ("resumed", "live_resume", kill_dir),
+            ("replay", "live_replay", kill_dir),
+            ("killed_release", "live_kill_release", kill_dir),
+            ("recovered", "live_recover", kill_dir)):
+        proc = _run_harness(mode, workdir, mesh=mesh)
+        if step.startswith("killed_"):
+            assert proc.returncode == -signal.SIGKILL, (
+                f"{mode}: expected SIGKILL, got rc={proc.returncode};\n"
+                f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
+            assert "HARNESS_NOT_KILLED" not in proc.stdout
+        else:
+            assert proc.returncode == 0, (
+                f"{mode} failed;\nstdout:\n{proc.stdout}\n"
+                f"stderr:\n{proc.stderr}")
+        out[step] = proc
+    return out
+
+
+class TestLiveSessionKillRecovery:
+    """Crash-exactly-once streaming append: SIGKILL before the WAL
+    commit loses the batch entirely (reopen at N); SIGKILL after it
+    loses nothing (reopen at N+1); either way the reopened session is
+    bit-identical to the never-killed one."""
+
+    def test_kill_before_wal_commit_reopens_at_n(self, live_kill_run):
+        prepared = live_kill_run["prepared"]
+        saved_fp = _marker(prepared, "HARNESS_SAVED ").split()[1]
+        state = _json_marker(live_kill_run["after_append_kill"],
+                             "HARNESS_LIVE_STATE ")
+        # The encode-stage kill died before the WAL record: the epoch
+        # payload staged on disk is an ignored orphan, and the reopened
+        # session is bit-identically the pre-append one.
+        assert state["epoch"] == 2
+        assert state["fingerprint"] == saved_fp
+        assert state["sealed"] == [[0, 1]]
+
+    def test_kill_after_wal_commit_reopens_at_n_plus_1(self,
+                                                       live_kill_run):
+        state = _json_marker(live_kill_run["after_fold_kill"],
+                             "HARNESS_LIVE_STATE ")
+        # The fold-stage kill died after the WAL record: the reopened
+        # session rebuilt the fold the dead process never ran.
+        assert state["epoch"] == 3
+        assert state["sealed"] == [[0, 1], [1, 2]]
+
+    def test_resubmitted_batch_is_digest_idempotent(self, live_kill_run):
+        dup = _json_marker(live_kill_run["dup"], "HARNESS_LIVE_DUP ")
+        assert dup == {"duplicate": True, "epoch_before": 3,
+                       "epoch_after": 3}
+
+    def test_windowed_releases_bit_identical_to_cold_batch(
+            self, live_kill_run):
+        """The acceptance: the windowed release stream over the killed-
+        and-reopened session is bit-identical to (a) the never-killed
+        live run and (b) cold batch sessions over the same rows."""
+        clean = _json_marker(live_kill_run["clean"],
+                             "HARNESS_LIVE_WINDOWS ")
+        cold = _json_marker(live_kill_run["cold"],
+                            "HARNESS_LIVE_WINDOWS ")
+        resumed = _json_marker(live_kill_run["resumed"],
+                               "HARNESS_LIVE_WINDOWS ")
+        assert sorted(resumed) == ["0,1", "1,2", "2,3"]
+        assert resumed == clean  # hex-encoded raw bytes
+        assert resumed == cold
+
+    def test_full_union_query_bit_identical_to_cold_batch(
+            self, live_kill_run):
+        clean = _columns(live_kill_run["clean"])
+        cold = _columns(live_kill_run["cold"])
+        resumed = _columns(live_kill_run["resumed"])
+        assert resumed == clean
+        assert resumed == cold
+
+    def test_cross_restart_schedule_replay_refused(self, live_kill_run):
+        replay = live_kill_run["replay"]
+        # Catch-up state is exact: nothing due after the reopen ...
+        assert _json_marker(replay, "HARNESS_LIVE_DUE ") == []
+        # ... and the deliberate replay of a released window is refused
+        # by the tenant's durable release journal, charge refunded
+        # (3 windows x 0.5 + one 1.0 full query = 2.5, not 3.0).
+        _marker(replay, "HARNESS_DOUBLE_RELEASE")
+        assert _ledger(replay) == pytest.approx(2.5)
+
+    def test_release_kill_recovers_exactly_once(self, live_kill_run):
+        """SIGKILL between a window's release and its outcome record:
+        the catch-up re-run is refused by the release journal, recorded
+        as 'recovered', and its charge exactly refunded."""
+        recovered = live_kill_run["recovered"]
+        assert _json_marker(recovered, "HARNESS_LIVE_DUE ") == [
+            [1, 2], [2, 3]]
+        assert _json_marker(recovered, "HARNESS_LIVE_OUTCOMES ") == [
+            [[1, 2], "recovered"], [[2, 3], "released"]]
+        # resume (2.5) + killed [0,1) charge (0.5) + killed [1,2)
+        # charge (0.5, conservative: the dead process may have
+        # released) + recovered [1,2) re-run refunded (net 0) +
+        # [2,3) (0.5) = 4.0 exactly.
+        assert _ledger(recovered) == pytest.approx(4.0)
+
+    def test_killed_append_process_left_parseable_spool(
+            self, live_kill_run):
+        spool = _marker(live_kill_run["killed_append"],
+                        "HARNESS_FLIGHT ").split(" ", 1)[1]
+        assert spool != "None"
+        doc = flight_lib.read_spool(spool)
+        kinds = [e["kind"] for e in doc["events"]]
+        assert "append_start" in kinds
+
+
 class TestFlightRecorderKillLeg:
     """The PR-13 operational-plane acceptance on the kill harness: a
     SIGKILL'd process leaves a parseable flight-recorder post-mortem
