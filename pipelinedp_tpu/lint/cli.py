@@ -6,9 +6,14 @@ findings, 2 = usage or internal error. The default baseline file,
 ``--write-baseline`` snapshots the current findings so existing debt can
 be ratcheted down without blocking CI.
 
-Pre-commit latency: ``--changed-only`` lints just the files git reports
-changed (worktree + index, against ``--diff-base`` when given), so the
-gate runs in seconds. CI integration: ``--format=sarif`` emits SARIF
+Pre-commit latency: ``--changed-only`` reports findings for just the
+files git says changed (worktree + index, against ``--diff-base`` when
+given) plus any module connected to them in the call graph — the whole
+tree is still parsed into dpflow summaries (cache-warm, so still
+seconds) because the project rules are only sound over the full graph.
+``--dump-lock-graph`` prints the dpverify canonical lock inventory and
+acquired-while-held edges instead of linting. CI integration:
+``--format=sarif`` emits SARIF
 2.1.0 for inline annotations, ``--forbid-suppressions`` turns every
 suppressed finding into a reported one (the dpflow-strict gates), and
 the dpflow summary cache is controlled by ``--flow-cache`` /
@@ -38,8 +43,9 @@ SARIF_SCHEMA_URI = ("https://raw.githubusercontent.com/oasis-tcs/"
 def _build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="pipelinedp-tpu-lint",
-        description="AST + dataflow privacy & JAX-correctness linter for "
-                    "pipelinedp_tpu (rules DPL001-DPL010).")
+        description="AST + dataflow privacy, durability & JAX-"
+                    "correctness linter for pipelinedp_tpu "
+                    "(rules DPL001-DPL015).")
     parser.add_argument("paths", nargs="*",
                         help="files or directories to scan (default: "
                              "pipelinedp_tpu/ under the current directory)")
@@ -59,8 +65,14 @@ def _build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--format", choices=("text", "json", "sarif"),
                         default="text", dest="fmt")
     parser.add_argument("--changed-only", action="store_true",
-                        help="lint only files git reports as changed "
-                             "(worktree + index) under the given paths")
+                        help="report findings only for files git says "
+                             "changed (worktree + index) and modules "
+                             "connected to them in the call graph; the "
+                             "full tree is still summarized")
+    parser.add_argument("--dump-lock-graph", action="store_true",
+                        help="print the dpverify canonical lock "
+                             "inventory and acquired-while-held edges, "
+                             "then exit (0 = acyclic)")
     parser.add_argument("--diff-base", default=None,
                         help="with --changed-only: diff against this git "
                              "rev (default: the working tree vs HEAD)")
@@ -124,6 +136,32 @@ def _changed_files(paths: Sequence[str],
                 selected.append(rel)
                 break
     return selected
+
+
+def _dump_lock_graph(flow) -> int:
+    """Prints the canonical lock inventory and acquired-while-held
+    edges; exit 1 when the graph has a cycle."""
+    if flow is None:
+        print("pipelinedp-tpu-lint: no project flow was built (no "
+              "project rules selected?)", file=sys.stderr)
+        return 2
+    sites = flow.lock_sites()
+    print(f"{len(sites)} canonical lock(s):")
+    for name, acquires in sorted(sites.items()):
+        print(f"  {name}  [{len(acquires)} acquire site(s)]")
+        for qual, line in sorted(acquires):
+            print(f"      {qual}:{line}")
+    graph = flow.lock_graph()
+    edges = [(outer, inner, site) for outer, inners in graph.items()
+             for inner, site in inners.items()]
+    print(f"{len(edges)} acquired-while-held edge(s):")
+    for outer, inner, (qual, line) in sorted(edges):
+        print(f"  {outer} -> {inner}   via {qual}:{line}")
+    cycles = flow.lock_cycles()
+    for cycle in cycles:
+        print(f"CYCLE: {' -> '.join([*cycle, cycle[0]])}")
+    print(f"{len(cycles)} cycle(s)")
+    return 1 if cycles else 0
 
 
 def _sarif_payload(findings, rules) -> dict:
@@ -200,6 +238,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(e, file=sys.stderr)
         return 2
 
+    focus = None
     if args.changed_only:
         changed = _changed_files(paths, args.diff_base)
         if changed is None:
@@ -210,12 +249,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print("pipelinedp-tpu-lint: no changed files under "
                   f"{', '.join(paths)}", file=sys.stderr)
             return 0
-        paths = changed
+        # Keep the full roots: the project rules are only sound over
+        # the complete call graph (a hazard introduced in a changed
+        # callee surfaces in its unchanged caller). The changed set
+        # narrows what gets reported, not what gets analyzed.
+        focus = changed
 
     flow_cache = None if args.no_flow_cache else args.flow_cache
     t0 = time.perf_counter()
     result = engine.lint_paths(paths, config=DEFAULT_CONFIG, rules=rules,
-                               flow_cache_path=flow_cache)
+                               flow_cache_path=flow_cache, focus=focus)
+
+    if args.dump_lock_graph:
+        return _dump_lock_graph(result.flow)
     elapsed = time.perf_counter() - t0
     findings = result.all_reportable
     if args.forbid_suppressions and result.suppressed:

@@ -89,6 +89,65 @@ class LintConfig:
         "pipelinedp_tpu.lint.*",
     )
 
+    # DPL012 — durable-write discipline exemptions. Unlike the module
+    # patterns above these match *function qualnames* (module + in-module
+    # dotted path) because the verdict is per-transaction, not per-file.
+    #  * JsonlWal internals: the append discipline IS the durability
+    #    protocol — one long-lived 'ab' handle, every record
+    #    write+flush+fsync'd; rewrite/recover manage that handle.
+    #  * flight-recorder spool: flush-only by design (obs/flight.py) —
+    #    an fsync per appended event would serialize the hot path, and
+    #    the crash spool tolerates losing the final buffered lines.
+    #  * ops_plane._writable: the /healthz writability probe creates and
+    #    unlinks a throwaway file; durability is the question it asks,
+    #    not a property it needs.
+    #  * regress/profiler/lint: operator-facing report and cache
+    #    artifacts — loss is repaired by re-running the tool.
+    atomic_write_exempt: Tuple[str, ...] = (
+        "pipelinedp_tpu.runtime.journal.JsonlWal.*",
+        "pipelinedp_tpu.obs.flight.FlightRecorder.bind_spool",
+        "pipelinedp_tpu.obs.flight.FlightRecorder._rotate_spool_locked",
+        "pipelinedp_tpu.obs.ops_plane._writable",
+        "pipelinedp_tpu.obs.regress.*",
+        "pipelinedp_tpu.profiler.*",
+        "pipelinedp_tpu.lint.*",
+    )
+
+    # DPL013 — transactions whose pre-commit durability is itself the
+    # protocol (none in-tree today; the tuple exists so a future
+    # write-behind cache documents its contract here instead of
+    # sprinkling suppressions through strict-gated trees).
+    commit_ordering_trusted: Tuple[str, ...] = ()
+
+    # DPL014 — canonical lock names whose *contract* is "the lock
+    # serializes the durable append", so holding them across the WAL
+    # fsync is the design, not an inversion:
+    #  * live-session append lock: the append transaction (payload save
+    #    -> WAL record -> fold) must be serialized end-to-end or two
+    #    appends could commit records out of payload order.
+    #  * audit-trail lock: audit records are ordered by the lock; the
+    #    fsync under it is what makes "ordered" mean anything on disk.
+    lock_scope_exempt: Tuple[str, ...] = (
+        "pipelinedp_tpu.serving.live.LiveDatasetSession._append_lock",
+        "pipelinedp_tpu.obs.audit.AuditTrail._lock",
+    )
+
+    # DPL015 — function qualnames allowed nondeterminism primitives on
+    # release paths:
+    #  * ops.noise / ops.selection / ops.finalize: the blessed compiled
+    #    entries — their jnp arithmetic traces under jit into one XLA
+    #    program, which is exactly the determinism contract.
+    #  * JaxDPEngine._legacy_finalize: the unfused eager parity oracle,
+    #    pinned bit-identical to the fused path by finalize tests.
+    #  * lint itself analyzes release code without being on the path.
+    release_determinism_exempt: Tuple[str, ...] = (
+        "pipelinedp_tpu.ops.noise.*",
+        "pipelinedp_tpu.ops.selection.*",
+        "pipelinedp_tpu.ops.finalize.*",
+        "pipelinedp_tpu.jax_engine.JaxDPEngine._legacy_finalize",
+        "pipelinedp_tpu.lint.*",
+    )
+
     @staticmethod
     def _matches(module: str, patterns: Sequence[str]) -> bool:
         return any(fnmatch.fnmatch(module, p) for p in patterns)
@@ -107,6 +166,18 @@ class LintConfig:
 
     def is_telemetry_taint_trusted(self, module: str) -> bool:
         return self._matches(module, self.telemetry_taint_trusted)
+
+    def is_atomic_write_exempt(self, qualname: str) -> bool:
+        return self._matches(qualname, self.atomic_write_exempt)
+
+    def is_commit_ordering_trusted(self, qualname: str) -> bool:
+        return self._matches(qualname, self.commit_ordering_trusted)
+
+    def is_lock_scope_exempt(self, lock_name: str) -> bool:
+        return self._matches(lock_name, self.lock_scope_exempt)
+
+    def is_release_determinism_exempt(self, qualname: str) -> bool:
+        return self._matches(qualname, self.release_determinism_exempt)
 
 
 DEFAULT_CONFIG = LintConfig()

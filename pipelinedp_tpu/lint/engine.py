@@ -275,6 +275,7 @@ class LintResult:
     lines_by_path: Dict[str, List[str]]
     flow_cache_hits: int = 0
     flow_cache_misses: int = 0
+    flow: Optional[object] = None    # ProjectFlow when project rules ran
 
     @property
     def all_reportable(self) -> List[Finding]:
@@ -290,16 +291,30 @@ def lint_paths(paths: Sequence[str],
                config: Optional[LintConfig] = None,
                rules: Optional[Sequence[Rule]] = None,
                root: Optional[str] = None,
-               flow_cache_path: Optional[str] = None) -> LintResult:
+               flow_cache_path: Optional[str] = None,
+               focus: Optional[Sequence[str]] = None) -> LintResult:
     """Runs every rule over every .py file under ``paths``.
 
     ``flow_cache_path`` persists the dpflow per-file summaries keyed by
     content digest (see lint/flow/cache.py); None keeps the flow layer
     fully in-memory.
+
+    ``focus`` (the --changed-only shape) narrows *reporting*, not
+    analysis: every file under ``paths`` is still parsed and summarized
+    so the project rules see the whole call graph, but module rules run
+    only on the focus files and project findings are kept only for
+    modules connected to a focus module in the call graph — a hazard
+    introduced in B must still surface at its manifestation site in an
+    unchanged caller A.
     """
     config = config or DEFAULT_CONFIG
     rules = list(rules) if rules is not None else default_rules()
     root = os.path.abspath(root or os.getcwd())
+    focus_rel: Optional[Set[str]] = None
+    if focus is not None:
+        focus_rel = {
+            os.path.relpath(os.path.abspath(p), root).replace(os.sep, "/")
+            for p in focus}
     findings: List[Finding] = []
     suppressed: List[Finding] = []
     parse_errors: List[Finding] = []
@@ -338,6 +353,8 @@ def lint_paths(paths: Sequence[str],
         digests[relpath] = hashlib.sha1(source.encode("utf-8")).hexdigest()
         suppressions = Suppressions(lines)
         suppressions_by_path[relpath] = suppressions
+        if focus_rel is not None and relpath not in focus_rel:
+            continue  # summarized for the graph, not module-linted
         for line, codes in suppressions.unjustified:
             # Unsuppressible by design: the fix is writing the reason.
             findings.append(Finding(
@@ -352,6 +369,7 @@ def lint_paths(paths: Sequence[str],
                     findings.append(finding)
 
     flow_hits = flow_misses = 0
+    project_flow = None
     if project_rules and module_ctxs:
         from pipelinedp_tpu.lint import flow as flow_lib
 
@@ -367,10 +385,22 @@ def lint_paths(paths: Sequence[str],
             summaries[relpath] = summary
         cache.save()
         flow_hits, flow_misses = cache.hits, cache.misses
+        project_flow = flow_lib.ProjectFlow(summaries)
         project = ProjectContext(modules=module_ctxs, config=config,
-                                 flow=flow_lib.ProjectFlow(summaries))
+                                 flow=project_flow)
+        report_modules: Optional[Set[str]] = None
+        if focus_rel is not None:
+            report_modules = _connected_modules(
+                project_flow,
+                {ctx.module for rp, ctx in module_ctxs.items()
+                 if rp in focus_rel})
         for rule in project_rules:
             for finding in rule.check_project(project):
+                if report_modules is not None:
+                    ctx = module_ctxs.get(finding.path)
+                    if ctx is not None and \
+                            ctx.module not in report_modules:
+                        continue
                 supp = suppressions_by_path.get(finding.path)
                 if supp is not None and supp.is_suppressed(finding):
                     suppressed.append(finding)
@@ -383,4 +413,29 @@ def lint_paths(paths: Sequence[str],
     parse_errors.sort(key=key)
     return LintResult(findings, suppressed, parse_errors, lines_by_path,
                       flow_cache_hits=flow_hits,
-                      flow_cache_misses=flow_misses)
+                      flow_cache_misses=flow_misses,
+                      flow=project_flow)
+
+
+def _connected_modules(flow, seeds: Set[str]) -> Set[str]:
+    """Modules connected to ``seeds`` in the undirected call graph —
+    the set whose project findings a changed-only run must report: a
+    changed callee can manifest a violation in its unchanged caller,
+    and vice versa."""
+    adjacency: Dict[str, Set[str]] = {}
+    for qual in flow.functions:
+        mod = flow.function_module[qual]
+        for callee in flow.edges(qual):
+            callee_mod = flow.function_module[callee]
+            if callee_mod != mod:
+                adjacency.setdefault(mod, set()).add(callee_mod)
+                adjacency.setdefault(callee_mod, set()).add(mod)
+    reached = set(seeds)
+    frontier = list(seeds)
+    while frontier:
+        mod = frontier.pop()
+        for nxt in adjacency.get(mod, ()):
+            if nxt not in reached:
+                reached.add(nxt)
+                frontier.append(nxt)
+    return reached

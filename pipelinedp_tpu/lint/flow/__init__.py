@@ -1,15 +1,20 @@
 """dpflow: interprocedural privacy-dataflow and concurrency analysis.
 
-The flow layer underneath dplint's whole-program rules (DPL007–DPL010):
+The flow layer underneath dplint's whole-program rules (DPL007–DPL015):
 
   summary.py  per-file extraction — call sites, taint flows, pool-worker
-              hazards, donate_argnums — a pure function of one file
+              hazards, donate_argnums, and the dpverify ordered effect
+              traces (wal_append/fsync/rename/lock_acquire/...) — a pure
+              function of one file
   cache.py    digest-keyed summary cache so warm runs skip extraction
   graph.py    project symbol table, import-resolved call graph (method
               resolution through project classes, __init__ re-exports,
-              import cycles), reachability + taint-exposure fixed points
+              import cycles), reachability + taint-exposure fixed
+              points, effect-kind closures, and the canonical lock
+              graph (DPL014)
 
-See LINT.md ("dpflow") for the analysis contracts and knobs.
+See LINT.md ("dpflow" and "dpverify") for the analysis contracts and
+knobs.
 """
 
 from pipelinedp_tpu.lint.flow.cache import (
@@ -19,6 +24,7 @@ from pipelinedp_tpu.lint.flow.cache import (
 )
 from pipelinedp_tpu.lint.flow.graph import ProjectFlow
 from pipelinedp_tpu.lint.flow.summary import (
+    Effect,
     FunctionSummary,
     ModuleSummary,
     extract_module,
@@ -26,6 +32,7 @@ from pipelinedp_tpu.lint.flow.summary import (
 
 __all__ = [
     "DEFAULT_CACHE_PATH",
+    "Effect",
     "FlowCache",
     "FunctionSummary",
     "ModuleSummary",

@@ -73,6 +73,8 @@ class FlowCache:
         try:
             with open(tmp, "w", encoding="utf-8") as fh:
                 json.dump(payload, fh, sort_keys=True)
+                fh.flush()
+                os.fsync(fh.fileno())
             os.replace(tmp, self.path)
         except OSError:
             pass  # a cache that cannot persist is a slow run, not an error

@@ -29,7 +29,9 @@ from typing import Callable, Dict, FrozenSet, List, Optional, Set, Tuple
 from pipelinedp_tpu.lint.flow import summary as summary_lib
 from pipelinedp_tpu.lint.flow.summary import (
     ALL_FLAGS,
+    EFFECT_LOCK_ACQUIRE,
     CallSite,
+    Effect,
     FunctionSummary,
     ModuleSummary,
     TaintFlow,
@@ -57,6 +59,10 @@ class ProjectFlow:
         self._edges: Dict[str, Tuple[str, ...]] = {}
         self._reach_cache: Dict[str, FrozenSet[str]] = {}
         self._resolve_cache: Dict[Tuple[str, str], Optional[str]] = {}
+        self._kind_closure: Optional[Dict[str, FrozenSet[str]]] = None
+        self._locks_acquired: Optional[Dict[str, FrozenSet[str]]] = None
+        self._lock_owner_cache: Dict[Tuple[str, str, str],
+                                     Optional[str]] = {}
 
     # -- symbol resolution --------------------------------------------------
 
@@ -204,6 +210,227 @@ class ProjectFlow:
         function/wrapper in the project."""
         return {qual: fsum.donated
                 for qual, fsum in self.functions.items() if fsum.donated}
+
+    # -- dpverify effect closures (DPL012-DPL015) ----------------------------
+
+    def effect_kind_closure(self) -> Dict[str, FrozenSet[str]]:
+        """qualname -> every effect kind present in the function itself
+        or any transitive project callee. Monotone fixed point, so call
+        cycles converge. Lets the ordering rules treat `self.save(...)`
+        as durable when the chain ends in fsync/rename."""
+        if self._kind_closure is None:
+            kinds: Dict[str, Set[str]] = {
+                qual: {e.kind for e in fsum.effects}
+                for qual, fsum in self.functions.items()}
+            changed = True
+            while changed:
+                changed = False
+                for qual in self.functions:
+                    own = kinds[qual]
+                    before = len(own)
+                    for callee in self.edges(qual):
+                        own |= kinds[callee]
+                    if len(own) != before:
+                        changed = True
+            self._kind_closure = {q: frozenset(s)
+                                  for q, s in kinds.items()}
+        return self._kind_closure
+
+    def callee_effect_kinds(self, target: str,
+                            module: str) -> FrozenSet[str]:
+        """Closure effect kinds behind one raw call target (empty when
+        the callee is not a project function)."""
+        callee = self.resolve(target, module)
+        if callee is None:
+            return frozenset()
+        return self.effect_kind_closure().get(callee, frozenset())
+
+    # -- dpverify lock graph (DPL014) ----------------------------------------
+
+    def canonical_lock(self, detail: str, module: str) -> str:
+        """Project-unique lock name for one acquire-site detail.
+
+        ``Cls:attr`` details walk the MRO to the class whose summary
+        *created* the lock (``ModuleSummary.locks``), so an inherited
+        ``self._lock`` unifies with its base-class definition. Module
+        -level lock names resolve against the module's own ``locks``.
+        Anything else stays opaque, prefixed with the observing module —
+        conservative: unresolved locks never unify, so they can't
+        manufacture false cycles."""
+        if ":" in detail:
+            cls, attr = detail.split(":", 1)
+            key = (module, cls, attr)
+            if key not in self._lock_owner_cache:
+                self._lock_owner_cache[key] = self._lock_owner(
+                    module, cls, attr)
+            owner = self._lock_owner_cache[key]
+            return owner if owner else f"{module}.{cls}.{attr}"
+        head = detail.split(".")[0]
+        msum = self.modules.get(module)
+        if msum is not None and detail in msum.locks:
+            return f"{module}.{detail}"
+        if msum is not None and head in msum.aliases:
+            fwd = msum.aliases[head]
+            fwd_mod = fwd.rsplit(".", 1)[0] if "." in fwd else fwd
+            fwd_name = fwd.rsplit(".", 1)[-1]
+            fsum = self.modules.get(fwd_mod)
+            if fsum is not None and fwd_name in fsum.locks:
+                return f"{fwd_mod}.{fwd_name}"
+        return f"{module}.{detail}"
+
+    def _lock_owner(self, module: str, cls: str,
+                    attr: str) -> Optional[str]:
+        queue: List[Tuple[str, str]] = [(module, cls)]
+        visited: Set[Tuple[str, str]] = set()
+        while queue:
+            mod, cname = queue.pop(0)
+            if (mod, cname) in visited or mod not in self.modules:
+                continue
+            visited.add((mod, cname))
+            msum = self.modules[mod]
+            if f"{cname}.{attr}" in msum.locks:
+                return f"{mod}.{cname}.{attr}"
+            for base in msum.classes.get(cname, ()):
+                resolved = self._resolve_class(base, mod)
+                if resolved is not None:
+                    queue.append(resolved)
+        return None
+
+    def locks_acquired(self) -> Dict[str, FrozenSet[str]]:
+        """qualname -> canonical locks acquired by the function or any
+        transitive project callee (fixed point, cycle-safe)."""
+        if self._locks_acquired is None:
+            acq: Dict[str, Set[str]] = {}
+            for qual, fsum in self.functions.items():
+                module = self.function_module[qual]
+                acq[qual] = {
+                    self.canonical_lock(e.detail, module)
+                    for e in fsum.effects
+                    if e.kind == EFFECT_LOCK_ACQUIRE}
+            changed = True
+            while changed:
+                changed = False
+                for qual in self.functions:
+                    own = acq[qual]
+                    before = len(own)
+                    for callee in self.edges(qual):
+                        own |= acq[callee]
+                    if len(own) != before:
+                        changed = True
+            self._locks_acquired = {q: frozenset(s)
+                                    for q, s in acq.items()}
+        return self._locks_acquired
+
+    def lock_sites(self) -> Dict[str, List[Tuple[str, int]]]:
+        """canonical lock -> every (function qualname, line) that
+        acquires it — the --dump-lock-graph inventory."""
+        sites: Dict[str, List[Tuple[str, int]]] = {}
+        for qual, fsum in self.functions.items():
+            module = self.function_module[qual]
+            for eff in fsum.effects:
+                if eff.kind == EFFECT_LOCK_ACQUIRE:
+                    name = self.canonical_lock(eff.detail, module)
+                    sites.setdefault(name, []).append((qual, eff.line))
+        return sites
+
+    def lock_graph(self) -> Dict[str, Dict[str, Tuple[str, int]]]:
+        """Ordered acquisition edges: ``graph[outer][inner]`` = one
+        witness ``(function qualname, line)`` where ``inner`` is
+        acquired (directly, or through a call chain) while ``outer`` is
+        held. Only with-block acquires contribute outer scopes — a bare
+        ``.acquire()`` has no statically known extent (``end == -1``)."""
+        acquired = self.locks_acquired()
+        graph: Dict[str, Dict[str, Tuple[str, int]]] = {}
+
+        def add(outer: str, inner: str, qual: str, line: int) -> None:
+            if inner != outer:
+                graph.setdefault(outer, {}).setdefault(
+                    inner, (qual, line))
+
+        for qual, fsum in self.functions.items():
+            module = self.function_module[qual]
+            lacqs = [e for e in fsum.effects
+                     if e.kind == EFFECT_LOCK_ACQUIRE]
+            for i, outer_eff in enumerate(lacqs):
+                if outer_eff.end < 0:
+                    continue
+                outer = self.canonical_lock(outer_eff.detail, module)
+                for inner_eff in lacqs[i + 1:]:
+                    if inner_eff.line > outer_eff.end:
+                        break
+                    add(outer,
+                        self.canonical_lock(inner_eff.detail, module),
+                        qual, inner_eff.line)
+                for call in fsum.calls:
+                    if not (outer_eff.line <= call.line
+                            <= outer_eff.end):
+                        continue
+                    callee = self.resolve(call.target, module)
+                    if callee is None:
+                        continue
+                    for inner in acquired[callee]:
+                        add(outer, inner, qual, call.line)
+        return graph
+
+    def lock_cycles(self) -> List[List[str]]:
+        """Elementary cycles in the lock graph (each reported once,
+        rotated to start at its lexicographically smallest lock)."""
+        graph = self.lock_graph()
+        cycles: List[List[str]] = []
+        seen: Set[Tuple[str, ...]] = set()
+
+        def dfs(node: str, path: List[str], on_path: Set[str]) -> None:
+            for nxt in sorted(graph.get(node, ())):
+                if nxt in on_path:
+                    cyc = path[path.index(nxt):]
+                    k = min(range(len(cyc)), key=lambda i: cyc[i])
+                    key = tuple(cyc[k:] + cyc[:k])
+                    if key not in seen:
+                        seen.add(key)
+                        cycles.append(list(key))
+                    continue
+                if len(path) < 16:
+                    dfs(nxt, path + [nxt], on_path | {nxt})
+
+        for start in sorted(graph):
+            dfs(start, [start], {start})
+        return cycles
+
+    def held_effects(self, qual: str,
+                     kinds: FrozenSet[str]) -> List[Tuple[Effect, str]]:
+        """(lock-acquire effect, offending kind) pairs where an effect
+        of one of ``kinds`` happens — directly or through a call chain —
+        inside the acquire's with-block span. The DPL014 lock-scope
+        (latency-inversion) query."""
+        fsum = self.functions.get(qual)
+        if fsum is None:
+            return []
+        module = self.function_module[qual]
+        closure = self.effect_kind_closure()
+        out: List[Tuple[Effect, str]] = []
+        for acq in fsum.effects:
+            if acq.kind != EFFECT_LOCK_ACQUIRE or acq.end < 0:
+                continue
+            hit: Optional[str] = None
+            for eff in fsum.effects:
+                if eff.kind in kinds and \
+                        acq.line <= eff.line <= acq.end:
+                    hit = eff.kind
+                    break
+            if hit is None:
+                for call in fsum.calls:
+                    if not (acq.line <= call.line <= acq.end):
+                        continue
+                    callee = self.resolve(call.target, module)
+                    if callee is None:
+                        continue
+                    inner = closure.get(callee, frozenset()) & kinds
+                    if inner:
+                        hit = sorted(inner)[0]
+                        break
+            if hit is not None:
+                out.append((acq, hit))
+        return out
 
     # -- DPL007 exposure fixed point -----------------------------------------
 

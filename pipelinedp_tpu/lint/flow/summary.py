@@ -23,7 +23,15 @@ nested ``<locals>`` functions):
     handed to an executor/thread, to state shared with the enclosing
     scope — decidable per file, so the summary stores finished hazards;
   * donated-argument positions for DPL010 (``donate_argnums`` on a
-    ``jax.jit`` decorator or wrapper assignment).
+    ``jax.jit`` decorator or wrapper assignment);
+  * the **dpverify effect trace** for DPL012–DPL015: the function's
+    ordered durable/concurrency effects — ``wal_append``, ``fsync``,
+    ``rename``, ``raw_durable_write``, ``lock_acquire`` (with the lock
+    name and the guarded line span), ``noise_draw``, ``release_commit``,
+    ``unordered_iter``, ``eager_jnp_arith``, ``wallclock_source``, plus
+    the bookkeeping kinds ``tmp_create``, ``lock_create`` and
+    ``state_mutation`` the rules need to model the tmp+fsync+rename
+    idiom, the project lock graph and the commit-ordering contract.
 """
 
 from __future__ import annotations
@@ -35,7 +43,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from pipelinedp_tpu.lint import astutils
 
-SUMMARY_VERSION = 3  # v3: PR-13 obs sinks (flight recorder, captures)
+SUMMARY_VERSION = 4  # v4: PR-16 dpverify effect traces (DPL012-DPL015)
 
 # -- taint vocabulary (DPL007) ----------------------------------------------
 
@@ -102,6 +110,63 @@ _MUTATORS = frozenset({
     "append", "extend", "add", "update", "insert", "remove", "discard",
     "pop", "popitem", "clear", "setdefault", "appendleft",
 })
+
+# -- dpverify effect vocabulary (DPL012-DPL015) ------------------------------
+
+EFFECT_WAL_APPEND = "wal_append"
+EFFECT_FSYNC = "fsync"
+EFFECT_RENAME = "rename"
+EFFECT_RAW_WRITE = "raw_durable_write"
+EFFECT_TMP_CREATE = "tmp_create"
+EFFECT_LOCK_ACQUIRE = "lock_acquire"
+EFFECT_LOCK_CREATE = "lock_create"
+EFFECT_NOISE_DRAW = "noise_draw"
+EFFECT_RELEASE_COMMIT = "release_commit"
+EFFECT_UNORDERED_ITER = "unordered_iter"
+EFFECT_EAGER_JNP = "eager_jnp_arith"
+EFFECT_WALLCLOCK = "wallclock_source"
+EFFECT_STATE_MUTATION = "state_mutation"
+
+# `self._wal.append(...)` / `wal.append(...)` — the WAL commit point.
+# Matched against the module-locally resolved call target, so the
+# `self:Cls._wal.append` markers the resolver leaves for untyped
+# attribute receivers match too.
+WAL_APPEND_TARGET_RE = re.compile(r"(?:^|\.)_?wal\.append$")
+FSYNC_TARGETS = frozenset({"os.fsync"})
+RENAME_TARGETS = frozenset({"os.replace", "os.rename"})
+TMPFILE_TARGETS = frozenset({
+    "tempfile.mkstemp", "tempfile.NamedTemporaryFile", "tempfile.mkdtemp",
+})
+# File-handle constructors whose mode argument decides writability.
+_OPEN_TARGETS = frozenset({"open", "io.open", "os.fdopen", "gzip.open"})
+_WRITE_MODE_RE = re.compile(r"[wax+]")
+_LOCK_CLASS_TARGETS = frozenset({"threading.Lock", "threading.RLock"})
+# Wall-clock / uuid sources that must never feed seeds, keys or tokens
+# on a release path (DPL015); perf_counter/monotonic are deliberately
+# absent — they feed latency metrics, not identity.
+WALLCLOCK_TARGET_RE = re.compile(
+    r"^(?:time\.time(?:_ns)?|uuid\.uuid[14])$|"
+    r"(?:^|\.)datetime\.(?:now|utcnow|today)$|(?:^|\.)date\.today$")
+SEEDISH_NAME_RE = re.compile(
+    r"(?:^|_)(?:seed|key|token|nonce|salt)s?(?:_|$)", re.IGNORECASE)
+# Iteration sources with no deterministic order: sets (dicts are
+# insertion-ordered and deterministic since 3.7) and unsorted directory
+# listings. `sorted(set(...))` never matches — the iterable inspected is
+# the outermost expression.
+_UNORDERED_CALL_TARGETS = frozenset({
+    "set", "frozenset", "os.listdir", "os.scandir",
+})
+_UNORDERED_SET_METHODS = frozenset({
+    "intersection", "union", "difference", "symmetric_difference",
+})
+# Eager `jnp` arithmetic (the PR-4 FMA-contraction bug class): outside
+# jit the XLA fusion decisions — and therefore the bits — can differ
+# from the compiled release path.
+JNP_ARITH_RE = re.compile(
+    r"^jax\.numpy\.(?:add|subtract|multiply|divide|true_divide|"
+    r"floor_divide|mod|power|sum|prod|mean|var|std|dot|matmul|tensordot|"
+    r"exp|expm1|log|log1p|log2|sqrt|square|abs|absolute|maximum|minimum|"
+    r"clip|where|cumsum|cumprod|round|floor|ceil|sign|reciprocal)$")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -176,6 +241,33 @@ class PoolHazard:
                           shared_line=int(data[5]))
 
 
+@dataclasses.dataclass(frozen=True)
+class Effect:
+    """One ordered durable/concurrency effect (dpverify, DPL012-DPL015).
+
+    ``detail`` carries the effect's operand: the resolved call target
+    (draws, fsync), the open() mode for ``raw_durable_write``, the lock
+    name for ``lock_acquire``/``lock_create`` (``Cls:attr`` for
+    ``self.attr`` locks, the raw dotted name otherwise), the mutated
+    ``self.x`` root for ``state_mutation``, or ``source->name`` for a
+    ``wallclock_source`` feeding a seed/key/token binding. ``end`` is
+    the last guarded line of a ``lock_acquire`` with-block (-1 when the
+    span is unknown, e.g. a bare ``.acquire()``).
+    """
+    kind: str
+    line: int
+    detail: str = ""
+    end: int = -1
+
+    def to_json(self) -> list:
+        return [self.kind, self.line, self.detail, self.end]
+
+    @staticmethod
+    def from_json(data: Sequence) -> "Effect":
+        return Effect(kind=data[0], line=int(data[1]), detail=data[2],
+                      end=int(data[3]))
+
+
 @dataclasses.dataclass
 class FunctionSummary:
     name: str       # qualified within the module: "f", "Cls.meth",
@@ -186,6 +278,7 @@ class FunctionSummary:
     flows: Tuple[TaintFlow, ...]
     hazards: Tuple[PoolHazard, ...]
     donated: Tuple[int, ...]  # donate_argnums positions, if jit-donating
+    effects: Tuple[Effect, ...] = ()  # ordered dpverify effect trace
 
     def to_json(self) -> dict:
         return {
@@ -196,6 +289,7 @@ class FunctionSummary:
             "flows": [f.to_json() for f in self.flows],
             "hazards": [h.to_json() for h in self.hazards],
             "donated": list(self.donated),
+            "effects": [e.to_json() for e in self.effects],
         }
 
     @staticmethod
@@ -208,7 +302,11 @@ class FunctionSummary:
             flows=tuple(TaintFlow.from_json(f) for f in data["flows"]),
             hazards=tuple(PoolHazard.from_json(h) for h in data["hazards"]),
             donated=tuple(int(i) for i in data["donated"]),
+            effects=tuple(Effect.from_json(e) for e in data["effects"]),
         )
+
+    def effects_of(self, *kinds: str) -> Tuple[Effect, ...]:
+        return tuple(e for e in self.effects if e.kind in kinds)
 
 
 @dataclasses.dataclass
@@ -217,6 +315,12 @@ class ModuleSummary:
     functions: Dict[str, FunctionSummary]  # keyed by in-module qualname
     classes: Dict[str, Tuple[str, ...]]    # class name -> resolved bases
     aliases: Dict[str, str]                # import/re-export aliases
+    # Lock objects this module *creates*: bare names for module-level
+    # locks, "Cls.attr" for `self.attr = threading.Lock()` in a method.
+    # The DPL014 lock graph canonicalizes `self._lock` acquires through
+    # the MRO to the creating class, so a lock inherited from a base
+    # class is one graph node, not one per subclass.
+    locks: Tuple[str, ...] = ()
 
     def to_json(self) -> dict:
         return {
@@ -226,6 +330,7 @@ class ModuleSummary:
                           for k, f in self.functions.items()},
             "classes": {k: list(v) for k, v in self.classes.items()},
             "aliases": dict(self.aliases),
+            "locks": list(self.locks),
         }
 
     @staticmethod
@@ -238,6 +343,7 @@ class ModuleSummary:
                        for k, f in data["functions"].items()},
             classes={k: tuple(v) for k, v in data["classes"].items()},
             aliases=dict(data["aliases"]),
+            locks=tuple(data.get("locks", ())),
         )
 
 
@@ -307,6 +413,7 @@ class Extractor(ast.NodeVisitor):
         self.aliases = dict(aliases)
         self.functions: Dict[str, FunctionSummary] = {}
         self.classes: Dict[str, Tuple[str, ...]] = {}
+        self.locks: Set[str] = set()
         self._module_defs: Dict[str, str] = {}
 
     def run(self) -> ModuleSummary:
@@ -316,7 +423,8 @@ class Extractor(ast.NodeVisitor):
         for node in ast.iter_child_nodes(self.tree):
             self._walk_container(node, scope, cls=None)
         return ModuleSummary(module=self.module, functions=self.functions,
-                             classes=self.classes, aliases=self.aliases)
+                             classes=self.classes, aliases=self.aliases,
+                             locks=tuple(sorted(self.locks)))
 
     # -- module-level symbol discovery --------------------------------------
 
@@ -345,6 +453,11 @@ class Extractor(ast.NodeVisitor):
                 if len(node.targets) == 1 and isinstance(
                         node.targets[0], ast.Name):
                     target_name = node.targets[0].id
+                    if isinstance(node.value, ast.Call) and \
+                            astutils.call_target(
+                                node.value,
+                                self.aliases) in _LOCK_CLASS_TARGETS:
+                        self.locks.add(target_name)
                     resolved = astutils.resolve(node.value, self.aliases)
                     if resolved is not None:
                         self.aliases.setdefault(target_name, resolved)
@@ -392,10 +505,12 @@ class Extractor(ast.NodeVisitor):
         calls = self._collect_calls(fn, scope)
         flows = _TaintWalker(self, scope).run(fn, params)
         hazards = _find_pool_hazards(self, fn, scope)
+        effects = _EffectWalker(self, scope).run(fn)
         self.functions[qual] = FunctionSummary(
             name=qual, line=fn.lineno, params=params, calls=tuple(calls),
             flows=tuple(flows), hazards=tuple(hazards),
-            donated=_donated_argnums(fn, self.aliases))
+            donated=_donated_argnums(fn, self.aliases),
+            effects=tuple(effects))
         for child in ast.iter_child_nodes(fn):
             self._walk_container(child, scope, cls=None)
 
@@ -496,6 +611,224 @@ def iter_scopes(module: str, tree: ast.AST, aliases: Dict[str, str]):
     for child in ast.iter_child_nodes(tree):
         walk(child, root, None)
     return out
+
+
+# ---------------------------------------------------------------------------
+# dpverify effect extraction (DPL012-DPL015)
+# ---------------------------------------------------------------------------
+
+
+def _is_jitted(fn, aliases: Dict[str, str]) -> bool:
+    """True when the function compiles under a jit decorator — its
+    arithmetic is a fixed XLA program, not eager dispatch."""
+    for deco in getattr(fn, "decorator_list", ()):
+        if isinstance(deco, ast.Call):
+            target = astutils.call_target(deco, aliases)
+            if target == "jax.jit":
+                return True
+            if target == "functools.partial" and deco.args and \
+                    astutils.resolve(deco.args[0], aliases) == "jax.jit":
+                return True
+        elif astutils.resolve(deco, aliases) == "jax.jit":
+            return True
+    return False
+
+
+def _self_root(node: ast.AST) -> Optional[str]:
+    """``self.attr`` dotted root of a write target (subscripts stripped),
+    or None when the target is not instance state."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    dotted = astutils.dotted_name(node)
+    if dotted and dotted.startswith("self.") and dotted.count(".") >= 1:
+        return ".".join(dotted.split(".")[:2])
+    return None
+
+
+def _binding_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+class _EffectWalker:
+    """Ordered dpverify effect trace of one function body.
+
+    Nested function/class scopes are excluded — they summarize
+    separately, exactly like call collection. Statements are visited in
+    source order, so line order reflects execution order on the
+    straight-line path; that ordering is what the DPL012/DPL013 idiom
+    and commit-ordering checks consume.
+    """
+
+    def __init__(self, extractor: Extractor, scope: _Scope):
+        self.ex = extractor
+        self.scope = scope
+        self.effects: List[Effect] = []
+        self.jitted = False
+
+    def run(self, fn) -> List[Effect]:
+        self.jitted = _is_jitted(fn, self.ex.aliases)
+        for stmt in fn.body:
+            self._visit(stmt)
+        self.effects.sort(key=lambda e: e.line)
+        return self.effects
+
+    def _visit(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            end = max((getattr(n, "lineno", node.lineno)
+                       for n in ast.walk(node)), default=node.lineno)
+            for item in node.items:
+                self._with_item(item, end)
+                self._visit(item.context_expr)
+            for stmt in node.body:
+                self._visit(stmt)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            self._assign(node)
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            self._iter_source(node.iter)
+        if isinstance(node, ast.comprehension):
+            self._iter_source(node.iter)
+        if isinstance(node, ast.Call):
+            self._call(node)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child)
+
+    # -- with-blocks: lock acquisition with its guarded span ----------------
+
+    def _with_item(self, item: ast.withitem, end: int) -> None:
+        expr = item.context_expr
+        callee = expr.func if isinstance(expr, ast.Call) else expr
+        dotted = astutils.dotted_name(callee)
+        if dotted and _LOCKISH_RE.search(dotted.split(".")[-1]):
+            self.effects.append(Effect(
+                EFFECT_LOCK_ACQUIRE, expr.lineno,
+                self._lock_name(dotted), end))
+
+    def _lock_name(self, dotted: str) -> str:
+        cls = self.scope.cls_context()
+        for head in ("self.", "cls."):
+            if dotted.startswith(head) and cls:
+                return f"{cls}:{dotted[len(head):]}"
+        return dotted
+
+    # -- assignments: lock creation, state mutation, wallclock seeds -------
+
+    def _assign(self, node) -> None:
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        value = getattr(node, "value", None)
+        if isinstance(value, ast.Call) and \
+                astutils.call_target(value,
+                                     self.ex.aliases) in _LOCK_CLASS_TARGETS:
+            cls = self.scope.cls_context()
+            for t in targets:
+                dotted = astutils.dotted_name(t)
+                if dotted and dotted.startswith("self.") and cls:
+                    attr = dotted[len("self."):]
+                    self.ex.locks.add(f"{cls}.{attr}")
+                    self.effects.append(Effect(
+                        EFFECT_LOCK_CREATE, node.lineno, f"{cls}:{attr}"))
+            return  # a lock binding is not transactional state
+        for t in targets:
+            root = _self_root(t)
+            if root is not None:
+                self.effects.append(Effect(
+                    EFFECT_STATE_MUTATION, node.lineno, root))
+        if value is not None:
+            wc = self._wallclock_in(value)
+            if wc:
+                for t in targets:
+                    name = _binding_name(t)
+                    if name and SEEDISH_NAME_RE.search(name):
+                        self.effects.append(Effect(
+                            EFFECT_WALLCLOCK, node.lineno,
+                            f"{wc}->{name}"))
+
+    def _wallclock_in(self, node: ast.AST) -> Optional[str]:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                target = astutils.call_target(sub, self.ex.aliases)
+                if target and WALLCLOCK_TARGET_RE.search(target):
+                    return target
+        return None
+
+    # -- iteration order ----------------------------------------------------
+
+    def _iter_source(self, iter_expr: ast.AST) -> None:
+        detail = None
+        if isinstance(iter_expr, ast.Set):
+            detail = "set literal"
+        elif isinstance(iter_expr, ast.Call):
+            target = self.ex.resolve_call(iter_expr, self.scope)
+            if target in _UNORDERED_CALL_TARGETS:
+                detail = f"{target}()"
+            elif isinstance(iter_expr.func, ast.Attribute) and \
+                    iter_expr.func.attr in _UNORDERED_SET_METHODS:
+                detail = f".{iter_expr.func.attr}()"
+        if detail is not None:
+            self.effects.append(Effect(
+                EFFECT_UNORDERED_ITER, iter_expr.lineno, detail))
+
+    # -- calls --------------------------------------------------------------
+
+    @staticmethod
+    def _open_mode(node: ast.Call) -> Optional[str]:
+        mode = node.args[1] if len(node.args) >= 2 else None
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+            return mode.value
+        return None
+
+    def _call(self, node: ast.Call) -> None:
+        target = self.ex.resolve_call(node, self.scope)
+        line = node.lineno
+        if WAL_APPEND_TARGET_RE.search(target):
+            self.effects.append(Effect(EFFECT_WAL_APPEND, line, target))
+        elif target in FSYNC_TARGETS:
+            self.effects.append(Effect(EFFECT_FSYNC, line, target))
+        elif target in RENAME_TARGETS:
+            self.effects.append(Effect(EFFECT_RENAME, line, target))
+        elif target in TMPFILE_TARGETS:
+            self.effects.append(Effect(EFFECT_TMP_CREATE, line, target))
+        elif target in _OPEN_TARGETS:
+            mode = self._open_mode(node)
+            if mode is not None and _WRITE_MODE_RE.search(mode):
+                self.effects.append(Effect(EFFECT_RAW_WRITE, line, mode))
+        if DRAW_TARGET_RE.search(target):
+            self.effects.append(Effect(EFFECT_NOISE_DRAW, line, target))
+        elif COMMIT_TARGET_RE.search(target):
+            self.effects.append(Effect(EFFECT_RELEASE_COMMIT, line,
+                                       target))
+        if not self.jitted and JNP_ARITH_RE.match(target):
+            self.effects.append(Effect(EFFECT_EAGER_JNP, line, target))
+        for kw in node.keywords:
+            if kw.arg and SEEDISH_NAME_RE.search(kw.arg):
+                wc = self._wallclock_in(kw.value)
+                if wc:
+                    self.effects.append(Effect(
+                        EFFECT_WALLCLOCK, line, f"{wc}->{kw.arg}"))
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "acquire":
+                dotted = astutils.dotted_name(node.func.value)
+                if dotted and _LOCKISH_RE.search(dotted.split(".")[-1]):
+                    self.effects.append(Effect(
+                        EFFECT_LOCK_ACQUIRE, line,
+                        self._lock_name(dotted), -1))
+            elif node.func.attr in _MUTATORS:
+                root = _self_root(node.func.value)
+                if root is not None:
+                    self.effects.append(Effect(
+                        EFFECT_STATE_MUTATION, line,
+                        f"{root}.{node.func.attr}()"))
 
 
 # ---------------------------------------------------------------------------

@@ -13,6 +13,12 @@ from pipelinedp_tpu.lint.rules.commit_before_draw import (
 )
 from pipelinedp_tpu.lint.rules.donated_reuse import DonatedReuseRule
 from pipelinedp_tpu.lint.rules.telemetry_taint import TelemetryTaintRule
+from pipelinedp_tpu.lint.rules.durable_write import DurableWriteRule
+from pipelinedp_tpu.lint.rules.commit_ordering import CommitOrderingRule
+from pipelinedp_tpu.lint.rules.lock_order import LockOrderRule
+from pipelinedp_tpu.lint.rules.release_determinism import (
+    ReleaseDeterminismRule,
+)
 
 ALL_RULES = (
     KeyReuseRule,
@@ -26,6 +32,10 @@ ALL_RULES = (
     CommitBeforeDrawRule,
     DonatedReuseRule,
     TelemetryTaintRule,
+    DurableWriteRule,
+    CommitOrderingRule,
+    LockOrderRule,
+    ReleaseDeterminismRule,
 )
 
 __all__ = [cls.__name__ for cls in ALL_RULES] + ["ALL_RULES"]

@@ -1,0 +1,136 @@
+"""DPL013 — commit ordering: nothing the WAL record promises may
+precede it, nothing it references may follow it.
+
+Every durable transaction in the tree is write-ahead shaped
+(serving/live.py append, runtime/journal.py commit, RESILIENCE.md):
+
+  1. make the *payload* durable (epoch npz, journal temp file);
+  2. append the WAL / commit record that references it — this fsync is
+     the commit point;
+  3. only then mutate in-memory state to reflect the committed fact.
+
+Inverting either half breaks crash-exactly-once: a payload written
+*after* the record means recovery finds a record pointing at nothing;
+state mutated *before* the record means a crash leaves memory (and
+anything derived from it, e.g. dedup indexes) claiming a fact the log
+never committed. This generalizes DPL009's commit-before-draw to the
+append/release/checkpoint transactions.
+
+dpverify anchors on functions with a direct ``wal_append`` effect (or
+``*.commit`` functions whose call closure is durable) and checks the
+effect trace against the two orderings. Mutations of the WAL binding
+itself (``self._wal = ...``) are the commit *channel*, not transaction
+state, and are ignored. ``LintConfig.commit_ordering_trusted`` exempts
+functions whose pre-commit durability is itself the protocol.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, List, Optional
+
+from pipelinedp_tpu.lint.engine import Finding, ProjectContext, ProjectRule
+from pipelinedp_tpu.lint.flow.summary import (
+    EFFECT_FSYNC,
+    EFFECT_RAW_WRITE,
+    EFFECT_RENAME,
+    EFFECT_STATE_MUTATION,
+    EFFECT_TMP_CREATE,
+    EFFECT_WAL_APPEND,
+    WAL_APPEND_TARGET_RE,
+)
+
+_DURABLE_KINDS = frozenset({EFFECT_FSYNC, EFFECT_RENAME,
+                            EFFECT_RAW_WRITE, EFFECT_TMP_CREATE})
+# self._wal assignments establish the commit channel, not state.
+_WAL_BINDING_RE = re.compile(r"(?:^|\.)_?wal\b")
+
+
+class CommitOrderingRule(ProjectRule):
+    rule_id = "DPL013"
+    name = "commit-ordering"
+    description = ("A durable side effect or state mutation is on the "
+                   "wrong side of the WAL/commit record.")
+    hint = ("Order the transaction payload-first: durable payload "
+            "writes, then the WAL append (the commit point), then "
+            "in-memory mutations; see serving/live.py _append_locked "
+            "and RESILIENCE.md for the crash contract.")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        flow = project.flow
+        config = project.config
+        closure = flow.effect_kind_closure()
+        findings: List[Finding] = []
+        for qual, fsum in flow.functions.items():
+            if config.is_commit_ordering_trusted(qual):
+                continue
+            module = flow.function_module[qual]
+            commit_line, via_wal = self._commit_point(
+                qual, fsum, flow, closure)
+            if commit_line is None:
+                continue
+            relpath = project.relpath_of(module)
+            func = qual[len(module) + 1:]
+            for eff in fsum.effects:
+                if eff.kind == EFFECT_STATE_MUTATION and \
+                        eff.line < commit_line and \
+                        not _WAL_BINDING_RE.search(eff.detail):
+                    findings.append(Finding(
+                        self.rule_id, relpath, eff.line, 1,
+                        f"`{func}` mutates `{eff.detail}` before the "
+                        f"commit record at line {commit_line} is "
+                        f"durable — a crash leaves memory claiming a "
+                        f"fact the log never committed",
+                        self.hint))
+            if not via_wal:
+                continue
+            # The WAL record references the payload: anything durable
+            # after the append arrives too late for recovery to find.
+            seen = set()
+            for eff in fsum.effects:
+                if eff.kind in _DURABLE_KINDS and eff.line > commit_line:
+                    seen.add(eff.line)
+                    findings.append(Finding(
+                        self.rule_id, relpath, eff.line, 1,
+                        f"durable `{eff.kind}` in `{func}` after the "
+                        f"WAL append at line {commit_line} — the "
+                        f"record can commit while its payload is lost",
+                        self.hint))
+            for call in fsum.calls:
+                if call.line <= commit_line or call.line in seen:
+                    continue
+                if WAL_APPEND_TARGET_RE.search(call.target):
+                    continue  # a later record is its own commit
+                if closure.get(flow.resolve(call.target, module) or "",
+                               frozenset()) & _DURABLE_KINDS:
+                    seen.add(call.line)
+                    leaf = call.target.split(".")[-1]
+                    findings.append(Finding(
+                        self.rule_id, relpath, call.line, 1,
+                        f"`{leaf}` performs durable writes after the "
+                        f"WAL append at line {commit_line} of `{func}` "
+                        f"— the record can commit while its payload "
+                        f"is lost",
+                        self.hint))
+        return findings
+
+    @staticmethod
+    def _commit_point(qual, fsum, flow, closure):
+        """(line, via_wal) of the transaction's commit point, or
+        (None, False) when the function is not an anchor."""
+        wal_lines = [e.line for e in fsum.effects
+                     if e.kind == EFFECT_WAL_APPEND]
+        if wal_lines:
+            return min(wal_lines), True
+        if qual.endswith(".commit"):
+            module = flow.function_module[qual]
+            durable_calls: List[int] = []
+            for call in fsum.calls:
+                callee = flow.resolve(call.target, module)
+                if callee is not None and \
+                        closure.get(callee, frozenset()) & \
+                        frozenset({EFFECT_FSYNC, EFFECT_RENAME}):
+                    durable_calls.append(call.line)
+            if durable_calls:
+                return min(durable_calls), False
+        return None, False

@@ -1,0 +1,76 @@
+"""DPL012 — non-atomic durable write: every byte that must survive a
+crash goes through tmp+fsync+rename or the WAL append discipline.
+
+The store/WAL/spool/capture trees are read back by crash recovery
+(serving/store.py, runtime/journal.py, RESILIENCE.md), so a plain
+``open(path, "w")`` is a torn-state generator: a crash mid-write leaves
+a half-file that recovery then trusts. The two sanctioned idioms are
+
+  * tmp+fsync+rename — ``tempfile.mkstemp`` (or a dot-tmp sibling),
+    write, ``flush``+``os.fsync``, ``os.replace`` (store._atomic_write);
+  * the ``JsonlWal`` append discipline — one long-lived append handle,
+    every record write+flush+fsync'd, truncate-only recovery.
+
+dpverify checks each function's effect trace: a ``raw_durable_write``
+is only clean when the same function also carries ``fsync`` *and*
+``rename`` (the atomic idiom), and an ``os.replace`` publish without an
+``fsync`` is flagged too — the rename is atomic but the *payload* may
+still be sitting in the page cache (the checkpoint-store bug class).
+Modeled-exempt patterns live in ``LintConfig.atomic_write_exempt``
+(WAL internals, the flush-only flight spool, the /healthz probe,
+operator-artifact writers).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from pipelinedp_tpu.lint.engine import Finding, ProjectContext, ProjectRule
+from pipelinedp_tpu.lint.flow.summary import (
+    EFFECT_FSYNC,
+    EFFECT_RAW_WRITE,
+    EFFECT_RENAME,
+)
+
+
+class DurableWriteRule(ProjectRule):
+    rule_id = "DPL012"
+    name = "non-atomic-durable-write"
+    description = ("A durable write bypasses the tmp+fsync+rename idiom "
+                   "and the JsonlWal append discipline.")
+    hint = ("Write through serving/store.py `_atomic_write` (mkstemp -> "
+            "write -> flush+fsync -> os.replace) or a JsonlWal; if the "
+            "file is genuinely loss-tolerant, add the function to "
+            "LintConfig.atomic_write_exempt with the structural reason.")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        flow = project.flow
+        config = project.config
+        findings: List[Finding] = []
+        for qual, fsum in flow.functions.items():
+            if config.is_atomic_write_exempt(qual):
+                continue
+            kinds = {e.kind for e in fsum.effects}
+            module = flow.function_module[qual]
+            relpath = project.relpath_of(module)
+            func = qual[len(module) + 1:]
+            atomic = EFFECT_FSYNC in kinds and EFFECT_RENAME in kinds
+            for eff in fsum.effects:
+                if eff.kind == EFFECT_RAW_WRITE and not atomic:
+                    findings.append(Finding(
+                        self.rule_id, relpath, eff.line, 1,
+                        f"raw `open(..., {eff.detail!r})` write in "
+                        f"`{func}` without the tmp+fsync+rename idiom — "
+                        f"a crash mid-write leaves a torn file for "
+                        f"recovery to trust",
+                        self.hint))
+                elif eff.kind == EFFECT_RENAME and \
+                        EFFECT_FSYNC not in kinds:
+                    findings.append(Finding(
+                        self.rule_id, relpath, eff.line, 1,
+                        f"`{func}` publishes with os.replace/rename but "
+                        f"never fsyncs the payload — the rename is "
+                        f"atomic, the bytes behind it may not be on "
+                        f"disk",
+                        self.hint))
+        return findings

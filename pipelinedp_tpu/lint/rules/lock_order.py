@@ -1,0 +1,107 @@
+"""DPL014 — lock-order cycles and lock-scope (latency-inversion)
+hazards over the project lock graph.
+
+The serving/obs/runtime planes hold 18 ``threading.Lock`` sites; none
+of them is documented as an ordered hierarchy, so the only defensible
+invariant is the one dpverify can check: the *acquired-while-held*
+graph — built from every function's ``lock_acquire`` effect spans plus
+the transitive acquire sets of everything called inside those spans,
+with inherited ``self._lock`` attributes canonicalized to the class
+that created them — must stay acyclic. A cycle is a deadlock waiting
+for the fleet (ROADMAP item 1) to schedule the interleaving.
+
+The same spans also expose latency inversions: a lock held across an
+``fsync``/WAL append or a device synchronization
+(``device_get``/``block_until_ready``) serializes millisecond-scale
+waits into every contender. Transactions whose *contract* is "the lock
+serializes the durable append" are exempted by canonical lock name in
+``LintConfig.lock_scope_exempt``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, List
+
+from pipelinedp_tpu.lint.engine import Finding, ProjectContext, ProjectRule
+from pipelinedp_tpu.lint.flow.summary import (
+    EFFECT_FSYNC,
+    EFFECT_LOCK_ACQUIRE,
+    EFFECT_WAL_APPEND,
+)
+
+_HELD_KINDS = frozenset({EFFECT_FSYNC, EFFECT_WAL_APPEND})
+DEVICE_SYNC_RE = re.compile(
+    r"(?:^|\.)(?:device_get|device_put|block_until_ready)$")
+
+
+class LockOrderRule(ProjectRule):
+    rule_id = "DPL014"
+    name = "lock-order"
+    description = ("The project lock graph has an ordering cycle, or a "
+                   "lock is held across fsync/device synchronization.")
+    hint = ("Break the cycle by acquiring the locks in one global "
+            "order (release the outer lock first, or hoist the inner "
+            "acquisition out of the critical section); for scope "
+            "findings, move the fsync/device sync outside the lock or "
+            "record the serialization contract in "
+            "LintConfig.lock_scope_exempt.")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        flow = project.flow
+        config = project.config
+        findings: List[Finding] = []
+
+        graph = flow.lock_graph()
+        for cycle in flow.lock_cycles():
+            pairs = list(zip(cycle, cycle[1:] + cycle[:1]))
+            qual, line = graph[pairs[0][0]][pairs[0][1]]
+            module = flow.function_module[qual]
+            loop = " -> ".join([*cycle, cycle[0]])
+            findings.append(Finding(
+                self.rule_id, project.relpath_of(module), line, 1,
+                f"lock-order cycle {loop}: `{qual.split('.')[-1]}` "
+                f"acquires `{pairs[0][1].rsplit('.', 1)[-1]}` while "
+                f"holding `{pairs[0][0].rsplit('.', 1)[-1]}` and "
+                f"another path nests them in the opposite order — "
+                f"a deadlock under concurrency",
+                self.hint))
+
+        sync_reaching = flow.reaching(DEVICE_SYNC_RE.pattern)
+        for qual, fsum in flow.functions.items():
+            module = flow.function_module[qual]
+            relpath = project.relpath_of(module)
+            func = qual[len(module) + 1:]
+            for acq, kind in flow.held_effects(qual, _HELD_KINDS):
+                name = flow.canonical_lock(acq.detail, module)
+                if config.is_lock_scope_exempt(name):
+                    continue
+                findings.append(Finding(
+                    self.rule_id, relpath, acq.line, 1,
+                    f"`{name.rsplit('.', 1)[-1]}` is held across "
+                    f"`{kind}` in `{func}` — every contender now "
+                    f"waits on storage latency",
+                    self.hint))
+            for acq in fsum.effects:
+                if acq.kind != EFFECT_LOCK_ACQUIRE or acq.end < 0:
+                    continue
+                name = flow.canonical_lock(acq.detail, module)
+                if config.is_lock_scope_exempt(name):
+                    continue
+                for call in fsum.calls:
+                    if not (acq.line <= call.line <= acq.end):
+                        continue
+                    callee = flow.resolve(call.target, module)
+                    if DEVICE_SYNC_RE.search(call.target) or \
+                            (callee is not None and
+                             callee in sync_reaching):
+                        findings.append(Finding(
+                            self.rule_id, relpath, call.line, 1,
+                            f"`{name.rsplit('.', 1)[-1]}` is held "
+                            f"across a device synchronization "
+                            f"(`{call.target.split('.')[-1]}`) in "
+                            f"`{func}` — device latency serializes "
+                            f"every contender",
+                            self.hint))
+                        break
+        return findings

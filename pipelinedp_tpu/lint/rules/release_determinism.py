@@ -1,0 +1,92 @@
+"""DPL015 — release-path nondeterminism: releases must be a pure
+function of (data, params, seed).
+
+Bit-identical releases are a pinned contract (tests/determinism, the
+PR 4 FMA-contraction fix): the same dataset, parameters and seed must
+produce the same bytes on every host in the fleet. Three
+nondeterminism classes defeat that silently:
+
+  * iteration over unordered collections (``set`` literals,
+    ``os.listdir``, set algebra) — Python sets hash-order by pointer,
+    listdir is filesystem-order; anything derived from the walk order
+    (vocab ids, key folds, output order) diverges across hosts;
+  * wall-clock / uuid values feeding seeds, keys or tokens — the value
+    differs per process by construction;
+  * eager ``jax.numpy`` arithmetic outside the blessed compiled
+    entries (``ops/noise``, ``ops/selection``, ``ops/finalize``) — the
+    PR 4 bug class: op-by-op dispatch and XLA-fused compilation are
+    allowed to differ in FMA contraction, so the same math eager vs
+    compiled yields different low bits.
+
+dpverify scopes the check to *release paths*: functions whose call
+closure reaches a noise/selection draw or a release commit. The
+blessed compiled entries and the documented eager parity oracle are
+exempted in ``LintConfig.release_determinism_exempt``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from pipelinedp_tpu.lint.engine import Finding, ProjectContext, ProjectRule
+from pipelinedp_tpu.lint.flow.summary import (
+    COMMIT_TARGET_RE,
+    DRAW_TARGET_RE,
+    EFFECT_EAGER_JNP,
+    EFFECT_UNORDERED_ITER,
+    EFFECT_WALLCLOCK,
+)
+
+
+class ReleaseDeterminismRule(ProjectRule):
+    rule_id = "DPL015"
+    name = "release-determinism"
+    description = ("A nondeterminism source (unordered iteration, "
+                   "wall-clock seed, eager jnp arithmetic) sits on a "
+                   "release path.")
+    hint = ("Releases are a pure function of (data, params, seed): "
+            "sort before iterating, derive seeds/keys from the "
+            "KeyStream, and keep jnp arithmetic inside the blessed "
+            "compiled entries (ops/noise, ops/selection, ops/finalize) "
+            "— see the PR 4 FMA-contraction note in DETERMINISM.md.")
+
+    _MESSAGES = {
+        EFFECT_UNORDERED_ITER: (
+            "iterates {detail} on a release path — hash/filesystem "
+            "order diverges across hosts, so the release bytes do too"),
+        EFFECT_WALLCLOCK: (
+            "{detail}: a wall-clock/uuid value feeds a seed-like "
+            "binding on a release path — the release stops being a "
+            "function of (data, params, seed)"),
+        EFFECT_EAGER_JNP: (
+            "eager `{detail}` on a release path outside the blessed "
+            "compiled entries — eager dispatch and XLA fusion may "
+            "differ in FMA contraction (the PR 4 bug class)"),
+    }
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        flow = project.flow
+        config = project.config
+        release = flow.reaching(DRAW_TARGET_RE.pattern) | \
+            flow.reaching(COMMIT_TARGET_RE.pattern)
+        closure = flow.effect_kind_closure()
+        findings: List[Finding] = []
+        for qual, fsum in flow.functions.items():
+            if config.is_release_determinism_exempt(qual):
+                continue
+            if qual not in release and not (
+                    closure.get(qual, frozenset()) &
+                    frozenset({"noise_draw", "release_commit"})):
+                continue
+            module = flow.function_module[qual]
+            relpath = project.relpath_of(module)
+            func = qual[len(module) + 1:]
+            for eff in fsum.effects:
+                template = self._MESSAGES.get(eff.kind)
+                if template is None:
+                    continue
+                findings.append(Finding(
+                    self.rule_id, relpath, eff.line, 1,
+                    f"`{func}` " + template.format(detail=eff.detail),
+                    self.hint))
+        return findings

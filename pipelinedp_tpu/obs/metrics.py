@@ -48,6 +48,7 @@ import bisect
 import math
 import os
 import re
+import tempfile
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -482,8 +483,20 @@ def _export_at_exit(path: str) -> None:
     data = (reg.to_prometheus() if not path.endswith(".json")
             else __import__("json").dumps(reg.snapshot(), indent=1))
     try:
-        with open(path, "w") as f:
-            f.write(data)
+        # Atomic publish: scrapers polling the textfile never see a
+        # half-written export, even if the process dies mid-dump.
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                                   suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
     except OSError:
         pass  # exit-time export is best-effort by design
 

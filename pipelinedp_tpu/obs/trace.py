@@ -43,6 +43,7 @@ import dataclasses
 import itertools
 import json
 import os
+import tempfile
 import threading
 import time
 from typing import Deque, Dict, List, Optional, Tuple
@@ -223,8 +224,18 @@ class Tracer:
         parent = os.path.dirname(path)
         if parent:
             os.makedirs(parent, exist_ok=True)
-        with open(path, "w") as f:
-            json.dump(self.export_chrome(trace_id), f)
+        # Atomic publish: a reader (or crash) never sees a torn trace.
+        fd, tmp = tempfile.mkstemp(dir=parent or ".", suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(self.export_chrome(trace_id), f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
         return path
 
     def reset(self) -> None:

@@ -1,0 +1,23 @@
+"""dplint fixture — DPL012 violations: durable writes that bypass the
+tmp+fsync+rename idiom.
+
+``store_dir`` is a serving store root (serving/store.py); everything
+under it is read back by crash recovery, so torn files are trusted.
+"""
+
+import json
+import os
+import tempfile
+
+
+def write_manifest(store_dir, manifest):
+    path = os.path.join(store_dir, "manifest.json")
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+
+
+def publish_snapshot(store_dir, payload):
+    fd, tmp = tempfile.mkstemp(dir=store_dir)
+    with os.fdopen(fd, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, os.path.join(store_dir, "snapshot.bin"))

@@ -1,0 +1,23 @@
+"""dplint fixture — DPL012 clean: the tmp+fsync+rename idiom.
+
+``store_dir`` is a serving store root (serving/store.py).
+"""
+
+import json
+import os
+import tempfile
+
+
+def publish_manifest(store_dir, manifest):
+    path = os.path.join(store_dir, "manifest.json")
+    fd, tmp = tempfile.mkstemp(dir=store_dir, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise

@@ -1,0 +1,35 @@
+"""dplint fixture — DPL013 violations: effects on the wrong side of the
+WAL record.
+
+``wal`` is a runtime.journal.JsonlWal; the append transaction must run
+payload -> record -> fold (serving/live.py, RESILIENCE.md).
+"""
+
+import os
+import tempfile
+
+
+class LiveSession:
+
+    def __init__(self, wal, root):
+        self._wal = wal
+        self._root = root
+        self._epochs = []
+
+    def _save_epoch(self, epoch_id, payload):
+        fd, tmp = tempfile.mkstemp(dir=self._root, suffix=".tmp")
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self._root, f"{epoch_id}.bin"))
+
+    def append_record_first(self, epoch_id, payload):
+        self._wal.append({"epoch": epoch_id})
+        self._save_epoch(epoch_id, payload)
+        self._epochs.append(epoch_id)
+
+    def fold_before_commit(self, epoch_id, payload):
+        self._save_epoch(epoch_id, payload)
+        self._epochs.append(epoch_id)
+        self._wal.append({"epoch": epoch_id})

@@ -1,0 +1,28 @@
+"""dplint fixture — DPL013 clean: payload -> record -> fold.
+
+``wal`` is a runtime.journal.JsonlWal (serving/live.py append shape).
+"""
+
+import os
+import tempfile
+
+
+class LiveSession:
+
+    def __init__(self, wal, root):
+        self._wal = wal
+        self._root = root
+        self._epochs = []
+
+    def _save_epoch(self, epoch_id, payload):
+        fd, tmp = tempfile.mkstemp(dir=self._root, suffix=".tmp")
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self._root, f"{epoch_id}.bin"))
+
+    def append(self, epoch_id, payload):
+        self._save_epoch(epoch_id, payload)
+        self._wal.append({"epoch": epoch_id})
+        self._epochs.append(epoch_id)

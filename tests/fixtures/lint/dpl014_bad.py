@@ -1,0 +1,26 @@
+"""dplint fixture — DPL014 violations: a reversed lock pair and an
+fsync under a lock (the serving manager/store shape).
+"""
+
+import os
+import threading
+
+manager_lock = threading.Lock()
+store_lock = threading.Lock()
+
+
+def admit_then_save(session):
+    with manager_lock:
+        with store_lock:
+            session.save()
+
+
+def save_then_admit(session):
+    with store_lock:
+        with manager_lock:
+            session.admit()
+
+
+def flush_under_lock(fd):
+    with store_lock:
+        os.fsync(fd)

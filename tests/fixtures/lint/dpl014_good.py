@@ -1,0 +1,28 @@
+"""dplint fixture — DPL014 clean: one global lock order, fsync outside
+the critical section.
+"""
+
+import os
+import threading
+
+manager_lock = threading.Lock()
+store_lock = threading.Lock()
+
+
+def admit_then_save(session):
+    with manager_lock:
+        with store_lock:
+            session.save()
+
+
+def save_more(session):
+    with manager_lock:
+        with store_lock:
+            session.admit()
+
+
+def flush_outside_lock(fd):
+    with store_lock:
+        pending = True
+    if pending:
+        os.fsync(fd)

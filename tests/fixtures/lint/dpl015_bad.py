@@ -1,0 +1,30 @@
+"""dplint fixture — DPL015 violations: nondeterminism on the release
+path.
+
+``spec`` is a resolved budget_accounting.MechanismSpec; releases must
+be a pure function of (data, params, seed).
+"""
+
+import os
+import time
+
+import jax.numpy as jnp
+
+from pipelinedp_tpu import noise_core
+
+
+def release_with_clock_seed(totals, spec):
+    seed = int(time.time())
+    return noise_core.add_laplace_noise_array(totals, 1.0 / spec.eps), seed
+
+
+def release_in_listdir_order(root, totals, spec):
+    names = []
+    for name in os.listdir(root):
+        names.append(name)
+    return names, noise_core.add_gaussian_noise_array(totals, spec.std)
+
+
+def release_after_eager_clip(totals, spec):
+    clipped = jnp.maximum(totals, 0.0)
+    return noise_core.add_laplace_noise_array(clipped, 1.0 / spec.eps)

@@ -1,0 +1,14 @@
+"""dplint fixture — DPL015 clean: sorted iteration and seed-derived
+randomness on the release path.
+
+``spec`` is a resolved budget_accounting.MechanismSpec.
+"""
+
+from pipelinedp_tpu import noise_core
+
+
+def release_in_sorted_order(vocab, totals, spec):
+    names = []
+    for name in sorted(vocab):
+        names.append(name)
+    return names, noise_core.add_laplace_noise_array(totals, 1.0 / spec.eps)

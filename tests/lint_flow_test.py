@@ -1,12 +1,17 @@
 """Tests for dpflow (pipelinedp_tpu/lint/flow): the symbol table /
-call-graph layer, the digest cache, and the seeded-hazard contract —
-the three known hazard classes (journal commit reordered, donated
-operand reuse, unlocked pool write) must be caught when deliberately
-introduced into production-shaped code.
+call-graph layer, the digest cache, the dpverify effect-summary layer
+(effect traces, lock graph), and the seeded-hazard contract — every
+known hazard class (journal commit reordered, donated operand reuse,
+unlocked pool write, non-atomic durable write, WAL fold/record
+inversion, reversed lock pair, nondeterministic release epilogue) must
+be caught when deliberately introduced into production-shaped code.
 """
 
 import ast
 import os
+import shutil
+import textwrap
+import time
 
 import pytest
 
@@ -19,6 +24,7 @@ from pipelinedp_tpu.lint.flow import (
     source_digest,
 )
 from pipelinedp_tpu.lint import astutils
+from pipelinedp_tpu.lint.flow import summary as flow_summary
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -178,6 +184,229 @@ class TestFlowCache:
                           flow_cache_path=cache_path)
         assert warm.flow_cache_hits == 1 and warm.flow_cache_misses == 0
 
+    def test_summary_version_bump_cold_invalidates(self, tmp_path,
+                                                   monkeypatch):
+        """Bumping SUMMARY_VERSION (e.g. when a new effect kind lands)
+        must turn every cached entry into a miss — stale summaries with
+        the old effect vocabulary would silently blind the new rules."""
+        monkeypatch.chdir(tmp_path)
+        (tmp_path / "mod.py").write_text(self.SRC)
+        cache_path = str(tmp_path / "flow.json")
+        lint_paths(["mod.py"], root=str(tmp_path),
+                   flow_cache_path=cache_path)
+        warm = lint_paths(["mod.py"], root=str(tmp_path),
+                          flow_cache_path=cache_path)
+        assert warm.flow_cache_hits == 1
+        monkeypatch.setattr(flow_summary, "SUMMARY_VERSION",
+                            flow_summary.SUMMARY_VERSION + 1)
+        bumped = lint_paths(["mod.py"], root=str(tmp_path),
+                            flow_cache_path=cache_path)
+        assert bumped.flow_cache_hits == 0
+        assert bumped.flow_cache_misses == 1
+        assert bumped.parse_errors == []
+
+
+def _extract(src, module="pkg.m"):
+    tree = ast.parse(src)
+    return extract_module(module, tree, astutils.build_aliases(tree))
+
+
+class TestEffectTraces:
+    """Pin the dpverify effect-summary layer: the ordered per-function
+    durable/concurrency effect traces the DPL012-DPL015 rules read."""
+
+    def test_atomic_publish_trace_in_line_order(self):
+        summary = _extract(
+            "import json\n"
+            "import os\n"
+            "import tempfile\n"
+            "def publish(path, payload):\n"
+            "    fd, tmp = tempfile.mkstemp(dir='.')\n"
+            "    with os.fdopen(fd, 'w') as fh:\n"
+            "        json.dump(payload, fh)\n"
+            "        fh.flush()\n"
+            "        os.fsync(fh.fileno())\n"
+            "    os.replace(tmp, path)\n")
+        kinds = [e.kind for e in summary.functions["publish"].effects]
+        assert kinds == [flow_summary.EFFECT_TMP_CREATE,
+                         flow_summary.EFFECT_RAW_WRITE,
+                         flow_summary.EFFECT_FSYNC,
+                         flow_summary.EFFECT_RENAME]
+
+    def test_write_in_with_context_expression_is_seen(self):
+        # Regression: the walker must descend into the With item's
+        # context expression, not just the body — `with open(p, 'w')`
+        # is where nearly every raw write in the tree lives.
+        summary = _extract(
+            "import json\n"
+            "def raw(path, payload):\n"
+            "    with open(path, 'w') as fh:\n"
+            "        json.dump(payload, fh)\n")
+        kinds = [e.kind for e in summary.functions["raw"].effects]
+        assert kinds == [flow_summary.EFFECT_RAW_WRITE]
+
+    def test_eager_jnp_exempt_under_jit(self):
+        summary = _extract(
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def eager(x):\n"
+            "    return jnp.maximum(x, 0.0)\n"
+            "@jax.jit\n"
+            "def compiled(x):\n"
+            "    return jnp.maximum(x, 0.0)\n")
+        eager = [e.kind for e in summary.functions["eager"].effects]
+        compiled = [e.kind
+                    for e in summary.functions["compiled"].effects]
+        assert flow_summary.EFFECT_EAGER_JNP in eager
+        assert flow_summary.EFFECT_EAGER_JNP not in compiled
+
+    def test_wal_append_transaction_trace(self):
+        summary = _extract(
+            "class S:\n"
+            "    def append(self, rec):\n"
+            "        with self._append_lock:\n"
+            "            self._rows.append(rec)\n"
+            "            self._wal.append(rec)\n")
+        effects = summary.functions["S.append"].effects
+        by_kind = {}
+        for e in effects:  # keep the FIRST effect of each kind
+            by_kind.setdefault(e.kind, e)
+        lock = by_kind[flow_summary.EFFECT_LOCK_ACQUIRE]
+        wal = by_kind[flow_summary.EFFECT_WAL_APPEND]
+        mutation = by_kind[flow_summary.EFFECT_STATE_MUTATION]
+        assert lock.detail == "S:_append_lock"
+        assert lock.end >= wal.line  # with-block span covers the append
+        assert mutation.line < wal.line  # the fold precedes the record
+
+    def test_lock_canonicalizes_through_base_class(self):
+        flow = ProjectFlow(_summaries({
+            "pkg.base": ("import threading\n"
+                         "class Base:\n"
+                         "    def __init__(self):\n"
+                         "        self._lock = threading.Lock()\n"),
+            "pkg.sub": ("from pkg.base import Base\n"
+                        "class Sub(Base):\n"
+                        "    def grab(self):\n"
+                        "        with self._lock:\n"
+                        "            return 1\n"),
+        }))
+        assert flow.canonical_lock("Sub:_lock", "pkg.sub") == \
+            "pkg.base.Base._lock"
+        assert "pkg.base.Base._lock" in flow.lock_sites()
+
+    def test_lock_cycle_detected_and_consistent_order_is_clean(self):
+        reversed_pair = _summaries({
+            "pkg.locks": ("import threading\n"
+                          "a_lock = threading.Lock()\n"
+                          "b_lock = threading.Lock()\n"
+                          "def ab():\n"
+                          "    with a_lock:\n"
+                          "        with b_lock:\n"
+                          "            return 1\n"
+                          "def ba():\n"
+                          "    with b_lock:\n"
+                          "        with a_lock:\n"
+                          "            return 1\n"),
+        })
+        cycles = ProjectFlow(reversed_pair).lock_cycles()
+        assert cycles and set(cycles[0]) == {"pkg.locks.a_lock",
+                                             "pkg.locks.b_lock"}
+        consistent = _summaries({
+            "pkg.locks": ("import threading\n"
+                          "a_lock = threading.Lock()\n"
+                          "b_lock = threading.Lock()\n"
+                          "def ab():\n"
+                          "    with a_lock:\n"
+                          "        with b_lock:\n"
+                          "            return 1\n"
+                          "def ab2():\n"
+                          "    with a_lock:\n"
+                          "        with b_lock:\n"
+                          "            return 2\n"),
+        })
+        assert ProjectFlow(consistent).lock_cycles() == []
+
+    def test_held_effects_crosses_calls(self):
+        flow = ProjectFlow(_summaries({
+            "pkg.io": ("import os\n"
+                       "import threading\n"
+                       "io_lock = threading.Lock()\n"
+                       "def flush(fd):\n"
+                       "    os.fsync(fd)\n"
+                       "def locked_flush(fd):\n"
+                       "    with io_lock:\n"
+                       "        flush(fd)\n"),
+        }))
+        held = flow.held_effects(
+            "pkg.io.locked_flush",
+            frozenset({flow_summary.EFFECT_FSYNC}))
+        assert [(acq.detail, kind) for acq, kind in held] == \
+            [("io_lock", flow_summary.EFFECT_FSYNC)]
+
+
+class TestChangedOnlyFocus:
+    """--changed-only narrows *reporting*, not analysis: a hazard whose
+    witness lives outside the changed file must still be reported when
+    the changed file participates in it (the PR-16 bugfix satellite)."""
+
+    A_SRC = (
+        "from pkg import b\n"
+        "class Engine:\n"
+        "    def _commit_release(self, counter):\n"
+        "        self._journal.commit(('t', counter))\n"
+        "    def aggregate(self, accs, spec, counter):\n"
+        "        cols = b.epilogue(accs, spec)\n"
+        "        self._commit_release(counter)\n"
+        "        return cols\n")
+    B_SRC = (
+        "from pipelinedp_tpu import noise_core\n"
+        "def epilogue(accs, spec):\n"
+        "    return noise_core.add_noise_array(\n"
+        "        accs, True, 1.0 / spec.eps)\n")
+    # Same hazard shape, but in a module with no edges to pkg.b.
+    C_SRC = (
+        "from pipelinedp_tpu import noise_core\n"
+        "class Island:\n"
+        "    def _commit_release(self, counter):\n"
+        "        self._journal.commit(('t', counter))\n"
+        "    def aggregate(self, accs, spec, counter):\n"
+        "        cols = noise_core.add_noise_array(\n"
+        "            accs, True, 1.0 / spec.eps)\n"
+        "        self._commit_release(counter)\n"
+        "        return cols\n")
+
+    def _write_tree(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "a.py").write_text(self.A_SRC)
+        (pkg / "b.py").write_text(self.B_SRC)
+        (pkg / "c.py").write_text(self.C_SRC)
+        return pkg
+
+    def test_hazard_reported_when_witness_is_outside_focus(self, tmp_path):
+        # The noise draw moved after commit via a helper in b.py: with
+        # only b.py "changed", the finding (which anchors in a.py) must
+        # still surface. The old behavior analyzed only the changed
+        # file, lost the call graph, and reported nothing.
+        pkg = self._write_tree(tmp_path)
+        result = lint_paths([str(pkg)], root=str(tmp_path),
+                            focus=[str(pkg / "b.py")])
+        dpl009 = [f for f in result.findings if f.rule_id == "DPL009"]
+        assert any(f.path == "pkg/a.py" for f in dpl009), \
+            "\n".join(f.format() for f in result.findings)
+
+    def test_unconnected_module_findings_are_filtered(self, tmp_path):
+        # c.py has the same hazard but no call-graph connection to the
+        # focus file: its finding is someone else's report.
+        pkg = self._write_tree(tmp_path)
+        result = lint_paths([str(pkg)], root=str(tmp_path),
+                            focus=[str(pkg / "b.py")])
+        assert not any(f.path == "pkg/c.py" for f in result.findings)
+        # Without focus the island is reported as usual.
+        full = lint_paths([str(pkg)], root=str(tmp_path))
+        assert any(f.path == "pkg/c.py" and f.rule_id == "DPL009"
+                   for f in full.findings)
+
 
 class TestSeededHazards:
     """The acceptance contract: deliberately reintroducing each known
@@ -245,6 +474,96 @@ class TestSeededHazards:
         assert "DPL007" in self._rule_ids(tmp_path, src)
 
 
+class TestDpverifySeededHazards:
+    """PR-16 acceptance: seed a scratch copy of the real tree with one
+    production-shaped hazard per rule and pin that DPL012-DPL015 each
+    catch exactly their seeded hazard — findings land in the seeded
+    file and nowhere else (the unseeded tree is clean, so any other
+    location would be a false positive)."""
+
+    def _seed(self, tmp_path, relpath, addition):
+        scratch = tmp_path / "pipelinedp_tpu"
+        shutil.copytree(
+            os.path.join(REPO_ROOT, "pipelinedp_tpu"), str(scratch),
+            ignore=shutil.ignore_patterns("__pycache__"))
+        target = scratch / relpath
+        target.write_text(
+            target.read_text(encoding="utf-8")
+            + textwrap.dedent(addition), encoding="utf-8")
+        result = lint_paths([str(scratch)], root=str(tmp_path))
+        assert result.parse_errors == []
+        return result, "pipelinedp_tpu/" + relpath
+
+    def _assert_caught(self, result, rule_id, relpath):
+        hits = [f for f in result.findings if f.rule_id == rule_id]
+        assert any(f.path == relpath for f in hits), (
+            f"{rule_id} missed its seeded hazard in {relpath}; "
+            "all findings:\n"
+            + "\n".join(f.format() for f in result.findings))
+        strays = [f for f in hits if f.path != relpath]
+        assert strays == [], (
+            f"{rule_id} fired outside the seeded file:\n"
+            + "\n".join(f.format() for f in strays))
+
+    def test_dpl012_raw_manifest_write_in_store(self, tmp_path):
+        # The session store growing a raw open(..., 'w') manifest dump:
+        # a crash mid-write leaves a torn manifest for the next reader.
+        result, relpath = self._seed(tmp_path, "serving/store.py", """
+
+            def _seeded_write_manifest(root, manifest):
+                with open(os.path.join(root, "manifest.json"), "w") as fh:
+                    json.dump(manifest, fh)
+            """)
+        self._assert_caught(result, "DPL012", relpath)
+
+    def test_dpl013_fold_before_wal_record_in_live(self, tmp_path):
+        # The live append transaction inverted: the in-memory fold runs
+        # before the WAL record lands, so a crash between the two
+        # replays to a state that never contained the fold.
+        result, relpath = self._seed(tmp_path, "serving/live.py", """
+
+            class _SeededLiveSession(LiveDatasetSession):
+
+                def append_fold_first(self, payload, epoch_id):
+                    with self._append_lock:
+                        self._epochs.append(epoch_id)
+                        self._wal.append({"kind": "append",
+                                          "epoch": epoch_id})
+            """)
+        self._assert_caught(result, "DPL013", relpath)
+
+    def test_dpl014_reversed_lock_pair_in_manager(self, tmp_path):
+        # The manager/session lock pair nested in both orders: two
+        # threads running the two methods deadlock.
+        result, relpath = self._seed(tmp_path, "serving/manager.py", """
+
+            class _SeededManager(SessionManager):
+
+                def admit_locked(self, peer):
+                    with self._lock:
+                        with peer._lock:
+                            return True
+
+                def spill_locked(self, peer):
+                    with peer._lock:
+                        with self._lock:
+                            return True
+            """)
+        self._assert_caught(result, "DPL014", relpath)
+
+    def test_dpl015_eager_jnp_on_release_path_in_engine(self, tmp_path):
+        # An eager jnp epilogue after the noise draw: XLA fusion bits
+        # outside jit can differ from the compiled release path.
+        result, relpath = self._seed(tmp_path, "jax_engine.py", """
+
+            def _seeded_release_epilogue(totals, eps):
+                noised = noise_core.add_laplace_noise_array(
+                    totals, 1.0 / eps)
+                return jnp.maximum(noised, 0.0)
+            """)
+        self._assert_caught(result, "DPL015", relpath)
+
+
 class TestProductionFlowProperties:
     """Pin the dpflow facts the strict CI gates rely on."""
 
@@ -252,9 +571,27 @@ class TestProductionFlowProperties:
         package = os.path.join(REPO_ROOT, "pipelinedp_tpu")
         result = lint_paths([package], root=REPO_ROOT)
         assert result.parse_errors == []
-        assert [f for f in result.findings
-                if f.rule_id in ("DPL007", "DPL008", "DPL009",
-                                 "DPL010")] == []
+        project_findings = [
+            f for f in result.findings
+            if f.rule_id in ("DPL007", "DPL008", "DPL009", "DPL010",
+                             "DPL011", "DPL012", "DPL013", "DPL014",
+                             "DPL015")]
+        assert project_findings == [], \
+            "\n".join(f.format() for f in project_findings)
+
+    def test_warm_full_tree_within_ci_budget(self, tmp_path):
+        """The PR-16 wall-time satellite: a warm dpverify run over the
+        whole tree must land inside the 30s CI budget."""
+        package = os.path.join(REPO_ROOT, "pipelinedp_tpu")
+        cache_path = str(tmp_path / "flow.json")
+        lint_paths([package], root=REPO_ROOT,
+                   flow_cache_path=cache_path)
+        start = time.monotonic()
+        warm = lint_paths([package], root=REPO_ROOT,
+                          flow_cache_path=cache_path)
+        elapsed = time.monotonic() - start
+        assert warm.flow_cache_misses == 0 and warm.flow_cache_hits > 0
+        assert elapsed < 30.0, f"warm dpverify run took {elapsed:.1f}s"
 
     def test_every_suppression_is_justified(self):
         """The satellite contract: zero bare `# dplint: disable` lines

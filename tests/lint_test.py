@@ -32,6 +32,10 @@ MIN_BAD_FINDINGS = {
     "DPL009": 2,  # direct draw before commit, draw via helper
     "DPL010": 3,  # read after donate, loop carry, exception path
     "DPL011": 4,  # span attr, metric observe (direct + via helper), audit
+    "DPL012": 3,  # raw manifest write, raw snapshot write, no-fsync rename
+    "DPL013": 2,  # payload saved after the record, fold before the record
+    "DPL014": 2,  # reversed lock pair cycle, fsync under lock
+    "DPL015": 3,  # wall-clock seed, listdir order, eager jnp clip
 }
 ALL_RULE_IDS = sorted(MIN_BAD_FINDINGS)
 
