@@ -98,6 +98,8 @@ def _session_statusz(session_stats: dict) -> dict:
     }
     if "live" in session_stats:
         out["live"] = session_stats["live"]
+    if "planner" in session_stats:
+        out["planner"] = session_stats["planner"]
     return out
 
 

@@ -48,8 +48,14 @@ HEADLINE_METRICS: Tuple[Tuple[str, str, float], ...] = (
     ("serving_warm_query_pps",
      "serving.warm_query_partitions_per_sec", 0.25),
     ("serving_cold_pps", "serving.cold_partitions_per_sec", 0.20),
+    ("serving_batched_qps_w1",
+     "serving.batched.width_1_queries_per_sec", 0.40),
+    ("serving_batched_qps_w8",
+     "serving.batched.width_8_queries_per_sec", 0.40),
     ("serving_batched_qps_w32",
      "serving.batched.width_32_queries_per_sec", 0.40),
+    ("serving_batched_qps_w256",
+     "serving.batched.width_256_queries_per_sec", 0.40),
     ("utility_sweep_vs_host", "utility_sweep_vs_host", 0.35),
     ("live_append_rows_per_sec", "live.append_rows_per_sec", 0.30),
     ("live_release_windows_per_sec",
@@ -84,6 +90,23 @@ def shape_signature(row: dict) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted(_SHAPE_RE.findall(row.get("cmd", ""))))
 
 
+def shapes_comparable(a, b) -> bool:
+    """Whether two shape signatures describe the same workload. Exact
+    equality always qualifies; two non-empty signatures also qualify
+    when they agree on every knob BOTH recorded — bench.py grows new
+    knobs over time (each defaulted in older rounds), and a richer
+    recording of the same workload must not orphan the trajectory.
+    Signatures that share no knobs, or disagree on one, don't compare;
+    an empty signature (nothing recorded) only matches another empty."""
+    if a == b:
+        return True
+    da, db = dict(a), dict(b)
+    shared = set(da) & set(db)
+    if not shared:
+        return False
+    return all(da[k] == db[k] for k in shared)
+
+
 def load_rows(paths: Sequence[str]) -> List[dict]:
     rows = []
     for path in paths:
@@ -116,7 +139,7 @@ def compare(rows: Sequence[dict],
     latest = rows[-1]
     latest_sig = shape_signature(latest)
     priors = [r for r in rows[:-1]
-              if shape_signature(r) == latest_sig]
+              if shapes_comparable(shape_signature(r), latest_sig)]
     findings: List[dict] = []
     for label, path, base_tol in HEADLINE_METRICS:
         current = _get_path(latest.get("parsed") or {}, path)

@@ -1589,7 +1589,7 @@ def replay_resident_wire(key: jax.Array,
                      "has_group_clip"))
 def _chunk_step_rle_batch(c, keys, row, n_valid, n_uniq_c, accs, linf_caps,
                           l0_caps, row_clip_los, row_clip_his, middles,
-                          group_clip_los, group_clip_his, *,
+                          group_clip_los, group_clip_his, l1_caps=None, *,
                           num_partitions: int, fmt: wirecodec.WireFormat,
                           need_flags=(True, True, True, True),
                           has_group_clip: bool = True):
@@ -1603,12 +1603,15 @@ def _chunk_step_rle_batch(c, keys, row, n_valid, n_uniq_c, accs, linf_caps,
     sorts are exact and the per-config accumulations are independent
     lanes of the batched kernel); the per-config key schedule is the
     engine's own ``fold_in(key_b, c)``.
+
+    ``l1_caps`` (per-config total-contribution caps, [B] int32 or None)
+    rides an extra vmapped lane; None keeps the l1-free kernel shape.
     """
     pid, pk, value, valid, vkw = _decode_for_kernel(row, n_valid, n_uniq_c,
                                                     fmt)
 
     def one(key, acc, linf_cap, l0_cap, row_clip_lo, row_clip_hi, middle,
-            group_clip_lo, group_clip_hi):
+            group_clip_lo, group_clip_hi, l1_cap=None):
         chunk_accs = columnar.bound_and_aggregate(
             jax.random.fold_in(key, c), pid, pk, value, valid,
             num_partitions=num_partitions,
@@ -1619,6 +1622,7 @@ def _chunk_step_rle_batch(c, keys, row, n_valid, n_uniq_c, accs, linf_caps,
             middle=middle,
             group_clip_lo=group_clip_lo,
             group_clip_hi=group_clip_hi,
+            l1_cap=l1_cap,
             need_count=need_flags[0],
             need_sum=need_flags[1],
             need_norm=need_flags[2],
@@ -1630,6 +1634,10 @@ def _chunk_step_rle_batch(c, keys, row, n_valid, n_uniq_c, accs, linf_caps,
         return columnar.PartitionAccumulators(
             *(a + ch for a, ch in zip(acc, chunk_accs)))
 
+    if l1_caps is not None:
+        return jax.vmap(one)(keys, accs, linf_caps, l0_caps, row_clip_los,
+                             row_clip_his, middles, group_clip_los,
+                             group_clip_his, l1_caps)
     return jax.vmap(one)(keys, accs, linf_caps, l0_caps, row_clip_los,
                          row_clip_his, middles, group_clip_los,
                          group_clip_his)
@@ -1645,6 +1653,7 @@ def replay_resident_wire_batched(keys,
                                  middles,
                                  group_clip_los,
                                  group_clip_his,
+                                 l1_caps=None,
                                  need_flags=(True, True, True, True),
                                  has_group_clip: bool = True,
                                  n_transfers: Optional[int] = None
@@ -1688,13 +1697,15 @@ def replay_resident_wire_batched(keys,
     mid = jnp.asarray(np.asarray(middles, dtype=np.float32))
     glo = jnp.asarray(np.asarray(group_clip_los, dtype=np.float32))
     ghi = jnp.asarray(np.asarray(group_clip_his, dtype=np.float32))
+    l1 = (None if l1_caps is None
+          else jnp.asarray(np.asarray(l1_caps, dtype=np.int32)))
     k = wire.k
     n_t = n_transfers or _num_transfers(wire.slab.nbytes, k)
     window = max(1, (k + n_t - 1) // n_t)
     cost = columnar.sort_cost(
         fmt.cap, num_partitions=num_partitions,
         max_segments=fmt.ucap if fmt.pid_sorted else None,
-        pid_sorted=fmt.pid_sorted, l1_mode=False)
+        pid_sorted=fmt.pid_sorted, l1_mode=l1 is not None)
     for s0 in range(0, k, window):
         s1 = min(s0 + window, k)
         if wire._device_slab is not None:
@@ -1705,7 +1716,7 @@ def replay_resident_wire_batched(keys,
             accs = _chunk_step_rle_batch(
                 c, keys, payload[c - s0], int(wire.counts[c]),
                 int(wire.n_uniq[c]), accs, linf, l0, rlo, rhi, mid, glo,
-                ghi, num_partitions=num_partitions, fmt=fmt,
+                ghi, l1, num_partitions=num_partitions, fmt=fmt,
                 need_flags=tuple(need_flags),
                 has_group_clip=has_group_clip)
             # ONE launch covers all B configs; the sort model runs B

@@ -955,6 +955,180 @@ def replay_resident_wire(mesh: Mesh,
         sort_stats=sort_stats)
 
 
+def _reduce_scatter_lanes(x, scatter_axes):
+    # Batched twin of _reduce_scatter: lane dim 0 is replicated, the
+    # partition dim 1 scatters in the same ICI-first order.
+    for axis in scatter_axes:
+        x = jax.lax.psum_scatter(x, axis, scatter_dimension=1, tiled=True)
+    return x
+
+
+@functools.lru_cache(maxsize=None)
+def _codec_batch_kernel(mesh: Mesh, padded_p: int, fmt, has_l1: bool,
+                        need_flags, has_group_clip: bool):
+    """Batched twin of _codec_scalar_kernel: ONE launch folds a chunk for
+    B query configs. Each device decodes its codec bucket once, vmaps the
+    bounding kernel over the per-config (key, caps, clip bounds) lanes,
+    and reduce-scatters the [B, padded_p] partials along the partition
+    dim. Per-config lanes match that config's sequential mesh replay: the
+    per-device key schedule is the same _device_key(fold_in(key_b, c))
+    and each lane's bounding math is independent."""
+    from pipelinedp_tpu.ops import streaming
+
+    axes = tuple(mesh.axis_names)
+    scatter_axes = _scatter_axes(mesh)
+
+    def local_step(keys, row, n_valid, n_uniq, linf_caps, l0_caps,
+                   row_clip_los, row_clip_his, middles, group_clip_los,
+                   group_clip_his, *l1_args):
+        pid, pk, value, valid, vkw = streaming._decode_for_kernel(
+            row[0], n_valid[0], n_uniq[0], fmt)
+
+        def one(key, linf_cap, l0_cap, row_clip_lo, row_clip_hi, middle,
+                group_clip_lo, group_clip_hi, l1_cap=None):
+            return columnar.bound_and_aggregate(
+                _device_key(key, axes), pid, pk, value, valid,
+                num_partitions=padded_p,
+                linf_cap=linf_cap,
+                l0_cap=l0_cap,
+                row_clip_lo=row_clip_lo,
+                row_clip_hi=row_clip_hi,
+                middle=middle,
+                group_clip_lo=group_clip_lo,
+                group_clip_hi=group_clip_hi,
+                l1_cap=l1_cap,
+                need_count=need_flags[0],
+                need_sum=need_flags[1],
+                need_norm=need_flags[2],
+                need_norm_sq=need_flags[3],
+                has_group_clip=has_group_clip,
+                pid_sorted=fmt.pid_sorted,
+                max_segments=fmt.ucap if fmt.pid_sorted else None,
+                **vkw)
+
+        if has_l1:
+            accs = jax.vmap(one)(keys, linf_caps, l0_caps, row_clip_los,
+                                 row_clip_his, middles, group_clip_los,
+                                 group_clip_his, l1_args[0])
+        else:
+            accs = jax.vmap(one)(keys, linf_caps, l0_caps, row_clip_los,
+                                 row_clip_his, middles, group_clip_los,
+                                 group_clip_his)
+        return columnar.PartitionAccumulators(
+            *(_reduce_scatter_lanes(a, scatter_axes) for a in accs))
+
+    spec = _spec(mesh)
+    lane_part = P(None, _scatter_axes(mesh))
+    fn = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(), spec, spec, spec) + (P(),) * (8 if has_l1 else 7),
+        out_specs=columnar.PartitionAccumulators(*(lane_part,) * 5),
+        check_vma=False)
+    return jax.jit(fn)
+
+
+@jax.jit
+def _fold_lane_keys(keys, c):
+    # The engine's per-chunk key schedule, one lane per config.
+    return jax.vmap(jax.random.fold_in, in_axes=(0, None))(keys, c)
+
+
+def replay_resident_wire_batched(mesh: Mesh,
+                                 keys,
+                                 wire,
+                                 *,
+                                 linf_caps,
+                                 l0_caps,
+                                 row_clip_los,
+                                 row_clip_his,
+                                 middles,
+                                 group_clip_los,
+                                 group_clip_his,
+                                 l1_caps=None,
+                                 need_flags=(True, True, True, True),
+                                 has_group_clip: bool = True
+                                 ) -> columnar.PartitionAccumulators:
+    """Folds a mesh-ingested ResidentWire for B query configs in ONE
+    launch per chunk — the multi-chip twin of
+    streaming.replay_resident_wire_batched. Returns [B, padded_p]
+    PartitionAccumulators sharded over the partition dim (lane dim
+    replicated); lane b is bit-identical to that config's sequential
+    replay_resident_wire(mesh, ...) fold, and therefore to its cold mesh
+    run. Uses the parity-oracle statics (untiled packed sort, float32
+    payload/accumulation, no hash bins), which the segment-sort parity
+    matrix pins bit-identical to every other mode.
+    """
+    from pipelinedp_tpu import profiler
+    from pipelinedp_tpu.ops import streaming
+
+    import dataclasses
+
+    n_dev = mesh.devices.size
+    if wire.n_dev != n_dev:
+        raise ValueError(
+            f"handle was ingested for {wire.n_dev} devices; this mesh has "
+            f"{n_dev}")
+    padded_p = padded_num_partitions(mesh, wire.num_partitions)
+    B = len(linf_caps)
+    lane_sharding = NamedSharding(mesh, P(None, _scatter_axes(mesh)))
+    if wire.n_rows == 0:
+        return columnar.PartitionAccumulators(
+            *(jax.device_put(np.zeros((B, padded_p), np.float32),
+                             lane_sharding) for _ in range(5)))
+    profiler.count_event(streaming.EVENT_SERVING_REPLAYS)
+    from pipelinedp_tpu.obs import trace as obs_trace
+    obs_trace.event("wire_replay_batched", n_chunks=wire.n_chunks,
+                    n_dev=n_dev, width=B)
+    fmt = dataclasses.replace(wire.fmt, tile_rows=0, tile_slack=0,
+                              hash_bins=0, hash_bin_rows=0,
+                              sort_value_narrow=False)
+    kernel = _codec_batch_kernel(mesh, padded_p, fmt,
+                                 l1_caps is not None, tuple(need_flags),
+                                 has_group_clip)
+    keys = jnp.stack([jnp.asarray(k) for k in keys])
+    linf = jnp.asarray(np.asarray(linf_caps, dtype=np.int32))
+    l0 = jnp.asarray(np.asarray(l0_caps, dtype=np.int32))
+    rlo = jnp.asarray(np.asarray(row_clip_los, dtype=np.float32))
+    rhi = jnp.asarray(np.asarray(row_clip_his, dtype=np.float32))
+    mid = jnp.asarray(np.asarray(middles, dtype=np.float32))
+    glo = jnp.asarray(np.asarray(group_clip_los, dtype=np.float32))
+    ghi = jnp.asarray(np.asarray(group_clip_his, dtype=np.float32))
+    l1 = (None if l1_caps is None
+          else jnp.asarray(np.asarray(l1_caps, dtype=np.int32)))
+    sharding = NamedSharding(mesh, _spec(mesh))
+    counts = np.asarray(wire.counts, dtype=np.int32)
+    n_uniq = np.asarray(wire.n_uniq, dtype=np.int32)
+    cost = columnar.sort_cost(
+        fmt.cap, num_partitions=padded_p,
+        max_segments=fmt.ucap if fmt.pid_sorted else None,
+        pid_sorted=fmt.pid_sorted, l1_mode=l1 is not None)
+    accs = None
+    for c in range(wire.n_chunks):
+        dslab = jax.device_put(wire.slab[c * n_dev:(c + 1) * n_dev],
+                               sharding)
+        dvalid = jax.device_put(counts[c * n_dev:(c + 1) * n_dev],
+                                sharding)
+        duniq = jax.device_put(n_uniq[c * n_dev:(c + 1) * n_dev], sharding)
+        args = (_fold_lane_keys(keys, c), dslab, dvalid, duniq,
+                linf, l0, rlo, rhi, mid, glo, ghi)
+        if l1 is not None:
+            args += (l1,)
+        chunk_accs = kernel(*args)
+        # First chunk's partials ARE the accumulators, exactly as
+        # _MeshPlacement.step folds the sequential replay.
+        accs = (chunk_accs if accs is None else
+                columnar.PartitionAccumulators(
+                    *(a + b for a, b in zip(accs, chunk_accs))))
+        # ONE launch covers all B configs across n_dev bucket stages.
+        profiler.count_event(streaming.EVENT_SERVING_LAUNCHES)
+        profiler.count_event(columnar.EVENT_SORT_ROWS,
+                             int(cost["rows"]) * B * n_dev)
+        profiler.count_event(columnar.EVENT_SORT_BYTES,
+                             int(cost["operand_bytes"]) * B * n_dev)
+    return accs
+
+
 class _MeshPlacement(driver_lib.DevicePlacement):
     """Mesh strategy for the unified slab driver (runtime/driver.py owns
     the loop; this class owns how a chunk's sharded slab lands on the

@@ -45,6 +45,7 @@ import json
 import os
 import tempfile
 import threading
+import time
 from typing import List, Optional, Tuple
 
 from pipelinedp_tpu import profiler
@@ -94,6 +95,15 @@ class JsonlWal:
         self.recovered: List[dict] = self._recover()
         self._fh = open(self._path, "ab")
         self._next_seq = len(self.recovered)
+        # Group-commit state: appends under ``sync=False`` are written
+        # and flushed but not yet fsync'd; ``sync_through`` runs one
+        # fsync covering every write up to its ticket (leader/follower —
+        # concurrent callers coalesce behind a single fsync).
+        self._io_lock = threading.Lock()
+        self._sync_cond = threading.Condition()
+        self._written_ticket = 0   # monotone count of appended records
+        self._synced_ticket = 0    # fsync has covered tickets <= this
+        self._sync_leader = False
 
     @property
     def path(self) -> str:
@@ -166,19 +176,89 @@ class JsonlWal:
                 f.truncate(good_end)
         return payloads
 
-    def append(self, payload: dict) -> int:
+    def append(self, payload: dict, sync: bool = True) -> int:
         """Durably appends one payload (must carry its ``seq``; must not
-        carry a ``digest`` key); returns the bytes written."""
+        carry a ``digest`` key); returns the bytes written.
+
+        With ``sync=False`` the line is written and flushed to the OS
+        (it survives SIGKILL via the page cache) but not fsync'd — the
+        caller must follow with :meth:`sync_through` before treating the
+        record as committed against power loss. Group commit rides this:
+        many appends, one fsync."""
         if "digest" in payload:
             raise ValueError("payload key 'digest' is reserved by the WAL")
         line = self._line(payload)
-        self._fh.write(line)
-        self._fh.flush()
-        os.fsync(self._fh.fileno())
-        seq = payload.get("seq")
-        if isinstance(seq, int):
-            self._next_seq = max(self._next_seq, seq + 1)
+        with self._io_lock:
+            self._fh.write(line)
+            self._fh.flush()
+            self._written_ticket += 1
+            ticket = self._written_ticket
+            fd = self._fh.fileno()
+            seq = payload.get("seq")
+            if isinstance(seq, int):
+                self._next_seq = max(self._next_seq, seq + 1)
+        if sync:
+            # fsync OUTSIDE the io lock: contenders keep writing while
+            # storage syncs (an fsync covers every byte written before
+            # it runs, so crediting `ticket` stays conservative).
+            os.fsync(fd)
+            with self._sync_cond:
+                if ticket > self._synced_ticket:
+                    self._synced_ticket = ticket
+                    self._sync_cond.notify_all()
         return len(line)
+
+    def sync_ticket(self) -> int:
+        """The current write ticket: passing it to :meth:`sync_through`
+        guarantees every append that returned before this call is
+        fsync'd. Callers serializing their own appends (the serving
+        append WAL holds its append lock across append + sync_ticket)
+        get exactly their record's ticket."""
+        with self._io_lock:
+            return self._written_ticket
+
+    @property
+    def synced_ticket(self) -> int:
+        """Tickets <= this are fsync'd (durable against power loss)."""
+        with self._sync_cond:
+            return self._synced_ticket
+
+    def sync_through(self, ticket: int, window_s: float = 0.0) -> None:
+        """Blocks until every append up to ``ticket`` is fsync'd,
+        coalescing concurrent callers behind one fsync (group commit).
+
+        One caller becomes the leader: it optionally waits ``window_s``
+        (a bounded commit window, letting more appends land), then runs
+        a single fsync covering everything written so far and wakes the
+        followers. Followers whose ticket is still uncovered loop and
+        elect a new leader."""
+        while True:
+            with self._sync_cond:
+                if self._synced_ticket >= ticket:
+                    return
+                if self._sync_leader:
+                    self._sync_cond.wait(timeout=1.0)
+                    continue
+                self._sync_leader = True
+            covered = None
+            try:
+                if window_s > 0.0:
+                    time.sleep(window_s)
+                with self._io_lock:
+                    target = self._written_ticket
+                    self._fh.flush()
+                    fd = self._fh.fileno()
+                # fsync OUTSIDE the io lock so appenders never stall on
+                # storage latency; it covers every byte flushed above,
+                # so crediting `target` afterwards stays conservative.
+                os.fsync(fd)
+                covered = target
+            finally:
+                with self._sync_cond:
+                    self._sync_leader = False
+                    if covered is not None and covered > self._synced_ticket:
+                        self._synced_ticket = covered
+                    self._sync_cond.notify_all()
 
     def rewrite(self, payloads) -> None:
         """Atomically replaces the file with ``payloads`` (compaction;
@@ -197,10 +277,18 @@ class JsonlWal:
             if os.path.exists(tmp):
                 os.unlink(tmp)
             raise
-        if self._fh is not None:
-            self._fh.close()
-        self._fh = open(self._path, "ab")
-        self._next_seq = len(payloads)
+        with self._io_lock:
+            if self._fh is not None:
+                self._fh.close()
+            self._fh = open(self._path, "ab")
+            self._next_seq = len(payloads)
+            covered = self._written_ticket
+        with self._sync_cond:
+            # The rewritten file is fully fsync'd: every prior append is
+            # durable by construction.
+            if covered > self._synced_ticket:
+                self._synced_ticket = covered
+            self._sync_cond.notify_all()
 
     def close(self) -> None:
         if self._fh is not None:

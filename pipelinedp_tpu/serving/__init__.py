@@ -56,11 +56,15 @@ budget semantics and the interaction with checkpoint/resume.
 
 from pipelinedp_tpu.serving.session import (  # noqa: F401
     EVENT_BOUND_EVICTIONS, EVENT_BOUND_HITS, EVENT_BOUND_MISSES,
-    EVENT_DEADLINE_HITS, EVENT_DEVICE_FALLBACKS, EVENT_QUERIES,
-    EVENT_REHYDRATIONS, BATCH_WIDTH_ENV, DEADLINE_ENV, RESIDENT_BYTES_ENV,
+    EVENT_DEADLINE_HITS, EVENT_DEVICE_FALLBACKS, EVENT_PLANNER_CACHE_SKIPS,
+    EVENT_PLANNER_DEDUPES, EVENT_PLANNER_GROUPS, EVENT_QUERIES,
+    EVENT_REHYDRATIONS, BATCH_WIDTH_ENV, DEADLINE_ENV,
+    EPILOGUE_WORKERS_ENV, RESIDENT_BYTES_ENV,
     DatasetSession, QueryConfig, SessionClosedError, StaleDatasetError,
-    TenantState, batch_width, default_deadline_s, resident_byte_budget,
-    serving_counters)
+    TenantState, batch_width, default_deadline_s, epilogue_workers,
+    resident_byte_budget, serving_counters)
+from pipelinedp_tpu.serving.planner import (  # noqa: F401
+    LaunchGroup, PlanEntry, QueryPlan, ReplayLane, compile_plan)
 from pipelinedp_tpu.serving.store import (  # noqa: F401
     EVENT_BOUND_DROPPED, EVENT_OPENS, EVENT_SAVES, SESSION_DIR_ENV,
     SessionCorruptError, SessionNotFoundError, SessionStore,
@@ -73,9 +77,10 @@ from pipelinedp_tpu.serving.live import (  # noqa: F401
     EVENT_APPENDS, EVENT_APPEND_DUPLICATES, EVENT_APPENDS_SHED,
     EVENT_EPOCH_FOLDS, EVENT_LATE_DEADLETTERED, EVENT_LATE_REJECTED,
     EVENT_RELEASES_RECOVERED, EVENT_RELEASES_SUPPRESSED,
-    EVENT_SCHEDULED_RELEASES, MAX_PENDING_ENV, AppendResult,
-    IngestOverloadedError, LateArrivalError, LiveDatasetSession,
-    ReleaseSchedule, WindowSpec, live_counters,
+    EVENT_SCHEDULED_RELEASES, APPEND_COMMIT_WINDOW_ENV, MAX_PENDING_ENV,
+    AppendResult, IngestOverloadedError, LateArrivalError,
+    LiveDatasetSession, ReleaseSchedule, WindowSpec,
+    append_commit_window_s, live_counters,
     max_pending_appends_default, window_seed)
 from pipelinedp_tpu.budget_accounting import (  # noqa: F401
     BudgetExhaustedError, TenantBudgetLedger)

@@ -80,11 +80,20 @@ from pipelinedp_tpu.serving.session import DatasetSession
 #     max_pending_appends= overrides, including an explicit 0 (shed
 #     everything — the backpressure tests use it).
 MAX_PENDING_ENV = "PIPELINEDP_TPU_MAX_PENDING_APPENDS"
+#   PIPELINEDP_TPU_APPEND_COMMIT_WINDOW_MS — bounded group-commit
+#     window (default 0): the fsync leader waits this long so racing
+#     appends ride one fsync. 0 still group-commits opportunistically
+#     (appends that land while a leader is fsyncing coalesce behind the
+#     next leader); >0 trades append latency for fewer fsyncs.
+APPEND_COMMIT_WINDOW_ENV = "PIPELINEDP_TPU_APPEND_COMMIT_WINDOW_MS"
 # Test seam for the kill harness (tests/kill_harness.py): "<stage>" or
 # "<stage>@<n>" SIGKILLs the process at that append/release stage —
-# "encode" fires before the WAL commit point (reopen lands at epoch N),
-# "fold" after it (reopen lands at N+1), "release" between a scheduled
-# window's release and its outcome record (catch-up recovers it).
+# "encode" fires before the WAL record is written (reopen lands at
+# epoch N), "commit" after the record is written+flushed but before the
+# group fsync (the page cache survives SIGKILL, so reopen lands at N+1;
+# only power loss could tear it), "fold" after the fsync barrier
+# (reopen lands at N+1), "release" between a scheduled window's release
+# and its outcome record (catch-up recovers it).
 LIVE_CRASH_ENV = "PIPELINEDP_TPU_LIVE_CRASH"
 
 # Profiler event counters (profiler.count_event / event_count):
@@ -103,6 +112,13 @@ def max_pending_appends_default() -> int:
     """Validated PIPELINEDP_TPU_MAX_PENDING_APPENDS (default 64)."""
     from pipelinedp_tpu.native import loader
     return loader.env_int(MAX_PENDING_ENV, 64, 1, 1 << 16)
+
+
+def append_commit_window_s() -> float:
+    """Validated PIPELINEDP_TPU_APPEND_COMMIT_WINDOW_MS as seconds
+    (default 0: opportunistic coalescing only)."""
+    from pipelinedp_tpu.native import loader
+    return loader.env_int(APPEND_COMMIT_WINDOW_ENV, 0, 0, 1000) / 1000.0
 
 
 def live_counters() -> Dict[str, int]:
@@ -379,6 +395,16 @@ class LiveDatasetSession(DatasetSession):
         self._has_value: Optional[bool] = None
         self._window_wires: Dict[tuple, Any] = {}
         self._wal: Optional[journal_lib.JsonlWal] = None
+        # Group-commit state (SERVING.md "The append commit point"):
+        # epochs are assigned and WAL-written under _append_lock, then
+        # *staged* until the group fsync covers their WAL ticket; only
+        # then do they promote (in epoch order) into _epochs. The fold
+        # coalesces: one union re-encode may cover several promotions.
+        self._next_epoch = 0
+        self._staged: Dict[int, dict] = {}        # epoch -> staged rec
+        self._staged_digests: Dict[str, dict] = {}  # digest -> same rec
+        self._fold_lock = threading.Lock()
+        self._folded_epochs = 0
 
     # -- identity & status ------------------------------------------------
 
@@ -497,16 +523,35 @@ class LiveDatasetSession(DatasetSession):
                     f"payloads are allow_pickle=False npz")
         digest = streaming.input_digest(pid, pk, value)
         store, name = self._store_binding
+        # Phase A (under _append_lock): validate, write the epoch
+        # payload + WAL record (flushed, not yet fsync'd), stage. The
+        # fsync itself happens OUTSIDE the lock so concurrent appends
+        # coalesce behind one group commit instead of serializing on
+        # per-record fsyncs.
+        dup_staged = None
         with self._append_lock:
             self._check_open()
             # Idempotency FIRST — before event assignment, so a blind
             # re-submit of a committed batch never re-enters as a new
-            # (possibly late) event.
-            if digest in self._digests:
+            # (possibly late) event. Promotion mutates the committed
+            # maps under self._lock, so read them under it too.
+            with self._lock:
+                prior_epoch = self._digests.get(digest)
+                prior = (self._epochs[prior_epoch]
+                         if prior_epoch is not None else None)
+                if prior is None:
+                    dup_staged = self._staged_digests.get(digest)
+                dead = digest in self._deadletters
+                eff_max_event = self._max_event
+                has_value = self._has_value
+                for rec in self._staged.values():
+                    eff_max_event = max(eff_max_event,
+                                        rec["event_epoch"])
+                    if has_value is None:
+                        has_value = rec["value_present"]
+            if prior is not None:
                 profiler.count_event(EVENT_APPEND_DUPLICATES)
                 obs_trace.event("append_duplicate", digest=digest)
-                prior_epoch = self._digests[digest]
-                prior = self._epochs[prior_epoch]
                 obs_metrics.append_seconds().observe(
                     time.perf_counter() - t0)
                 return AppendResult(
@@ -514,7 +559,7 @@ class LiveDatasetSession(DatasetSession):
                     n_rows=prior["n_rows"],
                     event_epoch=prior["event_epoch"], committed=False,
                     duplicate=True)
-            if digest in self._deadletters:
+            if dead:
                 profiler.count_event(EVENT_APPEND_DUPLICATES)
                 obs_metrics.append_seconds().observe(
                     time.perf_counter() - t0)
@@ -523,72 +568,144 @@ class LiveDatasetSession(DatasetSession):
                     event_epoch=(event_epoch if event_epoch is not None
                                  else -1),
                     committed=False, duplicate=True, dead_lettered=True)
-            if event_epoch is None:
-                event_epoch = self._max_event + 1
-            event_epoch = int(event_epoch)
-            if event_epoch < 0:
-                raise ValueError(
-                    f"event_epoch must be >= 0, got {event_epoch}")
-            horizon = self._max_event - self._live_window.allowed_lateness
-            if event_epoch < horizon:
-                return self._handle_late(store, name, digest, pid, pk,
-                                         value, event_epoch, horizon, t0)
-            if value is not None and self._has_value is False or \
-                    value is None and self._has_value is True:
-                raise ValueError(
-                    "value column presence must be consistent across "
-                    "a live session's appends (the union fold encodes "
-                    "one value plan)")
-            epoch = len(self._epochs)
-            with obs_trace.span("serving/append", session=self._name,
-                                epoch=epoch, n_rows=n,
-                                event_epoch=event_epoch):
-                obs_flight.record("append_start", session=self._name,
-                                  epoch=epoch, digest=digest, n_rows=n,
-                                  event_epoch=event_epoch)
-                # Durable payload, then the pre-commit micro-encode:
-                # re-drives the SlabDriver ingest schedule over JUST
-                # the new rows, so rows that cannot encode (value
-                # overflow, bad ids) fail HERE — before the WAL commit,
-                # leaving the session untouched at epoch N.
-                store.save_epoch(name, epoch, pid, pk, value)
-                self._micro_encode(pid, pk, value)
-                _maybe_crash("encode", epoch)
-                # THE commit point: one fsync'd WAL record. Before it,
-                # the epoch does not exist; after it, reopen folds it.
-                # "digest" is the WAL's own per-record key; the batch
-                # identity travels as content_digest.
-                self._wal.append({
-                    "seq": self._wal.next_seq, "kind": "append",
-                    "epoch": epoch, "content_digest": digest,
-                    "n_rows": n, "event_epoch": event_epoch})
-                _maybe_crash("fold", epoch)
-                # In-memory fold: union re-encode + atomic epoch bump.
-                with self._lock:
-                    self._epochs.append({
+            if dup_staged is None:
+                if event_epoch is None:
+                    event_epoch = eff_max_event + 1
+                event_epoch = int(event_epoch)
+                if event_epoch < 0:
+                    raise ValueError(
+                        f"event_epoch must be >= 0, got {event_epoch}")
+                horizon = (eff_max_event
+                           - self._live_window.allowed_lateness)
+                if event_epoch < horizon:
+                    return self._handle_late(store, name, digest, pid,
+                                             pk, value, event_epoch,
+                                             horizon, t0)
+                if value is not None and has_value is False or \
+                        value is None and has_value is True:
+                    raise ValueError(
+                        "value column presence must be consistent across "
+                        "a live session's appends (the union fold encodes "
+                        "one value plan)")
+                epoch = self._next_epoch
+                with obs_trace.span("serving/append", session=self._name,
+                                    epoch=epoch, n_rows=n,
+                                    event_epoch=event_epoch):
+                    obs_flight.record("append_start", session=self._name,
+                                      epoch=epoch, digest=digest,
+                                      n_rows=n, event_epoch=event_epoch)
+                    # Durable payload, then the pre-commit micro-encode:
+                    # re-drives the SlabDriver ingest schedule over JUST
+                    # the new rows, so rows that cannot encode (value
+                    # overflow, bad ids) fail HERE — before the WAL
+                    # record exists, leaving the session untouched at
+                    # epoch N.
+                    store.save_epoch(name, epoch, pid, pk, value)
+                    self._micro_encode(pid, pk, value)
+                    _maybe_crash("encode", epoch)
+                    # The commit record: written + flushed here; durable
+                    # against power loss only after the group fsync
+                    # below. "digest" is the WAL's own per-record key;
+                    # the batch identity travels as content_digest.
+                    self._wal.append({
+                        "seq": self._wal.next_seq, "kind": "append",
+                        "epoch": epoch, "content_digest": digest,
+                        "n_rows": n, "event_epoch": event_epoch},
+                        sync=False)
+                    _maybe_crash("commit", epoch)
+                    ticket = self._wal.sync_ticket()
+                    staged = {
                         "epoch": epoch, "digest": digest, "n_rows": n,
-                        "event_epoch": event_epoch})
-                    self._epoch_rows[epoch] = (pid, pk, value)
-                    self._digests[digest] = epoch
-                    self._max_event = max(self._max_event, event_epoch)
-                    if self._has_value is None:
-                        self._has_value = value is not None
-                old_fp = self._wire.fingerprint
-                new_wire = self._fold_union()
-                with self._lock:
-                    self._wire = new_wire
-                    self._sweep_stale_bound_entries(old_fp)
-                if (self._mesh is None and new_wire.n_rows > 0
-                        and new_wire.host_nbytes <= self._byte_budget):
-                    new_wire.ensure_device()
-                profiler.count_event(EVENT_APPENDS)
-                profiler.count_event(EVENT_EPOCH_FOLDS)
-                obs_flight.record("append_commit", session=self._name,
-                                  epoch=epoch, digest=digest,
-                                  fingerprint=new_wire.fingerprint)
+                        "event_epoch": event_epoch, "ticket": ticket,
+                        "rows": (pid, pk, value),
+                        "value_present": value is not None}
+                    with self._lock:
+                        self._staged[epoch] = staged
+                        self._staged_digests[digest] = staged
+                    self._next_epoch = epoch + 1
+        if dup_staged is not None:
+            # A racing append already wrote this batch's WAL record but
+            # has not fsync'd yet: ride its group commit, then report
+            # the duplicate against the promoted epoch.
+            self._wal.sync_through(dup_staged["ticket"])
+            self._promote_staged()
+            profiler.count_event(EVENT_APPEND_DUPLICATES)
+            obs_trace.event("append_duplicate", digest=digest)
             obs_metrics.append_seconds().observe(time.perf_counter() - t0)
-            return AppendResult(epoch=epoch, digest=digest, n_rows=n,
-                                event_epoch=event_epoch, committed=True)
+            return AppendResult(
+                epoch=dup_staged["epoch"], digest=digest,
+                n_rows=dup_staged["n_rows"],
+                event_epoch=dup_staged["event_epoch"], committed=False,
+                duplicate=True)
+        # Phase B: THE commit point — the group fsync. One leader
+        # fsyncs for every staged append up to its ticket (bounded
+        # coalescing window via PIPELINEDP_TPU_APPEND_COMMIT_WINDOW_MS).
+        # Before it, the epoch does not exist (against power loss);
+        # after it, reopen folds it.
+        self._wal.sync_through(ticket,
+                               window_s=append_commit_window_s())
+        _maybe_crash("fold", epoch)
+        # Phase C: ordered promotion into the committed maps
+        # (idempotent — whichever thread reaches an epoch first
+        # promotes it; epochs promote strictly in order).
+        self._promote_staged()
+        # Phase D: the coalesced union fold — one re-encode may cover
+        # several freshly promoted epochs.
+        fingerprint = self._fold_committed()
+        profiler.count_event(EVENT_APPENDS)
+        obs_flight.record("append_commit", session=self._name,
+                          epoch=epoch, digest=digest,
+                          fingerprint=fingerprint)
+        obs_metrics.append_seconds().observe(time.perf_counter() - t0)
+        return AppendResult(epoch=epoch, digest=digest, n_rows=n,
+                            event_epoch=event_epoch, committed=True)
+
+    def _promote_staged(self) -> None:
+        """Moves fsync-covered staged epochs into the committed maps,
+        strictly in epoch order (any thread may run this; promotion is
+        idempotent under self._lock). A staged epoch promotes once the
+        WAL's synced ticket covers its record."""
+        synced = self._wal.synced_ticket
+        while True:
+            with self._lock:
+                rec = self._staged.get(len(self._epochs))
+                if rec is None or rec["ticket"] > synced:
+                    return
+                epoch = rec["epoch"]
+                self._epochs.append({
+                    "epoch": epoch, "digest": rec["digest"],
+                    "n_rows": rec["n_rows"],
+                    "event_epoch": rec["event_epoch"]})
+                self._epoch_rows[epoch] = rec["rows"]
+                self._digests[rec["digest"]] = epoch
+                self._max_event = max(self._max_event,
+                                      rec["event_epoch"])
+                if self._has_value is None:
+                    self._has_value = rec["value_present"]
+                del self._staged[epoch]
+                self._staged_digests.pop(rec["digest"], None)
+
+    def _fold_committed(self) -> str:
+        """The coalesced in-memory fold: re-encodes the union of the
+        committed epochs unless a concurrent fold already covered them
+        (one union re-encode may serve several promotions). Returns the
+        current wire fingerprint."""
+        with self._fold_lock:
+            with self._lock:
+                target = len(self._epochs)
+                if self._folded_epochs >= target:
+                    return self._wire.fingerprint
+            old_fp = self._wire.fingerprint
+            new_wire = self._fold_union()
+            with self._lock:
+                self._wire = new_wire
+                self._folded_epochs = target
+                self._sweep_stale_bound_entries(old_fp)
+            if (self._mesh is None and new_wire.n_rows > 0
+                    and new_wire.host_nbytes <= self._byte_budget):
+                new_wire.ensure_device()
+            profiler.count_event(EVENT_EPOCH_FOLDS)
+            return new_wire.fingerprint
 
     def _handle_late(self, store, name, digest, pid, pk, value,
                      event_epoch, horizon, t0) -> AppendResult:
@@ -706,6 +823,12 @@ class LiveDatasetSession(DatasetSession):
         return self._accumulate_wire(
             wire, ("wire_fp", wire.fingerprint), k_kernel, mesh=mesh,
             resilience=resilience, **kw)
+
+    def _batch_key_prefix(self):
+        # query_batch's planner keys must match _accumulate's cache
+        # keys exactly, or batch-warmed entries would never hit (and a
+        # fold's sweep would miss them).
+        return ("wire_fp", self._wire.fingerprint)
 
     # -- window queries ---------------------------------------------------
 
@@ -827,7 +950,9 @@ class LiveDatasetSession(DatasetSession):
             if self._has_value is None:
                 self._has_value = value is not None
         self._deadletters = set(store.deadletter_digests(name))
+        self._next_epoch = len(self._epochs)
         self._wire = self._fold_union()
+        self._folded_epochs = len(self._epochs)
         if (mesh is None and self._wire.n_rows > 0
                 and self._wire.host_nbytes <= self._byte_budget):
             self._wire.ensure_device()

@@ -234,6 +234,14 @@ class SessionManager:
         first when spilled); equivalent to ``get(name).query(...)``."""
         return self.get(name).query(params, **query_kwargs)
 
+    def query_batch(self, name: str, configs, **batch_kwargs):
+        """Routes a batch through the session's query planner
+        (re-hydrating first when spilled); equivalent to
+        ``get(name).query_batch(...)``. The whole batch rides one
+        admission slot — shedding is all-or-nothing, matching the
+        plan's all-or-nothing refund domain."""
+        return self.get(name).query_batch(configs, **batch_kwargs)
+
     @contextlib.contextmanager
     def admission(self):
         """The bounded in-flight gate: entered by every query of a

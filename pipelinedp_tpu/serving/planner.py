@@ -1,0 +1,158 @@
+"""Query planner for the serving batch path (SERVING.md "Query plane").
+
+`DatasetSession.query_batch` compiles its configs through this module
+BEFORE any launch: the planner decides, as pure data, which configs skip
+replay entirely (their resolved-sampler bound key is already cached),
+which configs share one replay lane (identical bound keys dedupe to a
+single vmapped lane), and how the surviving lanes fuse into launch
+groups (configs whose kernel statics agree ride one batched launch).
+Budget, release-journal, and audit state never enter the plan — each
+config keeps its own; the plan only routes accumulator work.
+
+Everything here is deliberately free of session/device state so plans
+are unit-testable as plain objects: the session supplies hashable bound
+keys and fusion keys, the planner returns index routing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Hashable, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanEntry:
+    """One query config as the planner sees it.
+
+    bound_key: the config's resolved-sampler accumulator-cache key (the
+    exact key `_accumulate_wire` would use), or None when the config is
+    not cacheable; fusion_key: the kernel statics the batched replay is
+    specialized on — configs must share it to share a launch;
+    need_flags: the config's own accumulator-column needs (used to union
+    flags per group and to gate cache inserts on exact-column parity).
+    """
+    index: int
+    bound_key: Optional[Hashable]
+    fusion_key: Hashable
+    need_flags: Tuple[bool, bool, bool, bool]
+    cached: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplayLane:
+    """One vmapped lane of a launch group: the owner index's config
+    parameterizes the lane; follower indexes had an identical bound key
+    and reuse the lane's accumulators without replaying."""
+    owner: int
+    followers: Tuple[int, ...] = ()
+
+    @property
+    def indexes(self) -> Tuple[int, ...]:
+        return (self.owner,) + self.followers
+
+
+@dataclasses.dataclass(frozen=True)
+class LaunchGroup:
+    """One batched replay launch: len(lanes) <= max_width lanes sharing
+    one set of kernel statics. union_flags is the OR of every member
+    config's need_flags (the launch computes the union of columns;
+    per-config finalize reads only its own). flags_exact[i] marks lanes
+    whose own need_flags equal the union — only those lanes' results may
+    populate the bound cache, since a solo replay of that config would
+    have produced exactly these columns."""
+    fusion_key: Hashable
+    union_flags: Tuple[bool, bool, bool, bool]
+    lanes: Tuple[ReplayLane, ...]
+    flags_exact: Tuple[bool, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class QueryPlan:
+    """The compiled batch: cache-skipped config indexes go straight to
+    finalize; launch groups replay in order. stats feed the session's
+    planner counters."""
+    groups: Tuple[LaunchGroup, ...]
+    cached_indexes: Tuple[int, ...]
+    stats: Dict[str, int]
+
+    @property
+    def n_lanes(self) -> int:
+        return sum(len(g.lanes) for g in self.groups)
+
+
+def _union(flags: Sequence[Tuple[bool, bool, bool, bool]]
+           ) -> Tuple[bool, bool, bool, bool]:
+    return (any(f[0] for f in flags), any(f[1] for f in flags),
+            any(f[2] for f in flags), any(f[3] for f in flags))
+
+
+def compile_plan(entries: Sequence[PlanEntry],
+                 max_width: int) -> QueryPlan:
+    """Compiles a batch of entries into a QueryPlan.
+
+    Three passes, all pure:
+      1. admission — entries flagged `cached` skip replay entirely;
+      2. dedupe — identical bound keys collapse to one lane (the first
+         occurrence owns the lane; later ones follow it), so duplicate
+         configs replay the wire exactly once;
+      3. fusion — lanes group by fusion_key and split at max_width; each
+         group's launch computes the union of its members' need_flags.
+
+    Entries with bound_key=None never dedupe (each owns a private lane).
+    """
+    if max_width < 1:
+        raise ValueError(f"max_width must be >= 1, got {max_width}")
+    cached: List[int] = []
+    lane_of: Dict[Hashable, int] = {}
+    lanes: List[List[int]] = []        # member entry positions
+    lane_entries: List[PlanEntry] = []  # owner entry per lane
+    dedupes = 0
+    by_pos = {e.index: e for e in entries}
+    if len(by_pos) != len(entries):
+        raise ValueError("duplicate entry indexes in batch plan")
+    for e in entries:
+        if e.cached:
+            cached.append(e.index)
+            continue
+        if e.bound_key is not None and e.bound_key in lane_of:
+            lanes[lane_of[e.bound_key]].append(e.index)
+            dedupes += 1
+            continue
+        if e.bound_key is not None:
+            lane_of[e.bound_key] = len(lanes)
+        lanes.append([e.index])
+        lane_entries.append(e)
+    # Fusion: preserve first-seen order of fusion keys, then split wide
+    # groups at max_width (matching the pre-planner launch splitting).
+    fused: Dict[Hashable, List[int]] = {}
+    for lane_idx, owner in enumerate(lane_entries):
+        fused.setdefault(owner.fusion_key, []).append(lane_idx)
+    groups: List[LaunchGroup] = []
+    for fusion_key, lane_idxs in fused.items():
+        for s in range(0, len(lane_idxs), max_width):
+            chunk = lane_idxs[s:s + max_width]
+            member_flags = []
+            for li in chunk:
+                member_flags.extend(by_pos[i].need_flags
+                                    for i in lanes[li])
+            union_flags = _union(member_flags)
+            group_lanes = tuple(
+                ReplayLane(owner=lanes[li][0],
+                           followers=tuple(lanes[li][1:]))
+                for li in chunk)
+            flags_exact = tuple(
+                lane_entries[li].bound_key is not None
+                and lane_entries[li].need_flags == union_flags
+                for li in chunk)
+            groups.append(LaunchGroup(
+                fusion_key=fusion_key, union_flags=union_flags,
+                lanes=group_lanes, flags_exact=flags_exact))
+    stats = {
+        "configs": len(entries),
+        "cache_skips": len(cached),
+        "dedupes": dedupes,
+        "lanes": len(lane_entries),
+        "fused_groups": len(groups),
+    }
+    return QueryPlan(groups=tuple(groups), cached_indexes=tuple(cached),
+                     stats=stats)

@@ -65,9 +65,15 @@ from pipelinedp_tpu.runtime import watchdog as watchdog_lib
 #     seconds (0 = none): an expired query surfaces as a typed,
 #     retryable QueryDeadlineError instead of running (or hanging)
 #     unboundedly.
+#   PIPELINEDP_TPU_EPILOGUE_WORKERS — bounded executor width for the
+#     pipelined per-config finalizes of query_batch (default 2; 0 runs
+#     epilogues synchronously). Released bits are identical at every
+#     width: the plan fixes commit order and per-config keys before any
+#     epilogue runs.
 RESIDENT_BYTES_ENV = "PIPELINEDP_TPU_RESIDENT_BYTES"
 BATCH_WIDTH_ENV = "PIPELINEDP_TPU_SERVING_BATCH"
 DEADLINE_ENV = "PIPELINEDP_TPU_QUERY_DEADLINE_S"
+EPILOGUE_WORKERS_ENV = "PIPELINEDP_TPU_EPILOGUE_WORKERS"
 
 # Profiler event counters (profiler.count_event / event_count; the
 # replay-side counters live in ops/streaming.py, the fleet-level
@@ -86,6 +92,12 @@ EVENT_DEADLINE_HITS = "serving/query_deadline_hits"
 EVENT_REHYDRATIONS = "serving/sessions_rehydrations"
 # Slow-query capture bundles written (obs/flight.py; PR 13).
 EVENT_SLOW_CAPTURES = "serving/slow_query_captures"
+# Query-plane (serving/planner.py) counters: batch configs that skipped
+# replay on a bound-cache hit, configs that deduped onto another
+# config's replay lane, and fused launch groups compiled.
+EVENT_PLANNER_CACHE_SKIPS = "serving/planner_cache_skips"
+EVENT_PLANNER_DEDUPES = "serving/planner_dedupes"
+EVENT_PLANNER_GROUPS = "serving/planner_fused_groups"
 
 # Per-process query trace ids: "q<pid>-<n>". The same id lands on the
 # query's root span (attr "qid"), its flight-recorder events, its audit
@@ -109,6 +121,14 @@ def batch_width() -> int:
     configs one vmapped launch carries; wider batches split."""
     from pipelinedp_tpu.native import loader
     return loader.env_int(BATCH_WIDTH_ENV, 32, 1, 1024)
+
+
+def epilogue_workers() -> int:
+    """Validated PIPELINEDP_TPU_EPILOGUE_WORKERS (default 2): executor
+    width for query_batch's pipelined per-config finalizes; 0 disables
+    the overlap (epilogues run synchronously after their group)."""
+    from pipelinedp_tpu.native import loader
+    return loader.env_int(EPILOGUE_WORKERS_ENV, 2, 0, 32)
 
 
 def default_deadline_s() -> Optional[float]:
@@ -135,6 +155,10 @@ def serving_counters() -> Dict[str, int]:
         "device_fallbacks": profiler.event_count(EVENT_DEVICE_FALLBACKS),
         "query_deadline_hits": profiler.event_count(EVENT_DEADLINE_HITS),
         "slow_query_captures": profiler.event_count(EVENT_SLOW_CAPTURES),
+        "planner_cache_skips": profiler.event_count(
+            EVENT_PLANNER_CACHE_SKIPS),
+        "planner_dedupes": profiler.event_count(EVENT_PLANNER_DEDUPES),
+        "planner_fused_groups": profiler.event_count(EVENT_PLANNER_GROUPS),
     }
 
 
@@ -172,6 +196,7 @@ class QueryConfig:
     noise_kind: NoiseKind = NoiseKind.LAPLACE
     max_partitions_contributed: Optional[int] = None
     max_contributions_per_partition: Optional[int] = None
+    max_contributions: Optional[int] = None
     min_value: Optional[float] = None
     max_value: Optional[float] = None
     min_sum_per_partition: Optional[float] = None
@@ -186,6 +211,7 @@ class QueryConfig:
             max_partitions_contributed=self.max_partitions_contributed,
             max_contributions_per_partition=self.
             max_contributions_per_partition,
+            max_contributions=self.max_contributions,
             min_value=self.min_value,
             max_value=self.max_value,
             min_sum_per_partition=self.min_sum_per_partition,
@@ -214,6 +240,7 @@ class _PreparedQuery:
     key_counter: int
     linf_cap: int
     l0_cap: int
+    l1_cap: Optional[int]
     row_lo: float
     row_hi: float
     glo: float
@@ -226,6 +253,12 @@ class _PreparedQuery:
     # (None for non-tenant configs).
     state: Any = None
     charge: Any = None
+    # Query-plane routing (serving/planner.py): the config's resolved
+    # bound-cache key, and the wall-clock duration of ITS replay +
+    # finalize (set when its epilogue completes; audit falls back to
+    # the batch duration when the config never finished).
+    bound_key: Any = None
+    duration_s: Optional[float] = None
 
 
 class DatasetSession:
@@ -325,6 +358,12 @@ class DatasetSession:
         self._cache_bytes = 0
         self._tenants: Dict[str, TenantState] = {}
         self._queries = 0
+        # Query-plane accounting (serving/planner.py): cumulative plan
+        # stats + replay/epilogue wall time for the overlap ratio.
+        self._planner_totals = {
+            "batches": 0, "configs": 0, "cache_skips": 0, "dedupes": 0,
+            "lanes": 0, "fused_groups": 0, "replay_s": 0.0,
+            "epilogue_s": 0.0, "wall_s": 0.0}
         self._frame_meta = None  # set by from_frame
         # Durable-fleet state (serving/store.py, serving/manager.py):
         #   _store_binding — (SessionStore, name) after save()/open();
@@ -472,6 +511,7 @@ class DatasetSession:
                 "active_queries": self._active,
                 "store": (self._store_binding[0].path(self._store_binding[1])
                           if self._store_binding is not None else None),
+                "planner": self._planner_stats_locked(),
                 "tenants": {
                     tid: {
                         "total_epsilon": st.ledger.total_epsilon,
@@ -484,6 +524,30 @@ class DatasetSession:
                     for tid, st in self._tenants.items()
                 },
             }
+
+    def _planner_stats_locked(self) -> dict:
+        """The query-plane sub-dict of stats() (caller holds _lock).
+
+        epilogue_overlap_ratio estimates how much per-config finalize
+        time was hidden behind batched replays: with replay + epilogue
+        busy time R and E inside total batch wall W, anything past W
+        must have run concurrently, so overlap = clamp((R + E - W) / E).
+        0.0 = fully sequential, 1.0 = every epilogue hidden."""
+        t = self._planner_totals
+        overlap = 0.0
+        if t["epilogue_s"] > 0.0:
+            overlap = (t["replay_s"] + t["epilogue_s"] - t["wall_s"]
+                       ) / t["epilogue_s"]
+            overlap = max(0.0, min(1.0, overlap))
+        return {
+            "batches": t["batches"],
+            "configs": t["configs"],
+            "cache_skips": t["cache_skips"],
+            "dedupes": t["dedupes"],
+            "lanes": t["lanes"],
+            "fused_groups": t["fused_groups"],
+            "epilogue_overlap_ratio": round(overlap, 4),
+        }
 
     def close(self) -> None:
         """Frees the handle (device + host) and every cache; further
@@ -1252,8 +1316,8 @@ class DatasetSession:
 
     _BATCH_UNSUPPORTED = (
         "batched resident queries support the scalar metrics "
-        "(COUNT/PRIVACY_ID_COUNT/SUM/MEAN/VARIANCE) without "
-        "max_contributions; run {} through session.query instead")
+        "(COUNT/PRIVACY_ID_COUNT/SUM/MEAN/VARIANCE); run {} through "
+        "session.query instead")
 
     def _prepare_query(self, index: int, cfg: QueryConfig,
                        secure_host_noise: Optional[bool]) -> _PreparedQuery:
@@ -1281,7 +1345,8 @@ class DatasetSession:
                if secure_host_noise is None else secure_host_noise)
         engine = jax_engine.JaxDPEngine(
             accountant, seed=cfg.seed, secure_host_noise=shn,
-            epilogue_cache=self._epilogue_cache, release_journal=journal)
+            mesh=self._mesh, epilogue_cache=self._epilogue_cache,
+            release_journal=journal)
         # Budget-request order replays engine.aggregate exactly, so the
         # per-mechanism (eps, delta) splits are identical to a sequential
         # run of the same config.
@@ -1299,9 +1364,6 @@ class DatasetSession:
         k_kernel, k_select, k_noise = jax.random.split(key, 3)
         linf_cap, l0_cap, l1_cap = jax_engine.derive_contribution_caps(
             params, compound, self.n_rows, self.num_partitions)
-        if l1_cap is not None:
-            raise NotImplementedError(
-                self._BATCH_UNSUPPORTED.format("max_contributions"))
         row_lo, row_hi, glo, ghi, middle = jax_engine.derive_clip_bounds(
             params)
         return _PreparedQuery(
@@ -1309,6 +1371,7 @@ class DatasetSession:
             compound=compound, sel_spec=sel_spec, params=params,
             k_kernel=k_kernel, k_select=k_select, k_noise=k_noise,
             key_counter=key_counter, linf_cap=linf_cap, l0_cap=l0_cap,
+            l1_cap=l1_cap,
             row_lo=row_lo, row_hi=row_hi, glo=glo, ghi=ghi, middle=middle,
             need_flags=jax_engine.derive_need_flags(compound),
             has_group_clip=bool(params.bounds_per_partition_are_set),
@@ -1319,24 +1382,29 @@ class DatasetSession:
                     *,
                     secure_host_noise: Optional[bool] = None,
                     max_width: Optional[int] = None) -> List[dict]:
-        """Answers a batch of queries that share the sorted wire in as
-        few launches as possible: configs with the same kernel statics
-        pack into one vmapped bounding launch per wire chunk (at most
-        ``max_width`` / PIPELINEDP_TPU_SERVING_BATCH configs per launch);
-        each config then finalizes through its own fused epilogue under
-        its own keys and budget.
+        """Answers a batch of queries through the query plane
+        (serving/planner.py, SERVING.md "Query plane"): the batch is
+        compiled to a QueryPlan before any launch — configs whose
+        resolved-sampler bound key is already cached skip replay
+        entirely, duplicate configs collapse onto one replay lane, and
+        the surviving lanes fuse into vmapped launch groups keyed on
+        their kernel statics (at most ``max_width`` /
+        PIPELINEDP_TPU_SERVING_BATCH lanes per launch). Per-config
+        finalizes run on a bounded executor
+        (PIPELINEDP_TPU_EPILOGUE_WORKERS) pipelined behind the next
+        group's replay; each config commits its release token before
+        any noise draw, under its own keys, budget, and journal.
 
-        Returns one released column dict per config, in input order —
-        value-for-value what ``session.query`` (and therefore a cold
-        engine run) releases for that config alone.
+        Works on single-device and mesh sessions. Returns one released
+        column dict per config, in input order — value-for-value what
+        ``session.query`` (and therefore a cold engine run) releases
+        for that config alone, at any executor width.
         """
         self._check_open()
-        if self._mesh is not None:
-            raise NotImplementedError(
-                "query_batch is single-device; mesh sessions run queries "
-                "through session.query")
         self.verify_source()
         width = max_width or batch_width()
+        shn = (self._secure_host_noise
+               if secure_host_noise is None else secure_host_noise)
         gate = (self._manager.admission()
                 if self._manager is not None else contextlib.nullcontext())
         # One trace id for the whole batched launch: every config's
@@ -1347,7 +1415,8 @@ class DatasetSession:
                           session=self._name, n_configs=len(configs))
         t_b0 = time.perf_counter()
         with obs_trace.span("serving/query_batch", session=self._name,
-                            n_configs=len(configs), qid=qid), \
+                            n_configs=len(configs),
+                            qid=qid) as batch_span, \
                 gate, self._pinned():
             prepared: List[_PreparedQuery] = []
             results: List[Optional[dict]] = [None] * len(configs)
@@ -1355,16 +1424,9 @@ class DatasetSession:
                 for i, cfg in enumerate(configs):
                     prepared.append(
                         self._prepare_query(i, cfg, secure_host_noise))
-                # Launch groups: configs sharing the kernel statics
-                # (has_group_clip — the group-stage topology) batch
-                # together.
-                groups: Dict[bool, List[_PreparedQuery]] = {}
-                for p in prepared:
-                    groups.setdefault(p.has_group_clip, []).append(p)
-                for has_group_clip, group in groups.items():
-                    for s in range(0, len(group), width):
-                        self._run_batch_group(group[s:s + width],
-                                              has_group_clip, results)
+                plan, cached_results = self._plan_batch(prepared, width)
+                self._execute_plan(plan, prepared, cached_results,
+                                   results, shn, batch_span, t_b0)
             except BaseException as exc:
                 # Exact refunds for every tenant config whose release
                 # token never committed (the failed launch group and any
@@ -1392,7 +1454,10 @@ class DatasetSession:
         """One audit record per prepared batch config. A config whose
         released columns landed in ``results`` (or whose tenant journal
         holds its token) reads ``released``; the rest take the batch
-        failure's outcome."""
+        failure's outcome. Each record carries the config's OWN
+        duration (batch start -> its epilogue completion) when it
+        finished; configs that never finished record the batch wall
+        time."""
         outcome_on_failure = (self._failure_outcome(exc)
                               if exc is not None else "refunded")
         for p in prepared:
@@ -1416,36 +1481,241 @@ class DatasetSession:
                                    str(cfg.noise_kind)),
                 epsilon=float(cfg.epsilon), delta=float(cfg.delta),
                 partitions_kept=kept, partitions_dropped=dropped,
-                duration_s=duration_s, seed=cfg.seed, trace_id=qid)
+                duration_s=(p.duration_s if p.duration_s is not None
+                            else duration_s),
+                seed=cfg.seed, trace_id=qid)
 
-    def _run_batch_group(self, group: List[_PreparedQuery],
-                         has_group_clip: bool,
-                         results: List[Optional[dict]]) -> None:
-        # The union of the group's need flags: computing a column an
-        # individual config would skip never changes the columns it does
-        # read (the sampling sorts are flag-independent), so per-config
-        # lanes still match that config's solo run bit-for-bit.
-        union_flags = tuple(
-            any(p.need_flags[i] for p in group) for i in range(4))
-        accs_b = streaming.replay_resident_wire_batched(
-            [p.k_kernel for p in group], self._wire,
-            linf_caps=[p.linf_cap for p in group],
-            l0_caps=[p.l0_cap for p in group],
-            row_clip_los=[p.row_lo for p in group],
-            row_clip_his=[p.row_hi for p in group],
-            middles=[p.middle for p in group],
-            group_clip_los=[p.glo for p in group],
-            group_clip_his=[p.ghi for p in group],
-            need_flags=union_flags,
+    # -- the query plane (serving/planner.py) ----------------------------
+
+    def _batch_key_prefix(self):
+        """Bound-cache key prefix for batched queries (None here; live
+        sessions tag entries with the wire fingerprint, matching their
+        single-query `_accumulate` override)."""
+        return None
+
+    def _batch_kw(self, p: _PreparedQuery) -> dict:
+        """The exact kw dict `JaxDPEngine._execute` hands `_accumulate`
+        for this config on the resident path — batch bound keys MUST
+        alias single-query keys, so this mirrors that call site
+        field-for-field (quantile metrics never reach the batch path,
+        hence quantile_spec=None)."""
+        return dict(
+            linf_cap=p.linf_cap, l0_cap=p.l0_cap,
+            row_clip_lo=p.row_lo, row_clip_hi=p.row_hi, middle=p.middle,
+            group_clip_lo=p.glo, group_clip_hi=p.ghi, l1_cap=p.l1_cap,
+            need_flags=p.need_flags, has_group_clip=p.has_group_clip,
+            quantile_spec=None, segment_sort=self._segment_sort,
+            compact_merge=self._compact_merge)
+
+    def _batch_bound_key(self, p: _PreparedQuery) -> tuple:
+        """The bound-cache key `_accumulate_wire` would build for this
+        config: a batch cache-skip reads exactly the accumulators the
+        sequential query would have read, and a batch lane's insert is
+        readable by subsequent single queries."""
+        kw = self._batch_kw(p)
+        key_fp = checkpoint_lib.key_fingerprint(p.k_kernel)
+        kw_for_key = {k: v for k, v in kw.items() if k != "segment_sort"}
+        cache_key = self._cache_key(key_fp, kw_for_key) + (
+            ("resolved_sampler", self._resolved_sampler(self._mesh, kw)),)
+        prefix = self._batch_key_prefix()
+        if prefix is not None:
+            cache_key = (prefix,) + cache_key
+        return cache_key
+
+    def _plan_batch(self, prepared: List[_PreparedQuery], width: int):
+        """Compiles the batch into a QueryPlan and fetches the cached
+        accumulators of every cache-skip under the lock (so a skip can
+        never race an eviction between planning and finalize)."""
+        from pipelinedp_tpu.serving import planner as planner_lib
+        entries = []
+        cached_results: Dict[int, Any] = {}
+        with self._lock:
+            self._check_open()
+            for p in prepared:
+                p.bound_key = self._batch_bound_key(p)
+                entry = self._bound_cache.get(p.bound_key)
+                if entry is not None:
+                    self._bound_cache.move_to_end(p.bound_key)
+                    cached_results[p.index] = entry.result
+                entries.append(planner_lib.PlanEntry(
+                    index=p.index, bound_key=p.bound_key,
+                    fusion_key=(p.has_group_clip, p.l1_cap is not None),
+                    need_flags=tuple(p.need_flags),
+                    cached=entry is not None))
+        plan = planner_lib.compile_plan(entries, width)
+        st = plan.stats
+        if st["cache_skips"]:
+            profiler.count_event(EVENT_BOUND_HITS, st["cache_skips"])
+            profiler.count_event(EVENT_PLANNER_CACHE_SKIPS,
+                                 st["cache_skips"])
+        if st["lanes"]:
+            profiler.count_event(EVENT_BOUND_MISSES, st["lanes"])
+        if st["dedupes"]:
+            profiler.count_event(EVENT_PLANNER_DEDUPES, st["dedupes"])
+        if st["fused_groups"]:
+            profiler.count_event(EVENT_PLANNER_GROUPS, st["fused_groups"])
+        obs_trace.event("batch_plan", **st)
+        with self._lock:
+            t = self._planner_totals
+            t["batches"] += 1
+            for k in ("configs", "cache_skips", "dedupes", "lanes",
+                      "fused_groups"):
+                t[k] += st[k]
+        return plan, cached_results
+
+    def _replay_group_batched(self, group, lanes: List[_PreparedQuery]):
+        """One launch group's batched replay (the mesh placement and the
+        single-device placement share the call shape)."""
+        has_group_clip, has_l1 = group.fusion_key
+        kwargs = dict(
+            linf_caps=[p.linf_cap for p in lanes],
+            l0_caps=[p.l0_cap for p in lanes],
+            row_clip_los=[p.row_lo for p in lanes],
+            row_clip_his=[p.row_hi for p in lanes],
+            middles=[p.middle for p in lanes],
+            group_clip_los=[p.glo for p in lanes],
+            group_clip_his=[p.ghi for p in lanes],
+            l1_caps=[p.l1_cap for p in lanes] if has_l1 else None,
+            need_flags=tuple(group.union_flags),
             has_group_clip=has_group_clip)
-        for b, p in enumerate(group):
-            p.accountant.compute_budgets()
-            # At-most-once: the release token commits before any noise
-            # draw, through this config's (tenant) journal.
-            p.engine._commit_release(p.key_counter)
+        keys = [p.k_kernel for p in lanes]
+        with obs_trace.span("serving/replay_batched", session=self._name,
+                            width=len(lanes), n_chunks=self._wire.n_chunks):
+            if self._mesh is not None:
+                from pipelinedp_tpu.parallel import sharded
+                return sharded.replay_resident_wire_batched(
+                    self._mesh, keys, self._wire, **kwargs)
+            return streaming.replay_resident_wire_batched(
+                keys, self._wire, **kwargs)
+
+    def _lane_accs(self, accs_b, b: int):
+        """Lane b's [num_partitions] accumulators out of the batched
+        [B, num_partitions] fold; on a mesh the slice is re-laid-out to
+        the partition sharding the sequential replay produces."""
+        accs = columnar.PartitionAccumulators(*(a[b] for a in accs_b))
+        if self._mesh is not None:
+            from pipelinedp_tpu.parallel import sharded
+            part = jax.sharding.NamedSharding(
+                self._mesh, sharded._part_spec(self._mesh))
             accs = columnar.PartitionAccumulators(
-                *(a[b] for a in accs_b))
-            results[p.index] = p.engine._fused_finalize(
-                p.compound, p.params, p.sel_spec, p.k_select, p.k_noise,
-                accs, None, None, self.num_partitions,
-                self._public is not None)
+                *(jax.device_put(a, part) for a in accs))
+        return accs
+
+    def _execute_plan(self, plan, prepared: List[_PreparedQuery],
+                      cached_results: Dict[int, Any],
+                      results: List[Optional[dict]], shn: bool,
+                      batch_span, t_b0: float) -> None:
+        """Runs a compiled QueryPlan: cache-skips finalize immediately,
+        launch groups replay in plan order, and per-config epilogues run
+        on the bounded executor double-buffered behind the NEXT group's
+        replay (group g's finalizes overlap group g+1's batched fold; at
+        most two groups of epilogue work ride behind the replay).
+
+        Released bits are identical at every executor width: the plan
+        fixes every config's keys and its commit-before-draw ordering up
+        front, and per-config finalize state (engine, accountant,
+        epilogue operands) is never shared. Under secure host noise the
+        executor narrows to one FIFO worker so the process-global host
+        RNG draws in plan order — deterministic for a given plan.
+
+        A failed group raises here; configs whose epilogue never
+        committed a release token are exactly refunded by query_batch's
+        except path (in-flight epilogues are drained first, so the
+        journal check races nothing).
+        """
+        from concurrent import futures as futures_lib
+
+        by_index = {p.index: p for p in prepared}
+        workers = epilogue_workers()
+        if shn:
+            workers = min(workers, 1)
+        if self._mesh is not None:
+            # Mesh sessions run epilogues inline: a worker-thread
+            # finalize on sharded accumulators, the next group's
+            # shard_map replay, and the lane-slice gathers would
+            # dispatch multi-device collectives concurrently, and
+            # interleaved collective enqueues across the mesh's device
+            # threads can deadlock. Plan-level dedupe/fusion still
+            # applies; only the overlap is single-device.
+            workers = 0
+        parent_sinks = profiler.current_sinks()
+        stats_lock = threading.Lock()
+        epilogue_s = [0.0]
+        replay_s = 0.0
+
+        def finalize_one(p: _PreparedQuery, accs) -> None:
+            t0 = time.perf_counter()
+            # Cross-thread telemetry handoff: the worker joins the batch
+            # caller's stage-time sinks and span tree.
+            with profiler.adopt_sinks(parent_sinks), \
+                    obs_trace.attach(batch_span):
+                p.accountant.compute_budgets()
+                # At-most-once: the release token commits before any
+                # noise draw, through this config's (tenant) journal.
+                p.engine._commit_release(p.key_counter)
+                results[p.index] = p.engine._fused_finalize(
+                    p.compound, p.params, p.sel_spec, p.k_select,
+                    p.k_noise, accs, None, None, self.num_partitions,
+                    self._public is not None)
+            now = time.perf_counter()
+            p.duration_s = now - t_b0
+            with stats_lock:
+                epilogue_s[0] += now - t0
+
+        executor = (futures_lib.ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="pdp-epilogue")
+            if workers > 0 else None)
+        all_futs: List[Any] = []
+
+        def submit(p: _PreparedQuery, accs) -> None:
+            if executor is None:
+                finalize_one(p, accs)
+            else:
+                all_futs.append(executor.submit(finalize_one, p, accs))
+
+        try:
+            # Cache-skips first: their accumulators are ready now, so
+            # their epilogues fill the executor while the first group's
+            # replay compiles and runs.
+            for idx in plan.cached_indexes:
+                submit(by_index[idx], cached_results[idx])
+            group_futs: List[List[Any]] = []
+            for g, group in enumerate(plan.groups):
+                if g >= 2 and executor is not None:
+                    # Double-buffer barrier: group g-2's epilogues must
+                    # drain before a third replay piles on (bounds the
+                    # in-flight accumulator memory to two groups).
+                    for f in group_futs[g - 2]:
+                        f.result()
+                lanes = [by_index[lane.owner] for lane in group.lanes]
+                mark = len(all_futs)
+                t_r0 = time.perf_counter()
+                accs_b = self._replay_group_batched(group, lanes)
+                replay_s += time.perf_counter() - t_r0
+                for b, lane in enumerate(group.lanes):
+                    accs = self._lane_accs(accs_b, b)
+                    owner = by_index[lane.owner]
+                    if group.flags_exact[b]:
+                        # Populate the bound cache FROM the batch: this
+                        # launch computed exactly the owner's columns
+                        # (union == own flags), so the lane's result is
+                        # what a solo replay would have cached.
+                        self._cache_insert(owner.bound_key, accs)
+                    for idx in lane.indexes:
+                        submit(by_index[idx], accs)
+                group_futs.append(all_futs[mark:])
+            for f in all_futs:
+                f.result()
+        finally:
+            if executor is not None:
+                # Failure path: drop queued epilogues (their configs
+                # never committed — refunded by the caller) and drain
+                # running ones, so the refund's journal check is
+                # race-free. Success path: everything already drained.
+                executor.shutdown(wait=True, cancel_futures=True)
+        wall = time.perf_counter() - t_b0
+        with self._lock:
+            t = self._planner_totals
+            t["replay_s"] += replay_s
+            t["epilogue_s"] += epilogue_s[0]
+            t["wall_s"] += wall

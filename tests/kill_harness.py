@@ -441,6 +441,16 @@ def _run_live(mode: str, workdir: str) -> None:
         os.environ[live_lib.LIVE_CRASH_ENV] = f"{stage}@2"
         session.append(*_build_live_epoch(2))
         print("HARNESS_NOT_KILLED")  # must never print
+    elif mode == "live_kill_commit":
+        # The group-commit seam: the WAL record is written + flushed
+        # but the group fsync has not run. SIGKILL here must still
+        # land the epoch (the page cache survives process death) —
+        # only power loss could tear an unfsync'd record.
+        print("HARNESS_EPOCH_BEFORE " + json.dumps(
+            {"epoch": session.epoch}), flush=True)
+        os.environ[live_lib.LIVE_CRASH_ENV] = f"commit@{session.epoch}"
+        session.append(*_build_live_epoch(session.epoch))
+        print("HARNESS_NOT_KILLED")  # must never print
     elif mode == "live_epoch":
         print("HARNESS_LIVE_STATE " + json.dumps({
             "epoch": session.epoch,

@@ -324,6 +324,92 @@ class TestBackpressure:
         assert serving.max_pending_appends_default() == 3
 
 
+class TestGroupCommit:
+    """Concurrent appends coalesce into group-commits (ISSUE 17): one
+    fsync may cover many batches, while each batch keeps its own
+    digest/epoch identity, duplicate no-op behavior, and the committed
+    session stays bit-identical to the same batches appended serially."""
+
+    def test_concurrent_appends_commit_dense_epochs(self, tmp_path,
+                                                    monkeypatch):
+        import threading
+        monkeypatch.setenv(serving.APPEND_COMMIT_WINDOW_ENV, "10")
+        _, s = make_live(tmp_path)
+        n_batches = 6
+        results = [None] * n_batches
+        errors = []
+        barrier = threading.Barrier(n_batches)
+
+        def worker(i):
+            try:
+                barrier.wait()
+                results[i] = s.append(*epoch_batch(i))
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_batches)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert all(r.committed and not r.duplicate for r in results)
+        assert s.epoch == n_batches
+        # Epoch numbering is dense regardless of interleaving.
+        assert sorted(r.epoch for r in results) == list(range(n_batches))
+        # Bit-identity: a serial session appending the same batches in
+        # the committed epoch order answers queries identically.
+        _, serial = make_live(tmp_path, sub="serial", name="serial-ds")
+        for r in sorted(results, key=lambda r: r.epoch):
+            batch_index = next(i for i in range(n_batches)
+                               if results[i] is r)
+            serial.append(*epoch_batch(batch_index))
+        q = lambda sess: sess.query(  # noqa: E731
+            count_sum_params(), epsilon=1.0, delta=1e-6, seed=3,
+            secure_host_noise=False).to_columns()
+        assert_identical(q(serial), q(s))
+
+    def test_concurrent_duplicate_submissions_commit_once(self,
+                                                          tmp_path):
+        import threading
+        _, s = make_live(tmp_path)
+        n_threads = 6
+        results = [None] * n_threads
+        errors = []
+        barrier = threading.Barrier(n_threads)
+
+        def worker(i):
+            try:
+                barrier.wait()
+                results[i] = s.append(*epoch_batch(0))
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        committed = [r for r in results if r.committed]
+        duplicates = [r for r in results if r.duplicate]
+        assert len(committed) == 1
+        assert len(duplicates) == n_threads - 1
+        assert all(r.epoch == 0 for r in results)
+        assert s.epoch == 1
+
+    def test_commit_window_env(self, monkeypatch):
+        from pipelinedp_tpu.serving import live as live_mod
+        monkeypatch.delenv(serving.APPEND_COMMIT_WINDOW_ENV,
+                           raising=False)
+        assert live_mod.append_commit_window_s() == 0.0
+        monkeypatch.setenv(serving.APPEND_COMMIT_WINDOW_ENV, "25")
+        assert live_mod.append_commit_window_s() == 0.025
+        assert serving.append_commit_window_s() == 0.025
+
+
 class TestReleaseSchedule:
 
     def _schedule(self, session, sid="sched", base_seed=5, **kwargs):

@@ -1,0 +1,135 @@
+"""Query-planner unit tests (ISSUE 17): dedupe / fusion / cache-skip
+decisions as pure plan objects — no session, no device, no launches."""
+
+import pytest
+
+from pipelinedp_tpu.serving import planner
+
+F_ALL = (True, True, True, True)
+F_CNT = (True, False, False, False)
+F_SUM = (False, True, False, False)
+
+
+def entry(i, bound_key, fusion_key="fk", need_flags=F_CNT, cached=False):
+    return planner.PlanEntry(index=i, bound_key=bound_key,
+                             fusion_key=fusion_key, need_flags=need_flags,
+                             cached=cached)
+
+
+class TestAdmission:
+
+    def test_cached_entries_skip_replay(self):
+        plan = planner.compile_plan(
+            [entry(0, "a", cached=True), entry(1, "b")], max_width=8)
+        assert plan.cached_indexes == (0,)
+        assert plan.n_lanes == 1
+        assert plan.stats["cache_skips"] == 1
+        assert plan.stats["lanes"] == 1
+
+    def test_all_cached_means_no_groups(self):
+        plan = planner.compile_plan(
+            [entry(i, str(i), cached=True) for i in range(3)], max_width=8)
+        assert plan.groups == ()
+        assert plan.cached_indexes == (0, 1, 2)
+
+    def test_empty_batch(self):
+        plan = planner.compile_plan([], max_width=8)
+        assert plan.groups == () and plan.cached_indexes == ()
+
+
+class TestDedupe:
+
+    def test_identical_bound_keys_share_one_lane(self):
+        plan = planner.compile_plan(
+            [entry(0, "a"), entry(1, "a"), entry(2, "b"), entry(3, "a")],
+            max_width=8)
+        assert plan.stats["dedupes"] == 2
+        assert plan.n_lanes == 2
+        (group,) = plan.groups
+        assert group.lanes[0].owner == 0
+        assert group.lanes[0].followers == (1, 3)
+        assert group.lanes[1].indexes == (2,)
+
+    def test_none_bound_key_never_dedupes(self):
+        plan = planner.compile_plan(
+            [entry(0, None), entry(1, None)], max_width=8)
+        assert plan.stats["dedupes"] == 0
+        assert plan.n_lanes == 2
+
+    def test_duplicate_indexes_refused(self):
+        with pytest.raises(ValueError, match="duplicate entry indexes"):
+            planner.compile_plan([entry(0, "a"), entry(0, "b")],
+                                 max_width=8)
+
+
+class TestFusion:
+
+    def test_distinct_fusion_keys_split_groups(self):
+        plan = planner.compile_plan(
+            [entry(0, "a", fusion_key="x"), entry(1, "b", fusion_key="y"),
+             entry(2, "c", fusion_key="x")], max_width=8)
+        assert plan.stats["fused_groups"] == 2
+        by_key = {g.fusion_key: g for g in plan.groups}
+        assert [l.owner for l in by_key["x"].lanes] == [0, 2]
+        assert [l.owner for l in by_key["y"].lanes] == [1]
+
+    def test_max_width_splits_within_fusion_key(self):
+        plan = planner.compile_plan(
+            [entry(i, str(i)) for i in range(5)], max_width=2)
+        assert plan.stats["fused_groups"] == 3
+        assert [len(g.lanes) for g in plan.groups] == [2, 2, 1]
+
+    def test_union_flags_cover_all_members_including_followers(self):
+        # The follower (index 2) needs SUM; the union must include it
+        # even though lane owners only need COUNT.
+        plan = planner.compile_plan(
+            [entry(0, "a", need_flags=F_CNT),
+             entry(1, "b", need_flags=F_CNT),
+             entry(2, "a", need_flags=F_SUM)], max_width=8)
+        (group,) = plan.groups
+        assert group.union_flags == (True, True, False, False)
+
+    def test_max_width_below_one_refused(self):
+        with pytest.raises(ValueError, match="max_width"):
+            planner.compile_plan([entry(0, "a")], max_width=0)
+
+
+class TestFlagsExact:
+    """Only lanes whose own need_flags equal the group union may
+    populate the bound cache — a solo replay of that config would have
+    produced exactly those columns."""
+
+    def test_exact_lane_marked(self):
+        plan = planner.compile_plan(
+            [entry(0, "a", need_flags=F_ALL),
+             entry(1, "b", need_flags=F_CNT)], max_width=8)
+        (group,) = plan.groups
+        assert group.union_flags == F_ALL
+        assert group.flags_exact == (True, False)
+
+    def test_none_bound_key_never_cacheable(self):
+        plan = planner.compile_plan(
+            [entry(0, None, need_flags=F_CNT)], max_width=8)
+        (group,) = plan.groups
+        assert group.flags_exact == (False,)
+
+    def test_homogeneous_group_all_exact(self):
+        plan = planner.compile_plan(
+            [entry(i, str(i), need_flags=F_CNT) for i in range(3)],
+            max_width=8)
+        (group,) = plan.groups
+        assert group.flags_exact == (True, True, True)
+
+
+class TestStats:
+
+    def test_stats_account_for_every_config(self):
+        plan = planner.compile_plan(
+            [entry(0, "a", cached=True), entry(1, "b"), entry(2, "b"),
+             entry(3, "c", fusion_key="other")], max_width=8)
+        st = plan.stats
+        assert st == {"configs": 4, "cache_skips": 1, "dedupes": 1,
+                      "lanes": 2, "fused_groups": 2}
+        routed = len(plan.cached_indexes) + sum(
+            len(l.indexes) for g in plan.groups for l in g.lanes)
+        assert routed == st["configs"]

@@ -321,6 +321,25 @@ class TestLiveSessionKillRecovery:
         assert resumed == clean
         assert resumed == cold
 
+    def test_kill_at_group_commit_seam_reopens_at_n_plus_1(
+            self, live_kill_run):
+        """SIGKILL between the flushed WAL record and the group fsync:
+        the record survives process death via the page cache, so the
+        reopened session lands at N+1 with the batch committed —
+        atomicity at the group-commit seam matches the fold seam."""
+        kill_dir = live_kill_run["kill_dir"]
+        mesh = live_kill_run["mesh"]
+        proc = _run_harness("live_kill_commit", kill_dir, mesh=mesh)
+        assert proc.returncode == -signal.SIGKILL, (
+            f"expected SIGKILL;\nstdout:\n{proc.stdout}\n"
+            f"stderr:\n{proc.stderr}")
+        assert "HARNESS_NOT_KILLED" not in proc.stdout
+        before = _json_marker(proc, "HARNESS_EPOCH_BEFORE ")
+        after_proc = _run_harness("live_epoch", kill_dir, mesh=mesh)
+        assert after_proc.returncode == 0, after_proc.stderr
+        after = _json_marker(after_proc, "HARNESS_LIVE_STATE ")
+        assert after["epoch"] == before["epoch"] + 1
+
     def test_cross_restart_schedule_replay_refused(self, live_kill_run):
         replay = live_kill_run["replay"]
         # Catch-up state is exact: nothing due after the reopen ...

@@ -69,6 +69,34 @@ class TestCompare:
                 "e2e_partitions_per_sec"]
         assert f["status"] == "NEW"
 
+    def test_superset_shape_compares_against_leaner_prior(self):
+        # bench.py grows knobs over time: a newer round that records
+        # MORE knobs (each defaulted in the prior's run) still compares
+        # as long as every shared knob agrees — a richer recording of
+        # the same workload must not orphan the trajectory.
+        rows = [_row(1, cmd="BENCH_ROWS=1000 python bench.py",
+                     value=10_000.0),
+                _row(2, cmd="BENCH_ROWS=1000 python bench.py",
+                     value=6_000.0)]
+        rows[1]["parsed"]["shape"] = {"BENCH_ROWS": "1000",
+                                      "BENCH_LIVE_EPOCHS": "6"}
+        findings, summary = regress.compare(rows)
+        (f,) = [x for x in findings if x["metric"] ==
+                "e2e_partitions_per_sec"]
+        assert summary["comparable_priors"] == [1]
+        assert f["status"] == "REGRESSION"
+
+    def test_shared_knob_disagreement_never_compares(self):
+        # The superset rule only covers agreement: one shared knob with
+        # a different value keeps the rounds apart, and an empty
+        # signature only matches another empty one.
+        assert not regress.shapes_comparable(
+            (("BENCH_ROWS", "9"), ("BENCH_LIVE_EPOCHS", "6")),
+            (("BENCH_ROWS", "1000"),))
+        assert not regress.shapes_comparable(
+            (), (("BENCH_ROWS", "1000"),))
+        assert regress.shapes_comparable((), ())
+
     def test_noise_aware_tolerance_widens_with_cv(self):
         # Three jittery priors -> tolerance grows to 2*cv and a drop
         # inside that band passes.

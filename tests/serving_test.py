@@ -300,6 +300,187 @@ class TestBatchedQueries:
             session.query_batch([pct])
 
 
+class TestQueryPlane:
+    """ISSUE 17: query_batch compiles through the planner (cache
+    admission, dedupe, fusion) and runs pipelined async epilogues —
+    bit-identical config-for-config to sequential session.query."""
+
+    @staticmethod
+    def _configs():
+        base = dict(metrics=[M.COUNT, M.SUM], epsilon=1.0, delta=1e-6,
+                    min_value=0.0, max_value=5.0)
+        return [
+            serving.QueryConfig(max_partitions_contributed=4,
+                                max_contributions_per_partition=2,
+                                seed=7, **base),
+            serving.QueryConfig(max_partitions_contributed=4,
+                                max_contributions_per_partition=2,
+                                seed=7, **base),  # exact duplicate of 0
+            serving.QueryConfig(metrics=[M.COUNT], epsilon=2.0,
+                                delta=1e-6, max_partitions_contributed=8,
+                                max_contributions_per_partition=4, seed=8),
+            serving.QueryConfig(max_contributions=6, seed=9, **base),
+        ]
+
+    def _assert_matches_sequential(self, data, configs, outs, mesh=None):
+        ref_sess = serving.DatasetSession(data, mesh=mesh, n_chunks=2)
+        for i, cfg in enumerate(configs):
+            ref = ref_sess.query(cfg.to_params(), epsilon=cfg.epsilon,
+                                 delta=cfg.delta, seed=cfg.seed,
+                                 secure_host_noise=False).to_columns()
+            assert_columns_identical(ref, outs[i])
+
+    def test_duplicate_configs_trigger_exactly_one_replay(self):
+        data = make_columns(n=20_000)
+        session = serving.DatasetSession(data, n_chunks=2)
+        configs = self._configs()
+        d0 = profiler.event_count(serving.EVENT_PLANNER_DEDUPES)
+        l0 = profiler.event_count(streaming.EVENT_SERVING_LAUNCHES)
+        outs = session.query_batch(configs, secure_host_noise=False)
+        # Config 1 duplicates config 0's bound key: one lane, counted.
+        assert profiler.event_count(
+            serving.EVENT_PLANNER_DEDUPES) - d0 == 1
+        # Two fusion groups (the max_contributions lane has different
+        # kernel statics), one launch per chunk each — the duplicate
+        # adds NO launch.
+        assert profiler.event_count(
+            streaming.EVENT_SERVING_LAUNCHES) - l0 == 2 * session.n_chunks
+        assert_columns_identical(outs[0], outs[1])
+        self._assert_matches_sequential(data, configs, outs)
+
+    def test_batch_parity_matrix(self, engine_mesh):
+        """Batched-vs-sequential bit parity, single-device + mesh8,
+        including the max_contributions (l1) lane."""
+        data = make_columns(n=20_000)
+        session = serving.DatasetSession(data, mesh=engine_mesh,
+                                         n_chunks=2)
+        configs = self._configs()
+        outs = session.query_batch(configs, secure_host_noise=False)
+        self._assert_matches_sequential(data, configs, outs,
+                                        mesh=engine_mesh)
+
+    def test_async_epilogues_on_off_bit_identical(self, engine_mesh,
+                                                  monkeypatch):
+        data = make_columns(n=20_000)
+        configs = self._configs()
+        monkeypatch.setenv(serving.EPILOGUE_WORKERS_ENV, "2")
+        on = serving.DatasetSession(data, mesh=engine_mesh,
+                                    n_chunks=2).query_batch(
+            configs, secure_host_noise=False)
+        monkeypatch.setenv(serving.EPILOGUE_WORKERS_ENV, "0")
+        off = serving.DatasetSession(data, mesh=engine_mesh,
+                                     n_chunks=2).query_batch(
+            configs, secure_host_noise=False)
+        for a, b in zip(on, off):
+            assert_columns_identical(a, b)
+
+    def test_batch_populates_bound_cache_for_single_queries(self):
+        data = make_columns(n=20_000)
+        session = serving.DatasetSession(data, n_chunks=2)
+        cfg = self._configs()[0]
+        outs = session.query_batch([cfg], secure_host_noise=False)
+        h0 = profiler.event_count(serving.EVENT_BOUND_HITS)
+        r0 = profiler.event_count(streaming.EVENT_SERVING_REPLAYS)
+        single = session.query(cfg.to_params(), epsilon=cfg.epsilon,
+                               delta=cfg.delta, seed=cfg.seed,
+                               secure_host_noise=False).to_columns()
+        # The batch lane's accumulators warmed the cache: hit, no replay.
+        assert profiler.event_count(serving.EVENT_BOUND_HITS) == h0 + 1
+        assert profiler.event_count(
+            streaming.EVENT_SERVING_REPLAYS) == r0
+        assert_columns_identical(single, outs[0])
+
+    def test_cached_configs_skip_replay_in_batch(self):
+        data = make_columns(n=20_000)
+        session = serving.DatasetSession(data, n_chunks=2)
+        cfg = self._configs()[0]
+        session.query(cfg.to_params(), epsilon=cfg.epsilon,
+                      delta=cfg.delta, seed=cfg.seed,
+                      secure_host_noise=False).to_columns()
+        s0 = profiler.event_count(serving.EVENT_PLANNER_CACHE_SKIPS)
+        r0 = profiler.event_count(streaming.EVENT_SERVING_REPLAYS)
+        outs = session.query_batch([cfg], secure_host_noise=False)
+        assert profiler.event_count(
+            serving.EVENT_PLANNER_CACHE_SKIPS) - s0 == 1
+        assert profiler.event_count(
+            streaming.EVENT_SERVING_REPLAYS) == r0
+        assert len(outs) == 1
+
+    def test_planner_stats_and_per_config_durations(self):
+        data = make_columns(n=20_000)
+        session = serving.DatasetSession(data, n_chunks=2)
+        configs = self._configs()
+        session.query_batch(configs, secure_host_noise=False)
+        st = session.stats()["planner"]
+        assert st["batches"] == 1
+        assert st["configs"] == 4
+        assert st["dedupes"] == 1
+        assert st["lanes"] == 3
+        assert st["fused_groups"] == 2
+        assert 0.0 <= st["epilogue_overlap_ratio"] <= 1.0
+        recs = session.audit_trail.records()[-len(configs):]
+        durations = [r.duration_s for r in recs]
+        assert all(d > 0 for d in durations)
+        # Per-config, not one batch-wide wall time for every config.
+        assert len(set(durations)) > 1
+
+    def test_hammer_mixed_query_and_batch_across_tenants(self):
+        data = make_columns(n=20_000)
+        session = serving.DatasetSession(data, n_chunks=2)
+        session.register_tenant("a", total_epsilon=100.0,
+                                total_delta=1e-3)
+        session.register_tenant("b", total_epsilon=100.0,
+                                total_delta=1e-3)
+        params = count_sum_params(l0=4, linf=2)
+        errors = []
+        results = {}
+
+        def single(tenant, seed):
+            try:
+                results[("q", tenant, seed)] = session.query(
+                    params, epsilon=1.0, delta=1e-6, seed=seed,
+                    tenant=tenant, secure_host_noise=False).to_columns()
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                errors.append(exc)
+
+        def batch(tenant, seeds):
+            try:
+                cfgs = [serving.QueryConfig(
+                    metrics=[M.COUNT, M.SUM], epsilon=1.0, delta=1e-6,
+                    max_partitions_contributed=4,
+                    max_contributions_per_partition=2, min_value=0.0,
+                    max_value=5.0, seed=s, tenant=tenant)
+                    for s in seeds]
+                outs = session.query_batch(cfgs,
+                                           secure_host_noise=False)
+                for s, out in zip(seeds, outs):
+                    results[("b", tenant, s)] = out
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                errors.append(exc)
+
+        # All seeds distinct: a repeated (tenant, seed, config) release
+        # is exactly what the at-most-once journal refuses.
+        threads = [
+            threading.Thread(target=single, args=("a", 21)),
+            threading.Thread(target=single, args=("b", 22)),
+            threading.Thread(target=batch, args=("a", (23, 24, 26))),
+            threading.Thread(target=batch, args=("b", (25, 27))),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        # Every released answer — threaded single or batched, either
+        # tenant — is bit-identical to a fresh sequential run.
+        ref_sess = serving.DatasetSession(data, n_chunks=2)
+        for (_, _, seed), cols in results.items():
+            ref = ref_sess.query(params, epsilon=1.0, delta=1e-6,
+                                 seed=seed,
+                                 secure_host_noise=False).to_columns()
+            assert_columns_identical(ref, cols)
+
+
 class TestTenantIsolation:
     """Two tenants on one resident dataset never share budget or
     release history."""
