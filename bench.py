@@ -851,6 +851,91 @@ def bench_live():
     return out
 
 
+# Fleet-failover row (ISSUE 19): sized so the row finishes in seconds
+# while the follower still replays every epoch digest-verified and the
+# promotion pays the real lease-takeover + writable-reopen path.
+FLEET_EPOCHS = int(os.environ.get("BENCH_FLEET_EPOCHS", 3))
+FLEET_EPOCH_ROWS = int(os.environ.get("BENCH_FLEET_ROWS", 50_000))
+FLEET_PARTITIONS = 2_000
+
+
+def bench_fleet():
+    """Fleet-failover row (ISSUE 19): follower replication lag over a
+    digest-verified WAL tail, hedged warm-read hit rate through the
+    router, and the failover headline — seconds from a dead primary to
+    a promoted follower that has taken the lease, reopened writable,
+    and committed its first append (``failovers_per_sec`` feeds the
+    regress gate as its higher-is-better reciprocal)."""
+    import tempfile
+
+    from pipelinedp_tpu import serving
+    from pipelinedp_tpu.runtime import watchdog as watchdog_mod
+    from pipelinedp_tpu.serving import fleet as fleet_mod
+
+    out = {}
+    rng = np.random.default_rng(13)
+    epochs = [
+        (rng.integers(0, max(FLEET_EPOCH_ROWS // 10, 1),
+                      FLEET_EPOCH_ROWS, dtype=np.int32),
+         rng.integers(0, FLEET_PARTITIONS, FLEET_EPOCH_ROWS,
+                      dtype=np.int32),
+         rng.integers(1, 6, FLEET_EPOCH_ROWS).astype(np.float32))
+        for _ in range(FLEET_EPOCHS + 1)
+    ]
+    with tempfile.TemporaryDirectory() as td:
+        store = serving.SessionStore(td)
+        primary = serving.LiveDatasetSession.create(
+            store=store, name="bench-fleet",
+            public_partitions=list(range(FLEET_PARTITIONS)),
+            n_chunks=4, window=serving.WindowSpec(size=1),
+            secure_host_noise=False)
+        for pid, pk, value in epochs[:FLEET_EPOCHS]:
+            primary.append(pid, pk, value)
+        before = fleet_mod.fleet_counters()
+        t0 = time.perf_counter()
+        follower = fleet_mod.FollowerSession(store, "bench-fleet")
+        while follower.replication_lag()["records_behind"] > 0:
+            follower.poll()
+        out["follower_attach_s"] = round(time.perf_counter() - t0, 4)
+        out["replication"] = follower.replication_lag()
+        # Hedged warm reads: a burnt deadline routes the tenantless
+        # read to the replica instead of betting on the primary.
+        router = fleet_mod.FleetRouter()
+        router.add_host("primary", primary)
+        router.add_follower(follower)
+        t0 = time.perf_counter()
+        n_reads = 4
+        for i in range(n_reads):
+            router.query(_params(), shard_key=i,
+                         deadline=watchdog_mod.Deadline.after(0.0),
+                         epsilon=EPS, delta=DELTA, seed=100 + i,
+                         secure_host_noise=False)
+        hedge_s = time.perf_counter() - t0
+        counters = fleet_mod.fleet_counters()
+        hedged = counters["hedged_reads"] - before["hedged_reads"]
+        out["hedged_reads"] = hedged
+        out["hedged_hit_rate"] = round(
+            (counters["hedged_hits"] - before["hedged_hits"])
+            / max(hedged, 1), 3)
+        out["hedged_reads_per_sec"] = round(n_reads / hedge_s, 2)
+        # Failover: the primary goes away; the follower takes the
+        # lease (fencing token bump), reopens writable, and proves the
+        # new primary with one committed append.
+        primary.close()
+        t0 = time.perf_counter()
+        promoted = follower.promote()
+        result = promoted.append(*epochs[FLEET_EPOCHS])
+        failover_s = time.perf_counter() - t0
+        assert result.committed
+        out["failover_time_s"] = round(failover_s, 4)
+        out["failovers_per_sec"] = round(1.0 / failover_s, 3)
+        out["lease"] = promoted.lease.status()
+        final = fleet_mod.fleet_counters()
+        out["counters"] = {k: final[k] - before[k] for k in final}
+        promoted.close()
+    return out
+
+
 def bench_cpu_baseline() -> float:
     import pipelinedp_tpu as pdp
 
@@ -1001,6 +1086,12 @@ def main():
         extra["live"] = bench_live()
     except Exception as e:  # noqa: BLE001
         extra["live_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        # Fleet-failover row (ISSUE 19): follower replication, hedged
+        # warm reads, and the promote-to-first-commit failover time.
+        extra["fleet"] = bench_fleet()
+    except Exception as e:  # noqa: BLE001
+        extra["fleet_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
         sweep_dev_sec, sweep_host_sec = bench_utility_sweep()
         extra.update({

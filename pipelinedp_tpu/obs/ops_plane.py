@@ -20,6 +20,10 @@ any container) exposes:
     appear — the serving leak scan covers this surface dynamically.
   * ``GET /debug/flightz`` — the most recent flight-recorder events
     (obs/flight.py), newest last.
+  * ``GET /fleetz``   — the failover plane (serving/fleet.py): lease
+    holder + fencing token per session, follower replication lag, and
+    the process-wide takeover/fence/hedge counters. Lease metadata is
+    operational (pid/host/token), never data.
 
 Start it with :func:`serve_ops(manager_or_session, port)` — any object
 with a ``stats()`` dict works; ``SessionManager`` and ``DatasetSession``
@@ -100,6 +104,10 @@ def _session_statusz(session_stats: dict) -> dict:
         out["live"] = session_stats["live"]
     if "planner" in session_stats:
         out["planner"] = session_stats["planner"]
+    if session_stats.get("read_only"):
+        out["read_only"] = True
+    if session_stats.get("fleet"):
+        out["fleet"] = session_stats["fleet"]
     return out
 
 
@@ -207,6 +215,32 @@ def healthz_payload(target) -> Tuple[dict, bool]:
             "checks": checks}, ok
 
 
+def fleetz_payload(target) -> dict:
+    """The /fleetz JSON: lease holder, fencing token, follower
+    replication lag, and the process-wide failover counters. ``target``
+    may be a SessionManager/DatasetSession (``stats()``) or a
+    FollowerSession/FleetRouter (``statusz()``)."""
+    from pipelinedp_tpu.serving import fleet as fleet_lib
+    out = {
+        "process_id": os.getpid(),
+        "counters": fleet_lib.fleet_counters(),
+    }
+    statusz = getattr(target, "statusz", None)
+    if callable(statusz):  # FollowerSession / FleetRouter
+        out["target"] = statusz()
+        return out
+    stats = target.stats()
+    if _is_manager(target):
+        per_session = stats.get("sessions", {})
+    else:
+        per_session = {getattr(target, "name", "session"): stats}
+    out["sessions"] = {
+        name: {"fleet": s.get("fleet"),
+               "read_only": bool(s.get("read_only", False))}
+        for name, s in per_session.items()}
+    return out
+
+
 def flightz_payload(last: int = FLIGHTZ_EVENTS) -> dict:
     return {
         "process_id": os.getpid(),
@@ -251,9 +285,12 @@ class _OpsHandler(BaseHTTPRequestHandler):
                 self._send_json(200, statusz_payload(target))
             elif path == "/debug/flightz":
                 self._send_json(200, flightz_payload())
+            elif path == "/fleetz":
+                self._send_json(200, fleetz_payload(target))
             else:
                 self._send_json(404, {"error": "unknown endpoint", "endpoints": [
-                    "/metrics", "/healthz", "/statusz", "/debug/flightz"]})
+                    "/metrics", "/healthz", "/statusz", "/debug/flightz",
+                    "/fleetz"]})
         except BrokenPipeError:
             pass
         except Exception as exc:  # diagnostics must not kill the server

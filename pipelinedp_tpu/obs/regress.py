@@ -60,6 +60,10 @@ HEADLINE_METRICS: Tuple[Tuple[str, str, float], ...] = (
     ("live_append_rows_per_sec", "live.append_rows_per_sec", 0.30),
     ("live_release_windows_per_sec",
      "live.release_windows_per_sec", 0.40),
+    # Failover headline (ISSUE 19): reciprocal of failover_time_s so
+    # the gate stays higher-is-better; promotion cost is dominated by
+    # the writable reopen, so the tolerance is generous.
+    ("fleet_failovers_per_sec", "fleet.failovers_per_sec", 0.50),
 )
 
 MAX_TOLERANCE = 0.50

@@ -68,6 +68,14 @@ class JournalCorruptError(RuntimeError):
     silently forgetting a release."""
 
 
+class StaleWriterError(RuntimeError):
+    """A WAL append was refused by its writer fence: the appending
+    process no longer holds the session's single-writer lease (a newer
+    fencing token exists on disk), so its write must not land — a
+    partitioned-away ex-primary is fenced *at the journal*, not merely
+    raced (serving/fleet.py owns the lease protocol)."""
+
+
 class JsonlWal:
     """The shared fsync'd JSON-lines WAL (one implementation, many
     journals): FileReleaseJournal, the durable tenant ledgers, and the
@@ -92,6 +100,12 @@ class JsonlWal:
         if parent:
             os.makedirs(parent, exist_ok=True)
         self._fh = None
+        # Optional single-writer fence (serving/fleet.py): a callable
+        # returning the current fencing token (or raising
+        # StaleWriterError); every append consults it and embeds the
+        # token in the payload, so the record itself proves which
+        # lease generation wrote it.
+        self._fence = None
         self.recovered: List[dict] = self._recover()
         self._fh = open(self._path, "ab")
         self._next_seq = len(self.recovered)
@@ -128,7 +142,8 @@ class JsonlWal:
                 + f',"digest":"{_record_digest(canonical)}"}}'
                 + "\n").encode()
 
-    def _parse_line(self, raw: bytes, expected_seq: int) -> Optional[dict]:
+    @classmethod
+    def _parse_line(cls, raw: bytes, expected_seq: int) -> Optional[dict]:
         """Validated payload dict from one WAL line, or None."""
         try:
             obj = json.loads(raw.decode())
@@ -136,17 +151,19 @@ class JsonlWal:
         except (ValueError, KeyError, TypeError, UnicodeDecodeError):
             return None
         if not isinstance(obj, dict) or \
-                _record_digest(self._canonical(obj)) != digest:
+                _record_digest(cls._canonical(obj)) != digest:
             return None
         if obj.get("seq") != expected_seq:
             return None
         return obj
 
-    def _recover(self) -> List[dict]:
-        if not os.path.exists(self._path):
-            return []
-        with open(self._path, "rb") as f:
-            data = f.read()
+    @classmethod
+    def _scan(cls, data: bytes, path: str, corrupt_error
+              ) -> Tuple[List[dict], int]:
+        """(validated payloads, byte offset past the last good record).
+
+        Shared by :meth:`_recover` (which then truncates the torn tail)
+        and :func:`read_records` (which never writes — follower-safe)."""
         payloads: List[dict] = []
         good_end = 0
         lines = data.split(b"\n")
@@ -155,26 +172,46 @@ class JsonlWal:
         for i, raw in enumerate(lines):
             if raw == b"" and i == len(lines) - 1:
                 break
-            payload = self._parse_line(raw, expected_seq=len(payloads))
+            payload = cls._parse_line(raw, expected_seq=len(payloads))
             if payload is None:
                 if i == len(lines) - 1 or (i == len(lines) - 2
                                            and lines[-1] == b""):
                     # Torn tail: the crash happened mid-append, so this
                     # record was never acknowledged — drop it.
                     break
-                raise self._corrupt_error(
-                    f"{self._path}: record {len(payloads)} is malformed "
+                raise corrupt_error(
+                    f"{path}: record {len(payloads)} is malformed "
                     f"but later records follow — the journal is "
                     f"corrupted, not torn; refusing to guess at its "
                     f"history")
             payloads.append(payload)
             good_end += len(raw) + 1
+        return payloads, good_end
+
+    def _recover(self) -> List[dict]:
+        if not os.path.exists(self._path):
+            return []
+        with open(self._path, "rb") as f:
+            data = f.read()
+        payloads, good_end = self._scan(data, self._path,
+                                        self._corrupt_error)
         if good_end != len(data):
             # Truncate the torn tail so the next append starts a clean
             # line (a partial line would otherwise fuse with it).
             with open(self._path, "r+b") as f:
                 f.truncate(good_end)
         return payloads
+
+    def attach_fence(self, fence) -> None:
+        """Installs a single-writer fence: a callable returning the
+        current fencing token (int), consulted on *every* append and
+        embedded in the record as ``writer_token`` (digest-covered, so
+        the token is tamper-evident). The fence raises
+        :class:`StaleWriterError` when this process no longer holds the
+        lease — the append is refused before any byte lands. ``None``
+        detaches (followers replaying a fenced WAL tolerate the extra
+        key; only the appender needs the lease)."""
+        self._fence = fence
 
     def append(self, payload: dict, sync: bool = True) -> int:
         """Durably appends one payload (must carry its ``seq``; must not
@@ -187,6 +224,11 @@ class JsonlWal:
         many appends, one fsync."""
         if "digest" in payload:
             raise ValueError("payload key 'digest' is reserved by the WAL")
+        if self._fence is not None:
+            # The fence re-checks the on-disk lease and raises
+            # StaleWriterError if a newer token exists — a partitioned
+            # ex-primary is refused here, before the write lands.
+            payload = dict(payload, writer_token=int(self._fence()))
         line = self._line(payload)
         with self._io_lock:
             self._fh.write(line)
@@ -294,6 +336,28 @@ class JsonlWal:
         if self._fh is not None:
             self._fh.close()
             self._fh = None
+
+
+def read_records(path: str, corrupt_error=None) -> List[dict]:
+    """Read-only snapshot of a WAL's committed records — *no* side
+    effects on the file.
+
+    Constructing a :class:`JsonlWal` opens the file for append and
+    truncates any torn tail — both writes, both forbidden against a file
+    a *live* primary still owns. A hot follower (serving/fleet.py) tails
+    the primary's WALs with this scanner instead: same digest/seq
+    validation, same interior-corruption refusal, but a torn or
+    still-being-written tail line is simply ignored (to a reader it is
+    indistinguishable from an append in flight — the next poll sees it
+    complete or truncated by recovery, never half-applied)."""
+    if corrupt_error is None:
+        corrupt_error = JournalCorruptError
+    if not os.path.exists(path):
+        return []
+    with open(path, "rb") as f:
+        data = f.read()
+    payloads, _ = JsonlWal._scan(data, path, corrupt_error)
+    return payloads
 
 
 @dataclasses.dataclass(frozen=True)
@@ -413,6 +477,12 @@ class FileReleaseJournal(ReleaseJournal):
     def _persist(self, record: ReleaseRecord) -> None:
         nbytes = self._wal.append(self._payload(record))
         profiler.count_event(EVENT_JOURNAL_BYTES, nbytes)
+
+    def attach_fence(self, fence) -> None:
+        """Single-writer fence pass-through (see JsonlWal.attach_fence):
+        tenant ledgers and release journals are fenced too, so a stale
+        primary cannot spend budget any more than it can append data."""
+        self._wal.attach_fence(fence)
 
     def compact(self) -> None:
         """Atomically rewrites the WAL from the in-memory records (drops

@@ -34,8 +34,10 @@ RuntimeErrors whose messages carry the gRPC-style status code).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import os
 import time
-from typing import Callable
+from typing import Callable, Optional
 
 from pipelinedp_tpu.runtime import faults
 from pipelinedp_tpu.runtime import watchdog as watchdog_lib
@@ -68,6 +70,15 @@ def classify(exc: BaseException) -> str:
     return FATAL
 
 
+def _jitter_uniform(seed: int, draw: int) -> float:
+    """The ``draw``-th uniform in [0, 1) of the seeded jitter stream —
+    sha256-derived, so it is deterministic under ``seed`` without a
+    stateful stdlib PRNG. Timing jitter only; never a DP noise source
+    (DP noise rides the engine's threefry/native generators)."""
+    digest = hashlib.sha256(f"retry-jitter:{seed}:{draw}".encode()).digest()
+    return int.from_bytes(digest[:8], "big") / float(1 << 64)
+
+
 @dataclasses.dataclass
 class RetryPolicy:
     """Bounded backoff + OOM degradation knobs for the slab drivers.
@@ -83,10 +94,50 @@ class RetryPolicy:
     backoff_max_s: float = 2.0
     # sleep is injectable so tests assert backoff without waiting it out.
     sleep: Callable[[float], None] = time.sleep
+    # jitter="decorrelated" spreads a fleet of hosts retrying the same
+    # store (lease renews, shared-WAL contention) so they don't
+    # thundering-herd on synchronized exponential steps. Default "none"
+    # keeps the historical pure-exponential delays bit-for-bit.
+    # jitter_seed pins the jitter sequence (chaos tests must reproduce);
+    # None draws an OS seed — fine for timing, never used for DP noise.
+    jitter: str = "none"
+    jitter_seed: Optional[int] = None
+
+    def __post_init__(self):
+        if self.jitter not in ("none", "decorrelated"):
+            raise ValueError(
+                f"jitter must be 'none' or 'decorrelated', got "
+                f"{self.jitter!r}")
+        if self.jitter_seed is None:
+            self.jitter_seed = int.from_bytes(os.urandom(8), "big")
+        self._jitter_draws = 0
+        self._prev_backoff_s = self.backoff_base_s
 
     def backoff_s(self, attempt: int) -> float:
-        """Exponential backoff delay before retry ``attempt`` (0-based)."""
-        return min(self.backoff_max_s, self.backoff_base_s * (2.0**attempt))
+        """Backoff delay before retry ``attempt`` (0-based).
+
+        jitter="none": deterministic bounded exponential. With
+        "decorrelated" jitter each delay is drawn uniformly from
+        [base, 3 * previous_delay] and capped at backoff_max_s (the
+        AWS "decorrelated jitter" recipe) — successive retries spread
+        apart instead of marching in lockstep with every other host
+        that failed at the same instant. Deterministic under
+        ``jitter_seed``; :meth:`reset_backoff` restarts the sequence."""
+        base = min(self.backoff_max_s, self.backoff_base_s * (2.0**attempt))
+        if self.jitter == "none":
+            return base
+        hi = max(self.backoff_base_s, self._prev_backoff_s * 3.0)
+        u = _jitter_uniform(self.jitter_seed, self._jitter_draws)
+        self._jitter_draws += 1
+        delay = min(self.backoff_max_s,
+                    self.backoff_base_s + (hi - self.backoff_base_s) * u)
+        self._prev_backoff_s = delay
+        return delay
+
+    def reset_backoff(self) -> None:
+        """Restarts the decorrelated-jitter chain (call after a success
+        so the next failure backs off from the base again)."""
+        self._prev_backoff_s = self.backoff_base_s
 
     def degrade_slab_buckets(self, slab_buckets: int) -> int:
         """Halved slab window (>= 1 chunk) after a device OOM."""

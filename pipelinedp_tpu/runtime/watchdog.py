@@ -128,6 +128,19 @@ class Deadline:
     def expired(self) -> bool:
         return self.remaining_s() <= 0
 
+    def fraction_remaining(self) -> float:
+        """Budget left as a fraction of the total, clamped to [0, 1].
+
+        Shared pacing signal for the fleet tier (serving/fleet.py): a
+        lease holder renews once its expiry deadline drops below half,
+        and the FleetRouter hedges a warm read to a follower when a
+        query's deadline budget is nearly burnt — both ride the same
+        monotonic arithmetic the slab driver's checks use, so neither is
+        fooled by wall-clock jumps."""
+        if self.total_s <= 0:
+            return 0.0
+        return max(0.0, min(1.0, self.remaining_s() / self.total_s))
+
     def check(self, what: str) -> None:
         if self.expired:
             # A deadline expiry is a hang report: leave the flight dump

@@ -49,6 +49,13 @@ The durable fleet layer (SERVING.md "Fleet operation") sits on top:
     bounded in-flight admission gate (typed
     ``SessionOverloadedError`` shedding), and per-query deadlines
     (``QueryDeadlineError`` riding the DispatchWatchdog).
+  * :mod:`~pipelinedp_tpu.serving.fleet` adds host-death failover
+    (SERVING.md "Fleet failover"): fencing-token single-writer leases
+    per stored session (stale ex-primaries are refused at the WAL),
+    digest-verified hot followers serving warm read-only queries,
+    exactly-once release catch-up across promotion, and a
+    :class:`~pipelinedp_tpu.serving.fleet.FleetRouter` that routes by
+    shard ownership, sheds across hosts, and hedges warm reads.
 
 See SERVING.md for the session lifecycle, memory/eviction knobs, tenant
 budget semantics and the interaction with checkpoint/resume.
@@ -60,9 +67,9 @@ from pipelinedp_tpu.serving.session import (  # noqa: F401
     EVENT_PLANNER_DEDUPES, EVENT_PLANNER_GROUPS, EVENT_QUERIES,
     EVENT_REHYDRATIONS, BATCH_WIDTH_ENV, DEADLINE_ENV,
     EPILOGUE_WORKERS_ENV, RESIDENT_BYTES_ENV,
-    DatasetSession, QueryConfig, SessionClosedError, StaleDatasetError,
-    TenantState, batch_width, default_deadline_s, epilogue_workers,
-    resident_byte_budget, serving_counters)
+    DatasetSession, QueryConfig, SessionClosedError, SessionReadOnlyError,
+    StaleDatasetError, TenantState, batch_width, default_deadline_s,
+    epilogue_workers, resident_byte_budget, serving_counters)
 from pipelinedp_tpu.serving.planner import (  # noqa: F401
     LaunchGroup, PlanEntry, QueryPlan, ReplayLane, compile_plan)
 from pipelinedp_tpu.serving.store import (  # noqa: F401
@@ -82,6 +89,12 @@ from pipelinedp_tpu.serving.live import (  # noqa: F401
     LiveDatasetSession, ReleaseSchedule, WindowSpec,
     append_commit_window_s, live_counters,
     max_pending_appends_default, window_seed)
+from pipelinedp_tpu.serving.fleet import (  # noqa: F401
+    FOLLOWER_POLL_ENV, LEASE_TTL_ENV, FleetRouter, FollowerSession,
+    LeaseHeldError, LeaseLostError, SessionLease, StaleWriterError,
+    follower_poll_s, lease_ttl_s)
+from pipelinedp_tpu.serving.fleet import (  # noqa: F401
+    fleet_counters as failover_counters)
 from pipelinedp_tpu.budget_accounting import (  # noqa: F401
     BudgetExhaustedError, TenantBudgetLedger)
 from pipelinedp_tpu.runtime.watchdog import QueryDeadlineError  # noqa: F401

@@ -1,0 +1,690 @@
+"""Fleet failover tier: leased single-writer sessions, hot followers,
+and exactly-once releases across host death.
+
+PR 8/10/15 made one *process* crash-exactly-once against its own store
+directory; this module extends the contract to a *fleet* sharing that
+directory (ROADMAP item 1, SERVING.md "Fleet failover"). Three pieces:
+
+  * :class:`SessionLease` — a fencing-token lease file per session
+    directory. Acquisition is an atomic claim (``O_CREAT|O_EXCL`` claim
+    file resolves races) followed by a tmp+fsync+rename publish of the
+    new lease record; every renew is the same atomic publish, so a
+    crash mid-renew leaves the previous valid lease, never a torn one.
+    The monotonically increasing ``token`` is the fence: sessions
+    attach ``lease.admit`` to their WALs
+    (:meth:`runtime.journal.JsonlWal.attach_fence`), so *every* append
+    re-checks the on-disk lease and embeds the token in the record — a
+    partitioned-away ex-primary whose lease was taken over is refused
+    at the journal (:class:`runtime.journal.StaleWriterError`), not
+    merely raced.
+
+  * :class:`FollowerSession` — a hot read-only replica. It opens the
+    primary's session ``read_only=True`` (no lease, no WAL handles —
+    the read path never truncates or appends the primary's files; see
+    :func:`runtime.journal.read_records`) and polls the append WAL,
+    digest-verifying each committed epoch payload against its WAL
+    record before folding it into the replica's ``ResidentWire``. Warm
+    read-only queries are served off that replayed wire;
+    ``replication_lag`` (records behind + poll age) is surfaced on
+    ``/statusz`` and ``/fleetz``.
+
+  * :class:`FleetRouter` — steers queries across hosts: deterministic
+    pid-shard ownership picks the owner, an unhealthy owner is shed
+    *across* hosts before any within-host shedding triggers, and when
+    a query's deadline budget is nearly burnt the router hedges warm
+    (tenantless) reads to a follower instead of betting the remaining
+    budget on the primary.
+
+Failover is follower-driven: when the primary's lease expires (host
+death — the pid-liveness probe only helps same-host restarts),
+:meth:`FollowerSession.promote` closes the replica and reopens the
+session *writable* — acquiring the lease, truncating any torn WAL
+tail (``JsonlWal`` recovery), and replaying ``ReleaseSchedule``
+catch-up. Exactly-once releases across the failover need nothing new:
+the durable release journal + ledger already refuse a release the dead
+primary committed (``DoubleReleaseError`` → "recovered" outcome with
+the charge refunded exactly), and an uncommitted one re-issues
+bit-identically under the same ``window_seed``. The two-process kill
+harness (tests/kill_harness.py ``fleet_*`` modes) pins the whole
+story: SIGKILL the primary mid-release, promote the follower, and the
+released stream byte-compares against an uninterrupted single-host
+run, with the fenced ex-primary's late append refused.
+"""
+
+from __future__ import annotations
+
+import errno
+import hashlib
+import json
+import os
+import socket
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+from pipelinedp_tpu import profiler
+from pipelinedp_tpu.runtime import journal as journal_lib
+from pipelinedp_tpu.runtime import retry as retry_lib
+from pipelinedp_tpu.runtime import watchdog as watchdog_lib
+
+# Validated env knobs (README "Tuning knobs", SERVING.md):
+#   PIPELINEDP_TPU_LEASE_TTL_S — single-writer lease TTL in seconds. A
+#     primary renews at half-TTL; a follower may promote once the lease
+#     is this stale. Smaller = faster failover, more renew I/O.
+#   PIPELINEDP_TPU_FOLLOWER_POLL_MS — hot-follower WAL poll period.
+LEASE_TTL_ENV = "PIPELINEDP_TPU_LEASE_TTL_S"
+FOLLOWER_POLL_ENV = "PIPELINEDP_TPU_FOLLOWER_POLL_MS"
+
+LEASE_FILE = "lease.json"
+
+# Profiler event counters (profiler.count_event / event_count):
+EVENT_LEASE_RENEWALS = "serving/fleet_lease_renewals"
+EVENT_LEASE_TAKEOVERS = "serving/fleet_lease_takeovers"
+EVENT_FENCED_WRITES = "serving/fleet_fenced_writes"
+EVENT_PROMOTIONS = "serving/fleet_promotions"
+EVENT_FOLLOWER_POLLS = "serving/fleet_follower_polls"
+EVENT_FOLLOWER_RECORDS = "serving/fleet_follower_records"
+EVENT_HEDGED_READS = "serving/fleet_hedged_reads"
+EVENT_HEDGED_HITS = "serving/fleet_hedged_hits"
+EVENT_CROSS_HOST_SHEDS = "serving/fleet_cross_host_sheds"
+
+# Re-exported so fleet callers catch one typed error for "your lease is
+# gone" whether it surfaces from the lease API or from a fenced WAL.
+StaleWriterError = journal_lib.StaleWriterError
+
+
+class LeaseHeldError(RuntimeError):
+    """The session's single-writer lease is validly held elsewhere —
+    opening writable would create the dual-primary split this module
+    exists to prevent. Open ``read_only=True`` (a follower) or wait for
+    expiry/release."""
+
+
+class LeaseLostError(StaleWriterError):
+    """This process's lease is no longer the one on disk (taken over,
+    released, or removed): every fenced write path must stop — a newer
+    primary owns the session now."""
+
+
+def lease_ttl_s() -> float:
+    """The PIPELINEDP_TPU_LEASE_TTL_S default (seconds)."""
+    from pipelinedp_tpu.native import loader
+    return float(loader.env_int(LEASE_TTL_ENV, 30, 1, 3600))
+
+
+def follower_poll_s() -> float:
+    """The PIPELINEDP_TPU_FOLLOWER_POLL_MS default, in seconds."""
+    from pipelinedp_tpu.native import loader
+    return loader.env_int(FOLLOWER_POLL_ENV, 100, 1, 60000) / 1000.0
+
+
+def _pid_alive(pid: int) -> bool:
+    """Best-effort same-host liveness probe (signal 0). PermissionError
+    means the pid exists under another uid — alive."""
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    except OSError:
+        return True  # unknown — err toward "alive" (no takeover)
+    return True
+
+
+def read_lease(path: str) -> Optional[dict]:
+    """The on-disk lease record, or None when absent/unreadable.
+
+    Unreadable is treated like absent on purpose: lease writes are
+    tmp+fsync+rename, so a torn record cannot exist — garbage here
+    means the file never was a lease, and refusing forever would wedge
+    the session with no holder to fix it."""
+    try:
+        with open(path, "rb") as f:
+            record = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(record, dict) or \
+            not isinstance(record.get("token"), int):
+        return None
+    return record
+
+
+def _write_lease(path: str, record: dict) -> None:
+    """Atomic, durable lease publish: tmp + fsync + rename (DPL012) —
+    a crash mid-write leaves the previous lease intact, and the new
+    record's bytes are on disk before the rename makes it visible."""
+    parent = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=parent, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(json.dumps(record, sort_keys=True).encode())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+class SessionLease:
+    """One process's hold on a session directory's single-writer lease.
+
+    Use :meth:`acquire`; the constructor only wires fields (tests forge
+    stale leases with it). The instance is owned by one session/thread;
+    the cross-process protocol lives entirely in the lease file:
+
+      * ``token`` — strictly increasing across takeovers; THE fence.
+      * ``pid``/``host`` — the holder, for liveness probes and ops.
+      * ``expires_unix`` — wall clock, because two hosts cannot share a
+        monotonic clock. The in-process renewal *pacing* still rides a
+        monotonic :class:`watchdog.Deadline` so a wall-clock jump never
+        convinces a healthy primary it already expired.
+      * ``released`` — a clean close handed the lease back; the next
+        acquire may take over immediately.
+    """
+
+    def __init__(self, path: str, *, token: int, ttl_s: float,
+                 pid: Optional[int] = None, host: Optional[str] = None,
+                 clock=time.time):
+        self.path = path
+        self.token = int(token)
+        self.ttl_s = float(ttl_s)
+        self.pid = os.getpid() if pid is None else pid
+        self.host = socket.gethostname() if host is None else host
+        self._clock = clock
+        self._released = False
+        self._renewals = 0
+        self._deadline = watchdog_lib.Deadline.after(self.ttl_s)
+
+    # -- acquisition ------------------------------------------------------
+
+    @classmethod
+    def acquire(cls, path: str, *, ttl_s: Optional[float] = None,
+                force: bool = False, clock=time.time) -> "SessionLease":
+        """Acquires (or takes over) the lease at ``path``.
+
+        Takeover is allowed only when the current record is absent,
+        released, expired, held by this same pid+host (re-entrant —
+        an in-process reopen of one's own session), or held by a
+        *dead* pid on this host (liveness probe; a SIGKILL'd primary's
+        successor must not wait out a long TTL). ``force=True`` skips
+        eligibility — operator surgery only. A validly-held lease
+        raises :class:`LeaseHeldError`.
+
+        Races between eligible claimants are resolved by an
+        ``O_CREAT|O_EXCL`` claim file named after the *next* token:
+        both see token T and want T+1, exactly one creates
+        ``lease.json.claim.<T+1>``; the loser raises LeaseHeldError and
+        may retry (by then the winner's record is visible). A claim
+        file orphaned by a crash older than the TTL is swept.
+        """
+        if ttl_s is None:
+            ttl_s = lease_ttl_s()
+        ttl_s = float(ttl_s)
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        current = read_lease(path)
+        takeover = current is not None and not current.get("released")
+        if not force and not cls._eligible(current, clock):
+            raise LeaseHeldError(
+                f"{path}: lease token {current['token']} is held by "
+                f"pid {current.get('pid')}@{current.get('host')} for "
+                f"another {current.get('expires_unix', 0) - clock():.1f}s"
+                f" — open read_only=True (follower) or wait for "
+                f"expiry/release")
+        token = (current["token"] + 1) if current is not None else 1
+        claim = f"{path}.claim.{token}"
+        try:
+            os.close(os.open(claim, os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+                             0o644))
+        except OSError as exc:
+            if exc.errno != errno.EEXIST:
+                raise
+            # A crashed claimant's orphan blocks this token forever;
+            # sweep it once it is TTL-stale, else lose the race.
+            try:
+                stale = clock() - os.stat(claim).st_mtime > ttl_s
+            except OSError:
+                stale = False
+            if not stale:
+                raise LeaseHeldError(
+                    f"{path}: lost the takeover race for token {token}")
+            try:
+                os.unlink(claim)
+            except OSError:
+                pass
+            return cls.acquire(path, ttl_s=ttl_s, force=force,
+                               clock=clock)
+        try:
+            latest = read_lease(path)
+            latest_token = latest["token"] if latest is not None else None
+            current_token = (current["token"] if current is not None
+                             else None)
+            if latest_token != current_token:
+                raise LeaseHeldError(
+                    f"{path}: lease changed hands (token "
+                    f"{current_token!r} -> {latest_token!r}) while "
+                    f"claiming token {token}")
+            lease = cls(path, token=token, ttl_s=ttl_s, clock=clock)
+            lease._publish()
+        finally:
+            try:
+                os.unlink(claim)
+            except OSError:
+                pass
+        if takeover:
+            profiler.count_event(EVENT_LEASE_TAKEOVERS)
+        return lease
+
+    @staticmethod
+    def _eligible(current: Optional[dict], clock) -> bool:
+        if current is None or current.get("released"):
+            return True
+        if clock() > float(current.get("expires_unix", 0.0)):
+            return True
+        host = socket.gethostname()
+        if current.get("host") == host:
+            if current.get("pid") == os.getpid():
+                return True  # re-entrant: our own prior open
+            if not _pid_alive(int(current.get("pid", -1))):
+                return True  # dead same-host holder (SIGKILL'd primary)
+        return False
+
+    # -- holder operations ------------------------------------------------
+
+    def _record(self) -> dict:
+        now = self._clock()
+        return {"token": self.token, "pid": self.pid, "host": self.host,
+                "ttl_s": self.ttl_s, "renewed_unix": now,
+                "expires_unix": now + self.ttl_s,
+                "released": self._released}
+
+    def _publish(self) -> None:
+        _write_lease(self.path, self._record())
+        self._deadline = watchdog_lib.Deadline.after(self.ttl_s)
+
+    def renew(self) -> None:
+        """Extends the expiry by one TTL (atomic publish). Raises
+        :class:`LeaseLostError` when the on-disk token is no longer
+        ours — the session was taken over; every fenced write path is
+        already refusing, and so must the renewer."""
+        self._check_held()
+        self._publish()
+        self._renewals += 1
+        profiler.count_event(EVENT_LEASE_RENEWALS)
+
+    def renew_with_retry(self,
+                         policy: Optional[retry_lib.RetryPolicy] = None
+                         ) -> None:
+        """Renewal with bounded decorrelated-jitter backoff on
+        filesystem hiccups (a fleet renewing against one shared store
+        must not thundering-herd; the jitter seed is the token, so
+        chaos runs reproduce). LeaseLostError is never retried — a
+        newer token on disk is a fact, not a fault."""
+        if policy is None:
+            policy = retry_lib.RetryPolicy(jitter="decorrelated",
+                                           jitter_seed=self.token)
+        for attempt in range(policy.max_retries + 1):
+            try:
+                self.renew()
+                policy.reset_backoff()
+                return
+            except LeaseLostError:
+                raise
+            except OSError:
+                if attempt >= policy.max_retries:
+                    raise
+                policy.sleep(policy.backoff_s(attempt))
+
+    def maintain(self) -> bool:
+        """Renews once the in-process expiry deadline drops below half
+        (``Deadline.fraction_remaining`` — the same monotonic pacing
+        the router's hedging uses). Call from the primary's work loop;
+        returns True when a renewal happened."""
+        if self._deadline.fraction_remaining() >= 0.5:
+            return False
+        self.renew_with_retry()
+        return True
+
+    def admit(self) -> int:
+        """The WAL fence (JsonlWal.attach_fence): re-reads the on-disk
+        lease on *every* append and returns the token to embed, or
+        raises :class:`LeaseLostError` when the token on disk is not
+        ours (taken over / released / removed). Mere TTL expiry with
+        our token still on disk is admitted: the fence's job is
+        refusing writes that would race a *successor*, and until a
+        successor claims a new token there is nobody to race."""
+        if self._released:
+            profiler.count_event(EVENT_FENCED_WRITES)
+            raise LeaseLostError(
+                f"{self.path}: lease token {self.token} was released by "
+                f"this process; the session is closed for writes")
+        current = read_lease(self.path)
+        if current is None or current["token"] != self.token \
+                or current.get("released"):
+            profiler.count_event(EVENT_FENCED_WRITES)
+            disk = current["token"] if current is not None else None
+            raise LeaseLostError(
+                f"{self.path}: write fenced — this process holds lease "
+                f"token {self.token} but disk shows {disk!r}; a newer "
+                f"primary owns the session (stale-writer append "
+                f"refused)")
+        return self.token
+
+    def release(self) -> None:
+        """Hands the lease back (marks the record released so the next
+        acquire may take over immediately). Idempotent; a lease we no
+        longer hold is left alone — it is the successor's now."""
+        if self._released:
+            return
+        self._released = True
+        current = read_lease(self.path)
+        if current is not None and current["token"] == self.token:
+            _write_lease(self.path, self._record())
+
+    def _check_held(self) -> None:
+        if self._released:
+            raise LeaseLostError(
+                f"{self.path}: lease token {self.token} was released")
+        current = read_lease(self.path)
+        if current is None or current["token"] != self.token \
+                or current.get("released"):
+            disk = current["token"] if current is not None else None
+            raise LeaseLostError(
+                f"{self.path}: lease token {self.token} superseded by "
+                f"{disk!r} on disk")
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def released(self) -> bool:
+        return self._released
+
+    def status(self) -> dict:
+        """Lease fields for /statusz and /fleetz."""
+        current = read_lease(self.path)
+        return {
+            "token": self.token,
+            "pid": self.pid,
+            "host": self.host,
+            "ttl_s": self.ttl_s,
+            "renewals": self._renewals,
+            "released": self._released,
+            "held": (current is not None
+                     and current["token"] == self.token
+                     and not current.get("released")),
+            "expires_in_s": (
+                None if current is None
+                else round(float(current.get("expires_unix", 0.0))
+                           - self._clock(), 3)),
+        }
+
+
+class FollowerSession:
+    """A hot, digest-verified read-only replica of a live session.
+
+    Opens the session ``read_only=True`` (no lease, no WAL file
+    handles) and tails the primary's append WAL with the truncation-
+    free :func:`runtime.journal.read_records` scanner. Every new
+    ``append`` record's epoch payload is loaded through
+    ``SessionStore.load_epoch`` — which refuses any payload failing the
+    content digest the WAL record committed — before folding into the
+    replica's wire, so a follower can never serve bits the primary
+    never acknowledged. Tenants are deliberately NOT replicated: budget
+    ledgers and release journals are single-writer state owned by the
+    lease holder; followers serve warm *tenantless* reads only.
+    """
+
+    def __init__(self, store, name: str, *, mesh=None,
+                 poll_s: Optional[float] = None):
+        self._store = store
+        self._name = name
+        self._mesh = mesh
+        self._poll_s = follower_poll_s() if poll_s is None else \
+            float(poll_s)
+        self._last_poll_unix: Optional[float] = None
+        self._promoted = False
+        self._session = store.open_live(name, mesh=mesh, read_only=True)
+
+    @property
+    def session(self):
+        """The read-only replica session (serves warm queries)."""
+        return self._session
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def poll_s(self) -> float:
+        return self._poll_s
+
+    def poll(self) -> int:
+        """One replication step: applies every append-WAL record beyond
+        what the replica has folded. Returns the number applied."""
+        profiler.count_event(EVENT_FOLLOWER_POLLS)
+        self._last_poll_unix = time.time()
+        payloads = journal_lib.read_records(
+            self._store.append_wal_path(self._name))
+        applied = self._session.applied_wal_records
+        fresh = payloads[applied:]
+        if fresh:
+            self._session.apply_wal_payloads(fresh)
+            profiler.count_event(EVENT_FOLLOWER_RECORDS, len(fresh))
+        return len(fresh)
+
+    def replication_lag(self) -> dict:
+        """(records_behind, poll age) without mutating the replica —
+        the /statusz+/fleetz lag surface."""
+        payloads = journal_lib.read_records(
+            self._store.append_wal_path(self._name))
+        behind = len(payloads) - self._session.applied_wal_records
+        return {
+            "records_behind": max(0, behind),
+            "poll_age_s": (None if self._last_poll_unix is None else
+                           round(time.time() - self._last_poll_unix, 3)),
+            "poll_s": self._poll_s,
+        }
+
+    def lease_status(self) -> Optional[dict]:
+        """The primary's lease record as seen from this follower (the
+        promotion decision input)."""
+        return read_lease(os.path.join(self._store.path(self._name),
+                                       LEASE_FILE))
+
+    def primary_dead(self) -> bool:
+        """True when nobody validly holds the lease: expired, released,
+        absent, or a dead same-host pid — i.e. promotion is eligible.
+        (Delegates to the acquire eligibility rules, so the follower
+        never *thinks* it can promote and then finds it cannot.)"""
+        return SessionLease._eligible(self.lease_status(), time.time)
+
+    def promote(self, *, ttl_s: Optional[float] = None, force: bool = False):
+        """Failover: close the replica and reopen the session WRITABLE.
+
+        The writable open acquires the lease (new fencing token — the
+        dead primary's late writes are refused from this instant),
+        truncates any torn WAL tail (JsonlWal recovery; the torn record
+        was never acknowledged), and replays the full epoch log;
+        ``ReleaseSchedule.replay`` then refuses already-committed
+        releases (exact refund) and re-issues uncommitted windows
+        bit-identically under the same window_seed. Returns the new
+        primary session; this follower is consumed."""
+        if self._promoted:
+            raise RuntimeError(f"follower of {self._name!r} was already "
+                               f"promoted")
+        self._session.close()
+        primary = self._store.open_live(
+            self._name, mesh=self._mesh,
+            lease_ttl_s=ttl_s, force_lease=force)
+        self._promoted = True
+        profiler.count_event(EVENT_PROMOTIONS)
+        return primary
+
+    def statusz(self) -> dict:
+        lease = self.lease_status()
+        return {
+            "name": self._name,
+            "role": "follower",
+            "promoted": self._promoted,
+            "replication": self.replication_lag(),
+            "primary_lease": lease,
+            "primary_dead": self.primary_dead(),
+        }
+
+    def close(self) -> None:
+        if not self._promoted:
+            self._session.close()
+
+
+class FleetRouter:
+    """Steers queries across a fleet of hosts serving shared sessions.
+
+    Hosts register a query target (a ``DatasetSession``-shaped object:
+    ``query(params, **kw)`` + ``stats()``); followers register for
+    hedged warm reads. Routing is three rules, in order:
+
+      1. **ownership** — partition shards map deterministically onto
+         the sorted host ring (sha256 of the shard key, mod n), so
+         every router instance agrees without coordination;
+      2. **shed across before within** — an unhealthy owner (health
+         override, else a live probe of ``stats()``) is skipped and
+         the query walks the ring; likewise a
+         ``SessionOverloadedError`` from one host tries the next host
+         before surfacing, so one hot host sheds to the fleet before
+         clients see backpressure;
+      3. **hedge near the deadline** — a warm (tenantless) read whose
+         ``Deadline.fraction_remaining()`` has burnt past the hedge
+         threshold is answered by a follower replica instead of
+         betting the last of the budget on the primary (tenant queries
+         never hedge: budget/ledger state is single-writer).
+    """
+
+    def __init__(self, *, hedge_fraction: float = 0.25):
+        if not 0.0 <= hedge_fraction <= 1.0:
+            raise ValueError(f"hedge_fraction must be in [0, 1], got "
+                             f"{hedge_fraction}")
+        self._hedge_fraction = float(hedge_fraction)
+        self._hosts: Dict[str, object] = {}
+        self._health: Dict[str, Optional[bool]] = {}
+        self._followers: List[FollowerSession] = []
+
+    # -- membership -------------------------------------------------------
+
+    def add_host(self, host_id: str, target) -> None:
+        if host_id in self._hosts:
+            raise ValueError(f"host {host_id!r} already registered")
+        self._hosts[host_id] = target
+        self._health[host_id] = None
+
+    def remove_host(self, host_id: str) -> None:
+        self._hosts.pop(host_id, None)
+        self._health.pop(host_id, None)
+
+    def add_follower(self, follower: FollowerSession) -> None:
+        self._followers.append(follower)
+
+    def set_health(self, host_id: str, healthy: Optional[bool]) -> None:
+        """Operator/health-checker override; ``None`` returns the host
+        to live probing."""
+        if host_id not in self._hosts:
+            raise ValueError(f"unknown host {host_id!r}")
+        self._health[host_id] = healthy
+
+    def healthy(self, host_id: str) -> bool:
+        override = self._health.get(host_id)
+        if override is not None:
+            return override
+        target = self._hosts.get(host_id)
+        if target is None:
+            return False
+        try:
+            target.stats()  # the /healthz probe: answers == healthy
+        except Exception:
+            return False
+        return True
+
+    # -- routing ----------------------------------------------------------
+
+    def owner_of(self, shard_key) -> str:
+        """The owning host for a partition shard: stable sha256 ring
+        placement, identical on every router."""
+        if not self._hosts:
+            raise RuntimeError("FleetRouter has no hosts")
+        ring = sorted(self._hosts)
+        digest = hashlib.sha256(repr(shard_key).encode()).digest()
+        return ring[int.from_bytes(digest[:8], "big") % len(ring)]
+
+    def _candidates(self, shard_key) -> List[str]:
+        ring = sorted(self._hosts)
+        start = ring.index(self.owner_of(shard_key))
+        return ring[start:] + ring[:start]
+
+    def query(self, params, *, shard_key=0, deadline=None, tenant=None,
+              **kwargs):
+        """Routes one query (kwargs thread into ``target.query``).
+
+        ``deadline`` is an optional :class:`watchdog.Deadline`; when
+        its remaining fraction drops below the hedge threshold and the
+        query is tenantless, a follower replica answers instead."""
+        from pipelinedp_tpu.serving.manager import SessionOverloadedError
+        if deadline is not None and tenant is None and self._followers \
+                and deadline.fraction_remaining() < self._hedge_fraction:
+            profiler.count_event(EVENT_HEDGED_READS)
+            for follower in self._followers:
+                try:
+                    result = follower.session.query(params, **kwargs)
+                except Exception:
+                    continue
+                profiler.count_event(EVENT_HEDGED_HITS)
+                return result
+            # every follower refused — fall through to the primaries
+        candidates = [h for h in self._candidates(shard_key)
+                      if self.healthy(h)]
+        if not candidates:
+            raise RuntimeError("FleetRouter: no healthy hosts")
+        owner = self.owner_of(shard_key)
+        last_overload = None
+        for host_id in candidates:
+            if host_id != owner:
+                # shedding ACROSS hosts (owner unhealthy or overloaded)
+                # before any within-host admission queueing kicks in.
+                profiler.count_event(EVENT_CROSS_HOST_SHEDS)
+            try:
+                return self._hosts[host_id].query(
+                    params, tenant=tenant, **kwargs)
+            except SessionOverloadedError as exc:
+                last_overload = exc
+                continue
+        raise last_overload
+
+    def statusz(self) -> dict:
+        return {
+            "hosts": {h: {"healthy": self.healthy(h),
+                          "override": self._health.get(h)}
+                      for h in sorted(self._hosts)},
+            "followers": [f.statusz() for f in self._followers],
+            "hedge_fraction": self._hedge_fraction,
+        }
+
+
+def fleet_counters() -> dict:
+    """The fleet tier's profiler counters (obs surface; see also
+    serving.manager.fleet_counters which merges these with the
+    admission/store counters)."""
+    return {
+        "lease_renewals": profiler.event_count(EVENT_LEASE_RENEWALS),
+        "lease_takeovers": profiler.event_count(EVENT_LEASE_TAKEOVERS),
+        "fenced_writes": profiler.event_count(EVENT_FENCED_WRITES),
+        "promotions": profiler.event_count(EVENT_PROMOTIONS),
+        "follower_polls": profiler.event_count(EVENT_FOLLOWER_POLLS),
+        "follower_records": profiler.event_count(EVENT_FOLLOWER_RECORDS),
+        "hedged_reads": profiler.event_count(EVENT_HEDGED_READS),
+        "hedged_hits": profiler.event_count(EVENT_HEDGED_HITS),
+        "cross_host_sheds": profiler.event_count(EVENT_CROSS_HOST_SHEDS),
+    }

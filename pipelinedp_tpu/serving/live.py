@@ -106,6 +106,10 @@ EVENT_EPOCH_FOLDS = "serving/epoch_folds"
 EVENT_SCHEDULED_RELEASES = "serving/scheduled_releases"
 EVENT_RELEASES_RECOVERED = "serving/scheduled_releases_recovered"
 EVENT_RELEASES_SUPPRESSED = "serving/scheduled_releases_suppressed"
+# Appends refused by the single-writer fence (serving/fleet.py): a
+# superseded ex-primary tried to write; the batch is dead-lettered so
+# the data is quarantined, never folded under a stale lease.
+EVENT_APPENDS_FENCED = "serving/appends_fenced"
 
 
 def max_pending_appends_default() -> int:
@@ -137,6 +141,7 @@ def live_counters() -> Dict[str, int]:
             EVENT_RELEASES_RECOVERED),
         "scheduled_releases_suppressed": profiler.event_count(
             EVENT_RELEASES_SUPPRESSED),
+        "appends_fenced": profiler.event_count(EVENT_APPENDS_FENCED),
     }
 
 
@@ -366,12 +371,22 @@ class LiveDatasetSession(DatasetSession):
             resident_bytes=resident_bytes, epilogue_cache=epilogue_cache,
             store_binding=None)
         self._init_live(window, int(n_chunks), max_pending_appends)
-        # Durable birth: wire spill + manifest, then the live section —
-        # register_tenant and open_live both need the manifest to exist.
-        self._store_binding = (store, name)
-        self.save(store)
-        store.record_live(name, self._live_meta())
-        self._wal = journal_lib.JsonlWal(store.append_wal_path(name))
+        # Single-writer from birth: the lease is taken BEFORE any
+        # durable state exists, so a concurrent create/open of the same
+        # name is refused instead of interleaved (serving/fleet.py).
+        lease = store._acquire_lease(name, None, False)
+        try:
+            # Durable birth: wire spill + manifest, then the live
+            # section — register_tenant and open_live both need the
+            # manifest to exist.
+            self._store_binding = (store, name)
+            self.save(store)
+            store.record_live(name, self._live_meta())
+            self._wal = journal_lib.JsonlWal(store.append_wal_path(name))
+            self._attach_lease(lease)
+        except BaseException:
+            lease.release()
+            raise
         return self
 
     def _init_live(self, window: WindowSpec, n_chunks: int,
@@ -405,6 +420,11 @@ class LiveDatasetSession(DatasetSession):
         self._staged_digests: Dict[str, dict] = {}  # digest -> same rec
         self._fold_lock = threading.Lock()
         self._folded_epochs = 0
+        # Replication cursor (serving/fleet.py FollowerSession): how
+        # many append-WAL records this session's state reflects —
+        # recovered count on a writable reopen, poll-applied count on a
+        # read-only follower.
+        self._applied_wal_records = 0
 
     # -- identity & status ------------------------------------------------
 
@@ -457,6 +477,8 @@ class LiveDatasetSession(DatasetSession):
                 "max_pending_appends": self._max_pending,
                 "deadletters": len(self._deadletters),
                 "wire_fingerprint": self._wire.fingerprint,
+                "role": ("follower" if self._read_only else "primary"),
+                "applied_wal_records": self._applied_wal_records,
             }
 
     def stats(self) -> dict:
@@ -467,6 +489,104 @@ class LiveDatasetSession(DatasetSession):
     def _live_meta(self) -> dict:
         return {"window": self._live_window.to_meta(),
                 "n_chunks": self._live_n_chunks}
+
+    # -- fleet tier (serving/fleet.py) ------------------------------------
+
+    @property
+    def applied_wal_records(self) -> int:
+        """How many append-WAL records this session's state reflects —
+        the follower's replication cursor."""
+        with self._lock:
+            return self._applied_wal_records
+
+    def _attach_lease(self, lease) -> None:
+        """Live sessions don't just hold the lease — they FENCE every
+        WAL with it: the append WAL and each tenant's release/ledger
+        journals re-check the on-disk lease per append and embed the
+        fencing token in the record, so a superseded writer is refused
+        at the journal (StaleWriterError), not merely raced."""
+        super()._attach_lease(lease)
+        fence = lease.admit
+        if self._wal is not None:
+            self._wal.attach_fence(fence)
+        with self._lock:
+            tenant_states = list(self._tenants.values())
+        for state in tenant_states:
+            self._fence_tenant(state, fence)
+
+    @staticmethod
+    def _fence_tenant(state, fence) -> None:
+        for journal in (state.release_journal, state.ledger._wal):
+            if hasattr(journal, "attach_fence"):
+                journal.attach_fence(fence)
+
+    def register_tenant(self, *args, **kwargs):
+        state = super().register_tenant(*args, **kwargs)
+        fence = self._wal_fence()
+        if fence is not None:
+            self._fence_tenant(state, fence)
+        return state
+
+    def apply_wal_payloads(self, payloads) -> None:
+        """Folds already-committed append-WAL payloads into a READ-ONLY
+        replica (FollowerSession.poll). Each "append" record's epoch
+        payload is loaded digest-validated against the record; the
+        replica's wire refolds once per batch of records. Refuses on a
+        writable session — the primary's own append path owns its
+        state."""
+        if not self._read_only:
+            raise RuntimeError(
+                "apply_wal_payloads is the follower replication path; "
+                "a writable session folds through append()")
+        store, name = self._store_binding
+        applied = 0
+        for payload in payloads:
+            self._apply_wal_payload(payload, store, name)
+            applied += 1
+        if applied == 0:
+            return
+        with self._lock:
+            self._applied_wal_records += applied
+            self._next_epoch = len(self._epochs)
+        self._deadletters = set(store.deadletter_digests(name))
+        old_fp = self._wire.fingerprint
+        new_wire = self._fold_union()
+        with self._lock:
+            self._wire = new_wire
+            self._folded_epochs = len(self._epochs)
+            self._sweep_stale_bound_entries(old_fp)
+        if (self._mesh is None and new_wire.n_rows > 0
+                and new_wire.host_nbytes <= self._byte_budget):
+            new_wire.ensure_device()
+
+    def _apply_wal_payload(self, payload: dict, store, name) -> None:
+        """Applies one append-WAL record to the in-memory epoch maps
+        (shared by the writable _reopen replay and the follower poll;
+        the caller refolds the wire afterwards)."""
+        kind = payload.get("kind")
+        if kind == "advance":
+            with self._lock:
+                self._max_event = max(self._max_event,
+                                      int(payload["event_epoch"]))
+            return
+        if kind != "append":
+            raise journal_lib.JournalCorruptError(
+                f"session {name!r}: append-WAL record "
+                f"{payload.get('seq')} has unknown kind {kind!r}")
+        epoch = int(payload["epoch"])
+        digest = payload["content_digest"]
+        pid, pk, value = store.load_epoch(name, epoch, digest)
+        with self._lock:
+            self._epochs.append({
+                "epoch": epoch, "digest": digest,
+                "n_rows": int(payload["n_rows"]),
+                "event_epoch": int(payload["event_epoch"])})
+            self._epoch_rows[epoch] = (pid, pk, value)
+            self._digests[digest] = epoch
+            self._max_event = max(self._max_event,
+                                  int(payload["event_epoch"]))
+            if self._has_value is None:
+                self._has_value = value is not None
 
     # -- append: the crash-exactly-once transaction -----------------------
 
@@ -484,6 +604,7 @@ class LiveDatasetSession(DatasetSession):
         :meth:`advance_watermark` instead — an empty append has no
         digest identity to make idempotent).
         """
+        self._ensure_writable("append()")
         with self._pending_lock:
             if self._pending >= self._max_pending:
                 profiler.count_event(EVENT_APPENDS_SHED)
@@ -606,12 +727,20 @@ class LiveDatasetSession(DatasetSession):
                     # The commit record: written + flushed here; durable
                     # against power loss only after the group fsync
                     # below. "digest" is the WAL's own per-record key;
-                    # the batch identity travels as content_digest.
-                    self._wal.append({
-                        "seq": self._wal.next_seq, "kind": "append",
-                        "epoch": epoch, "content_digest": digest,
-                        "n_rows": n, "event_epoch": event_epoch},
-                        sync=False)
+                    # the batch identity travels as content_digest. A
+                    # leased WAL's fence re-checks the on-disk lease
+                    # HERE — a superseded ex-primary's append is
+                    # refused before the record lands.
+                    try:
+                        self._wal.append({
+                            "seq": self._wal.next_seq, "kind": "append",
+                            "epoch": epoch, "content_digest": digest,
+                            "n_rows": n, "event_epoch": event_epoch},
+                            sync=False)
+                    except journal_lib.StaleWriterError:
+                        self._fenced_append(store, name, digest, pid,
+                                            pk, value, event_epoch)
+                        raise
                     _maybe_crash("commit", epoch)
                     ticket = self._wal.sync_ticket()
                     staged = {
@@ -659,6 +788,25 @@ class LiveDatasetSession(DatasetSession):
         obs_metrics.append_seconds().observe(time.perf_counter() - t0)
         return AppendResult(epoch=epoch, digest=digest, n_rows=n,
                             event_epoch=event_epoch, committed=True)
+
+    def _fenced_append(self, store, name, digest, pid, pk, value,
+                       event_epoch) -> None:
+        """A fenced (stale-writer) append's bookkeeping: the batch is
+        dead-lettered — quarantined data, never a committed epoch under
+        a superseded lease — and counted, before the StaleWriterError
+        propagates to the producer. The new primary sees the dead
+        letter on its next reopen/poll."""
+        profiler.count_event(EVENT_APPENDS_FENCED)
+        obs_trace.event("append_fenced", digest=digest,
+                        event_epoch=event_epoch)
+        obs_flight.record("append_fenced", session=self._name,
+                          digest=digest, event_epoch=event_epoch)
+        try:
+            store.save_deadletter(name, digest, pid, pk, value)
+            with self._lock:
+                self._deadletters.add(digest)
+        except OSError:
+            pass  # quarantine is best-effort; the refusal is the point
 
     def _promote_staged(self) -> None:
         """Moves fsync-covered staged epochs into the committed maps,
@@ -731,6 +879,7 @@ class LiveDatasetSession(DatasetSession):
         """Durably advances event time without rows (e.g. a quiet
         period that should seal — and release — empty windows). The
         advancement is a WAL record, so reopen replays it."""
+        self._ensure_writable("advance_watermark()")
         event_epoch = int(event_epoch)
         if event_epoch < 0:
             raise ValueError(
@@ -896,12 +1045,19 @@ class LiveDatasetSession(DatasetSession):
 
     @classmethod
     def _reopen(cls, store, name: str, manifest: dict, *, mesh=None,
-                resident_bytes=None, epilogue_cache=None
-                ) -> "LiveDatasetSession":
+                resident_bytes=None, epilogue_cache=None,
+                read_only: bool = False) -> "LiveDatasetSession":
         """SessionStore.open_live's worker: WAL replay -> digest-checked
         epoch payloads -> one union fold. Lands at exactly the epoch
         the WAL committed; the stored wire.npz (a point-in-time spill)
-        is ignored — the WAL is authoritative."""
+        is ignored — the WAL is authoritative.
+
+        ``read_only=True`` builds a follower replica: the WAL is
+        scanned with the truncation-free ``journal.read_records`` (a
+        live primary may still be appending — the follower must never
+        truncate its torn tail or open it for append), no WAL handle or
+        audit binding is created, and the replica keeps a replication
+        cursor for :meth:`apply_wal_payloads`."""
         live = manifest["live"]
         n_dev = mesh.devices.size if mesh is not None else 1
         if manifest["n_dev"] != n_dev:
@@ -922,33 +1078,23 @@ class LiveDatasetSession(DatasetSession):
             segment_sort=knobs["segment_sort"],
             compact_merge=knobs["compact_merge"],
             resident_bytes=resident_bytes,
-            epilogue_cache=epilogue_cache, store_binding=(store, name))
+            epilogue_cache=epilogue_cache,
+            store_binding=None if read_only else (store, name))
         self._init_live(WindowSpec.from_meta(live["window"]),
                         int(live["n_chunks"]), None)
-        self._wal = journal_lib.JsonlWal(store.append_wal_path(name))
-        for payload in self._wal.recovered:
-            kind = payload.get("kind")
-            if kind == "advance":
-                self._max_event = max(self._max_event,
-                                      int(payload["event_epoch"]))
-                continue
-            if kind != "append":
-                raise journal_lib.JournalCorruptError(
-                    f"session {name!r}: append-WAL record "
-                    f"{payload.get('seq')} has unknown kind {kind!r}")
-            epoch = int(payload["epoch"])
-            digest = payload["content_digest"]
-            pid, pk, value = store.load_epoch(name, epoch, digest)
-            self._epochs.append({
-                "epoch": epoch, "digest": digest,
-                "n_rows": int(payload["n_rows"]),
-                "event_epoch": int(payload["event_epoch"])})
-            self._epoch_rows[epoch] = (pid, pk, value)
-            self._digests[digest] = epoch
-            self._max_event = max(self._max_event,
-                                  int(payload["event_epoch"]))
-            if self._has_value is None:
-                self._has_value = value is not None
+        if read_only:
+            # Late-bind the store WITHOUT _bind_audit (no append handle
+            # on the primary's audit WAL) and without a _wal handle.
+            self._store_binding = (store, name)
+            self._read_only = True
+            payloads = journal_lib.read_records(
+                store.append_wal_path(name))
+        else:
+            self._wal = journal_lib.JsonlWal(store.append_wal_path(name))
+            payloads = self._wal.recovered
+        for payload in payloads:
+            self._apply_wal_payload(payload, store, name)
+        self._applied_wal_records = len(payloads)
         self._deadletters = set(store.deadletter_digests(name))
         self._next_epoch = len(self._epochs)
         self._wire = self._fold_union()
@@ -1009,6 +1155,7 @@ class ReleaseSchedule:
                 "at-most-once release journal is what refuses "
                 "cross-restart replays, and its ledger carries the "
                 "per-window budget")
+        session._ensure_writable("release_schedule()")
         session.tenant(tenant)  # fail fast on unknown tenants
         store, name = session.store_binding
         self._session = session
@@ -1022,6 +1169,12 @@ class ReleaseSchedule:
         self._query_kwargs = dict(query_kwargs or {})
         self._wal = journal_lib.JsonlWal(store.schedule_path(name,
                                                              schedule_id))
+        # The schedule's outcome WAL is fenced like every other WAL of
+        # a leased session: a superseded primary cannot record (or
+        # sync) outcomes a successor now owns.
+        fence = session._wal_fence()
+        if fence is not None:
+            self._wal.attach_fence(fence)
         self._recorded: Dict[tuple, str] = {}
         for payload in self._wal.recovered:
             self._recorded[(int(payload["a"]), int(payload["b"]))] = \

@@ -95,6 +95,8 @@ def fleet_counters(manager: Optional["SessionManager"] = None
         "bound_cache_corrupt_dropped": profiler.event_count(
             store_lib.EVENT_BOUND_DROPPED),
     }
+    from pipelinedp_tpu.serving import fleet as fleet_lib
+    out["fleet"] = fleet_lib.fleet_counters()
     if manager is not None:
         with manager._lock:
             sessions = list(manager._sessions.values())

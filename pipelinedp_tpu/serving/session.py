@@ -172,6 +172,12 @@ class SessionClosedError(RuntimeError):
     """The session was closed; its handle and caches are gone."""
 
 
+class SessionReadOnlyError(RuntimeError):
+    """A mutating operation on a ``read_only=True`` (follower) session:
+    followers hold no single-writer lease, so append/save/tenant/spill
+    paths refuse — promote to primary first (serving/fleet.py)."""
+
+
 @dataclasses.dataclass
 class TenantState:
     """One tenant's serving-side state: the cross-query budget ledger and
@@ -379,6 +385,13 @@ class DatasetSession:
         #     _accumulate on whatever thread executes the replay.
         self._store_binding = None
         self._manager = None
+        # Fleet tier (serving/fleet.py):
+        #   _lease — the SessionLease a writable store-bound open holds
+        #     (its admit() fences every WAL append on live sessions);
+        #   _read_only — a follower replica: every mutating path
+        #     refuses with SessionReadOnlyError.
+        self._lease = None
+        self._read_only = False
         self._spilled = False
         self._active = 0
         self._lifecycle_lock = threading.Lock()
@@ -511,6 +524,9 @@ class DatasetSession:
                 "active_queries": self._active,
                 "store": (self._store_binding[0].path(self._store_binding[1])
                           if self._store_binding is not None else None),
+                "read_only": self._read_only,
+                "fleet": ({"lease": self._lease.status()}
+                          if self._lease is not None else None),
                 "planner": self._planner_stats_locked(),
                 "tenants": {
                     tid: {
@@ -551,13 +567,20 @@ class DatasetSession:
 
     def close(self) -> None:
         """Frees the handle (device + host) and every cache; further
-        queries raise SessionClosedError."""
+        queries raise SessionClosedError. A held single-writer lease is
+        released (marked, not deleted — the next acquire takes over
+        immediately instead of waiting out the TTL)."""
         with self._lock:
             self._closed = True
             self._wire.drop_device()
             self._bound_cache.clear()
             self._cache_bytes = 0
             self._source = None
+        if self._lease is not None:
+            try:
+                self._lease.release()
+            except OSError:
+                pass  # best effort: expiry reclaims it anyway
         self._audit.close()
 
     def __enter__(self) -> "DatasetSession":
@@ -586,6 +609,35 @@ class DatasetSession:
         partition counts, timing and a typed outcome. Durable (WAL
         under the store) once the session is store-bound."""
         return self._audit
+
+    @property
+    def read_only(self) -> bool:
+        """True for a follower replica (serving/fleet.py): no lease, no
+        WAL handles; every mutating path refuses."""
+        return self._read_only
+
+    @property
+    def lease(self):
+        """The held SessionLease of a writable store-bound open (None
+        for leaseless or read-only sessions)."""
+        return self._lease
+
+    def _ensure_writable(self, what: str) -> None:
+        if self._read_only:
+            raise SessionReadOnlyError(
+                f"session {self._name!r} is a read-only follower "
+                f"replica; {what} needs the single-writer lease — "
+                f"promote first (serving/fleet.py)")
+
+    def _wal_fence(self):
+        """The fence callable for this session's WALs (None when no
+        lease is held — leaseless legacy opens stay unfenced)."""
+        return self._lease.admit if self._lease is not None else None
+
+    def _attach_lease(self, lease) -> None:
+        """Binds an acquired SessionLease; live sessions additionally
+        fence their WALs (the override in serving/live.py)."""
+        self._lease = lease
 
     def _bind_audit(self) -> None:
         """Moves the audit trail onto its durable WAL under the bound
@@ -616,6 +668,7 @@ class DatasetSession:
                     "session has no bound store; pass save(store=)")
             store = self._store_binding[0]
         self._check_open()
+        self._ensure_writable("save()")
         with obs_trace.span("fleet/save", session=self._name):
             path = store.save(self)
         self._bind_audit()
@@ -627,6 +680,8 @@ class DatasetSession:
         cache. Returns False — and keeps everything — when a query is
         executing (a replay must never lose the slab under its feet).
         The persisted bound entries re-hydrate with the wire."""
+        if self._read_only:
+            return False  # followers keep their replica resident
         with self._lifecycle_lock:
             if self._active > 0:
                 return False
@@ -762,6 +817,7 @@ class DatasetSession:
         any single release window on a live session (charges tagged with
         a window label by the continual-release scheduler); untagged
         queries see only the total caps."""
+        self._ensure_writable("register_tenant()")
         with self._lock:
             self._check_open()
             if tenant_id in self._tenants:

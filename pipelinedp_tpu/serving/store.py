@@ -248,6 +248,22 @@ class SessionStore:
         return os.path.join(self.path(name), TENANT_DIR,
                             f"{self._safe(tenant_id)}.ledger.wal")
 
+    def lease_path(self, name: str) -> str:
+        """The session's single-writer lease file (serving/fleet.py):
+        writable opens acquire it; its fencing token rides every WAL
+        append so a superseded writer is refused at the journal."""
+        from pipelinedp_tpu.serving import fleet as fleet_lib
+        return os.path.join(self.path(name), fleet_lib.LEASE_FILE)
+
+    def _acquire_lease(self, name: str, lease_ttl_s, force_lease: bool):
+        """The writable-open gate: takes the session's single-writer
+        lease (raising LeaseHeldError when another live process holds
+        it) so two processes can never interleave appends to one
+        session directory."""
+        from pipelinedp_tpu.serving import fleet as fleet_lib
+        return fleet_lib.SessionLease.acquire(
+            self.lease_path(name), ttl_s=lease_ttl_s, force=force_lease)
+
     def audit_path(self, name: str) -> str:
         """The session's release-audit-trail WAL (obs/audit.py): rides
         the same fsync'd JsonlWal discipline as the tenant journals, so
@@ -605,7 +621,8 @@ class SessionStore:
         return wire
 
     def open(self, name: str, *, mesh=None, resident_bytes=None,
-             epilogue_cache=None):
+             epilogue_cache=None, read_only: bool = False,
+             lease_ttl_s=None, force_lease: bool = False):
         """Re-hydrates a stored session.
 
         The returned DatasetSession serves warm queries bit-identical to
@@ -614,6 +631,15 @@ class SessionStore:
         every saved tenant reattached to its durable release journal and
         ledger WAL — a cross-restart release replay raises
         DoubleReleaseError, and spent budget stays spent.
+
+        A writable open acquires the session's single-writer lease
+        (``LeaseHeldError`` when another live process holds it — two
+        writers interleaving one directory is the split this refuses).
+        ``read_only=True`` opens a follower replica instead: no lease,
+        no WAL handles (the audit trail stays in-memory and the saved
+        tenants are NOT reattached — ledgers and release journals are
+        single-writer state), and every mutating path refuses with
+        SessionReadOnlyError.
 
         ``mesh`` must match the topology the wire was ingested for
         (n_dev buckets per chunk).
@@ -633,24 +659,39 @@ class SessionStore:
                 f"session {name!r} was ingested for n_dev="
                 f"{manifest['n_dev']}; opening with n_dev={n_dev} cannot "
                 f"replay it (pass the matching mesh)")
-        arrays = self._load_wire_arrays(name, manifest)
-        wire = self._rebuild_wire(name, manifest, arrays)
-        vocab = _decode_vocab(manifest["vocab"],
-                              arrays.get("vocab_keys"))
-        knobs = manifest["knobs"]
-        session = DatasetSession._restore(
-            wire, vocab,
-            public_partitions=manifest["public_partitions"],
-            mesh=mesh, name=manifest["name"],
-            secure_host_noise=knobs["secure_host_noise"],
-            segment_sort=knobs["segment_sort"],
-            compact_merge=knobs["compact_merge"],
-            resident_bytes=resident_bytes,
-            epilogue_cache=epilogue_cache,
-            store_binding=(self, name))
-        for key, result in self._load_bound_entries(name, manifest):
-            session._cache_insert(key, result)
-        self._reattach_tenants(session, name, manifest)
+        lease = None
+        if not read_only:
+            lease = self._acquire_lease(name, lease_ttl_s, force_lease)
+        try:
+            arrays = self._load_wire_arrays(name, manifest)
+            wire = self._rebuild_wire(name, manifest, arrays)
+            vocab = _decode_vocab(manifest["vocab"],
+                                  arrays.get("vocab_keys"))
+            knobs = manifest["knobs"]
+            session = DatasetSession._restore(
+                wire, vocab,
+                public_partitions=manifest["public_partitions"],
+                mesh=mesh, name=manifest["name"],
+                secure_host_noise=knobs["secure_host_noise"],
+                segment_sort=knobs["segment_sort"],
+                compact_merge=knobs["compact_merge"],
+                resident_bytes=resident_bytes,
+                epilogue_cache=epilogue_cache,
+                store_binding=None if read_only else (self, name))
+            for key, result in self._load_bound_entries(name, manifest):
+                session._cache_insert(key, result)
+            if read_only:
+                # Late-bind the store WITHOUT _bind_audit: a follower
+                # must never open append handles on the primary's WALs.
+                session._store_binding = (self, name)
+                session._read_only = True
+            else:
+                self._reattach_tenants(session, name, manifest)
+                session._attach_lease(lease)
+        except BaseException:
+            if lease is not None:
+                lease.release()
+            raise
         profiler.count_event(EVENT_OPENS)
         return session
 
@@ -778,13 +819,22 @@ class SessionStore:
                       json.dumps(manifest, indent=1).encode())
 
     def open_live(self, name: str, *, mesh=None, resident_bytes=None,
-                  epilogue_cache=None):
+                  epilogue_cache=None, read_only: bool = False,
+                  lease_ttl_s=None, force_lease: bool = False):
         """Reopens a live session after process death: replays the
         append WAL, loads and digest-validates every committed epoch
         payload, and rebuilds the union wire — landing at exactly the
         epoch the WAL committed (N, or N+1 when the crash fell after
         the WAL append), bit-identical to a session that never died.
-        See serving/live.py for the append/commit discipline."""
+        See serving/live.py for the append/commit discipline.
+
+        Writable opens take the single-writer lease FIRST — torn-tail
+        truncation during WAL recovery is a write, and only the lease
+        holder may perform it — then fence every WAL (append, tenant,
+        schedule) with the lease's token. ``read_only=True`` is the hot
+        follower: replay rides the truncation-free
+        ``runtime.journal.read_records`` scanner, no lease, no WAL
+        handles, tenants not reattached (serving/fleet.py)."""
         from pipelinedp_tpu.serving import live as live_lib
 
         manifest = self._read_manifest(name)
@@ -792,9 +842,20 @@ class SessionStore:
             raise SessionStoreError(
                 f"session {name!r} is not a live session; use "
                 f"SessionStore.open")
-        session = live_lib.LiveDatasetSession._reopen(
-            self, name, manifest, mesh=mesh,
-            resident_bytes=resident_bytes, epilogue_cache=epilogue_cache)
-        self._reattach_tenants(session, name, manifest)
+        lease = None
+        if not read_only:
+            lease = self._acquire_lease(name, lease_ttl_s, force_lease)
+        try:
+            session = live_lib.LiveDatasetSession._reopen(
+                self, name, manifest, mesh=mesh,
+                resident_bytes=resident_bytes,
+                epilogue_cache=epilogue_cache, read_only=read_only)
+            if not read_only:
+                self._reattach_tenants(session, name, manifest)
+                session._attach_lease(lease)
+        except BaseException:
+            if lease is not None:
+                lease.release()
+            raise
         profiler.count_event(EVENT_OPENS)
         return session

@@ -1,0 +1,489 @@
+"""Fleet failover: leased single-writer sessions, hot followers, and
+exactly-once releases across host death (ISSUE 19).
+
+Two layers:
+
+  * **Two-process failover scenario** — fresh ``python
+    tests/kill_harness.py fleet_*`` subprocesses sharing only the
+    filesystem: the primary is SIGKILLed mid-release (token durably
+    committed, outcome record lost), a follower that tailed its WAL
+    promotes and runs the catch-up tick. The released stream across
+    the kill must be byte-identical to an uninterrupted run, the
+    half-released window must recover with its charge exactly
+    refunded, and a superseded ex-primary's append must be refused at
+    the WAL (fenced + dead-lettered). Zero double-spends.
+  * **In-process unit tests** — the lease protocol (acquire / renew /
+    fence / release / takeover eligibility), the truncation-free WAL
+    reader, read-only session refusals, the router's ownership /
+    shedding / hedging rules, and decorrelated-jitter determinism.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from pipelinedp_tpu import profiler
+from pipelinedp_tpu.runtime import journal as journal_lib
+from pipelinedp_tpu.runtime import retry as retry_lib
+from pipelinedp_tpu.runtime import watchdog as watchdog_lib
+from pipelinedp_tpu.serving import fleet as fleet_lib
+
+_HARNESS = os.path.join(os.path.dirname(__file__), "kill_harness.py")
+
+
+def _run_harness(mode: str, workdir: str,
+                 mesh: bool = False) -> subprocess.CompletedProcess:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    env.pop("PDP_KH_MESH", None)
+    if mesh:
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        env["PDP_KH_MESH"] = "8"
+    return subprocess.run(
+        [sys.executable, _HARNESS, mode, workdir],
+        capture_output=True, text=True, env=env, timeout=300)
+
+
+def _marker(proc: subprocess.CompletedProcess, prefix: str) -> str:
+    lines = [line for line in proc.stdout.splitlines()
+             if line.startswith(prefix)]
+    assert lines, (f"no {prefix} marker in harness output;\n"
+                   f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
+    return lines[-1]
+
+
+def _json_marker(proc: subprocess.CompletedProcess, prefix: str):
+    return json.loads(_marker(proc, prefix)[len(prefix):])
+
+
+def _ledger(proc: subprocess.CompletedProcess) -> float:
+    return float(_marker(proc, "HARNESS_LEDGER ").split()[1])
+
+
+@pytest.fixture(scope="module", params=[
+    "single", pytest.param("mesh8", marks=pytest.mark.slow)])
+def fleet_run(request, tmp_path_factory):
+    """Runs the primary-kill -> follower-promote -> stale-writer
+    scenario once per leg; the tests below assert its facets."""
+    mesh = request.param == "mesh8"
+    clean_dir = str(tmp_path_factory.mktemp("fleet_clean"))
+    kill_dir = str(tmp_path_factory.mktemp("fleet_kill"))
+    clean = _run_harness("fleet_clean", clean_dir, mesh=mesh)
+    assert clean.returncode == 0, clean.stderr
+    primary = _run_harness("fleet_primary", kill_dir, mesh=mesh)
+    follower = _run_harness("fleet_follower", kill_dir, mesh=mesh)
+    assert follower.returncode == 0, (
+        f"stdout:\n{follower.stdout}\nstderr:\n{follower.stderr}")
+    stale = _run_harness("fleet_stale", kill_dir, mesh=mesh)
+    assert stale.returncode == 0, (
+        f"stdout:\n{stale.stdout}\nstderr:\n{stale.stderr}")
+    return {"clean": clean, "primary": primary, "follower": follower,
+            "stale": stale, "kill_dir": kill_dir}
+
+
+class TestFleetFailoverScenario:
+    """The two-process acceptance: host death between the release
+    token commit and the outcome record, survived exactly once."""
+
+    def test_primary_died_by_sigkill_mid_release(self, fleet_run):
+        primary = fleet_run["primary"]
+        assert primary.returncode == -signal.SIGKILL
+        assert "HARNESS_NOT_KILLED" not in primary.stdout
+        # Tick #1's window released and printed before the kill ...
+        windows = _json_marker(primary, "HARNESS_LIVE_WINDOWS ")
+        assert set(windows) == {"0,1"}
+        # ... and the lease showed this process as the live holder.
+        lease = _json_marker(primary, "HARNESS_LEASE ")
+        assert lease["held"] and not lease["released"]
+
+    def test_follower_tailed_and_observed_dead_holder(self, fleet_run):
+        follower = fleet_run["follower"]
+        lag = _json_marker(follower, "HARNESS_FLEET_LAG ")
+        assert lag["records_behind"] == 0
+        status = _json_marker(follower, "HARNESS_FLEET_STATUS ")
+        assert status["role"] == "follower"
+        assert status["epoch"] == 4  # all four appends digest-replayed
+        assert status["applied"] >= 4
+        assert status["primary_dead"] is True
+        # The dead primary's unexpired, unreleased lease is still on
+        # disk — only the same-host pid-liveness probe makes the
+        # takeover eligible.
+        assert status["holder"] is not None
+        assert not status["holder"]["released"]
+
+    def test_promotion_bumps_fencing_token(self, fleet_run):
+        old = _json_marker(fleet_run["primary"], "HARNESS_LEASE ")
+        new = _json_marker(fleet_run["follower"], "HARNESS_LEASE ")
+        assert new["token"] > old["token"]
+        assert new["held"] and not new["released"]
+
+    def test_committed_release_recovered_uncommitted_reissued(
+            self, fleet_run):
+        follower = fleet_run["follower"]
+        due = _json_marker(follower, "HARNESS_LIVE_DUE ")
+        assert [1, 2] in due and [2, 3] in due
+        assert [0, 1] not in due  # committed with outcome, not due
+        outcomes = dict(
+            (tuple(w), o)
+            for w, o in _json_marker(follower, "HARNESS_LIVE_OUTCOMES "))
+        # [1,2)'s token committed before the SIGKILL: the durable
+        # journal refuses the re-run and the charge is refunded.
+        assert outcomes[(1, 2)] == "recovered"
+        # [2,3) was never attempted: re-issued fresh by the successor.
+        assert outcomes[(2, 3)] == "released"
+
+    def test_released_stream_byte_identical_across_host_death(
+            self, fleet_run):
+        clean = _json_marker(fleet_run["clean"], "HARNESS_LIVE_WINDOWS ")
+        pre_kill = _json_marker(fleet_run["primary"],
+                                "HARNESS_LIVE_WINDOWS ")
+        post_kill = _json_marker(fleet_run["follower"],
+                                 "HARNESS_LIVE_WINDOWS ")
+        assert set(clean) == {"0,1", "1,2", "2,3"}
+        # The stream observed by a subscriber across the failover ==
+        # the primary's pre-kill windows + the successor's catch-up,
+        # byte-for-byte what one uninterrupted process released.
+        assert pre_kill["0,1"] == clean["0,1"]
+        assert post_kill["2,3"] == clean["2,3"]
+
+    def test_union_query_and_warm_read_byte_identical(self, fleet_run):
+        clean = _json_marker(fleet_run["clean"],
+                             "HARNESS_RESULT ")["columns"]
+        promoted = _json_marker(fleet_run["follower"],
+                                "HARNESS_RESULT ")["columns"]
+        warm_ro = _json_marker(fleet_run["follower"],
+                               "HARNESS_RO_RESULT ")["columns"]
+        assert promoted == clean
+        # The follower's pre-promotion warm read served the same bits
+        # off its digest-verified replica.
+        assert warm_ro == clean
+
+    def test_no_double_spend_exact_refund(self, fleet_run):
+        # clean: 3 windows @ 0.5 + union @ 1.0. Failover path: the
+        # primary durably charged [0,1) and [1,2); the successor's
+        # [1,2) catch-up charge was exactly refunded on refusal, then
+        # [2,3) + union charged. Identical totals or money leaked.
+        assert _ledger(fleet_run["follower"]) == pytest.approx(
+            _ledger(fleet_run["clean"]), abs=1e-9)
+
+    def test_stale_writer_fenced_and_deadlettered(self, fleet_run):
+        fenced = _json_marker(fleet_run["stale"], "HARNESS_FENCED ")
+        assert fenced["new_token"] > fenced["old_token"]
+        assert fenced["fenced_appends"] >= 1
+        assert fenced["deadletters"] >= 1
+        assert "HARNESS_STALE_ALLOWED" not in fleet_run["stale"].stdout
+
+
+# -- in-process unit tests ---------------------------------------------------
+
+
+class TestSessionLease:
+
+    def _path(self, tmp_path) -> str:
+        return str(tmp_path / "lease.json")
+
+    def test_acquire_renew_release_roundtrip(self, tmp_path):
+        path = self._path(tmp_path)
+        lease = fleet_lib.SessionLease.acquire(path, ttl_s=30.0)
+        assert lease.token == 1
+        on_disk = fleet_lib.read_lease(path)
+        assert on_disk["token"] == 1 and on_disk["pid"] == os.getpid()
+        before = on_disk["expires_unix"]
+        lease.renew()
+        assert fleet_lib.read_lease(path)["expires_unix"] >= before
+        assert lease.status()["renewals"] == 1
+        lease.release()
+        assert fleet_lib.read_lease(path)["released"] is True
+        lease.release()  # idempotent
+
+    def test_released_lease_taken_over_immediately(self, tmp_path):
+        path = self._path(tmp_path)
+        fleet_lib.SessionLease.acquire(path, ttl_s=30.0).release()
+        lease = fleet_lib.SessionLease.acquire(path, ttl_s=30.0)
+        assert lease.token == 2
+
+    def test_live_foreign_holder_refused_force_overrides(self, tmp_path):
+        path = self._path(tmp_path)
+        now = time.time()
+        # A holder on another host with time left on the clock: no
+        # pid probe can decide, so the takeover must wait (or force).
+        fleet_lib._write_lease(path, {
+            "token": 7, "pid": 12345, "host": "another-host",
+            "ttl_s": 30.0, "renewed_unix": now,
+            "expires_unix": now + 30.0, "released": False})
+        with pytest.raises(fleet_lib.LeaseHeldError):
+            fleet_lib.SessionLease.acquire(path, ttl_s=30.0)
+        lease = fleet_lib.SessionLease.acquire(path, ttl_s=30.0,
+                                               force=True)
+        assert lease.token == 8  # strictly increasing across takeovers
+
+    def test_expired_foreign_holder_taken_over(self, tmp_path):
+        path = self._path(tmp_path)
+        now = time.time()
+        fleet_lib._write_lease(path, {
+            "token": 3, "pid": 12345, "host": "another-host",
+            "ttl_s": 1.0, "renewed_unix": now - 10.0,
+            "expires_unix": now - 9.0, "released": False})
+        lease = fleet_lib.SessionLease.acquire(path, ttl_s=30.0)
+        assert lease.token == 4
+
+    def test_dead_same_host_holder_taken_over(self, tmp_path):
+        path = self._path(tmp_path)
+        # A genuinely dead same-host pid — the SIGKILL'd-primary
+        # shape, with an unexpired lease.
+        child = subprocess.Popen([sys.executable, "-c", "pass"])
+        child.wait()
+        now = time.time()
+        fleet_lib._write_lease(path, {
+            "token": 5, "pid": child.pid,
+            "host": socket.gethostname(), "ttl_s": 300.0,
+            "renewed_unix": now, "expires_unix": now + 300.0,
+            "released": False})
+        lease = fleet_lib.SessionLease.acquire(path, ttl_s=30.0)
+        assert lease.token == 6
+
+    def test_admit_fences_superseded_writer(self, tmp_path):
+        path = self._path(tmp_path)
+        old = fleet_lib.SessionLease.acquire(path, ttl_s=30.0)
+        assert old.admit() == old.token
+        new = fleet_lib.SessionLease.acquire(path, ttl_s=30.0)
+        assert new.token == old.token + 1
+        before = profiler.event_count(fleet_lib.EVENT_FENCED_WRITES)
+        with pytest.raises(fleet_lib.LeaseLostError):
+            old.admit()
+        assert profiler.event_count(
+            fleet_lib.EVENT_FENCED_WRITES) == before + 1
+        with pytest.raises(fleet_lib.LeaseLostError):
+            old.renew()
+        assert new.admit() == new.token
+        # A superseded lease's release must NOT clobber the successor.
+        old.release()
+        assert fleet_lib.read_lease(path)["token"] == new.token
+        assert not fleet_lib.read_lease(path)["released"]
+
+    def test_admit_survives_mere_expiry_without_successor(self, tmp_path):
+        # Expiry alone does not fence: until a successor claims a new
+        # token there is nobody the write could race.
+        path = self._path(tmp_path)
+        t = [1000.0]
+        lease = fleet_lib.SessionLease.acquire(
+            path, ttl_s=5.0, clock=lambda: t[0])
+        t[0] += 100.0
+        assert lease.admit() == lease.token
+
+    def test_stale_claim_file_swept(self, tmp_path):
+        path = self._path(tmp_path)
+        claim = path + ".claim.1"
+        with open(claim, "w") as f:
+            f.write("")
+        old = time.time() - 3600.0
+        os.utime(claim, (old, old))
+        lease = fleet_lib.SessionLease.acquire(path, ttl_s=30.0)
+        assert lease.token == 1
+        assert not os.path.exists(claim)
+
+    def test_garbage_lease_file_treated_as_absent(self, tmp_path):
+        path = self._path(tmp_path)
+        with open(path, "w") as f:
+            f.write("{not json")
+        assert fleet_lib.read_lease(path) is None
+        lease = fleet_lib.SessionLease.acquire(path, ttl_s=30.0)
+        assert lease.token == 1
+
+    def test_maintain_paces_on_monotonic_deadline(self, tmp_path):
+        path = self._path(tmp_path)
+        lease = fleet_lib.SessionLease.acquire(path, ttl_s=30.0)
+        assert lease.maintain() is False  # plenty of TTL left
+        lease._deadline = watchdog_lib.Deadline.after(0.0)
+        assert lease.maintain() is True
+        assert lease.status()["renewals"] == 1
+
+
+class TestDecorrelatedJitter:
+
+    def test_default_backoff_unchanged(self):
+        policy = retry_lib.RetryPolicy(max_retries=3, backoff_base_s=0.1,
+                                       backoff_max_s=2.0)
+        assert [policy.backoff_s(a) for a in range(3)] == [0.1, 0.2, 0.4]
+
+    def test_decorrelated_is_deterministic_under_seed(self):
+        def run():
+            policy = retry_lib.RetryPolicy(
+                max_retries=5, backoff_base_s=0.1, backoff_max_s=2.0,
+                jitter="decorrelated", jitter_seed=42)
+            return [policy.backoff_s(a) for a in range(5)]
+
+        first, second = run(), run()
+        assert first == second
+        assert all(0.1 <= d <= 2.0 for d in first)
+        # Jittered: not the deterministic exponential ladder.
+        assert first != [0.1, 0.2, 0.4, 0.8, 1.6]
+
+    def test_reset_backoff_restarts_the_walk(self):
+        policy = retry_lib.RetryPolicy(
+            max_retries=5, backoff_base_s=0.1, backoff_max_s=2.0,
+            jitter="decorrelated", jitter_seed=7)
+        first = policy.backoff_s(0)
+        policy.reset_backoff()
+        # The walk restarts from base (the rng stream continues — only
+        # the "previous delay" anchor resets).
+        assert policy.backoff_s(0) <= max(first * 3.0, 0.1 * 3.0)
+
+    def test_unknown_jitter_refused(self):
+        with pytest.raises(ValueError):
+            retry_lib.RetryPolicy(jitter="thundering-herd")
+
+
+class TestReadRecords:
+
+    def test_reads_without_truncating_torn_tail(self, tmp_path):
+        path = str(tmp_path / "tail.wal")
+        wal = journal_lib.JsonlWal(path)
+        wal.append({"seq": 0, "kind": "a", "n": 1})
+        wal.append({"seq": 1, "kind": "a", "n": 2})
+        wal.close()
+        with open(path, "ab") as f:
+            f.write(b'{"torn": ')  # a crash mid-write
+        size_before = os.path.getsize(path)
+        records = journal_lib.read_records(path)
+        assert [r["n"] for r in records] == [1, 2]
+        # A follower must NEVER repair the primary's file.
+        assert os.path.getsize(path) == size_before
+
+    def test_missing_file_is_empty(self, tmp_path):
+        assert journal_lib.read_records(str(tmp_path / "absent")) == []
+
+
+class TestFleetRouter:
+
+    class _Host:
+        def __init__(self, name, overloaded=False, broken=False):
+            self.name = name
+            self.overloaded = overloaded
+            self.broken = broken
+            self.queries = 0
+
+        def stats(self):
+            if self.broken:
+                raise RuntimeError("down")
+            return {}
+
+        def query(self, params, **kwargs):
+            from pipelinedp_tpu.serving.manager import \
+                SessionOverloadedError
+            if self.overloaded:
+                raise SessionOverloadedError(8, 8)
+            self.queries += 1
+            return self.name
+
+    def _router(self, *hosts, **kwargs):
+        router = fleet_lib.FleetRouter(**kwargs)
+        for host in hosts:
+            router.add_host(host.name, host)
+        return router
+
+    def test_ownership_is_stable_and_deterministic(self):
+        a, b, c = (self._Host(n) for n in ("a", "b", "c"))
+        router = self._router(a, b, c)
+        other = self._router(self._Host("a"), self._Host("b"),
+                             self._Host("c"))
+        owners = {k: router.owner_of(k) for k in range(32)}
+        assert owners == {k: other.owner_of(k) for k in range(32)}
+        assert len(set(owners.values())) > 1  # spreads across the ring
+        for k, owner in owners.items():
+            assert router.query(None, shard_key=k) == owner
+
+    def test_sheds_across_hosts_before_surfacing_overload(self):
+        a, b = self._Host("a", overloaded=True), self._Host("b",
+                                                            overloaded=True)
+        router = self._router(a, b)
+        before = profiler.event_count(fleet_lib.EVENT_CROSS_HOST_SHEDS)
+        key = next(k for k in range(64) if router.owner_of(k) == "a")
+        a.overloaded = False
+        assert router.query(None, shard_key=key) == "a"  # owner first
+        a.overloaded = True
+        b.overloaded = False
+        assert router.query(None, shard_key=key) == "b"  # shed across
+        assert profiler.event_count(
+            fleet_lib.EVENT_CROSS_HOST_SHEDS) > before
+        b.overloaded = True
+        from pipelinedp_tpu.serving.manager import SessionOverloadedError
+        with pytest.raises(SessionOverloadedError):
+            router.query(None, shard_key=key)
+
+    def test_unhealthy_owner_skipped(self):
+        a, b = self._Host("a", broken=True), self._Host("b")
+        router = self._router(a, b)
+        key = next(k for k in range(64) if router.owner_of(k) == "a")
+        assert router.query(None, shard_key=key) == "b"
+        router.set_health("a", True)  # operator override wins
+        a.broken = False
+        assert router.query(None, shard_key=key) == "a"
+        router.set_health("a", False)
+        assert router.query(None, shard_key=key) == "b"
+        router.set_health("b", False)
+        with pytest.raises(RuntimeError, match="no healthy hosts"):
+            router.query(None, shard_key=key)
+
+    def test_hedges_warm_reads_near_deadline(self):
+        primary = self._Host("a")
+
+        class _Replica:
+            def __init__(self):
+                self.queries = 0
+
+            def query(self, params, **kwargs):
+                self.queries += 1
+                return "replica"
+
+        class _Follower:
+            def __init__(self):
+                self.session = _Replica()
+
+            def statusz(self):
+                return {}
+
+        follower = _Follower()
+        router = self._router(primary, hedge_fraction=0.25)
+        router.add_follower(follower)
+        fat = watchdog_lib.Deadline.after(1000.0)
+        assert router.query(None, deadline=fat) == "a"
+        assert follower.session.queries == 0
+        burnt = watchdog_lib.Deadline.after(0.0)
+        assert router.query(None, deadline=burnt) == "replica"
+        assert follower.session.queries == 1
+        # Tenant queries never hedge: ledgers are single-writer state.
+        assert router.query(None, deadline=burnt, tenant="acme") == "a"
+        assert follower.session.queries == 1
+
+    def test_statusz_shape(self):
+        router = self._router(self._Host("a"))
+        payload = router.statusz()
+        assert payload["hosts"]["a"]["healthy"] is True
+        assert payload["hedge_fraction"] == 0.25
+
+
+class TestFleetKnobs:
+
+    def test_lease_ttl_env(self, monkeypatch):
+        assert fleet_lib.lease_ttl_s() == 30.0
+        monkeypatch.setenv(fleet_lib.LEASE_TTL_ENV, "120")
+        assert fleet_lib.lease_ttl_s() == 120.0
+
+    def test_follower_poll_env(self, monkeypatch):
+        assert fleet_lib.follower_poll_s() == pytest.approx(0.1)
+        monkeypatch.setenv(fleet_lib.FOLLOWER_POLL_ENV, "250")
+        assert fleet_lib.follower_poll_s() == pytest.approx(0.25)
+
+    def test_counters_surface(self):
+        counters = fleet_lib.fleet_counters()
+        for key in ("lease_renewals", "lease_takeovers", "fenced_writes",
+                    "promotions", "follower_polls", "follower_records",
+                    "hedged_reads", "hedged_hits", "cross_host_sheds"):
+            assert isinstance(counters[key], int)

@@ -97,6 +97,35 @@ windowed continual releases; ``LiveDatasetSession`` under
                       release journal and recorded as ``recovered``
                       (charge exactly refunded); [2,3) releases.
 
+Fleet-failover modes (ISSUE 19 — leased single-writer sessions, hot
+followers, exactly-once releases across host death; same live session
+shape as the live modes, two-tick release schedule):
+
+  fleet_clean    — fresh dir: create, append epochs 0..3, tick the
+                   schedule (3 sealed windows) and run the full-union
+                   query; the uninterrupted reference stream.
+  fleet_primary  — fresh dir: create, append 0..1, tick #1 (window
+                   [0,1) releases; its columns print), append 2..3,
+                   tick #2 with the ``release@1`` seam: window [1,2)'s
+                   release token commits durably, then SIGKILL before
+                   the outcome record. Window [2,3) is never attempted.
+  fleet_follower — same dir, fresh process: a ``FollowerSession``
+                   tails the primary's WAL read-only (digest-verified
+                   replay; prints replication lag), serves a warm
+                   read-only query, observes the lease holder's pid is
+                   dead, promotes (lease takeover → fencing token
+                   bump), and runs the catch-up tick: [1,2) is refused
+                   by the durable release journal (outcome
+                   ``recovered``, charge exactly refunded) and [2,3)
+                   releases fresh under its pinned window seed. Prints
+                   the released windows, the union query, and the
+                   ledger — all byte-compared against ``fleet_clean``.
+  fleet_stale    — same dir, after the follower closed: opens the
+                   session twice (the second open takes over the lease
+                   with a higher fencing token), then the superseded
+                   writer attempts an append — refused at the WAL with
+                   ``StaleWriterError``, the batch dead-lettered.
+
 Set ``PDP_KH_MESH=8`` to run the serving modes on an 8-device virtual
 mesh (the orchestrator also forces the XLA host-device-count flag).
 
@@ -498,6 +527,125 @@ def _run_live(mode: str, workdir: str) -> None:
         raise SystemExit(f"unknown live mode {mode!r}")
 
 
+# -- fleet-failover modes (ISSUE 19) -----------------------------------------
+
+
+def _print_fleet_windows(records) -> None:
+    """Released windows only — a ``recovered`` record carries no
+    re-drawn result by design (the journal refused the re-run)."""
+    out = {}
+    for r in records:
+        if r["outcome"] != "released":
+            continue
+        a, b = r["window"]
+        out[f"{a},{b}"] = _hex_columns(r["result"])
+    print("HARNESS_LIVE_WINDOWS " + json.dumps(out))
+
+
+def _fleet_union_query(session) -> None:
+    columns = session.query(
+        _live_params(), epsilon=1.0, delta=1e-6, seed=3, tenant="acme",
+        secure_host_noise=False).to_columns()
+    print("HARNESS_RESULT " + json.dumps(
+        {"mode": "fleet", "columns": _hex_columns(columns)}))
+    ledger = session.tenant("acme").ledger
+    print(f"HARNESS_LEDGER {ledger.spent_epsilon:.6f}")
+
+
+def _run_fleet(mode: str, workdir: str) -> None:
+    import time as time_lib
+
+    from pipelinedp_tpu import serving
+    from pipelinedp_tpu.serving import fleet as fleet_lib
+    from pipelinedp_tpu.serving import live as live_lib
+
+    if mode == "fleet_clean":
+        store, session = _live_session(workdir)
+        for e in range(4):
+            session.append(*_build_live_epoch(e))
+        sched = _live_schedule(session, "sched", _LIVE_BASE_SEED)
+        _print_fleet_windows(sched.tick())
+        _fleet_union_query(session)
+        print("HARNESS_LEASE " + json.dumps(session.lease.status()))
+    elif mode == "fleet_primary":
+        # The seam only matches window-start ordinal 1, so tick #1's
+        # [0,1) release survives and prints; tick #2 dies mid-[1,2)
+        # with the release token durably committed but no outcome
+        # record — the exactly-once case the follower must recover.
+        os.environ[live_lib.LIVE_CRASH_ENV] = "release@1"
+        store, session = _live_session(workdir)
+        for e in range(2):
+            session.append(*_build_live_epoch(e))
+        sched = _live_schedule(session, "sched", _LIVE_BASE_SEED)
+        _print_fleet_windows(sched.tick())
+        print("HARNESS_LEASE " + json.dumps(session.lease.status()))
+        sys.stdout.flush()
+        for e in range(2, 4):
+            session.append(*_build_live_epoch(e))
+        sched.tick()
+        print("HARNESS_NOT_KILLED")  # must never print
+    elif mode == "fleet_follower":
+        store = serving.SessionStore(os.path.join(workdir, "sessions"))
+        follower = fleet_lib.FollowerSession(store, _LIVE_NAME,
+                                             mesh=_serving_mesh())
+        # Tail the primary's WAL until caught up (digest-verified).
+        deadline = time_lib.monotonic() + 60.0
+        while follower.replication_lag()["records_behind"] > 0:
+            follower.poll()
+            if time_lib.monotonic() > deadline:
+                raise SystemExit("follower never caught up")
+            time_lib.sleep(follower.poll_s)
+        follower.poll()
+        print("HARNESS_FLEET_LAG " + json.dumps(follower.replication_lag()))
+        print("HARNESS_FLEET_STATUS " + json.dumps({
+            "epoch": follower.session.epoch,
+            "role": follower.session.live_status()["role"],
+            "applied": follower.session.applied_wal_records,
+            "primary_dead": follower.primary_dead(),
+            "holder": follower.lease_status()}))
+        # A warm read-only query served off the replica — no tenant
+        # (tenant ledgers are single-writer state, never replicated).
+        ro = follower.session.query(
+            _live_params(), epsilon=1.0, delta=1e-6, seed=3,
+            secure_host_noise=False).to_columns()
+        print("HARNESS_RO_RESULT " + json.dumps(
+            {"mode": "fleet_ro", "columns": _hex_columns(ro)}))
+        sys.stdout.flush()
+        # The holder is dead: promote (lease takeover bumps the
+        # fencing token) and run the exactly-once catch-up tick.
+        primary = follower.promote()
+        print("HARNESS_LEASE " + json.dumps(primary.lease.status()))
+        sched = _live_schedule(primary, "sched", _LIVE_BASE_SEED)
+        print("HARNESS_LIVE_DUE " + json.dumps(
+            [list(w) for w in sched.due_windows()]))
+        records = sched.tick()
+        print("HARNESS_LIVE_OUTCOMES " + json.dumps(
+            [[list(r["window"]), r["outcome"]] for r in records]))
+        _print_fleet_windows(records)
+        _fleet_union_query(primary)
+        primary.close()
+    elif mode == "fleet_stale":
+        store = serving.SessionStore(os.path.join(workdir, "sessions"))
+        stale = store.open_live(_LIVE_NAME, mesh=_serving_mesh())
+        old_token = stale.lease.token
+        fresh = store.open_live(_LIVE_NAME, mesh=_serving_mesh())
+        try:
+            stale.append(*_build_live_epoch(9))
+        except fleet_lib.StaleWriterError:
+            print("HARNESS_FENCED " + json.dumps({
+                "old_token": old_token,
+                "new_token": fresh.lease.token,
+                "fenced_appends": live_lib.live_counters()[
+                    "appends_fenced"],
+                "deadletters": len(store.deadletter_digests(_LIVE_NAME)),
+            }))
+            fresh.close()
+            return
+        print("HARNESS_STALE_ALLOWED")  # must never print
+    else:
+        raise SystemExit(f"unknown fleet mode {mode!r}")
+
+
 def main() -> None:
     mode, workdir = sys.argv[1], sys.argv[2]
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -509,6 +657,8 @@ def main() -> None:
         _run_serving(mode, workdir)
     elif mode.startswith("live_"):
         _run_live(mode, workdir)
+    elif mode.startswith("fleet_"):
+        _run_fleet(mode, workdir)
     else:
         _run_engine(mode, workdir)
 
